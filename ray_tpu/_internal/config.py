@@ -1,0 +1,106 @@
+"""Framework configuration flags.
+
+Equivalent of the reference's RAY_CONFIG system (src/ray/common/ray_config_def.h:
+~232 entries overridable via RAY_<name> env vars or a _system_config JSON passed
+to every process). Here: a typed registry of defaults, overridable by
+``RAY_TPU_<NAME>`` environment variables or a dict handed to ``init``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+
+def _env(name: str, default, typ):
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return typ(raw)
+
+
+@dataclass
+class Config:
+    # --- object plane ---
+    # Results at or below this size are returned inline in the task reply and
+    # held in the owner's in-process memory store (reference:
+    # ray_config_def.h:198 max_direct_call_object_size = 100KB).
+    max_direct_call_object_size: int = 100 * 1024
+    # Default shared-memory object store size per node (bytes).
+    object_store_memory: int = 512 * 1024 * 1024
+    # Chunk size for node-to-node object transfer.
+    object_transfer_chunk_size: int = 4 * 1024 * 1024
+
+    # --- scheduling ---
+    # Hybrid policy: prefer local node until utilization exceeds this, then
+    # spread over top-k remote candidates (reference: hybrid_scheduling_policy.h).
+    scheduler_spread_threshold: float = 0.5
+    scheduler_top_k_fraction: float = 0.2
+    # Max times a lease request is spilled back before failing.
+    max_lease_spillback: int = 32
+    # Worker pool
+    prestart_workers: int = 0
+    max_workers_per_node: int = 64
+    idle_worker_kill_s: float = 300.0
+
+    # --- fault tolerance ---
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 10.0
+    task_retry_delay_s: float = 0.05
+    actor_restart_delay_s: float = 0.1
+
+    # --- rpc ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_call_timeout_s: float = 120.0
+
+    # --- misc ---
+    session_dir: str = "/tmp/ray_tpu"
+    log_to_driver: bool = True
+    # Deterministic failure injection: JSON map of rpc method -> failure prob,
+    # equivalent of RAY_testing_rpc_failure (reference: rpc/rpc_chaos.h).
+    testing_rpc_failure: str = ""
+
+    def __post_init__(self):
+        # Env vars override *defaults* only — a value explicitly passed to the
+        # constructor wins over the environment.
+        for f in fields(self):
+            current = getattr(self, f.name)
+            if current == f.default:
+                setattr(self, f.name, _env(f.name, current, type(current)))
+
+    def apply_overrides(self, overrides: dict[str, Any] | None):
+        if not overrides:
+            return self
+        for k, v in overrides.items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown config key: {k}")
+            setattr(self, k, v)
+        return self
+
+    def to_json(self) -> str:
+        return json.dumps({f.name: getattr(self, f.name) for f in fields(self)})
+
+    @classmethod
+    def from_json(cls, raw: str) -> "Config":
+        cfg = cls()
+        cfg.apply_overrides(json.loads(raw))
+        return cfg
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config()
+    return _global_config
+
+
+def set_config(cfg: Config):
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,329 @@
+"""Asyncio RPC substrate.
+
+Role-equivalent of the reference's gRPC layer (src/ray/rpc/: GrpcServer,
+ClientCallManager, RetryableGrpcClient) — but deliberately not gRPC: a
+length-prefixed pickle protocol over asyncio TCP keeps the control plane in
+one dependency-free file, and every server in this framework (GCS, raylet,
+worker) is an ``RpcServer`` with async handler methods.
+
+Frame format:  [u32 length][pickle payload]
+Request:   (request_id:int, method:str, args:tuple, kwargs:dict)
+Response:  (request_id:int, ok:bool, value_or_exc)
+One-way:   request_id == -1 (no response expected)
+
+Includes deterministic chaos injection keyed by method name, the equivalent of
+the reference's RAY_testing_rpc_failure / rpc_chaos.h.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import random
+import struct
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import cloudpickle
+
+from ..exceptions import RpcError
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<I")
+_MAX_FRAME = 1 << 31
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(4)
+    (length,) = _LEN.unpack(header)
+    if length > _MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    body = await reader.readexactly(length)
+    return pickle.loads(body)
+
+
+def _write_frame(writer: asyncio.StreamWriter, payload: Any):
+    body = cloudpickle.dumps(payload)
+    if len(body) > _MAX_FRAME:
+        raise RpcError(f"frame too large: {len(body)} bytes")
+    writer.write(_LEN.pack(len(body)) + body)
+
+
+# ---------------------------------------------------------------------------
+# Chaos injection (reference: rpc/rpc_chaos.h, RAY_testing_rpc_failure)
+# ---------------------------------------------------------------------------
+
+_chaos: Dict[str, float] = {}
+_chaos_rng = random.Random(0)
+
+
+def set_rpc_chaos(spec: Dict[str, float], seed: int = 0):
+    """Configure per-method failure probabilities for testing."""
+    global _chaos_rng
+    _chaos.clear()
+    _chaos.update(spec)
+    _chaos_rng = random.Random(seed)
+
+
+def _maybe_inject_failure(method: str):
+    p = _chaos.get(method)
+    if p and _chaos_rng.random() < p:
+        raise RpcError(f"injected failure for {method}")
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class RpcServer:
+    """TCP server dispatching frames to registered async handlers.
+
+    Handlers are ``async def handle(*args, **kwargs)``; their return value is
+    pickled back. Exceptions propagate to the caller as the response payload.
+    """
+
+    def __init__(self, name: str = "server"):
+        self.name = name
+        self._handlers: Dict[str, Callable] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_lost_cb: Optional[Callable] = None
+        self._conns: set[asyncio.StreamWriter] = set()
+        self.port: Optional[int] = None
+
+    def register(self, method: str, handler: Callable):
+        self._handlers[method] = handler
+
+    def register_service(self, service: Any, prefix: str = ""):
+        """Register every ``handle_*`` coroutine of a service object."""
+        for attr in dir(service):
+            if attr.startswith("handle_"):
+                self.register(prefix + attr[len("handle_") :], getattr(service, attr))
+
+    def on_connection_lost(self, cb: Callable):
+        """cb(peer_meta) fires when a client connection drops; used for
+        worker-death detection (reference: NodeManager::HandleClientConnectionError)."""
+        self._conn_lost_cb = cb
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._on_client, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            # Unblock connection handlers parked in readexactly(); on
+            # Python 3.12 wait_closed() waits for every handler to finish.
+            for w in list(self._conns):
+                w.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _on_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        peer_meta: Dict[str, Any] = {}
+        tasks: set[asyncio.Task] = set()
+        self._conns.add(writer)
+        try:
+            while True:
+                try:
+                    frame = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+                    break
+                except Exception:
+                    # Malformed frame (bad pickle / oversized): this peer is
+                    # not speaking our protocol — drop the connection.
+                    logger.warning("%s: malformed frame, dropping connection", self.name)
+                    break
+                try:
+                    req_id, method, args, kwargs = frame
+                except (TypeError, ValueError):
+                    logger.warning("%s: malformed frame, dropping connection", self.name)
+                    break
+                if method == "__register__":
+                    peer_meta.update(kwargs)
+                    if req_id != -1:
+                        _write_frame(writer, (req_id, True, None))
+                    continue
+                t = asyncio.ensure_future(
+                    self._dispatch(writer, req_id, method, args, kwargs)
+                )
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+        finally:
+            self._conns.discard(writer)
+            for t in tasks:
+                t.cancel()
+            if self._conn_lost_cb is not None and peer_meta:
+                try:
+                    res = self._conn_lost_cb(peer_meta)
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception:
+                    logger.exception("connection-lost callback failed")
+            writer.close()
+
+    async def _dispatch(self, writer, req_id, method, args, kwargs):
+        try:
+            _maybe_inject_failure(method)
+            handler = self._handlers.get(method)
+            if handler is None:
+                raise RpcError(f"{self.name}: no handler for {method!r}")
+            value = await handler(*args, **kwargs)
+            ok = True
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:  # noqa: BLE001 — errors travel to caller
+            value, ok = e, False
+        if req_id == -1:
+            return
+        try:
+            try:
+                _write_frame(writer, (req_id, ok, value))
+            except Exception as e:
+                # Response unserializable or oversized: still answer the
+                # caller so its future resolves instead of hanging.
+                _write_frame(writer, (req_id, False, RpcError(f"bad response: {e}")))
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError, RuntimeError):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class RpcClient:
+    """Persistent connection to one RpcServer with request multiplexing and
+    reconnect-with-retry (reference: retryable_grpc_client.h)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        name: str = "client",
+        register_meta: Optional[Dict[str, Any]] = None,
+        connect_timeout: float = 10.0,
+    ):
+        self.host, self.port = host, port
+        self.name = name
+        self._register_meta = register_meta
+        self._connect_timeout = connect_timeout
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._req_ids = itertools.count(1)
+        self._recv_task: Optional[asyncio.Task] = None
+        self._lock = asyncio.Lock()
+        self._closed = False
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    async def _ensure_connected(self):
+        if self._closed:
+            raise RpcError(f"{self.name}: client is closed")
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        async with self._lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            deadline = asyncio.get_event_loop().time() + self._connect_timeout
+            delay = 0.02
+            while True:
+                try:
+                    self._reader, self._writer = await asyncio.open_connection(
+                        self.host, self.port
+                    )
+                    break
+                except OSError:
+                    if asyncio.get_event_loop().time() > deadline or self._closed:
+                        raise RpcError(
+                            f"{self.name}: cannot connect to {self.host}:{self.port}"
+                        )
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 0.5)
+            if self._register_meta:
+                _write_frame(self._writer, (-1, "__register__", (), self._register_meta))
+            self._recv_task = asyncio.ensure_future(self._recv_loop())
+
+    async def _recv_loop(self):
+        reader = self._reader
+        try:
+            while True:
+                req_id, ok, value = await _read_frame(reader)
+                fut = self._pending.pop(req_id, None)
+                if fut is None or fut.done():
+                    continue
+                if ok:
+                    fut.set_result(value)
+                else:
+                    fut.set_exception(value)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError, EOFError):
+            pass
+        except asyncio.CancelledError:
+            return
+        finally:
+            err = RpcError(f"{self.name}: connection to {self.host}:{self.port} lost")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
+
+    async def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
+        await self._ensure_connected()
+        req_id = next(self._req_ids)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[req_id] = fut
+        _write_frame(self._writer, (req_id, method, args, kwargs))
+        await self._writer.drain()
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    async def call_oneway(self, method: str, *args, **kwargs):
+        await self._ensure_connected()
+        _write_frame(self._writer, (-1, method, args, kwargs))
+        await self._writer.drain()
+
+    async def close(self):
+        self._closed = True
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+class ClientPool:
+    """Cache of RpcClients keyed by address (reference: rpc client pools in
+    core_worker — CoreWorkerClientPool / RayletClientPool)."""
+
+    def __init__(self, name: str = "pool", register_meta: Optional[Dict] = None):
+        self.name = name
+        self._register_meta = register_meta
+        self._clients: Dict[Tuple[str, int], RpcClient] = {}
+
+    def get(self, host: str, port: int) -> RpcClient:
+        key = (host, port)
+        client = self._clients.get(key)
+        if client is None or client._closed:
+            client = RpcClient(
+                host, port, name=f"{self.name}->{host}:{port}",
+                register_meta=self._register_meta,
+            )
+            self._clients[key] = client
+        return client
+
+    async def close_all(self):
+        for c in self._clients.values():
+            await c.close()
+        self._clients.clear()

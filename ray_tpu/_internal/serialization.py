@@ -1,0 +1,106 @@
+"""Value serialization for the object plane.
+
+The reference uses a forked cloudpickle plus zero-copy numpy through plasma
+(python/ray/_private/serialization.py). We use stock cloudpickle with an
+out-of-band buffer protocol (pickle protocol 5): large contiguous buffers
+(numpy arrays, bytes) are split out of the pickle stream so they can be placed
+directly into shared memory and memoryviewed back out without a copy.
+
+Wire format of a serialized object:
+    [u32 meta_len][u64 nbuf][meta pickle][u64 len_i ...][buffer bytes ...]
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+# Buffers smaller than this are kept inline in the pickle stream; splitting
+# tiny buffers out-of-band costs more than it saves.
+_OOB_THRESHOLD = 1 * 1024
+
+
+def serialize(value: Any) -> Tuple[bytes, List[memoryview]]:
+    """Serialize to (meta, out-of-band buffers)."""
+    buffers: List[pickle.PickleBuffer] = []
+
+    def cb(buf: pickle.PickleBuffer):
+        raw = buf.raw()
+        if raw.nbytes >= _OOB_THRESHOLD:
+            buffers.append(buf)
+            return False  # out-of-band
+        return True  # keep inline
+
+    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=cb)
+    return meta, [b.raw() for b in buffers]
+
+
+def deserialize(meta: bytes, buffers: List[memoryview]) -> Any:
+    return pickle.loads(meta, buffers=buffers)
+
+
+def pack(value: Any) -> bytes:
+    """One-shot serialize into a single contiguous byte string."""
+    meta, bufs = serialize(value)
+    parts = [struct.pack("<IQ", len(meta), len(bufs)), meta]
+    for b in bufs:
+        parts.append(struct.pack("<Q", b.nbytes))
+    for b in bufs:
+        parts.append(b.tobytes() if not b.contiguous else b)
+    return b"".join(bytes(p) if isinstance(p, memoryview) else p for p in parts)
+
+
+def packed_size(meta: bytes, bufs: List[memoryview]) -> int:
+    return 12 + len(meta) + 8 * len(bufs) + sum(b.nbytes for b in bufs)
+
+
+def pack_into(meta: bytes, bufs: List[memoryview], dest: memoryview) -> int:
+    """Pack directly into a destination buffer (e.g. shared memory). Returns
+    bytes written. This is the zero-copy put path: numpy array data is copied
+    exactly once, from user memory into the store arena."""
+    struct.pack_into("<IQ", dest, 0, len(meta), len(bufs))
+    off = 12
+    dest[off : off + len(meta)] = meta
+    off += len(meta)
+    for b in bufs:
+        struct.pack_into("<Q", dest, off, b.nbytes)
+        off += 8
+    for b in bufs:
+        n = b.nbytes
+        # buffers from serialize() are PickleBuffer.raw() views: 1-d,
+        # C-contiguous, uint8 — direct slice assignment is a single memcpy.
+        dest[off : off + n] = b
+        off += n
+    return off
+
+
+def unpack(data: memoryview | bytes) -> Any:
+    """Deserialize from a packed buffer. When ``data`` is a memoryview over
+    shared memory, array buffers alias the store arena (zero-copy get)."""
+    mv = memoryview(data)
+    meta_len, nbuf = struct.unpack_from("<IQ", mv, 0)
+    off = 12
+    meta = bytes(mv[off : off + meta_len])
+    off += meta_len
+    sizes = []
+    for _ in range(nbuf):
+        (n,) = struct.unpack_from("<Q", mv, off)
+        sizes.append(n)
+        off += 8
+    bufs = []
+    for n in sizes:
+        bufs.append(mv[off : off + n])
+        off += n
+    return deserialize(meta, bufs)
+
+
+def dumps(value: Any) -> bytes:
+    """Plain cloudpickle for control-plane payloads (function defs, specs)."""
+    return cloudpickle.dumps(value)
+
+
+def loads(raw: bytes) -> Any:
+    return pickle.loads(raw)

@@ -1,0 +1,109 @@
+"""User-visible exceptions.
+
+Parity with the reference's python/ray/exceptions.py: RayError hierarchy with
+task/actor/object failure causes that travel through object values — a failed
+task stores its exception as the object value, so ``get`` re-raises at the
+caller with the remote traceback attached.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception during execution.
+
+    Stored as the value of all of the task's return objects; re-raised by
+    ``get`` at the caller (reference: exceptions.py RayTaskError which wraps
+    the cause and remote traceback).
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"Task {function_name} failed:\n{traceback_str}")
+
+    @classmethod
+    def from_exception(cls, function_name: str, exc: Exception) -> "TaskError":
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        return cls(function_name, tb, exc)
+
+
+class ActorError(RayTpuError):
+    """Base for actor-related failures."""
+
+
+class ActorDiedError(ActorError):
+    """The actor died before or while executing the task (reference:
+    exceptions.py RayActorError)."""
+
+    def __init__(self, actor_id=None, reason: str = "actor died"):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"Actor {actor_id} unavailable: {reason}")
+
+
+class ActorUnschedulableError(ActorError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died (reference:
+    exceptions.py WorkerCrashedError). Retriable."""
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    """Object's value was lost (all copies gone / owner died) and could not be
+    reconstructed from lineage (reference: exceptions.py ObjectLostError)."""
+
+    def __init__(self, object_id=None, reason: str = "object lost"):
+        self.object_id = object_id
+        super().__init__(f"Object {object_id} lost: {reason}")
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled")
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupSchedulingError(RayTpuError):
+    pass
+
+
+class RpcError(RayTpuError):
+    """Transport-level RPC failure."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass

@@ -1,0 +1,35 @@
+"""Test configuration.
+
+Multi-chip behavior is tested on a virtual 8-device CPU mesh (the driver
+separately dry-runs the multichip path); env must be set before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Single-node cluster, torn down after the test (reference:
+    tests/conftest.py ray_start_regular)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, resources={"TPU": 4})
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def shutdown_only():
+    import ray_tpu
+
+    yield
+    ray_tpu.shutdown()

@@ -1,0 +1,104 @@
+"""Unit tests for IDs, serialization, and the RPC substrate."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ray_tpu._internal import serialization
+from ray_tpu._internal.event_loop import LoopThread
+from ray_tpu._internal.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu._internal.rpc import RpcClient, RpcServer, set_rpc_chaos
+from ray_tpu.exceptions import RpcError
+
+
+def test_object_id_derivation():
+    job = JobID.from_int(7)
+    task = TaskID.of(job)
+    assert task.job_id() == job
+    oid = ObjectID.for_task_return(task, 2)
+    assert oid.task_id() == task
+    assert oid.return_index() == 2
+    assert not oid.is_put()
+    put = ObjectID.for_put(task, 5)
+    assert put.is_put() and put.return_index() == 5
+    assert ActorID.of(job).job_id() == job
+
+
+def test_id_equality_and_pickle():
+    import pickle
+
+    t = TaskID.of(JobID.from_int(1))
+    assert pickle.loads(pickle.dumps(t)) == t
+    assert TaskID.nil().is_nil()
+
+
+def test_serialization_roundtrip_zero_copy():
+    arr = np.arange(10000, dtype=np.float32)
+    packed = serialization.pack({"x": arr, "y": "hello"})
+    out = serialization.unpack(packed)
+    assert out["y"] == "hello"
+    np.testing.assert_array_equal(out["x"], arr)
+
+
+def test_pack_into_matches_pack():
+    value = {"a": np.ones((64, 64)), "b": list(range(100))}
+    meta, bufs = serialization.serialize(value)
+    size = serialization.packed_size(meta, bufs)
+    dest = bytearray(size)
+    written = serialization.pack_into(meta, bufs, memoryview(dest))
+    assert written == size
+    out = serialization.unpack(memoryview(dest))
+    np.testing.assert_array_equal(out["a"], value["a"])
+    assert out["b"] == value["b"]
+
+
+class _EchoService:
+    async def handle_echo(self, x):
+        return x
+
+    async def handle_boom(self):
+        raise ValueError("boom")
+
+
+def test_rpc_roundtrip():
+    loop = LoopThread("test-rpc")
+
+    async def scenario():
+        server = RpcServer("echo")
+        server.register_service(_EchoService())
+        port = await server.start()
+        client = RpcClient("127.0.0.1", port)
+        out = await client.call("echo", {"a": 1})
+        assert out == {"a": 1}
+        with pytest.raises(ValueError, match="boom"):
+            await client.call("boom")
+        # concurrent calls multiplex on one connection
+        outs = await asyncio.gather(*[client.call("echo", i) for i in range(50)])
+        assert outs == list(range(50))
+        await client.close()
+        await server.stop()
+
+    loop.run(scenario(), timeout=30)
+    loop.stop()
+
+
+def test_rpc_chaos_injection():
+    loop = LoopThread("test-chaos")
+
+    async def scenario():
+        set_rpc_chaos({"echo": 1.0})
+        try:
+            server = RpcServer("echo")
+            server.register_service(_EchoService())
+            port = await server.start()
+            client = RpcClient("127.0.0.1", port)
+            with pytest.raises(RpcError, match="injected"):
+                await client.call("echo", 1)
+            await client.close()
+            await server.stop()
+        finally:
+            set_rpc_chaos({})
+
+    loop.run(scenario(), timeout=30)
+    loop.stop()
