@@ -1,0 +1,49 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+A brand-new system with the capabilities of Ray (tasks, actors, objects with
+distributed ownership, placement groups, collective communication, Train/Data/
+Serve/Tune libraries) designed TPU-first: chips, hosts, and ICI-connected
+slices are first-class scheduling primitives, the tensor plane is XLA
+collectives over ICI, and trainers compile to pjit/GSPMD.
+"""
+
+from .actor import method
+from .api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from .object_ref import ObjectRef
+from . import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "method",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "ObjectRef",
+    "exceptions",
+    "__version__",
+]

@@ -1,0 +1,151 @@
+"""Accelerator managers.
+
+Role-equivalent of the reference's accelerator plugin layer
+(_private/accelerators/accelerator.py:18 AcceleratorManager ABC and
+tpu.py:267 TPUAcceleratorManager): detect chips on the node, validate
+topologies, derive pod types, export node labels and extra resources, and
+control per-worker chip visibility.
+
+TPU-first: this is where chips/hosts/slices become scheduling state. A node
+that is part of a TPU slice advertises:
+  resources: {"TPU": <chips>}  (+ {"TPU-<pod_type>-head": 1} on worker 0)
+  labels:    ray.io/tpu-slice-name, ray.io/tpu-worker-id,
+             ray.io/tpu-pod-type, ray.io/tpu-topology
+(reference: constants.h:131-142 label keys; tpu.py:576 head resource,
+ :642 labels)
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Optional, Tuple
+
+# label keys (reference: common/constants.h:131-142)
+TPU_SLICE_NAME_LABEL = "ray.io/tpu-slice-name"
+TPU_WORKER_ID_LABEL = "ray.io/tpu-worker-id"
+TPU_POD_TYPE_LABEL = "ray.io/tpu-pod-type"
+TPU_TOPOLOGY_LABEL = "ray.io/tpu-topology"
+
+# generation -> chips per host (reference: tpu.py topology tables :90)
+_CHIPS_PER_HOST = {
+    "v2": 4,
+    "v3": 4,
+    "v4": 4,
+    "v5p": 4,
+    "v5e": 8,  # v5litepod: up to 8 chips/host
+    "v6e": 8,
+}
+
+# accelerator-type constants (reference: util/accelerators/accelerators.py:31-36)
+TPU_V2 = "TPU-V2"
+TPU_V3 = "TPU-V3"
+TPU_V4 = "TPU-V4"
+TPU_V5P = "TPU-V5P"
+TPU_V5E = "TPU-V5E"
+TPU_V6E = "TPU-V6E"
+
+
+def pod_type_num_chips(pod_type: str) -> int:
+    """'v5e-64' -> 64 chips (reference: tpu.py get_num_tpu_chips_from_pod_type)."""
+    gen, _, count = pod_type.partition("-")
+    if not count.isdigit():
+        raise ValueError(f"malformed TPU pod type {pod_type!r}")
+    n = int(count)
+    if gen in ("v2", "v3"):
+        # v2/v3 pod types count cores (2 per chip)
+        return max(n // 2, 1)
+    return n
+
+
+def pod_type_generation(pod_type: str) -> str:
+    return pod_type.partition("-")[0]
+
+
+def chips_per_host(pod_type: str) -> int:
+    gen = pod_type_generation(pod_type)
+    if gen not in _CHIPS_PER_HOST:
+        raise ValueError(f"unknown TPU generation {gen!r}")
+    return min(_CHIPS_PER_HOST[gen], pod_type_num_chips(pod_type))
+
+
+def pod_type_num_hosts(pod_type: str) -> int:
+    return max(pod_type_num_chips(pod_type) // chips_per_host(pod_type), 1)
+
+
+def infer_pod_type_from_topology(generation: str, topology: str) -> str:
+    """'v4' + '2x2x2' -> 'v4-8' (chip product; v2/v3 counted in cores)."""
+    dims = 1
+    for part in topology.lower().split("x"):
+        dims *= int(part)
+    if generation in ("v2", "v3"):
+        dims *= 2
+    return f"{generation}-{dims}"
+
+
+def tpu_head_resource(pod_type: str) -> str:
+    """Extra resource injected on worker 0 of a multi-host slice so whole
+    slices can be reserved by scheduling one head bundle (reference:
+    tpu.py:576)."""
+    return f"TPU-{pod_type}-head"
+
+
+class TpuAcceleratorManager:
+    """Detection for the current node."""
+
+    @staticmethod
+    def detect_num_chips() -> int:
+        env = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")
+        if env:
+            # "2,2,1" style bounds string
+            total = 1
+            for part in env.split(","):
+                total *= int(part)
+            return total
+        chips = len(glob.glob("/dev/accel*")) or len(glob.glob("/dev/vfio/*"))
+        return chips
+
+    @staticmethod
+    def current_node_identity() -> Dict[str, str]:
+        """Labels for this node from the TPU VM metadata environment
+        (reference: tpu.py reading TPU_* env vars set by the TPU runtime)."""
+        labels = {}
+        slice_name = os.environ.get("TPU_NAME") or os.environ.get(
+            "TPU_WORKER_HOSTNAMES", ""
+        ).split(",")[0]
+        if slice_name:
+            labels[TPU_SLICE_NAME_LABEL] = slice_name
+        worker_id = os.environ.get("TPU_WORKER_ID")
+        if worker_id is not None:
+            labels[TPU_WORKER_ID_LABEL] = worker_id
+        accel_type = os.environ.get("TPU_ACCELERATOR_TYPE")  # e.g. "v5litepod-16"
+        if accel_type:
+            labels[TPU_POD_TYPE_LABEL] = accel_type.replace("litepod", "5e").replace(
+                "v55e", "v5e"
+            )
+        topology = os.environ.get("TPU_TOPOLOGY")
+        if topology:
+            labels[TPU_TOPOLOGY_LABEL] = topology
+        return labels
+
+    @staticmethod
+    def node_resources_and_labels() -> Tuple[Dict[str, float], Dict[str, str]]:
+        chips = TpuAcceleratorManager.detect_num_chips()
+        resources: Dict[str, float] = {}
+        labels = TpuAcceleratorManager.current_node_identity()
+        if chips:
+            resources["TPU"] = float(chips)
+            pod_type = labels.get(TPU_POD_TYPE_LABEL)
+            if pod_type and labels.get(TPU_WORKER_ID_LABEL, "0") == "0":
+                resources[tpu_head_resource(pod_type)] = 1.0
+        return resources, labels
+
+
+def set_visible_chips(instance_ids) -> Dict[str, str]:
+    """Env vars restricting a worker process to specific chips (reference:
+    tpu.py TPU_VISIBLE_CHIPS handling :36-50)."""
+    ids = ",".join(str(i) for i in instance_ids)
+    return {
+        "TPU_VISIBLE_CHIPS": ids,
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": f"1,{max(len(instance_ids), 1)},1",
+    }

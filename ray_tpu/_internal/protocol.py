@@ -26,6 +26,19 @@ from .ids import (
 Address = Tuple[str, int]  # (host, port)
 
 
+def label_match(labels: Dict[str, str], selector: Dict[str, Any]) -> bool:
+    """Selector semantics: value may be a string (equality) or a list
+    (membership) — reference: label_selector.h 'in' operators."""
+    for key, want in selector.items():
+        have = labels.get(key)
+        if isinstance(want, (list, tuple, set)):
+            if have not in want:
+                return False
+        elif have != want:
+            return False
+    return True
+
+
 # ---------------------------------------------------------------------------
 # Scheduling strategies (reference: util/scheduling_strategies.py)
 # ---------------------------------------------------------------------------

@@ -283,11 +283,17 @@ class RpcClient:
         req_id = next(self._req_ids)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
-        _write_frame(self._writer, (req_id, method, args, kwargs))
-        await self._writer.drain()
-        if timeout is None:
-            return await fut
-        return await asyncio.wait_for(fut, timeout)
+        try:
+            _write_frame(self._writer, (req_id, method, args, kwargs))
+            await self._writer.drain()
+            if timeout is None:
+                return await fut
+            return await asyncio.wait_for(fut, timeout)
+        except BaseException:
+            # timeout / write failure / cancellation: drop the orphaned entry
+            # so a long-lived connection doesn't accumulate dead futures
+            self._pending.pop(req_id, None)
+            raise
 
     async def call_oneway(self, method: str, *args, **kwargs):
         await self._ensure_connected()

@@ -34,6 +34,15 @@ class TaskError(RayTpuError):
         tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
         return cls(function_name, tb, exc)
 
+    def __reduce__(self):
+        # custom __init__ signature needs explicit reconstruction args; the
+        # cause travels too so callers can except the original type
+        return (_rebuild_task_error, (self.function_name, self.traceback_str, self.cause))
+
+
+def _rebuild_task_error(function_name, traceback_str, cause):
+    return TaskError(function_name, traceback_str, cause)
+
 
 class ActorError(RayTpuError):
     """Base for actor-related failures."""
@@ -47,6 +56,9 @@ class ActorDiedError(ActorError):
         self.actor_id = actor_id
         self.reason = reason
         super().__init__(f"Actor {actor_id} unavailable: {reason}")
+
+    def __reduce__(self):
+        return (type(self), (self.actor_id, self.reason))
 
 
 class ActorUnschedulableError(ActorError):
@@ -68,7 +80,11 @@ class ObjectLostError(RayTpuError):
 
     def __init__(self, object_id=None, reason: str = "object lost"):
         self.object_id = object_id
+        self.reason = reason
         super().__init__(f"Object {object_id} lost: {reason}")
+
+    def __reduce__(self):
+        return (type(self), (self.object_id, self.reason))
 
 
 class OwnerDiedError(ObjectLostError):
@@ -87,6 +103,9 @@ class TaskCancelledError(RayTpuError):
     def __init__(self, task_id=None):
         self.task_id = task_id
         super().__init__(f"Task {task_id} was cancelled")
+
+    def __reduce__(self):
+        return (type(self), (self.task_id,))
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):

@@ -1,0 +1,87 @@
+"""ObjectRef: a distributed future.
+
+Role-equivalent of the reference's ObjectRef (includes/object_ref.pxi): wraps
+an ObjectID plus the owner's address. The process that created the ref (via
+``put`` or task submission) owns the object's metadata and lifetime; when the
+last Python reference in the owning process drops, the owner releases the
+object (reference: reference_counter.h local-ref accounting via __dealloc__).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ._internal.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_address", "_registered", "__weakref__")
+
+    def __init__(
+        self,
+        object_id: ObjectID,
+        owner_address: Optional[Tuple[str, int]] = None,
+        *,
+        _register: bool = True,
+    ):
+        self.id = object_id
+        self.owner_address = owner_address
+        self._registered = False
+        if _register:
+            from . import _worker_api
+
+            worker = _worker_api.maybe_get_core_worker()
+            if worker is not None:
+                worker.register_ref(self)
+                self._registered = True
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __del__(self):
+        if self._registered:
+            try:
+                from . import _worker_api
+            except ImportError:
+                return  # interpreter shutdown
+            worker = _worker_api.maybe_get_core_worker()
+            if worker is not None:
+                try:
+                    worker.unregister_ref(self)
+                except Exception:
+                    pass
+
+    def __reduce__(self):
+        # Serializing a ref (into task args or object values) makes the
+        # receiver a borrower; the owner address travels with the ref.
+        return (_deserialize_ref, (self.id, self.owner_address))
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        from . import _worker_api
+
+        return _worker_api.get_core_worker().as_future(self)
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+
+def _deserialize_ref(object_id, owner_address):
+    return ObjectRef(object_id, owner_address)

@@ -1,0 +1,186 @@
+"""GCS actor management: directory, scheduling, restart-on-failure.
+
+Role-equivalent of the reference's GcsActorManager + GcsActorScheduler
+(src/ray/gcs/gcs_actor_manager.h:93, gcs_actor_scheduler.h:108): actors are
+registered centrally, scheduled by leasing a worker from a raylet, restarted
+subject to ``max_restarts`` when their worker or node dies, and their
+addresses are published on the ``actor:*`` pubsub channel so callers can
+re-resolve after restarts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, TYPE_CHECKING
+
+from ..._internal.ids import ActorID, NodeID, WorkerID
+from ..._internal.protocol import ActorInfo, ActorState, TaskSpec
+from ...exceptions import ActorUnschedulableError
+
+if TYPE_CHECKING:
+    from .server import GcsServer
+
+logger = logging.getLogger(__name__)
+
+
+class GcsActorManager:
+    def __init__(self, gcs: "GcsServer"):
+        self._gcs = gcs
+        self._actors: Dict[ActorID, ActorInfo] = {}
+        # (namespace, name) -> actor_id
+        self._named: Dict[tuple, ActorID] = {}
+        # node_id -> set of actor ids placed there
+        self._by_node: Dict[NodeID, set] = {}
+        self._by_worker: Dict[WorkerID, ActorID] = {}
+
+    # -- registration / scheduling ----------------------------------------
+
+    async def register_actor(self, spec: TaskSpec, detached: bool) -> ActorInfo:
+        actor_id = spec.actor_id
+        name_key = (spec.namespace, spec.actor_name)
+        if spec.actor_name:
+            existing_id = self._named.get(name_key)
+            if existing_id is not None:
+                existing = self._actors.get(existing_id)
+                if existing is not None and existing.state != ActorState.DEAD:
+                    raise ValueError(
+                        f"Actor name {spec.actor_name!r} already taken in "
+                        f"namespace {spec.namespace!r}"
+                    )
+        info = ActorInfo(
+            actor_id=actor_id,
+            job_id=spec.job_id,
+            name=spec.actor_name,
+            namespace=spec.namespace,
+            state=ActorState.PENDING_CREATION,
+            max_restarts=spec.max_restarts,
+            creation_spec=spec,
+            detached=detached,
+            owner_address=spec.owner_address,
+        )
+        self._actors[actor_id] = info
+        if spec.actor_name:
+            self._named[name_key] = actor_id
+        asyncio.ensure_future(self._schedule(info))
+        return info
+
+    async def _schedule(self, info: ActorInfo):
+        """Lease a worker for the actor and push its creation task."""
+        spec = info.creation_spec
+        delay = 0.05
+        while info.state in (ActorState.PENDING_CREATION, ActorState.RESTARTING):
+            grant = None
+            try:
+                grant = await self._gcs.lease_worker_for_task(spec)
+            except Exception as e:
+                logger.debug("actor %s lease failed: %s", info.actor_id, e)
+            if grant is None:
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                continue
+            node_id, worker_id, worker_addr, lease_id = grant
+            try:
+                raylet = self._gcs.raylet_client(node_id)
+                worker_client = self._gcs.client_pool.get(*worker_addr)
+                await worker_client.call("create_actor", spec)
+            except Exception as e:
+                logger.warning("actor %s creation push failed: %s", info.actor_id, e)
+                try:
+                    await raylet.call_oneway("return_worker", lease_id, True)
+                except Exception:
+                    pass
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                continue
+            info.state = ActorState.ALIVE
+            info.address = worker_addr
+            info.node_id = node_id
+            info.worker_id = worker_id
+            self._by_node.setdefault(node_id, set()).add(info.actor_id)
+            self._by_worker[worker_id] = info.actor_id
+            self._publish(info)
+            logger.info("actor %s alive on %s", info.actor_id, worker_addr)
+            return
+
+    def _publish(self, info: ActorInfo):
+        self._gcs.publisher.publish(f"actor:{info.actor_id.hex()}", info)
+
+    # -- queries -----------------------------------------------------------
+
+    def get(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        return self._actors.get(actor_id)
+
+    def get_by_name(self, name: str, namespace: str) -> Optional[ActorInfo]:
+        actor_id = self._named.get((namespace, name))
+        return self._actors.get(actor_id) if actor_id else None
+
+    def list_actors(self):
+        return list(self._actors.values())
+
+    # -- failure handling --------------------------------------------------
+
+    async def on_worker_death(self, worker_id: WorkerID, reason: str):
+        actor_id = self._by_worker.pop(worker_id, None)
+        if actor_id is not None:
+            await self._handle_actor_failure(actor_id, f"worker died: {reason}")
+
+    async def on_node_death(self, node_id: NodeID):
+        for actor_id in list(self._by_node.pop(node_id, ())):
+            await self._handle_actor_failure(actor_id, "node died")
+
+    async def _handle_actor_failure(self, actor_id: ActorID, reason: str):
+        info = self._actors.get(actor_id)
+        if info is None or info.state == ActorState.DEAD:
+            return
+        if info.node_id is not None:
+            self._by_node.get(info.node_id, set()).discard(actor_id)
+        unlimited = info.max_restarts == -1
+        if info.state == ActorState.ALIVE and (
+            unlimited or info.num_restarts < info.max_restarts
+        ):
+            info.num_restarts += 1
+            info.state = ActorState.RESTARTING
+            info.address = None
+            self._publish(info)
+            logger.info(
+                "restarting actor %s (%d/%s): %s",
+                actor_id, info.num_restarts,
+                "inf" if unlimited else info.max_restarts, reason,
+            )
+            asyncio.ensure_future(self._schedule(info))
+        else:
+            await self._mark_dead(info, reason)
+
+    async def _mark_dead(self, info: ActorInfo, reason: str):
+        info.state = ActorState.DEAD
+        info.death_cause = reason
+        info.address = None
+        self._publish(info)
+
+    async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        info = self._actors.get(actor_id)
+        if info is None:
+            return
+        if no_restart:
+            # pre-mark dead so the death report doesn't trigger a restart
+            prev_addr, prev_worker = info.address, info.worker_id
+            await self._mark_dead(info, "killed via kill()")
+            if prev_worker is not None:
+                self._by_worker.pop(prev_worker, None)
+            if prev_addr is not None:
+                try:
+                    await self._gcs.client_pool.get(*prev_addr).call_oneway("exit_worker")
+                except Exception:
+                    pass
+        elif info.address is not None:
+            try:
+                await self._gcs.client_pool.get(*info.address).call_oneway("exit_worker")
+            except Exception:
+                pass
+
+    async def on_job_finished(self, job_id):
+        """Non-detached actors die with their job (reference: actor lifetime)."""
+        for info in list(self._actors.values()):
+            if info.job_id == job_id and not info.detached and info.state != ActorState.DEAD:
+                await self.kill_actor(info.actor_id)

@@ -1,0 +1,293 @@
+"""Global Control Service.
+
+Role-equivalent of the reference's GCS server (src/ray/gcs/gcs_server.h:98):
+one logical process on the head node composing node membership, internal KV,
+pubsub, the actor directory/scheduler, the placement-group manager, job
+accounting, cluster resource views, and raylet health checking. Every other
+component finds the cluster through this service's address.
+
+Storage is the in-memory store client equivalent; all tables live in process
+(reference: InMemoryStoreClient). A persistent backend can be slotted in by
+swapping the plain dicts for a store client.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..._internal.config import Config
+from ..._internal.event_loop import PeriodicRunner
+from ..._internal.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
+from ..._internal.protocol import (
+    label_match,
+    ActorInfo,
+    NodeInfo,
+    PlacementGroupInfo,
+    TaskSpec,
+)
+from ..._internal.rpc import ClientPool, RpcClient, RpcServer
+from .actor_manager import GcsActorManager
+from .placement_groups import GcsPlacementGroupManager
+from .pubsub import Publisher
+
+logger = logging.getLogger(__name__)
+
+
+class GcsServer:
+    def __init__(self, config: Config):
+        self.config = config
+        self.server = RpcServer("gcs")
+        self.publisher = Publisher()
+        self.client_pool = ClientPool("gcs-out")
+        self.actor_manager = GcsActorManager(self)
+        self.pg_manager = GcsPlacementGroupManager(self)
+
+        self._nodes: Dict[NodeID, NodeInfo] = {}
+        self._node_available: Dict[NodeID, Dict[str, float]] = {}
+        self._node_last_seen: Dict[NodeID, float] = {}
+        self._kv: Dict[str, bytes] = {}
+        self._jobs: Dict[JobID, dict] = {}
+        self._next_job = 1
+        self._runner: Optional[PeriodicRunner] = None
+        self.address: Optional[Tuple[str, int]] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self.server.register_service(self)
+        self.server.register("subscribe", self._handle_subscribe)
+        self.server.register("subscriber_poll", self._handle_subscriber_poll)
+        bound = await self.server.start(host, port)
+        self.address = (host, bound)
+        self._runner = PeriodicRunner(asyncio.get_event_loop())
+        self._runner.run_every(self.config.health_check_period_s, self._health_check)
+        logger.info("GCS listening on %s:%s", host, bound)
+        return self.address
+
+    async def stop(self):
+        if self._runner:
+            self._runner.stop()
+        await self.server.stop()
+        await self.client_pool.close_all()
+
+    # -- helpers -----------------------------------------------------------
+
+    def raylet_client(self, node_id: NodeID) -> RpcClient:
+        node = self._nodes[node_id]
+        return self.client_pool.get(*node.address)
+
+    def alive_nodes(self) -> Dict[NodeID, NodeInfo]:
+        return {nid: n for nid, n in self._nodes.items() if n.alive}
+
+    def node_available(self, node_id: NodeID) -> Dict[str, float]:
+        avail = self._node_available.get(node_id)
+        if avail is not None:
+            return avail
+        node = self._nodes.get(node_id)
+        return dict(node.resources_total) if node else {}
+
+    async def lease_worker_for_task(self, spec: TaskSpec):
+        """Lease a worker for a GCS-scheduled task (actor creation), walking
+        the spillback chain (reference: GcsActorScheduler leasing from
+        raylets)."""
+        nodes = self.alive_nodes()
+        # prefer nodes that can fit the request right now
+        candidates = sorted(
+            nodes,
+            key=lambda nid: -sum(
+                min(self.node_available(nid).get(k, 0.0), v)
+                for k, v in spec.resources.items()
+            )
+            if spec.resources
+            else 0,
+        )
+        for nid in candidates:
+            node = nodes[nid]
+            feasible = all(
+                node.resources_total.get(k, 0.0) >= v - 1e-9
+                for k, v in spec.resources.items()
+            ) and label_match(node.labels, spec.label_selector)
+            if not feasible:
+                continue
+            raylet = self.raylet_client(nid)
+            try:
+                reply = await raylet.call("request_worker_lease", spec, timeout=30.0)
+            except Exception as e:
+                logger.debug("lease from %s failed: %s", nid, e)
+                continue
+            if reply.get("granted"):
+                return (nid, reply["worker_id"], reply["worker_address"], reply["lease_id"])
+            # spillback or rejection: try the next candidate
+        return None
+
+    # -- node table --------------------------------------------------------
+
+    async def handle_register_node(self, info: NodeInfo):
+        self._nodes[info.node_id] = info
+        self._node_last_seen[info.node_id] = time.time()
+        self.publisher.publish("node", ("alive", info))
+        logger.info(
+            "node %s registered: %s labels=%s", info.node_id, info.resources_total,
+            info.labels,
+        )
+        return True
+
+    async def handle_unregister_node(self, node_id: NodeID):
+        await self._mark_node_dead(node_id, "drained")
+        return True
+
+    async def handle_get_all_nodes(self) -> List[NodeInfo]:
+        return list(self._nodes.values())
+
+    async def handle_report_resources(
+        self, node_id: NodeID, available: Dict[str, float]
+    ):
+        """Periodic resource view from each raylet (role of RaySyncer
+        RESOURCE_VIEW streams, ray_syncer.h:89). Deltas are re-broadcast to
+        subscribed raylets for spillback decisions."""
+        self._node_last_seen[node_id] = time.time()
+        prev = self._node_available.get(node_id)
+        self._node_available[node_id] = available
+        if prev != available:
+            self.publisher.publish("resource_view", (node_id, available))
+        return True
+
+    async def _health_check(self):
+        """Mark nodes dead when they stop reporting (reference:
+        GcsHealthCheckManager, gcs_health_check_manager.h:45)."""
+        now = time.time()
+        for node_id, node in list(self._nodes.items()):
+            if not node.alive:
+                continue
+            last = self._node_last_seen.get(node_id, now)
+            if now - last > self.config.health_check_timeout_s:
+                await self._mark_node_dead(node_id, "health check timed out")
+
+    async def _mark_node_dead(self, node_id: NodeID, reason: str):
+        node = self._nodes.get(node_id)
+        if node is None or not node.alive:
+            return
+        node.alive = False
+        self._node_available.pop(node_id, None)
+        logger.warning("node %s dead: %s", node_id, reason)
+        self.publisher.publish("node", ("dead", node))
+        await self.actor_manager.on_node_death(node_id)
+        await self.pg_manager.on_node_death(node_id)
+
+    # -- workers -----------------------------------------------------------
+
+    async def handle_report_worker_death(self, worker_id: WorkerID, reason: str):
+        await self.actor_manager.on_worker_death(worker_id, reason)
+        return True
+
+    # -- internal KV (reference: GcsInternalKVManager) ---------------------
+
+    async def handle_kv_put(self, key: str, value: bytes, overwrite: bool = True):
+        if not overwrite and key in self._kv:
+            return False
+        self._kv[key] = value
+        return True
+
+    async def handle_kv_get(self, key: str) -> Optional[bytes]:
+        return self._kv.get(key)
+
+    async def handle_kv_multi_get(self, keys: List[str]):
+        return {k: self._kv.get(k) for k in keys}
+
+    async def handle_kv_del(self, key: str):
+        return self._kv.pop(key, None) is not None
+
+    async def handle_kv_exists(self, key: str):
+        return key in self._kv
+
+    async def handle_kv_keys(self, prefix: str = ""):
+        return [k for k in self._kv if k.startswith(prefix)]
+
+    # -- pubsub ------------------------------------------------------------
+
+    async def _handle_subscribe(self, subscriber_id: str, channel: str):
+        self.publisher.subscribe(subscriber_id, channel)
+        return True
+
+    async def _handle_subscriber_poll(self, subscriber_id: str):
+        return await self.publisher.poll(subscriber_id, timeout=30.0)
+
+    async def handle_publish(self, channel: str, message):
+        self.publisher.publish(channel, message)
+        return True
+
+    # -- jobs --------------------------------------------------------------
+
+    async def handle_register_job(self, metadata: dict) -> JobID:
+        job_id = JobID.from_int(self._next_job)
+        self._next_job += 1
+        self._jobs[job_id] = {"metadata": metadata, "start_time": time.time()}
+        self.publisher.publish("job", ("started", job_id))
+        return job_id
+
+    async def handle_finish_job(self, job_id: JobID):
+        job = self._jobs.get(job_id)
+        if job is not None:
+            job["end_time"] = time.time()
+        await self.actor_manager.on_job_finished(job_id)
+        self.publisher.publish("job", ("finished", job_id))
+        return True
+
+    async def handle_list_jobs(self):
+        return dict(self._jobs)
+
+    # -- actors ------------------------------------------------------------
+
+    async def handle_register_actor(self, spec: TaskSpec, detached: bool) -> ActorInfo:
+        return await self.actor_manager.register_actor(spec, detached)
+
+    async def handle_get_actor(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        return self.actor_manager.get(actor_id)
+
+    async def handle_get_actor_by_name(self, name: str, namespace: str):
+        return self.actor_manager.get_by_name(name, namespace)
+
+    async def handle_list_actors(self):
+        return self.actor_manager.list_actors()
+
+    async def handle_kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        await self.actor_manager.kill_actor(actor_id, no_restart)
+        return True
+
+    # -- placement groups --------------------------------------------------
+
+    async def handle_create_placement_group(self, info: PlacementGroupInfo):
+        return await self.pg_manager.create(info)
+
+    async def handle_remove_placement_group(self, pg_id: PlacementGroupID):
+        await self.pg_manager.remove(pg_id)
+        return True
+
+    async def handle_get_placement_group(self, pg_id: PlacementGroupID):
+        return self.pg_manager.get(pg_id)
+
+    async def handle_get_placement_group_by_name(self, name: str):
+        return self.pg_manager.get_by_name(name)
+
+    async def handle_pg_wait_ready(self, pg_id: PlacementGroupID, timeout=None):
+        return await self.pg_manager.wait_ready(pg_id, timeout)
+
+    async def handle_list_placement_groups(self):
+        return self.pg_manager.list_groups()
+
+    # -- cluster info ------------------------------------------------------
+
+    async def handle_cluster_resources(self):
+        total: Dict[str, float] = {}
+        for node in self.alive_nodes().values():
+            for k, v in node.resources_total.items():
+                total[k] = total.get(k, 0.0) + v
+        return total
+
+    async def handle_cluster_available_resources(self):
+        avail: Dict[str, float] = {}
+        for nid in self.alive_nodes():
+            for k, v in self.node_available(nid).items():
+                avail[k] = avail.get(k, 0.0) + v
+        return avail

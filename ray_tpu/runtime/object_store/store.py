@@ -1,0 +1,259 @@
+"""Node-local shared-memory object store.
+
+Role-equivalent of the reference's Plasma store (src/ray/object_manager/plasma/
+store.h — mmap arenas + dlmalloc, create/seal/get/release lifecycle, LRU
+eviction, embedded in the raylet). Here: the raylet embeds an ``ObjectStore``
+whose objects live in named POSIX shared memory (`/dev/shm`), one segment per
+object; workers on the node attach segments by name for zero-copy reads.
+Control messages (create/seal/get/release/free) travel over the raylet's RPC
+endpoint rather than a dedicated unix socket.
+
+The store tracks per-object reader counts (pins) and evicts sealed,
+unpinned objects LRU when a create would exceed capacity (reference:
+eviction_policy.h). Spilling hooks onto the eviction path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+from typing import Dict, List, Optional
+
+from ..._internal.ids import ObjectID
+from ...exceptions import ObjectStoreFullError
+
+logger = logging.getLogger(__name__)
+
+
+class _Segment(shared_memory.SharedMemory):
+    """SharedMemory with store-owned lifetime.
+
+    On Python 3.12 even *attaching* registers a segment with the
+    multiprocessing resource tracker, which then unlinks it when the attaching
+    process exits — fatal for a store whose segments outlive readers. Every
+    segment is therefore unregistered at construction and unlinked explicitly
+    via shm_unlink (never through the tracker). The finalizer also swallows
+    BufferError: zero-copy numpy views may still alias the mapping at
+    interpreter teardown.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(self._name, "shared_memory")
+        except Exception:
+            pass
+
+    def unlink(self):
+        import _posixshmem
+
+        _posixshmem.shm_unlink(self._name)
+
+    def __del__(self):
+        try:
+            super().__del__()
+        except BufferError:
+            pass
+
+
+@dataclass
+class _Entry:
+    object_id: ObjectID
+    segment_name: str
+    size: int
+    shm: shared_memory.SharedMemory
+    sealed: bool = False
+    pin_count: int = 0
+    last_access: float = field(default_factory=time.time)
+    seal_waiters: List[asyncio.Event] = field(default_factory=list)
+    # objects pinned as primary copies (owned here) are never evicted until freed
+    primary: bool = False
+
+
+class ObjectStore:
+    """Server side, embedded in the raylet process."""
+
+    def __init__(self, capacity_bytes: int, session_id: str):
+        self.capacity = capacity_bytes
+        self.session_id = session_id
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._used = 0
+        self._seq = 0
+        # spill callback: async fn(entries) -> None, set by LocalObjectManager
+        self.spill_handler = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def create(self, object_id: ObjectID, size: int) -> str:
+        """Allocate a segment; returns its name. Caller writes then seals."""
+        if object_id in self._entries:
+            return self._entries[object_id].segment_name
+        if size > self.capacity:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes exceeds store capacity {self.capacity}"
+            )
+        self._evict_until(size)
+        name = f"rtpu_{self.session_id}_{self._seq}"
+        self._seq += 1
+        shm = _Segment(create=True, size=max(size, 1), name=name)
+        self._entries[object_id] = _Entry(object_id, name, size, shm)
+        self._used += size
+        return name
+
+    def seal(self, object_id: ObjectID):
+        entry = self._entries.get(object_id)
+        if entry is None:
+            raise KeyError(f"seal of unknown object {object_id}")
+        entry.sealed = True
+        entry.last_access = time.time()
+        for ev in entry.seal_waiters:
+            ev.set()
+        entry.seal_waiters.clear()
+
+    def create_and_write(self, object_id: ObjectID, data: bytes | memoryview) -> str:
+        """Server-local put (used when objects arrive via RPC transfer)."""
+        name = self.create(object_id, len(data))
+        entry = self._entries[object_id]
+        entry.shm.buf[: len(data)] = data
+        self.seal(object_id)
+        return name
+
+    def contains(self, object_id: ObjectID) -> bool:
+        e = self._entries.get(object_id)
+        return e is not None and e.sealed
+
+    async def get(self, object_id: ObjectID, timeout: Optional[float] = None):
+        """Wait until sealed; returns (segment_name, size). Pins the object."""
+        entry = self._entries.get(object_id)
+        if entry is None:
+            return None
+        if not entry.sealed:
+            ev = asyncio.Event()
+            entry.seal_waiters.append(ev)
+            try:
+                await asyncio.wait_for(ev.wait(), timeout)
+            except asyncio.TimeoutError:
+                return None
+            entry = self._entries.get(object_id)
+            if entry is None:
+                return None
+        entry.pin_count += 1
+        entry.last_access = time.time()
+        return entry.segment_name, entry.size
+
+    def release(self, object_id: ObjectID):
+        entry = self._entries.get(object_id)
+        if entry is not None and entry.pin_count > 0:
+            entry.pin_count -= 1
+
+    def pin_primary(self, object_id: ObjectID):
+        """Mark as the primary copy — protected from eviction until freed
+        (reference: primary-copy pinning in LocalObjectManager)."""
+        entry = self._entries.get(object_id)
+        if entry is not None:
+            entry.primary = True
+
+    def free(self, object_id: ObjectID):
+        entry = self._entries.pop(object_id, None)
+        if entry is not None:
+            self._used -= entry.size
+            try:
+                entry.shm.unlink()
+            except FileNotFoundError:
+                pass
+            try:
+                entry.shm.close()
+            except BufferError:
+                # a served memoryview still aliases the mapping; the unlink
+                # above already reclaimed the name, mapping dies with readers
+                pass
+
+    def read_local(self, object_id: ObjectID) -> Optional[memoryview]:
+        """Zero-copy view for in-process readers (the raylet's own transfers)."""
+        entry = self._entries.get(object_id)
+        if entry is None or not entry.sealed:
+            return None
+        entry.last_access = time.time()
+        return entry.shm.buf[: entry.size]
+
+    # -- eviction ----------------------------------------------------------
+
+    def _evict_until(self, need: int):
+        if self._used + need <= self.capacity:
+            return
+        victims = sorted(
+            (
+                e
+                for e in self._entries.values()
+                if e.sealed and e.pin_count == 0 and not e.primary
+            ),
+            key=lambda e: e.last_access,
+        )
+        for entry in victims:
+            if self._used + need <= self.capacity:
+                return
+            logger.debug("evicting %s (%d bytes)", entry.object_id, entry.size)
+            self.free(entry.object_id)
+        if self._used + need > self.capacity:
+            raise ObjectStoreFullError(
+                f"cannot allocate {need} bytes: {self._used}/{self.capacity} used, "
+                "all remaining objects pinned"
+            )
+
+    def stats(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "used": self._used,
+            "num_objects": len(self._entries),
+        }
+
+    def shutdown(self):
+        for oid in list(self._entries):
+            self.free(oid)
+
+
+class StoreClient:
+    """Client side, used by workers/driver to read and write segments
+    (reference: plasma/client.h — mmap'd client). Attach/close only; the
+    lifecycle RPCs go through the raylet client."""
+
+    def __init__(self):
+        self._attached: Dict[str, shared_memory.SharedMemory] = {}
+
+    def write(self, segment_name: str, meta: bytes, bufs, packed_size: int):
+        from ..._internal import serialization
+
+        shm = _Segment(name=segment_name)
+        try:
+            serialization.pack_into(meta, bufs, shm.buf[:packed_size])
+        finally:
+            shm.close()
+
+    def read(self, segment_name: str, size: int):
+        """Returns a memoryview aliasing shared memory. The segment stays
+        attached until released; numpy arrays deserialized from it alias the
+        store (zero-copy get)."""
+        shm = self._attached.get(segment_name)
+        if shm is None:
+            shm = _Segment(name=segment_name)
+            self._attached[segment_name] = shm
+        return shm.buf[:size]
+
+    def detach(self, segment_name: str):
+        shm = self._attached.pop(segment_name, None)
+        if shm is not None:
+            try:
+                shm.close()
+            except BufferError:
+                # a deserialized array still aliases the buffer; leave attached
+                self._attached[segment_name] = shm
+
+    def close(self):
+        for name in list(self._attached):
+            self.detach(name)

@@ -1,0 +1,522 @@
+"""Raylet: the per-node daemon.
+
+Role-equivalent of the reference's NodeManager (src/ray/raylet/node_manager.h:133)
+plus the embedded object store and the two-level scheduler:
+
+- worker-lease protocol: owners request a leased worker for a task; the raylet
+  grants locally, queues, or replies with a spillback target chosen from its
+  cluster resource view (reference: ClusterLeaseManager/LocalLeaseManager +
+  hybrid_scheduling_policy.h)
+- placement-group bundle prepare/commit/return (2-phase commit participant,
+  reference: HandlePrepareBundleResources node_manager.h:584)
+- node-local shared-memory object store service + node-to-node chunked object
+  pulls (reference: ObjectManager/PullManager, object_manager.h:128)
+- worker pool management and worker-death detection via connection loss
+  (reference: HandleClientConnectionError node_manager.h:332)
+- periodic resource-view reports to the GCS (role of RaySyncer)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+from collections import defaultdict, deque
+from typing import Dict, List, Optional, Tuple
+
+from ..._internal.config import Config
+from ..._internal.event_loop import PeriodicRunner
+from ..._internal.ids import NodeID, ObjectID, PlacementGroupID, UniqueID, WorkerID
+from ..._internal.protocol import (
+    label_match,
+    NodeInfo,
+    PlacementGroupSchedulingStrategy,
+    NodeAffinitySchedulingStrategy,
+    SpreadSchedulingStrategy,
+    TaskSpec,
+)
+from ..._internal.rpc import ClientPool, RpcServer
+from ...exceptions import ObjectStoreFullError
+from ..gcs.pubsub import SubscriberClient
+from ..object_store.store import ObjectStore
+from .resources import Allocation, LocalResourceManager
+from .worker_pool import WorkerHandle, WorkerPool
+
+logger = logging.getLogger(__name__)
+
+
+class Lease:
+    __slots__ = ("lease_id", "worker", "allocation", "spec")
+
+    def __init__(self, lease_id, worker: WorkerHandle, allocation: Allocation, spec):
+        self.lease_id = lease_id
+        self.worker = worker
+        self.allocation = allocation
+        self.spec = spec
+
+
+class Raylet:
+    def __init__(
+        self,
+        config: Config,
+        gcs_address: Tuple[str, int],
+        resources: Dict[str, float],
+        labels: Dict[str, str],
+        session_id: str,
+        is_head: bool = False,
+        object_store_memory: Optional[int] = None,
+    ):
+        self.config = config
+        self.node_id = NodeID.from_random()
+        self.gcs_address = gcs_address
+        self.session_id = session_id
+        self.is_head = is_head
+        self.server = RpcServer(f"raylet-{self.node_id.hex()[:6]}")
+        self.client_pool = ClientPool("raylet-out")
+        self.resources = LocalResourceManager(resources, labels)
+        self.store = ObjectStore(
+            object_store_memory or config.object_store_memory,
+            f"{session_id}_{self.node_id.hex()[:6]}",
+        )
+        self.worker_pool: Optional[WorkerPool] = None
+        self.address: Optional[Tuple[str, int]] = None
+
+        self._leases: Dict[UniqueID, Lease] = {}
+        self._lease_seq = itertools.count()
+        # scheduling-class FIFO queues of pending lease requests
+        # (reference: scheduling classes, scheduling_class_util.h)
+        self._queues: Dict[tuple, deque] = defaultdict(deque)
+        self._dispatch_wakeup = asyncio.Event()
+        self._dispatch_task: Optional[asyncio.Task] = None
+        # cluster view for spillback: node_id -> NodeInfo / availability
+        self._cluster_nodes: Dict[NodeID, NodeInfo] = {}
+        self._cluster_available: Dict[NodeID, Dict[str, float]] = {}
+        self._subscriber: Optional[SubscriberClient] = None
+        self._runner: Optional[PeriodicRunner] = None
+        self._last_reported: Optional[Dict[str, float]] = None
+        self._stopped = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self.server.register_service(self)
+        self.server.on_connection_lost(self._on_connection_lost)
+        bound = await self.server.start(host, port)
+        self.address = (host, bound)
+        self.worker_pool = WorkerPool(
+            self.node_id,
+            lambda: self.address[1],
+            self.gcs_address,
+            self.session_id,
+            self.config.max_workers_per_node,
+            self.config.to_json(),
+        )
+        gcs = self.client_pool.get(*self.gcs_address)
+        info = NodeInfo(
+            node_id=self.node_id,
+            address=self.address,
+            object_store_address=self.store.session_id,
+            resources_total=self.resources.total_float(),
+            labels=dict(self.resources.labels),
+            is_head=self.is_head,
+        )
+        await gcs.call("register_node", info)
+        self._cluster_nodes[self.node_id] = info
+        # cluster view subscription
+        self._subscriber = SubscriberClient(
+            self.client_pool.get(*self.gcs_address), f"raylet-{self.node_id.hex()}"
+        )
+        await self._subscriber.subscribe("node", self._on_node_event)
+        await self._subscriber.subscribe("resource_view", self._on_resource_view)
+        # periodic resource reports double as liveness heartbeats
+        self._runner = PeriodicRunner(asyncio.get_event_loop())
+        self._runner.run_every(
+            max(self.config.health_check_period_s / 2, 0.1), self._report_resources
+        )
+        self._runner.run_every(5.0, self._reap_idle_workers)
+        self._dispatch_task = asyncio.ensure_future(self._dispatch_loop())
+        if self.config.prestart_workers:
+            self.worker_pool.prestart(self.config.prestart_workers)
+        logger.info("raylet %s on %s", self.node_id, self.address)
+        return self.address
+
+    async def stop(self):
+        self._stopped = True
+        if self._runner:
+            self._runner.stop()
+        if self._subscriber:
+            await self._subscriber.close()
+        if self._dispatch_task:
+            self._dispatch_task.cancel()
+        if self.worker_pool:
+            self.worker_pool.shutdown()
+        self.store.shutdown()
+        await self.server.stop()
+        await self.client_pool.close_all()
+
+    async def _report_resources(self):
+        avail = self.resources.available_float()
+        gcs = self.client_pool.get(*self.gcs_address)
+        try:
+            await gcs.call("report_resources", self.node_id, avail)
+        except Exception:
+            pass
+        self._last_reported = avail
+
+    def _reap_idle_workers(self):
+        self.worker_pool.reap_idle(
+            keep=self.config.prestart_workers,
+            idle_kill_s=self.config.idle_worker_kill_s,
+        )
+
+    # -- cluster view ------------------------------------------------------
+
+    def _on_node_event(self, channel, message):
+        kind, info = message
+        if kind == "alive":
+            self._cluster_nodes[info.node_id] = info
+        else:
+            self._cluster_nodes.pop(info.node_id, None)
+            self._cluster_available.pop(info.node_id, None)
+
+    def _on_resource_view(self, channel, message):
+        node_id, available = message
+        self._cluster_available[node_id] = available
+        self._dispatch_wakeup.set()  # infeasible tasks may now be spillable
+
+    # -- worker registration / death --------------------------------------
+
+    async def handle_register_worker(
+        self, worker_id: WorkerID, address: Tuple[str, int], pid: int
+    ):
+        self.worker_pool.on_worker_registered(worker_id, address, pid)
+        return {"node_id": self.node_id, "store_session": self.store.session_id}
+
+    async def _on_connection_lost(self, peer_meta):
+        worker_id = peer_meta.get("worker_id")
+        if worker_id is None:
+            return
+        handle = self.worker_pool.on_worker_dead(worker_id)
+        if handle is None:
+            return
+        logger.warning("worker %s (pid %s) died", worker_id, handle.pid)
+        # free any leases held by the dead worker
+        for lease_id, lease in list(self._leases.items()):
+            if lease.worker.worker_id == worker_id:
+                self.resources.release(lease.allocation)
+                del self._leases[lease_id]
+        self._dispatch_wakeup.set()
+        try:
+            gcs = self.client_pool.get(*self.gcs_address)
+            await gcs.call("report_worker_death", worker_id, "connection lost")
+        except Exception:
+            pass
+
+    # -- lease protocol ----------------------------------------------------
+
+    async def handle_request_worker_lease(self, spec: TaskSpec):
+        """Grant a worker locally, queue, or spill to another node."""
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._queues[spec.scheduling_class()].append((spec, fut))
+        self._dispatch_wakeup.set()
+        return await fut
+
+    async def handle_return_worker(self, lease_id, worker_failed: bool = False):
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return False
+        self.resources.release(lease.allocation)
+        if not worker_failed:
+            self.worker_pool.push(lease.worker)
+        self._dispatch_wakeup.set()
+        return True
+
+    async def _dispatch_loop(self):
+        """Single dispatch loop draining per-class FIFO queues (reference:
+        ClusterLeaseManager::ScheduleAndGrantLeases)."""
+        while not self._stopped:
+            await self._dispatch_wakeup.wait()
+            self._dispatch_wakeup.clear()
+            progress = True
+            while progress:
+                progress = False
+                for cls, queue in list(self._queues.items()):
+                    if not queue:
+                        del self._queues[cls]
+                        continue
+                    spec, fut = queue[0]
+                    if fut.done():
+                        queue.popleft()
+                        progress = True
+                        continue
+                    decision = await self._try_dispatch(spec)
+                    if decision is None:
+                        continue  # head-of-line waits; other classes proceed
+                    queue.popleft()
+                    if not fut.done():
+                        fut.set_result(decision)
+                    progress = True
+
+    async def _try_dispatch(self, spec: TaskSpec) -> Optional[dict]:
+        """Returns a reply dict, or None to keep the request queued."""
+        strategy = spec.scheduling_strategy
+        bundle = None
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg_id = strategy.placement_group_id
+            index = strategy.bundle_index
+            if index == -1:
+                index = self._find_bundle(pg_id, spec.resources)
+                if index is None:
+                    return {"granted": False, "reason": "no bundle with capacity"}
+            if not self.resources.has_bundle(pg_id, index):
+                return {"granted": False, "reason": "bundle not on this node"}
+            if not self.resources.bundle_can_allocate(pg_id, index, spec.resources):
+                return None  # wait for bundle capacity
+            bundle = (pg_id, index)
+        elif isinstance(strategy, NodeAffinitySchedulingStrategy):
+            if strategy.node_id != self.node_id:
+                target = self._cluster_nodes.get(strategy.node_id)
+                if target is not None:
+                    return {"granted": False, "spillback": (target.node_id, target.address)}
+                if not strategy.soft:
+                    return {"granted": False, "reason": "affinity node not alive"}
+        else:
+            if not self.resources.feasible(spec.resources, spec.label_selector):
+                return self._spillback_or_reject(spec)
+            if isinstance(strategy, SpreadSchedulingStrategy):
+                target = self._pick_spread_target(spec)
+                if target is not None and target[0] != self.node_id:
+                    return {"granted": False, "spillback": target}
+            if not self.resources.pool.can_allocate(spec.resources):
+                # feasible but busy: hybrid policy — spill if a remote node
+                # has free capacity now, else queue locally
+                target = self._pick_remote_with_capacity(spec)
+                if target is not None:
+                    return {"granted": False, "spillback": target}
+                return None
+
+        allocation = self.resources.allocate(spec.resources, bundle=bundle)
+        if allocation is None:
+            return None
+        worker = await self.worker_pool.pop(timeout=60.0)
+        if worker is None:
+            self.resources.release(allocation)
+            return {"granted": False, "reason": "no worker available"}
+        lease_id = UniqueID.from_random()
+        self._leases[lease_id] = Lease(lease_id, worker, allocation, spec)
+        return {
+            "granted": True,
+            "lease_id": lease_id,
+            "worker_id": worker.worker_id,
+            "worker_address": worker.address,
+            "node_id": self.node_id,
+            "instances": allocation.instance_ids,
+        }
+
+    def _find_bundle(self, pg_id: PlacementGroupID, demand) -> Optional[int]:
+        for (bpg, index) in self.resources._committed:
+            if bpg == pg_id and self.resources.bundle_can_allocate(bpg, index, demand):
+                return index
+        return None
+
+    def _spillback_or_reject(self, spec: TaskSpec) -> dict:
+        """Task infeasible on this node: find a feasible node in the cluster
+        view (reference: spillback in ClusterLeaseManager)."""
+        for node_id, info in self._cluster_nodes.items():
+            if node_id == self.node_id or not info.alive:
+                continue
+            feasible = all(
+                info.resources_total.get(k, 0.0) >= v - 1e-9
+                for k, v in spec.resources.items()
+            ) and label_match(info.labels, spec.label_selector)
+            if feasible:
+                return {"granted": False, "spillback": (node_id, info.address)}
+        return {"granted": False, "infeasible": True,
+                "reason": f"no node satisfies {spec.resources} {spec.label_selector}"}
+
+    def _pick_remote_with_capacity(self, spec: TaskSpec) -> Optional[tuple]:
+        best = None
+        best_score = None
+        for node_id, info in self._cluster_nodes.items():
+            if node_id == self.node_id or not info.alive:
+                continue
+            if not label_match(info.labels, spec.label_selector):
+                continue
+            avail = self._cluster_available.get(node_id)
+            if avail is None:
+                continue
+            if all(avail.get(k, 0.0) >= v - 1e-9 for k, v in spec.resources.items()):
+                score = sum(avail.values())
+                if best_score is None or score > best_score:
+                    best, best_score = (node_id, info.address), score
+        return best
+
+    def _pick_spread_target(self, spec: TaskSpec) -> Optional[tuple]:
+        """SPREAD strategy: round-robin over feasible nodes by least load."""
+        candidates = []
+        for node_id, info in self._cluster_nodes.items():
+            if not info.alive:
+                continue
+            if not all(
+                info.resources_total.get(k, 0.0) >= v - 1e-9
+                for k, v in spec.resources.items()
+            ):
+                continue
+            avail = self._cluster_available.get(node_id, info.resources_total)
+            used = sum(
+                info.resources_total.get(k, 0.0) - avail.get(k, 0.0)
+                for k in info.resources_total
+            )
+            candidates.append((used, node_id, info.address))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: (c[0], c[1]))
+        _, node_id, address = candidates[0]
+        return (node_id, address)
+
+    # -- placement group bundles ------------------------------------------
+
+    async def handle_prepare_bundle(
+        self, pg_id: PlacementGroupID, index: int, resources: Dict[str, float]
+    ) -> bool:
+        return self.resources.prepare_bundle(pg_id, index, resources)
+
+    async def handle_commit_bundle(self, pg_id: PlacementGroupID, index: int) -> bool:
+        ok = self.resources.commit_bundle(pg_id, index)
+        self._dispatch_wakeup.set()
+        return ok
+
+    async def handle_return_bundle(self, pg_id: PlacementGroupID, index: int):
+        self.resources.return_bundle(pg_id, index)
+        self._dispatch_wakeup.set()
+        return True
+
+    # -- object store service ---------------------------------------------
+
+    async def handle_store_create(self, object_id: ObjectID, size: int):
+        try:
+            return {"ok": True, "segment": self.store.create(object_id, size)}
+        except ObjectStoreFullError as e:
+            return {"ok": False, "error": str(e)}
+
+    async def handle_store_seal(self, object_id: ObjectID, is_primary: bool = False):
+        self.store.seal(object_id)
+        if is_primary:
+            self.store.pin_primary(object_id)
+        return True
+
+    async def handle_store_contains(self, object_id: ObjectID):
+        return self.store.contains(object_id)
+
+    async def handle_store_get(
+        self,
+        object_id: ObjectID,
+        owner_address: Optional[Tuple[str, int]] = None,
+        timeout: Optional[float] = None,
+    ):
+        """Local get; pulls from a remote node when the object isn't here
+        (reference: PullManager)."""
+        if self.store.contains(object_id):
+            result = await self.store.get(object_id, timeout=0.1)
+            if result is not None:
+                return {"ok": True, "segment": result[0], "size": result[1]}
+        if owner_address is not None:
+            pulled = await self._pull_object(object_id, owner_address)
+            if pulled:
+                result = await self.store.get(object_id, timeout=1.0)
+                if result is not None:
+                    return {"ok": True, "segment": result[0], "size": result[1]}
+        result = await self.store.get(object_id, timeout=timeout)
+        if result is None:
+            return {"ok": False}
+        return {"ok": True, "segment": result[0], "size": result[1]}
+
+    async def handle_store_release(self, object_id: ObjectID):
+        self.store.release(object_id)
+        return True
+
+    async def handle_free_objects(self, object_ids: List[ObjectID]):
+        for oid in object_ids:
+            self.store.free(oid)
+        return True
+
+    async def handle_fetch_object(self, object_id: ObjectID, offset: int, length: int):
+        """Serve one chunk of a local object to a pulling peer (reference:
+        ObjectManager::Push chunking)."""
+        view = self.store.read_local(object_id)
+        if view is None:
+            return None
+        total = len(view)
+        chunk = bytes(view[offset : offset + length])
+        return {"total": total, "data": chunk}
+
+    async def _pull_object(self, object_id: ObjectID, owner_address) -> bool:
+        """Ask the owner where the object lives, then pull it chunk-by-chunk
+        from that node's raylet."""
+        try:
+            owner = self.client_pool.get(*owner_address)
+            loc = await owner.call("get_object_locations", object_id)
+        except Exception as e:
+            logger.debug("pull: owner lookup failed for %s: %s", object_id, e)
+            return False
+        if not loc:
+            return False
+        for node_address in loc:
+            if tuple(node_address) == tuple(self.address):
+                continue
+            try:
+                peer = self.client_pool.get(*node_address)
+                chunk_size = self.config.object_transfer_chunk_size
+                first = await peer.call("fetch_object", object_id, 0, chunk_size)
+                if first is None:
+                    continue
+                total = first["total"]
+                segment = self.store.create(object_id, total)
+                view = self.store._entries[object_id].shm.buf
+                view[: len(first["data"])] = first["data"]
+                offset = len(first["data"])
+                while offset < total:
+                    part = await peer.call("fetch_object", object_id, offset, chunk_size)
+                    if part is None:
+                        break
+                    data = part["data"]
+                    view[offset : offset + len(data)] = data
+                    offset += len(data)
+                if offset >= total:
+                    self.store.seal(object_id)
+                    # tell the owner this node now holds a copy
+                    try:
+                        owner = self.client_pool.get(*owner_address)
+                        await owner.call_oneway(
+                            "add_object_location", object_id, self.address
+                        )
+                    except Exception:
+                        pass
+                    return True
+                self.store.free(object_id)
+            except Exception as e:
+                logger.debug("pull of %s from %s failed: %s", object_id, node_address, e)
+        return False
+
+    # -- misc --------------------------------------------------------------
+
+    async def handle_ping(self):
+        return {"node_id": self.node_id, "time": time.time()}
+
+    async def handle_get_node_info(self):
+        return {
+            "node_id": self.node_id,
+            "address": self.address,
+            "resources_total": self.resources.total_float(),
+            "resources_available": self.resources.available_float(),
+            "labels": dict(self.resources.labels),
+            "store": self.store.stats(),
+            "num_workers": self.worker_pool.num_total if self.worker_pool else 0,
+        }
+
+    async def handle_drain(self):
+        """Graceful drain (reference: HandleDrainRaylet node_manager.h:313)."""
+        gcs = self.client_pool.get(*self.gcs_address)
+        await gcs.call("unregister_node", self.node_id)
+        return True

@@ -1,0 +1,176 @@
+"""Worker pool: spawning and leasing worker processes.
+
+Role-equivalent of the reference's WorkerPool (src/ray/raylet/worker_pool.h:276):
+the raylet spawns language workers as subprocesses, workers dial back and
+register, idle workers are popped to satisfy leases and pushed back on lease
+return. Idle workers above the prestart floor are reaped after a timeout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..._internal.ids import NodeID, WorkerID
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    address: tuple  # (host, port) of the worker's RPC server
+    pid: int
+    proc: Optional[subprocess.Popen] = None
+    idle_since: float = field(default_factory=time.time)
+    # env fingerprint for dedicated workers (runtime envs); "" = default
+    env_key: str = ""
+
+
+class WorkerPool:
+    def __init__(
+        self,
+        node_id: NodeID,
+        raylet_port_getter,
+        gcs_address,
+        session_id: str,
+        max_workers: int,
+        config_json: str,
+    ):
+        self._node_id = node_id
+        self._raylet_port_getter = raylet_port_getter
+        self._gcs_address = gcs_address
+        self._session_id = session_id
+        self._max_workers = max_workers
+        self._config_json = config_json
+        self._idle: List[WorkerHandle] = []
+        self._registered: Dict[WorkerID, WorkerHandle] = {}
+        self._starting = 0
+        self._spawned_procs: Dict[int, subprocess.Popen] = {}  # pid -> proc
+        self._waiters: List[asyncio.Future] = []
+        self._stopped = False
+
+    @property
+    def num_total(self) -> int:
+        return len(self._registered) + self._starting
+
+    def _spawn(self, env_overrides: Optional[dict] = None):
+        """Start one worker subprocess; it will dial back and register."""
+        self._starting += 1
+        env = dict(os.environ)
+        env["RAY_TPU_NODE_ID"] = self._node_id.hex()
+        env.update(env_overrides or {})
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        )
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        cmd = [
+            sys.executable,
+            "-m",
+            "ray_tpu.runtime.worker.worker_main",
+            "--raylet-port", str(self._raylet_port_getter()),
+            "--gcs-host", self._gcs_address[0],
+            "--gcs-port", str(self._gcs_address[1]),
+            "--node-id", self._node_id.hex(),
+            "--session", self._session_id,
+        ]
+        proc = subprocess.Popen(
+            cmd,
+            env=env,
+            stdout=subprocess.DEVNULL if env.get("RAY_TPU_WORKER_QUIET") else None,
+            stderr=None,
+        )
+        self._spawned_procs[proc.pid] = proc
+        logger.debug("spawned worker pid=%s", proc.pid)
+        return proc
+
+    def on_worker_registered(self, worker_id: WorkerID, address: tuple, pid: int):
+        handle = WorkerHandle(worker_id, address, pid)
+        self._registered[worker_id] = handle
+        if self._starting > 0:
+            self._starting -= 1
+        # hand directly to a waiter if any, else park as idle
+        while self._waiters:
+            fut = self._waiters.pop(0)
+            if not fut.done():
+                fut.set_result(handle)
+                return
+        self._idle.append(handle)
+
+    def on_worker_dead(self, worker_id: WorkerID) -> Optional[WorkerHandle]:
+        handle = self._registered.pop(worker_id, None)
+        self._idle = [w for w in self._idle if w.worker_id != worker_id]
+        return handle
+
+    async def pop(self, timeout: float = 60.0) -> Optional[WorkerHandle]:
+        """Pop an idle worker, spawning one if the pool is below its cap."""
+        if self._idle:
+            return self._idle.pop()
+        if self.num_total < self._max_workers:
+            self._spawn()
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            if fut in self._waiters:
+                self._waiters.remove(fut)
+            return None
+
+    def push(self, handle: WorkerHandle):
+        """Return a worker to the idle pool after its lease ends."""
+        if handle.worker_id in self._registered:
+            handle.idle_since = time.time()
+            while self._waiters:
+                fut = self._waiters.pop(0)
+                if not fut.done():
+                    fut.set_result(handle)
+                    return
+            self._idle.append(handle)
+
+    def prestart(self, count: int):
+        for _ in range(count):
+            if self.num_total < self._max_workers:
+                self._spawn()
+
+    def reap_idle(self, keep: int, idle_kill_s: float):
+        """Kill workers idle beyond the timeout, keeping a floor."""
+        now = time.time()
+        survivors = []
+        for handle in self._idle:
+            if (
+                len(self._idle) - (len(self._idle) - len(survivors) - 1) > keep
+                and now - handle.idle_since > idle_kill_s
+            ):
+                self._kill(handle)
+            else:
+                survivors.append(handle)
+        self._idle = survivors
+
+    def _kill(self, handle: WorkerHandle):
+        self._registered.pop(handle.worker_id, None)
+        try:
+            os.kill(handle.pid, 15)
+        except ProcessLookupError:
+            pass
+
+    def shutdown(self):
+        self._stopped = True
+        for handle in list(self._registered.values()):
+            self._kill(handle)
+        # also kill spawned-but-not-yet-registered workers
+        for pid, proc in self._spawned_procs.items():
+            if proc.poll() is None:
+                try:
+                    proc.terminate()
+                except ProcessLookupError:
+                    pass
+        self._registered.clear()
+        self._idle.clear()
+        self._spawned_procs.clear()

@@ -1,0 +1,971 @@
+"""CoreWorker: the per-process runtime for drivers and workers.
+
+Role-equivalent of the reference's CoreWorker (src/ray/core_worker/
+core_worker.h:167) and its satellites:
+
+- ownership + reference counting for objects this process created
+  (reference: reference_counter.h — local refs and submitted-task refs here;
+  the full borrower protocol is tracked per-ref owner address)
+- in-process memory store for small results (memory_store.h)
+- normal-task submission via raylet worker leases with spillback-following and
+  retries (normal_task_submitter.h)
+- actor-task submission with per-caller sequence numbers, client-side queueing
+  while the actor is pending/restarting (actor_task_submitter.h)
+- the execution side: function-table resolution, ordered actor queues,
+  result serialization with the small/large split (task_receiver.h)
+
+Every CoreWorker runs an RpcServer: owners serve object metadata/value
+requests on it; executors additionally serve push_task/create_actor/actor_task.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import enum
+import logging
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..._internal import serialization
+from ..._internal.config import Config
+from ..._internal.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+    UniqueID,
+    WorkerID,
+)
+from ..._internal.protocol import (
+    ActorInfo,
+    ActorState,
+    FunctionDescriptor,
+    PlacementGroupSchedulingStrategy,
+    ReturnObject,
+    TaskArg,
+    TaskReply,
+    TaskSpec,
+    TaskType,
+)
+from ..._internal.rpc import ClientPool, RpcClient, RpcServer
+from ...exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    RpcError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ...object_ref import ObjectRef
+from ..gcs.pubsub import SubscriberClient
+from ..object_store.store import StoreClient
+from .memory_store import MemoryStore
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerMode(enum.Enum):
+    DRIVER = 0
+    WORKER = 1
+
+
+class _ActorClientState:
+    """Client-side view of one actor (reference: ActorTaskSubmitter state)."""
+
+    __slots__ = ("actor_id", "state", "address", "seq", "queue", "death_cause")
+
+    def __init__(self, actor_id: ActorID):
+        self.actor_id = actor_id
+        self.state = ActorState.PENDING_CREATION
+        self.address: Optional[Tuple[str, int]] = None
+        self.seq = 0
+        # tasks parked while the actor is pending/restarting
+        self.queue: deque = deque()
+        self.death_cause = ""
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        mode: WorkerMode,
+        config: Config,
+        gcs_address: Tuple[str, int],
+        raylet_address: Tuple[str, int],
+        loop: asyncio.AbstractEventLoop,
+        job_id: Optional[JobID] = None,
+    ):
+        self.mode = mode
+        self.config = config
+        self.gcs_address = gcs_address
+        self.raylet_address = raylet_address
+        self.loop = loop
+        self.worker_id = WorkerID.from_random()
+        self.job_id = job_id or JobID.nil()
+        self.node_id: Optional[NodeID] = None
+
+        self.server = RpcServer(f"worker-{self.worker_id.hex()[:6]}")
+        self.client_pool = ClientPool(
+            "worker-out", register_meta={"worker_id": self.worker_id}
+        )
+        self.memory_store = MemoryStore()
+        self.store_client = StoreClient()
+        self.address: Optional[Tuple[str, int]] = None
+
+        # ownership / ref counting (owner side)
+        self._local_refs: Dict[ObjectID, int] = defaultdict(int)
+        self._submitted_refs: Dict[ObjectID, int] = defaultdict(int)
+        self._owned: set = set()
+        self._ref_lock = threading.Lock()
+
+        # task bookkeeping
+        self._current_task_id = TaskID.of(self.job_id)
+        self._put_index = 0
+        self._task_index = 0
+        self._pending_tasks: Dict[TaskID, TaskSpec] = {}
+        self._task_done_events: Dict[TaskID, asyncio.Event] = {}
+
+        # actor submission state
+        self._actors: Dict[ActorID, _ActorClientState] = {}
+        self._subscriber: Optional[SubscriberClient] = None
+
+        # execution side
+        self._function_cache: Dict[str, Callable] = {}
+        self._actor_instance: Any = None
+        self._actor_spec: Optional[TaskSpec] = None
+        self._executor_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        # per-caller ordered queues for actor tasks
+        self._caller_expected_seq: Dict[WorkerID, int] = defaultdict(int)
+        self._caller_parked: Dict[WorkerID, Dict[int, tuple]] = defaultdict(dict)
+        self._execution_lock = asyncio.Lock()
+        self._exit_requested = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1"):
+        self._register_handlers()
+        port = await self.server.start(host, 0)
+        self.address = (host, port)
+        self._subscriber = SubscriberClient(
+            self.client_pool.get(*self.gcs_address),
+            f"worker-{self.worker_id.hex()}",
+        )
+        return self.address
+
+    def _register_handlers(self):
+        s = self.server
+        # owner services
+        s.register("get_object", self._handle_get_object)
+        s.register("get_object_locations", self._handle_get_object_locations)
+        s.register("add_object_location", self._handle_add_object_location)
+        s.register("wait_object", self._handle_wait_object)
+        s.register("decref", self._handle_decref)
+        # executor services
+        s.register("push_task", self._handle_push_task)
+        s.register("create_actor", self._handle_create_actor)
+        s.register("actor_task", self._handle_actor_task)
+        s.register("exit_worker", self._handle_exit_worker)
+        s.register("ping", self._handle_ping)
+
+    async def connect_to_raylet(self):
+        raylet = self.client_pool.get(*self.raylet_address)
+        reply = await raylet.call(
+            "register_worker", self.worker_id, self.address, os.getpid()
+        )
+        self.node_id = reply["node_id"]
+        return reply
+
+    async def register_driver_job(self, metadata: dict) -> JobID:
+        gcs = self.client_pool.get(*self.gcs_address)
+        self.job_id = await gcs.call("register_job", metadata)
+        self._current_task_id = TaskID.of(self.job_id)
+        return self.job_id
+
+    async def shutdown(self):
+        if self.mode == WorkerMode.DRIVER and not self.job_id.is_nil():
+            try:
+                gcs = self.client_pool.get(*self.gcs_address)
+                await gcs.call("finish_job", self.job_id, timeout=5.0)
+            except Exception:
+                pass
+        if self._subscriber:
+            await self._subscriber.close()
+        await self.server.stop()
+        await self.client_pool.close_all()
+        self.store_client.close()
+        self._executor_pool.shutdown(wait=False)
+
+    # ------------------------------------------------------------------
+    # reference counting (owner side; reference: reference_counter.h)
+    # ------------------------------------------------------------------
+
+    def register_ref(self, ref: ObjectRef):
+        with self._ref_lock:
+            self._local_refs[ref.id] += 1
+
+    def unregister_ref(self, ref: ObjectRef):
+        """Called from ObjectRef.__del__ — possibly on any thread."""
+        with self._ref_lock:
+            self._local_refs[ref.id] -= 1
+            should_check = self._local_refs[ref.id] <= 0
+        if should_check and not self.loop.is_closed():
+            try:
+                self.loop.call_soon_threadsafe(self._maybe_free, ref.id)
+            except RuntimeError:
+                pass
+
+    def _maybe_free(self, object_id: ObjectID):
+        with self._ref_lock:
+            if (
+                self._local_refs.get(object_id, 0) > 0
+                or self._submitted_refs.get(object_id, 0) > 0
+            ):
+                return
+            self._local_refs.pop(object_id, None)
+            self._submitted_refs.pop(object_id, None)
+            owned = object_id in self._owned
+            self._owned.discard(object_id)
+        if not owned:
+            return
+        entry = self.memory_store.delete(object_id)
+        if entry is not None and entry.in_plasma and entry.locations:
+            for node_address in entry.locations:
+                try:
+                    client = self.client_pool.get(*node_address)
+                    asyncio.ensure_future(client.call_oneway("free_objects", [object_id]))
+                except Exception:
+                    pass
+
+    def _retain_for_task(self, object_ids: List[ObjectID]):
+        with self._ref_lock:
+            for oid in object_ids:
+                self._submitted_refs[oid] += 1
+
+    def _release_for_task(self, object_ids: List[ObjectID]):
+        with self._ref_lock:
+            for oid in object_ids:
+                self._submitted_refs[oid] -= 1
+        for oid in object_ids:
+            self._maybe_free(oid)
+
+    # ------------------------------------------------------------------
+    # put / get / wait
+    # ------------------------------------------------------------------
+
+    def next_put_id(self) -> ObjectID:
+        self._put_index += 1
+        return ObjectID.for_put(self._current_task_id, self._put_index)
+
+    async def put(self, value: Any, object_id: Optional[ObjectID] = None) -> ObjectID:
+        object_id = object_id or self.next_put_id()
+        meta, bufs = serialization.serialize(value)
+        size = serialization.packed_size(meta, bufs)
+        self._owned.add(object_id)
+        if size <= self.config.max_direct_call_object_size:
+            packed = bytearray(size)
+            serialization.pack_into(meta, bufs, memoryview(packed))
+            self.memory_store.put_value(object_id, bytes(packed))
+        else:
+            await self._put_plasma(object_id, meta, bufs, size, primary=True)
+        return object_id
+
+    async def _put_plasma(self, object_id, meta, bufs, size, primary: bool):
+        raylet = self.client_pool.get(*self.raylet_address)
+        reply = await raylet.call("store_create", object_id, size)
+        if not reply["ok"]:
+            raise ObjectLostError(object_id, reply.get("error", "store create failed"))
+        self.store_client.write(reply["segment"], meta, bufs, size)
+        await raylet.call("store_seal", object_id, primary)
+        self.memory_store.put_plasma(object_id, size, self.raylet_address)
+
+    async def get_objects(
+        self, refs: List[ObjectRef], timeout: Optional[float] = None
+    ) -> List[Any]:
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        results = await asyncio.gather(
+            *[self._get_one(ref, deadline) for ref in refs]
+        )
+        return list(results)
+
+    async def _get_one(self, ref: ObjectRef, deadline: Optional[float]):
+        while True:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise GetTimeoutError(f"get timed out on {ref}")
+            entry = self.memory_store.get_if_exists(ref.id)
+            if entry is not None and entry.is_available():
+                return await self._materialize(ref, entry)
+            if ref.id in self._owned or self._is_self(ref.owner_address):
+                entry = await self.memory_store.wait_available(
+                    ref.id, timeout=remaining
+                )
+                if entry is None:
+                    raise GetTimeoutError(f"get timed out on {ref}")
+                return await self._materialize(ref, entry)
+            # borrowed ref: ask the owner
+            value = await self._get_from_owner(ref, remaining)
+            if value is not _PENDING:
+                return value
+            await asyncio.sleep(0.01)
+
+    def _is_self(self, address) -> bool:
+        return address is not None and tuple(address) == tuple(self.address or ())
+
+    async def _materialize(self, ref: ObjectRef, entry) -> Any:
+        if entry.error is not None:
+            raise serialization.unpack(entry.error)
+        if entry.value is not None:
+            return serialization.unpack(entry.value)
+        if entry.in_plasma:
+            return await self._read_plasma(ref, entry.size)
+        raise ObjectLostError(ref.id, "entry empty")
+
+    async def _read_plasma(self, ref: ObjectRef, size: int):
+        raylet = self.client_pool.get(*self.raylet_address)
+        owner_addr = ref.owner_address if not self._is_self(ref.owner_address) else (
+            self.address
+        )
+        reply = await raylet.call(
+            "store_get", ref.id, owner_addr, timeout=self.config.rpc_call_timeout_s
+        )
+        if not reply["ok"]:
+            raise ObjectLostError(ref.id, "object not found in any store")
+        view = self.store_client.read(reply["segment"], reply["size"])
+        value = serialization.unpack(view)
+        # release the pin: the mapping stays valid in this process even if the
+        # store later evicts the segment (POSIX shm unlink semantics)
+        await raylet.call_oneway("store_release", ref.id)
+        return value
+
+    async def _get_from_owner(self, ref: ObjectRef, timeout: Optional[float]):
+        owner = self.client_pool.get(*ref.owner_address)
+        try:
+            reply = await owner.call(
+                "get_object", ref.id, min(timeout, 10.0) if timeout else 10.0
+            )
+        except RpcError:
+            raise ObjectLostError(ref.id, "owner died") from None
+        if reply.get("pending"):
+            return _PENDING
+        if "error" in reply:
+            raise serialization.unpack(reply["error"])
+        if "value" in reply:
+            # cache small values locally to skip future owner RPCs
+            self.memory_store.put_value(ref.id, reply["value"])
+            return serialization.unpack(reply["value"])
+        if "plasma" in reply:
+            self.memory_store.put_plasma(ref.id, reply["plasma"], None)
+            entry = self.memory_store.get_if_exists(ref.id)
+            return await self._read_plasma(ref, entry.size)
+        raise ObjectLostError(ref.id, f"owner reply malformed: {reply}")
+
+    async def wait(
+        self,
+        refs: List[ObjectRef],
+        num_returns: int,
+        timeout: Optional[float],
+        fetch_local: bool = True,
+    ):
+        pending = {ref: asyncio.ensure_future(self._wait_one(ref)) for ref in refs}
+        ready: List[ObjectRef] = []
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        while len(ready) < num_returns and pending:
+            remaining = None
+            if deadline is not None:
+                remaining = max(deadline - time.monotonic(), 0)
+                if remaining == 0:
+                    break
+            done, _ = await asyncio.wait(
+                pending.values(),
+                timeout=remaining,
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            if not done:
+                break
+            for ref in list(pending):
+                if pending[ref].done():
+                    pending.pop(ref)
+                    ready.append(ref)
+        for fut in pending.values():
+            fut.cancel()
+        not_ready = [r for r in refs if r not in ready]
+        # preserve input order
+        ready_sorted = [r for r in refs if r in ready][:num_returns]
+        not_ready += [r for r in refs if r in ready and r not in ready_sorted]
+        return ready_sorted, [r for r in refs if r not in ready_sorted]
+
+    async def _wait_one(self, ref: ObjectRef):
+        if ref.id in self._owned or self._is_self(ref.owner_address):
+            await self.memory_store.wait_available(ref.id, timeout=None)
+            return
+        owner = self.client_pool.get(*ref.owner_address)
+        while True:
+            reply = await owner.call("wait_object", ref.id, 10.0)
+            if reply:
+                return
+
+    def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
+        return asyncio.run_coroutine_threadsafe(
+            self._get_one(ref, None), self.loop
+        )
+
+    # ------------------------------------------------------------------
+    # owner service handlers
+    # ------------------------------------------------------------------
+
+    async def _handle_get_object(self, object_id: ObjectID, timeout: float):
+        entry = await self.memory_store.wait_available(object_id, timeout=timeout)
+        if entry is None or not entry.is_available():
+            return {"pending": True}
+        if entry.error is not None:
+            return {"error": entry.error}
+        if entry.value is not None:
+            return {"value": entry.value}
+        return {"plasma": entry.size, "locations": entry.locations}
+
+    async def _handle_get_object_locations(self, object_id: ObjectID):
+        entry = self.memory_store.get_if_exists(object_id)
+        if entry is None:
+            return []
+        return list(entry.locations)
+
+    async def _handle_add_object_location(self, object_id: ObjectID, node_address):
+        self.memory_store.add_location(object_id, tuple(node_address))
+        return True
+
+    async def _handle_wait_object(self, object_id: ObjectID, timeout: float):
+        entry = await self.memory_store.wait_available(object_id, timeout=timeout)
+        return entry is not None and entry.is_available()
+
+    async def _handle_decref(self, object_id: ObjectID):
+        self._maybe_free(object_id)
+        return True
+
+    async def _handle_ping(self):
+        return {"worker_id": self.worker_id}
+
+    # ------------------------------------------------------------------
+    # task submission (reference: normal_task_submitter.h)
+    # ------------------------------------------------------------------
+
+    def next_task_id(self) -> TaskID:
+        self._task_index += 1
+        return TaskID.of(self.job_id)
+
+    async def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
+        """Register the pending task and launch the async submission pipeline.
+        Return object ids are immediately valid futures in the memory store."""
+        return_ids = spec.return_object_ids()
+        for oid in return_ids:
+            self._owned.add(oid)
+            self.memory_store.entry(oid)  # create pending entry
+        self._pending_tasks[spec.task_id] = spec
+        arg_ids = [a.object_id for a in spec.args if a.object_id is not None]
+        self._retain_for_task(arg_ids)
+        asyncio.ensure_future(self._submit_pipeline(spec, arg_ids))
+        return return_ids
+
+    async def _submit_pipeline(self, spec: TaskSpec, arg_ids: List[ObjectID]):
+        try:
+            await self._resolve_dependencies(spec)
+            attempts = spec.max_retries + 1
+            last_error: Optional[Exception] = None
+            for attempt in range(max(attempts, 1)):
+                try:
+                    done = await self._submit_once(spec, attempt)
+                    if done:
+                        return
+                except Exception as e:  # noqa: BLE001
+                    last_error = e
+                    logger.warning(
+                        "task %s attempt %d failed: %s", spec.task_id, attempt, e
+                    )
+                await asyncio.sleep(self.config.task_retry_delay_s * (attempt + 1))
+            err = last_error or WorkerCrashedError(
+                f"task {spec.task_id} failed after {attempts} attempts"
+            )
+            self._fail_task(spec, err)
+        except Exception as e:  # noqa: BLE001
+            self._fail_task(spec, e)
+        finally:
+            self._release_for_task(arg_ids)
+            self._pending_tasks.pop(spec.task_id, None)
+            ev = self._task_done_events.pop(spec.task_id, None)
+            if ev:
+                ev.set()
+
+    async def _resolve_dependencies(self, spec: TaskSpec):
+        """Inline small owned args once available (reference:
+        LocalDependencyResolver)."""
+        for arg in spec.args:
+            if arg.object_id is None:
+                continue
+            if self._is_self(arg.owner_address) or arg.object_id in self._owned:
+                entry = await self.memory_store.wait_available(arg.object_id, None)
+                if entry.error is not None:
+                    raise serialization.unpack(entry.error)
+                if entry.value is not None:
+                    arg.value = entry.value
+                    arg.object_id = None
+                    arg.owner_address = None
+                # plasma-resident args stay by-reference
+
+    async def _submit_once(self, spec: TaskSpec, attempt: int) -> bool:
+        """One lease + push attempt. Returns True when the task reached a
+        terminal state (success or non-retriable failure)."""
+        grant = await self._acquire_lease(spec)
+        raylet_addr = grant["raylet_address"]
+        lease_id = grant["lease_id"]
+        worker_addr = grant["worker_address"]
+        worker_failed = False
+        try:
+            worker = self.client_pool.get(*worker_addr)
+            reply: TaskReply = await worker.call(
+                "push_task", spec, timeout=None
+            )
+        except RpcError as e:
+            worker_failed = True
+            raise WorkerCrashedError(str(e)) from None
+        finally:
+            try:
+                raylet = self.client_pool.get(*raylet_addr)
+                await raylet.call("return_worker", lease_id, worker_failed)
+            except Exception:
+                pass
+        if reply.error is not None:
+            if reply.retriable_failure and attempt < spec.max_retries:
+                return False
+            err_obj = serialization.unpack(reply.error)
+            if not isinstance(err_obj, Exception):
+                err_obj = TaskError(spec.function.qualname, str(err_obj))
+            if spec.retry_exceptions and attempt < spec.max_retries:
+                return False
+            self._fail_task(spec, err_obj)
+            return True
+        self._process_reply(spec, reply)
+        return True
+
+    async def _acquire_lease(self, spec: TaskSpec) -> dict:
+        """Request a worker lease, following spillback redirects (reference:
+        RequestNewWorkerIfNeeded + spillback handling)."""
+        target = self.raylet_address
+        if isinstance(spec.scheduling_strategy, PlacementGroupSchedulingStrategy):
+            bundle_node = await self._bundle_node_address(spec.scheduling_strategy)
+            if bundle_node is not None:
+                target = bundle_node
+        spillbacks = 0
+        infeasible_warned = False
+        while True:
+            raylet = self.client_pool.get(*target)
+            reply = await raylet.call("request_worker_lease", spec, timeout=None)
+            if reply.get("granted"):
+                reply["raylet_address"] = target
+                return reply
+            if "spillback" in reply:
+                spillbacks += 1
+                if spillbacks > self.config.max_lease_spillback:
+                    raise WorkerCrashedError(
+                        f"lease for {spec.task_id} spilled back too many times"
+                    )
+                _, target = reply["spillback"]
+                continue
+            if reply.get("infeasible"):
+                if not infeasible_warned:
+                    logger.warning(
+                        "task %s is infeasible: %s — waiting for cluster to change",
+                        spec.task_id, reply.get("reason"),
+                    )
+                    infeasible_warned = True
+                await asyncio.sleep(1.0)
+                continue
+            # transient rejection (e.g. no worker): brief backoff then retry
+            await asyncio.sleep(0.05)
+
+    async def _bundle_node_address(self, strategy: PlacementGroupSchedulingStrategy):
+        gcs = self.client_pool.get(*self.gcs_address)
+        for _ in range(600):
+            info = await gcs.call("get_placement_group", strategy.placement_group_id)
+            if info is None:
+                raise ValueError(
+                    f"placement group {strategy.placement_group_id} does not exist"
+                )
+            bundles = info.bundles
+            if strategy.bundle_index >= 0:
+                bundles = [info.bundles[strategy.bundle_index]]
+            for bundle in bundles:
+                if bundle.node_id is not None:
+                    node = await self._node_address(bundle.node_id)
+                    if node is not None:
+                        return node
+            await asyncio.sleep(0.1)
+        return None
+
+    async def _node_address(self, node_id: NodeID):
+        gcs = self.client_pool.get(*self.gcs_address)
+        nodes = await gcs.call("get_all_nodes")
+        for n in nodes:
+            if n.node_id == node_id and n.alive:
+                return n.address
+        return None
+
+    def _process_reply(self, spec: TaskSpec, reply: TaskReply):
+        for ret in reply.returns:
+            if ret.value is not None:
+                self.memory_store.put_value(ret.object_id, ret.value)
+            elif ret.in_plasma:
+                node_addr = ret.node_id
+                self.memory_store.put_plasma(ret.object_id, ret.size, node_addr)
+
+    def _fail_task(self, spec: TaskSpec, error: Exception):
+        packed = serialization.pack(error)
+        for oid in spec.return_object_ids():
+            self.memory_store.put_error(oid, packed)
+
+    # ------------------------------------------------------------------
+    # actor submission (reference: actor_task_submitter.h)
+    # ------------------------------------------------------------------
+
+    async def create_actor(self, spec: TaskSpec, detached: bool) -> ActorID:
+        state = _ActorClientState(spec.actor_id)
+        self._actors[spec.actor_id] = state
+        await self._subscriber.subscribe(
+            f"actor:{spec.actor_id.hex()}", self._on_actor_update
+        )
+        gcs = self.client_pool.get(*self.gcs_address)
+        info: ActorInfo = await gcs.call("register_actor", spec, detached)
+        state.state = info.state
+        if info.address:
+            state.address = info.address
+        return spec.actor_id
+
+    def attach_actor(self, actor_id: ActorID, info: Optional[ActorInfo] = None):
+        """Track an actor this process did not create (get_actor / handle
+        deserialization)."""
+        if actor_id in self._actors:
+            return
+        state = _ActorClientState(actor_id)
+        if info is not None:
+            state.state = info.state
+            state.address = info.address
+            state.death_cause = info.death_cause
+        self._actors[actor_id] = state
+
+        async def _sub():
+            await self._subscriber.subscribe(
+                f"actor:{actor_id.hex()}", self._on_actor_update
+            )
+            # re-fetch after subscribing to close the startup race
+            gcs = self.client_pool.get(*self.gcs_address)
+            latest = await gcs.call("get_actor", actor_id)
+            if latest is not None:
+                self._apply_actor_info(latest)
+
+        asyncio.ensure_future(_sub())
+
+    def _on_actor_update(self, channel, info: ActorInfo):
+        self._apply_actor_info(info)
+
+    def _apply_actor_info(self, info: ActorInfo):
+        state = self._actors.get(info.actor_id)
+        if state is None:
+            return
+        state.state = info.state
+        state.death_cause = info.death_cause
+        if info.state == ActorState.ALIVE and info.address is not None:
+            state.address = info.address
+            # New incarnation: the executor's per-caller sequence counters
+            # start at 0, so renumber the parked queue from 0 in FIFO order
+            # (ordering is preserved; only the epoch resets).
+            for i, (spec, _fut) in enumerate(state.queue):
+                spec.sequence_number = i
+            state.seq = len(state.queue)
+            asyncio.ensure_future(self._flush_actor_queue(state))
+        elif info.state == ActorState.DEAD:
+            state.address = None
+            while state.queue:
+                spec, fut = state.queue.popleft()
+                if not fut.done():
+                    fut.set_exception(
+                        ActorDiedError(info.actor_id, state.death_cause or "dead")
+                    )
+        else:
+            state.address = None
+
+    async def _flush_actor_queue(self, state: _ActorClientState):
+        while state.queue and state.address is not None:
+            spec, fut = state.queue.popleft()
+            asyncio.ensure_future(self._push_actor_task(state, spec, fut))
+
+    async def submit_actor_task(self, spec: TaskSpec) -> List[ObjectID]:
+        state = self._actors.get(spec.actor_id)
+        if state is None:
+            self.attach_actor(spec.actor_id)
+            state = self._actors[spec.actor_id]
+        return_ids = spec.return_object_ids()
+        for oid in return_ids:
+            self._owned.add(oid)
+            self.memory_store.entry(oid)
+        spec.sequence_number = state.seq
+        state.seq += 1
+        fut: asyncio.Future = self.loop.create_future()
+        if state.state == ActorState.DEAD:
+            fut.set_exception(ActorDiedError(spec.actor_id, state.death_cause))
+        elif state.address is None:
+            state.queue.append((spec, fut))
+        else:
+            asyncio.ensure_future(self._push_actor_task(state, spec, fut))
+        asyncio.ensure_future(self._finish_actor_task(spec, fut))
+        return return_ids
+
+    async def _push_actor_task(self, state, spec: TaskSpec, fut: asyncio.Future):
+        try:
+            worker = self.client_pool.get(*state.address)
+            reply = await worker.call("actor_task", spec, timeout=None)
+            if not fut.done():
+                fut.set_result(reply)
+        except RpcError:
+            # actor may be restarting: check authoritative state
+            gcs = self.client_pool.get(*self.gcs_address)
+            try:
+                info = await gcs.call("get_actor", spec.actor_id)
+            except Exception:
+                info = None
+            if info is not None and info.state in (
+                ActorState.RESTARTING,
+                ActorState.PENDING_CREATION,
+                ActorState.ALIVE,
+            ):
+                self._apply_actor_info(info)
+                if self._actor_retries_allowed(spec):
+                    state.queue.append((spec, fut))
+                    if info.state == ActorState.ALIVE:
+                        await self._flush_actor_queue(state)
+                    return
+            if not fut.done():
+                fut.set_exception(
+                    ActorDiedError(spec.actor_id, "connection lost")
+                )
+
+    def _actor_retries_allowed(self, spec: TaskSpec) -> bool:
+        if spec.max_task_retries == 0:
+            return False
+        if spec.max_task_retries > 0:
+            spec.max_task_retries -= 1
+        return True
+
+    async def _finish_actor_task(self, spec: TaskSpec, fut: asyncio.Future):
+        try:
+            reply: TaskReply = await fut
+        except Exception as e:  # noqa: BLE001
+            self._fail_task(spec, e)
+            return
+        if reply.error is not None:
+            err = serialization.unpack(reply.error)
+            if not isinstance(err, Exception):
+                err = TaskError(spec.function.qualname, str(err))
+            self._fail_task(spec, err)
+        else:
+            self._process_reply(spec, reply)
+
+    async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        gcs = self.client_pool.get(*self.gcs_address)
+        await gcs.call("kill_actor", actor_id, no_restart)
+
+    # ------------------------------------------------------------------
+    # execution side (reference: task_execution/, task_receiver.h)
+    # ------------------------------------------------------------------
+
+    async def _load_function(self, descriptor: FunctionDescriptor):
+        fn = self._function_cache.get(descriptor.function_hash)
+        if fn is None:
+            gcs = self.client_pool.get(*self.gcs_address)
+            raw = await gcs.call("kv_get", f"fn:{descriptor.function_hash}")
+            if raw is None:
+                raise TaskError(
+                    descriptor.qualname, "function definition not found in GCS"
+                )
+            fn = serialization.loads(raw)
+            self._function_cache[descriptor.function_hash] = fn
+        return fn
+
+    async def _handle_push_task(self, spec: TaskSpec) -> TaskReply:
+        """Execute a normal task and reply with its returns."""
+        prev_task = self._current_task_id
+        self._current_task_id = spec.task_id
+        try:
+            fn = await self._load_function(spec.function)
+            args, kwargs = await self._unflatten(spec)
+            try:
+                result = await self._run_user_code(fn, args, kwargs, spec)
+            except Exception as e:  # noqa: BLE001
+                return self._error_reply(spec, e)
+            return await self._build_reply(spec, result)
+        except Exception as e:  # noqa: BLE001 — system error: retriable
+            logger.exception("system error executing %s", spec.task_id)
+            return TaskReply(
+                task_id=spec.task_id,
+                returns=[],
+                error=serialization.pack(e),
+                retriable_failure=True,
+            )
+        finally:
+            self._current_task_id = prev_task
+
+    async def _unflatten(self, spec: TaskSpec) -> tuple:
+        """Reconstruct (args, kwargs): TaskArg[0] carries the pickled
+        structure with _ArgPlaceholder markers; the rest are by-ref values."""
+        from ..._internal.args import ArgPlaceholder, reconstruct
+
+        structure = serialization.unpack(spec.args[0].value)
+        resolved = []
+        for arg in spec.args[1:]:
+            if arg.value is not None:
+                resolved.append(serialization.unpack(arg.value))
+            else:
+                ref = ObjectRef(arg.object_id, arg.owner_address, _register=False)
+                resolved.append(await self._get_one(ref, None))
+        return reconstruct(structure, resolved)
+
+    async def _run_user_code(self, fn, args, kwargs, spec: TaskSpec):
+        if asyncio.iscoroutinefunction(fn):
+            return await fn(*args, **kwargs)
+        return await self.loop.run_in_executor(
+            self._executor_pool, lambda: fn(*args, **kwargs)
+        )
+
+    def _error_reply(self, spec: TaskSpec, exc: Exception) -> TaskReply:
+        err = TaskError.from_exception(spec.function.qualname, exc)
+        try:
+            packed = serialization.pack(err)
+        except Exception:
+            # unpicklable cause: ship the traceback text only
+            err.cause = None
+            packed = serialization.pack(err)
+        return TaskReply(
+            task_id=spec.task_id,
+            returns=[],
+            error=packed,
+            retriable_failure=False,
+        )
+
+    async def _build_reply(self, spec: TaskSpec, result) -> TaskReply:
+        if spec.num_returns == 1:
+            results = [result]
+        elif spec.num_returns == 0:
+            results = []
+        else:
+            results = list(result)
+            if len(results) != spec.num_returns:
+                return self._error_reply(
+                    spec,
+                    ValueError(
+                        f"task returned {len(results)} values, expected "
+                        f"{spec.num_returns}"
+                    ),
+                )
+        returns = []
+        for index, value in enumerate(results):
+            object_id = ObjectID.for_task_return(spec.task_id, index)
+            meta, bufs = serialization.serialize(value)
+            size = serialization.packed_size(meta, bufs)
+            if size <= self.config.max_direct_call_object_size:
+                packed = bytearray(size)
+                serialization.pack_into(meta, bufs, memoryview(packed))
+                returns.append(
+                    ReturnObject(object_id=object_id, value=bytes(packed), size=size)
+                )
+            else:
+                await self._put_plasma(object_id, meta, bufs, size, primary=True)
+                returns.append(
+                    ReturnObject(
+                        object_id=object_id,
+                        in_plasma=True,
+                        node_id=self.raylet_address,
+                        size=size,
+                    )
+                )
+        return TaskReply(task_id=spec.task_id, returns=returns, error=None)
+
+    # -- actor execution ---------------------------------------------------
+
+    async def _handle_create_actor(self, spec: TaskSpec):
+        gcs = self.client_pool.get(*self.gcs_address)
+        raw = await gcs.call("kv_get", f"fn:{spec.function.function_hash}")
+        if raw is None:
+            raise RuntimeError("actor class not found in GCS function table")
+        cls = serialization.loads(raw)
+        args, kwargs = await self._unflatten(spec)
+        if spec.max_concurrency > 1:
+            self._executor_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=spec.max_concurrency
+            )
+        instance = await self.loop.run_in_executor(
+            self._executor_pool, lambda: cls(*args, **kwargs)
+        )
+        self._actor_instance = instance
+        self._actor_spec = spec
+        return True
+
+    async def _handle_actor_task(self, spec: TaskSpec) -> TaskReply:
+        """Per-caller in-order execution (reference: ActorSchedulingQueue
+        sequencing by client seq-no)."""
+        caller = spec.owner_worker_id
+        expected = self._caller_expected_seq[caller]
+        if spec.sequence_number != expected:
+            # park until predecessors arrive
+            parked = self._caller_parked[caller]
+            ev = asyncio.Event()
+            parked[spec.sequence_number] = ev
+            await ev.wait()
+        reply = await self._execute_actor_task(spec)
+        self._caller_expected_seq[caller] = spec.sequence_number + 1
+        nxt = self._caller_parked[caller].pop(spec.sequence_number + 1, None)
+        if nxt is not None:
+            nxt.set()
+        return reply
+
+    async def _execute_actor_task(self, spec: TaskSpec) -> TaskReply:
+        if self._actor_instance is None:
+            return self._error_reply(spec, RuntimeError("actor not initialized"))
+        method = getattr(self._actor_instance, spec.function.qualname, None)
+        if method is None:
+            return self._error_reply(
+                spec, AttributeError(f"actor has no method {spec.function.qualname}")
+            )
+        try:
+            args, kwargs = await self._unflatten(spec)
+        except Exception as e:  # noqa: BLE001
+            return self._error_reply(spec, e)
+        max_conc = self._actor_spec.max_concurrency if self._actor_spec else 1
+        try:
+            if asyncio.iscoroutinefunction(method):
+                result = await method(*args, **kwargs)
+            elif max_conc > 1:
+                result = await self.loop.run_in_executor(
+                    self._executor_pool, lambda: method(*args, **kwargs)
+                )
+            else:
+                async with self._execution_lock:
+                    result = await self.loop.run_in_executor(
+                        self._executor_pool, lambda: method(*args, **kwargs)
+                    )
+        except Exception as e:  # noqa: BLE001
+            return self._error_reply(spec, e)
+        return await self._build_reply(spec, result)
+
+    async def _handle_exit_worker(self):
+        self._exit_requested = True
+        self.loop.call_later(0.05, os._exit, 0)
+        return True
+
+
+_PENDING = object()

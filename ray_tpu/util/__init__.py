@@ -1,0 +1,27 @@
+"""ray_tpu.util: placement groups, scheduling strategies, TPU slices, helpers."""
+
+from .actor_pool import ActorPool
+from .placement_group import (
+    PlacementGroup,
+    get_placement_group,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from .scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "ActorPool",
+    "PlacementGroup",
+    "placement_group",
+    "remove_placement_group",
+    "get_placement_group",
+    "placement_group_table",
+    "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+]

@@ -1,0 +1,90 @@
+"""ActorPool: round-robin work distribution over a fixed set of actors.
+
+Role-equivalent of the reference's ray.util.ActorPool (util/actor_pool.py):
+submit/map over idle actors, results retrievable in completion or submission
+order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+from .. import api
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    def submit(self, fn: Callable, value: Any):
+        """fn(actor, value) -> ObjectRef; queued if all actors are busy."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def get_next(self, timeout=None) -> Any:
+        """Next result in submission order."""
+        if self._next_return_index not in self._index_to_future:
+            raise StopIteration("no pending results")
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        value = api.get(ref, timeout=timeout)
+        self._return_actor(ref)
+        return value
+
+    def get_next_unordered(self, timeout=None) -> Any:
+        """Next result in completion order."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        ready, _ = api.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        index, _ = self._future_to_actor[ref]
+        self._index_to_future.pop(index, None)
+        value = api.get(ref)
+        self._return_actor(ref)
+        return value
+
+    def _return_actor(self, ref):
+        _, actor = self._future_to_actor.pop(ref)
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self._future_to_actor or self._pending_submits:
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None
+
+    def push(self, actor):
+        self._idle.append(actor)

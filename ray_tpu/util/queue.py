@@ -1,0 +1,118 @@
+"""Distributed FIFO queue backed by an actor.
+
+Role-equivalent of the reference's ray.util.queue.Queue (util/queue.py):
+a bounded multi-producer/multi-consumer queue usable from any task or actor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+from .. import api
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._queue = asyncio.Queue(maxsize)
+
+    async def put(self, item, timeout=None):
+        try:
+            if timeout is None:
+                await self._queue.put(item)
+            else:
+                await asyncio.wait_for(self._queue.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout=None):
+        try:
+            if timeout is None:
+                return True, await self._queue.get()
+            return True, await asyncio.wait_for(self._queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    async def put_nowait(self, item):
+        try:
+            self._queue.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def get_nowait(self):
+        try:
+            return True, self._queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    async def qsize(self):
+        return self._queue.qsize()
+
+    async def empty(self):
+        return self._queue.empty()
+
+    async def full(self):
+        return self._queue.full()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        options = dict(actor_options or {})
+        options.setdefault("num_cpus", 0)
+        cls = api.remote(_QueueActor)
+        self._actor = cls.options(**options).remote(maxsize)
+
+    def put(self, item: Any, block: bool = True, timeout: Optional[float] = None):
+        if not block:
+            ok = api.get(self._actor.put_nowait.remote(item))
+            if not ok:
+                raise Full
+            return
+        ok = api.get(self._actor.put.remote(item, timeout))
+        if not ok:
+            raise Full
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = api.get(self._actor.get_nowait.remote())
+            if not ok:
+                raise Empty
+            return item
+        ok, item = api.get(self._actor.get.remote(timeout))
+        if not ok:
+            raise Empty
+        return item
+
+    def put_nowait(self, item: Any):
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return api.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return api.get(self._actor.empty.remote())
+
+    def full(self) -> bool:
+        return api.get(self._actor.full.remote())
+
+    def put_async(self, item):
+        return self._actor.put.remote(item, None)
+
+    def get_async(self):
+        return self._actor.get.remote(None)
+
+    def shutdown(self):
+        api.kill(self._actor)

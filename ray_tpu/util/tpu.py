@@ -1,0 +1,169 @@
+"""TPU slice reservation.
+
+Role-equivalent of the reference's ray.util.tpu + reserve_tpu_slice
+(_private/accelerators/tpu.py:213, util/tpu.py:52,227): reserve every host of
+an ICI-connected TPU slice through one placement group so gang workloads land
+on one ICI domain, and reserve several slices for multislice (DCN) jobs.
+
+Mechanism (mirrors the reference):
+1. place a 1-bundle PG on the slice's head resource ``TPU-<pod_type>-head``
+   — only worker 0 of a slice advertises it, so winning that bundle claims
+   the slice;
+2. read the winning node's ``ray.io/tpu-slice-name`` label;
+3. build the worker gang as per-host bundles with a
+   ``bundle_label_selector={ray.io/tpu-slice-name: <name>}`` so all ranked
+   workers pin to that slice's hosts.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from .. import _worker_api
+from .._internal.accelerators import (
+    TPU_SLICE_NAME_LABEL,
+    chips_per_host,
+    pod_type_num_hosts,
+    tpu_head_resource,
+)
+from .placement_group import PlacementGroup, placement_group, remove_placement_group
+
+logger = logging.getLogger(__name__)
+
+
+class SliceReservation:
+    """One reserved slice: the head PG plus the worker-gang PG."""
+
+    def __init__(
+        self,
+        pod_type: str,
+        slice_name: str,
+        head_pg: PlacementGroup,
+        workers_pg: PlacementGroup,
+    ):
+        self.pod_type = pod_type
+        self.slice_name = slice_name
+        self.head_pg = head_pg
+        self.workers_pg = workers_pg
+
+    @property
+    def num_hosts(self) -> int:
+        return pod_type_num_hosts(self.pod_type)
+
+    @property
+    def chips_per_host(self) -> int:
+        return chips_per_host(self.pod_type)
+
+    @property
+    def placement_group(self) -> PlacementGroup:
+        return self.workers_pg
+
+    def bundle_label_selector(self) -> Dict[str, str]:
+        return {TPU_SLICE_NAME_LABEL: self.slice_name}
+
+    def release(self):
+        remove_placement_group(self.workers_pg)
+        remove_placement_group(self.head_pg)
+
+
+def reserve_tpu_slice(
+    pod_type: str,
+    *,
+    extra_worker_resources: Optional[Dict[str, float]] = None,
+    timeout: Optional[float] = 60.0,
+) -> SliceReservation:
+    """Reserve one whole slice of ``pod_type`` (e.g. "v5e-16").
+
+    Reference flow: reserve_tpu_slice (_private/accelerators/tpu.py:213) —
+    head-resource PG, slice-name lookup, label-selector gang.
+    """
+    head_pg = placement_group(
+        [{tpu_head_resource(pod_type): 1.0}], strategy="STRICT_PACK",
+    )
+    if not head_pg.ready(timeout=timeout):
+        remove_placement_group(head_pg)
+        raise TimeoutError(f"no free {pod_type} slice available")
+    info = head_pg.info()
+    head_node = info.bundles[0].node_id
+    slice_name = _node_label(head_node, TPU_SLICE_NAME_LABEL)
+    if slice_name is None:
+        remove_placement_group(head_pg)
+        raise RuntimeError(
+            f"slice head node {head_node} lacks {TPU_SLICE_NAME_LABEL} label"
+        )
+    num_hosts = pod_type_num_hosts(pod_type)
+    per_host = {"TPU": float(chips_per_host(pod_type))}
+    per_host.update(extra_worker_resources or {})
+    workers_pg = placement_group(
+        [dict(per_host) for _ in range(num_hosts)],
+        strategy="STRICT_SPREAD" if num_hosts > 1 else "STRICT_PACK",
+        bundle_label_selector=[
+            {TPU_SLICE_NAME_LABEL: slice_name} for _ in range(num_hosts)
+        ],
+    )
+    if not workers_pg.ready(timeout=timeout):
+        remove_placement_group(workers_pg)
+        remove_placement_group(head_pg)
+        raise TimeoutError(f"could not reserve all {num_hosts} hosts of {slice_name}")
+    logger.info("reserved TPU slice %s (%s, %d hosts)", slice_name, pod_type, num_hosts)
+    return SliceReservation(pod_type, slice_name, head_pg, workers_pg)
+
+
+class SlicePlacementGroup:
+    """Multislice reservation: N whole slices for a DCN-spanning job
+    (reference: ray.util.tpu.SlicePlacementGroup util/tpu.py:52)."""
+
+    def __init__(
+        self,
+        num_slices: int,
+        pod_type: str,
+        *,
+        timeout: Optional[float] = 120.0,
+    ):
+        self.num_slices = num_slices
+        self.pod_type = pod_type
+        self._reservations: List[SliceReservation] = []
+        try:
+            for _ in range(num_slices):
+                self._reservations.append(
+                    reserve_tpu_slice(pod_type, timeout=timeout)
+                )
+        except Exception:
+            self.release()
+            raise
+
+    @property
+    def reservations(self) -> List[SliceReservation]:
+        return list(self._reservations)
+
+    @property
+    def slice_names(self) -> List[str]:
+        return [r.slice_name for r in self._reservations]
+
+    @property
+    def num_hosts_per_slice(self) -> int:
+        return pod_type_num_hosts(self.pod_type)
+
+    def release(self):
+        for r in self._reservations:
+            try:
+                r.release()
+            except Exception:
+                pass
+        self._reservations.clear()
+
+
+def slice_placement_group(num_slices: int, pod_type: str, **kwargs) -> SlicePlacementGroup:
+    return SlicePlacementGroup(num_slices, pod_type, **kwargs)
+
+
+def _node_label(node_id, key: str) -> Optional[str]:
+    worker = _worker_api.get_core_worker()
+    nodes = _worker_api.run_on_worker_loop(
+        worker.client_pool.get(*worker.gcs_address).call("get_all_nodes")
+    )
+    for n in nodes:
+        if n.node_id == node_id:
+            return n.labels.get(key)
+    return None

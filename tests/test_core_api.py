@@ -1,0 +1,193 @@
+"""Core public API: tasks, objects, actors (reference test model:
+python/ray/tests/test_basic.py, test_actor.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, resources={"TPU": 4})
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_roundtrip(cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2), timeout=120) == 3
+
+
+def test_task_graph_by_ref(cluster):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    @ray_tpu.remote
+    def total(*xs):
+        return sum(xs)
+
+    refs = [sq.remote(i) for i in range(8)]
+    assert ray_tpu.get(total.remote(*refs), timeout=120) == sum(i * i for i in range(8))
+
+
+def test_put_get_small_and_large(cluster):
+    small = ray_tpu.put({"a": 1})
+    assert ray_tpu.get(small, timeout=60) == {"a": 1}
+    arr = np.arange(300_000, dtype=np.float32)  # > 100KB -> plasma
+    big = ray_tpu.put(arr)
+    np.testing.assert_array_equal(ray_tpu.get(big, timeout=60), arr)
+
+
+def test_plasma_task_returns(cluster):
+    @ray_tpu.remote
+    def make():
+        return np.ones((512, 512))
+
+    @ray_tpu.remote
+    def consume(a):
+        return float(a.sum())
+
+    ref = make.remote()
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == 512 * 512
+
+
+def test_error_propagation(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError) as exc_info:
+        ray_tpu.get(boom.remote(), timeout=120)
+    assert isinstance(exc_info.value.cause, ValueError)
+    assert "kaboom" in str(exc_info.value)
+
+
+def test_num_returns(cluster):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c], timeout=120) == [1, 2, 3]
+
+
+def test_wait(cluster):
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        import time
+
+        time.sleep(30)
+        return 2
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=60)
+    assert ready == [f] and not_ready == [s]
+
+
+def test_nested_tasks(cluster):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) * 10
+
+    assert ray_tpu.get(outer.remote(3), timeout=120) == 40
+
+
+def test_actor_basic_and_ordering(cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def inc(self, n=1):
+            self.v += n
+            return self.v
+
+    c = Counter.remote(100)
+    vals = ray_tpu.get([c.inc.remote() for _ in range(10)], timeout=120)
+    assert vals == list(range(101, 111))
+
+
+def test_actor_exceptions(cluster):
+    @ray_tpu.remote
+    class Crashy:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return "fine"
+
+    a = Crashy.remote()
+    with pytest.raises(TaskError):
+        ray_tpu.get(a.fail.remote(), timeout=120)
+    # actor survives its own exceptions
+    assert ray_tpu.get(a.ok.remote(), timeout=120) == "fine"
+
+
+def test_named_actor_get_actor(cluster):
+    @ray_tpu.remote
+    class Registry:
+        def whoami(self):
+            return "registry"
+
+    original = Registry.options(name="reg1").remote()
+    handle = ray_tpu.get_actor("reg1")
+    assert ray_tpu.get(handle.whoami.remote(), timeout=120) == "registry"
+    del original  # handle GC terminates the non-detached actor
+
+
+def test_actor_handle_passing(cluster):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = {}
+
+        def set(self, k, v):
+            self.v[k] = v
+            return True
+
+        def get(self, k):
+            return self.v.get(k)
+
+    @ray_tpu.remote
+    def writer(store):
+        return ray_tpu.get(store.set.remote("x", 42))
+
+    s = Store.remote()
+    assert ray_tpu.get(writer.remote(s), timeout=120)
+    assert ray_tpu.get(s.get.remote("x"), timeout=120) == 42
+
+
+def test_kill_actor(cluster):
+    from ray_tpu.exceptions import ActorDiedError
+
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote(), timeout=120) == "pong"
+    ray_tpu.kill(v)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(v.ping.remote(), timeout=120)
+
+
+def test_cluster_resources(cluster):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4.0
+    assert total["TPU"] == 4.0
+    assert len(ray_tpu.nodes()) == 1

@@ -1,0 +1,173 @@
+"""Multi-node scheduling, placement groups, TPU slice reservation
+(reference test model: tests using cluster_utils.Cluster, tests/accelerators/
+test_tpu.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu._internal.accelerators import (
+    TPU_POD_TYPE_LABEL,
+    TPU_SLICE_NAME_LABEL,
+    TPU_WORKER_ID_LABEL,
+)
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+@pytest.fixture(scope="module")
+def tpu_cluster():
+    """Head + a fake 2-host v5e-16 slice (8 chips per host)."""
+    cluster = Cluster(head_node_args=dict(num_cpus=2))
+    for worker_id in range(2):
+        labels = {
+            TPU_SLICE_NAME_LABEL: "slice-a",
+            TPU_WORKER_ID_LABEL: str(worker_id),
+            TPU_POD_TYPE_LABEL: "v5e-16",
+        }
+        resources = {"TPU": 8.0, "CPU": 2.0}
+        if worker_id == 0:
+            resources["TPU-v5e-16-head"] = 1.0
+        cluster.add_node(resources=resources, labels=labels)
+    cluster.connect()
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_cluster_sees_all_nodes(tpu_cluster):
+    assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == 3
+    total = ray_tpu.cluster_resources()
+    assert total["TPU"] == 16.0
+    assert total["TPU-v5e-16-head"] == 1.0
+
+
+def test_remote_node_execution(tpu_cluster):
+    @ray_tpu.remote(num_cpus=0, num_tpus=1)
+    def which_node():
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    # requires TPU -> must run on a slice host, not the head
+    node_env = ray_tpu.get(which_node.remote(), timeout=120)
+    tpu_nodes = {
+        n["NodeID"] for n in ray_tpu.nodes() if n["Resources"].get("TPU")
+    }
+    assert node_env in tpu_nodes
+
+
+def test_node_affinity(tpu_cluster):
+    nodes = [n for n in ray_tpu.nodes() if n["Resources"].get("TPU")]
+    target = nodes[1]["NodeID"]
+
+    @ray_tpu.remote(num_cpus=0)
+    def whoami():
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    got = ray_tpu.get(
+        whoami.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=target)
+        ).remote(),
+        timeout=120,
+    )
+    assert got == target
+
+
+def test_label_selector(tpu_cluster):
+    @ray_tpu.remote(num_cpus=0, label_selector={TPU_WORKER_ID_LABEL: "1"})
+    def on_worker_1():
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    got = ray_tpu.get(on_worker_1.remote(), timeout=120)
+    by_id = {n["NodeID"]: n for n in ray_tpu.nodes()}
+    assert by_id[got]["Labels"][TPU_WORKER_ID_LABEL] == "1"
+
+
+def test_placement_group_strict_spread(tpu_cluster):
+    pg = placement_group(
+        [{"TPU": 4.0}, {"TPU": 4.0}],
+        strategy="STRICT_SPREAD",
+        bundle_label_selector=[
+            {TPU_SLICE_NAME_LABEL: "slice-a"},
+            {TPU_SLICE_NAME_LABEL: "slice-a"},
+        ],
+    )
+    assert pg.ready(timeout=60)
+    node_ids = pg.bundle_node_ids()
+    assert len(set(node_ids)) == 2
+
+    @ray_tpu.remote(num_cpus=0, num_tpus=2)
+    def in_bundle():
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    got = ray_tpu.get(
+        in_bundle.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=1
+            )
+        ).remote(),
+        timeout=120,
+    )
+    assert got == node_ids[1]
+    remove_placement_group(pg)
+
+
+def test_placement_group_infeasible_strict_pack(tpu_cluster):
+    # 16 chips cannot strictly pack on one 8-chip host
+    pg = placement_group([{"TPU": 16.0}], strategy="STRICT_PACK")
+    assert not pg.ready(timeout=2)
+    remove_placement_group(pg)
+
+
+def test_reserve_tpu_slice(tpu_cluster):
+    from ray_tpu.util.tpu import reserve_tpu_slice
+
+    reservation = reserve_tpu_slice("v5e-16", timeout=60)
+    assert reservation.slice_name == "slice-a"
+    assert reservation.num_hosts == 2
+    assert reservation.chips_per_host == 8
+    # whole slice reserved: another reservation must time out
+    with pytest.raises(TimeoutError):
+        reserve_tpu_slice("v5e-16", timeout=2)
+    reservation.release()
+    # after release it works again
+    again = reserve_tpu_slice("v5e-16", timeout=60)
+    assert again.slice_name == "slice-a"
+    again.release()
+
+
+def test_cross_node_object_transfer(tpu_cluster):
+    import numpy as np
+
+    nodes = [n for n in ray_tpu.nodes() if n["Resources"].get("TPU")]
+
+    @ray_tpu.remote(num_cpus=0)
+    def produce():
+        return np.full((600, 600), 7.0)
+
+    @ray_tpu.remote(num_cpus=0)
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=nodes[0]["NodeID"])
+    ).remote()
+    out = ray_tpu.get(
+        consume.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nodes[1]["NodeID"]
+            )
+        ).remote(ref),
+        timeout=120,
+    )
+    assert out == 7.0 * 600 * 600
