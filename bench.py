@@ -1,0 +1,103 @@
+"""Benchmark: Llama-family training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Measures tokens/sec for full train steps (fwd + bwd + adamw) on a scaled
+Llama config in bfloat16 with the Pallas flash-attention kernel. K steps run
+inside one jitted lax.scan so device compute dominates and per-dispatch
+tunnel/host latency is amortized away.
+
+The reference publishes no throughput numbers (BASELINE.md: "published" is
+empty), so vs_baseline is the ratio against a fixed MFU target recorded
+below — it rises as the kernels/schedule improve across rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.llama import LlamaConfig, init_params, next_token_loss
+    from ray_tpu.parallel.sharding import unbox_params
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, dim=1024, n_layers=8, n_heads=16, n_kv_heads=16,
+            intermediate=2816, max_seq_len=1024, remat=False,
+        )
+        batch, steps = 8, 20
+    else:  # smoke fallback for dev boxes
+        cfg = LlamaConfig.tiny()
+        batch, steps = 2, 3
+    seq = cfg.max_seq_len
+
+    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
+    optimizer = optax.adamw(1e-3)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(p, tokens):
+        return next_token_loss(cfg, None, p, tokens)
+
+    def one_step(carry, tokens):
+        p, s = carry
+        loss, grads = jax.value_and_grad(loss_fn)(p, tokens)
+        updates, s2 = optimizer.update(grads, s, p)
+        return (optax.apply_updates(p, updates), s2), loss
+
+    @jax.jit
+    def run(p, s, data):
+        (p2, s2), losses = jax.lax.scan(one_step, (p, s), data)
+        return p2, s2, losses
+
+    # Timing through the remote-execution tunnel: block_until_ready does not
+    # round-trip, so force scalar materialization, and cancel the fixed
+    # dispatch overhead by timing two different step counts and using the
+    # slope (dt(2K steps) - dt(K steps)) / K.
+    def timed(n_steps, seed):
+        def make_data(s):
+            return jax.random.randint(
+                jax.random.PRNGKey(s), (n_steps, batch, seq), 0, cfg.vocab_size
+            )
+
+        _, _, losses = run(params, opt_state, make_data(seed + 1000))
+        float(losses[-1])  # compile + warm
+        # time with DIFFERENT data: the tunnel may serve repeated identical
+        # dispatches from cache
+        t0 = time.perf_counter()
+        _, _, losses = run(params, opt_state, make_data(seed))
+        float(losses[-1])
+        return time.perf_counter() - t0
+
+    t_short = timed(steps, seed=1)
+    t_long = timed(2 * steps, seed=2)
+    dt = max(t_long - t_short, 1e-9)
+
+    tokens_per_sec = steps * batch * seq / dt
+
+    # rough model FLOPs/token (6 * params for fwd+bwd, attention extra)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.dim * seq * 0.5
+    achieved = tokens_per_sec * flops_per_token
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
+    mfu = achieved / peak
+    # vs_baseline: achieved MFU against a 40% MFU target for this model size
+    vs_baseline = mfu / 0.40
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""ray_tpu.collective: collective communication.
+
+Role-equivalent of ray.util.collective (util/collective/collective.py:182-752)
+with the NCCL backend replaced by XLA collectives over ICI. Groups are
+registered per process under a name; tasks/actors in the same group call the
+module-level ops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .base import BaseGroup, ReduceOp
+from .cpu_group import GcsStoreGroup
+from .xla_group import XlaGroup
+
+_groups: Dict[str, BaseGroup] = {}
+
+_BACKENDS = {
+    "gcs": GcsStoreGroup,  # host tensors through the GCS KV (gloo role)
+    "cpu": GcsStoreGroup,
+    "xla": XlaGroup,  # device tensors over ICI (nccl role)
+}
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "xla",
+    group_name: str = "default",
+    **kwargs,
+) -> BaseGroup:
+    """Imperative group init, called by every member (reference:
+    collective.py:182)."""
+    if group_name in _groups:
+        raise ValueError(f"collective group {group_name!r} already exists")
+    cls = _BACKENDS[backend]
+    group = cls(world_size, rank, group_name, **kwargs)
+    _groups[group_name] = group
+    return group
+
+
+def create_collective_group(
+    actors: List,
+    world_size: int,
+    ranks: List[int],
+    backend: str = "gcs",
+    group_name: str = "default",
+):
+    """Declarative init: make every actor in ``actors`` join the group
+    (reference: collective.py:222). Uses the executor's reserved
+    ``__init_collective__`` actor-task hook, so actor classes need no
+    special method."""
+    from .. import api
+    from ..actor import ActorMethod
+
+    refs = [
+        ActorMethod(actor, "__init_collective__", {}).remote(
+            world_size, rank, backend, group_name
+        )
+        for actor, rank in zip(actors, ranks)
+    ]
+    return api.get(refs)
+
+
+def get_group(group_name: str = "default") -> BaseGroup:
+    group = _groups.get(group_name)
+    if group is None:
+        raise ValueError(
+            f"no collective group {group_name!r} in this process; call "
+            "init_collective_group first"
+        )
+    return group
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def destroy_collective_group(group_name: str = "default"):
+    group = _groups.pop(group_name, None)
+    if group is not None:
+        group.destroy()
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return get_group(group_name).allreduce(tensor, op)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return get_group(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return get_group(group_name).reducescatter(tensor, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(tensor, src_rank)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    return get_group(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return get_group(group_name).recv(src_rank)
+
+
+def barrier(group_name: str = "default"):
+    return get_group(group_name).barrier()
+
+
+__all__ = [
+    "BaseGroup", "ReduceOp", "GcsStoreGroup", "XlaGroup",
+    "init_collective_group", "create_collective_group",
+    "destroy_collective_group", "get_group", "is_group_initialized",
+    "allreduce", "allgather", "reducescatter", "broadcast",
+    "send", "recv", "barrier",
+]

@@ -1,0 +1,59 @@
+"""Collective group ABC.
+
+Role-equivalent of the reference's BaseGroup
+(util/collective/collective_group/base_collective_group.py:16) with the same
+five-op surface plus send/recv/barrier. Backends: the GCS-KV CPU group
+(tests, control-plane tensors — the gloo role) and the XLA/ICI group (device
+tensors lowering to jax.lax collectives — the NCCL role).
+"""
+
+from __future__ import annotations
+
+import enum
+from abc import ABC, abstractmethod
+from typing import Any, List
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "prod"
+    MIN = "min"
+    MAX = "max"
+
+
+class BaseGroup(ABC):
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+
+    @abstractmethod
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        ...
+
+    @abstractmethod
+    def allgather(self, tensor) -> List[Any]:
+        ...
+
+    @abstractmethod
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        """Input: full tensor on each rank; returns this rank's reduced shard."""
+
+    @abstractmethod
+    def broadcast(self, tensor, src_rank: int = 0):
+        ...
+
+    @abstractmethod
+    def send(self, tensor, dst_rank: int):
+        ...
+
+    @abstractmethod
+    def recv(self, src_rank: int):
+        ...
+
+    @abstractmethod
+    def barrier(self):
+        ...
+
+    def destroy(self):
+        pass

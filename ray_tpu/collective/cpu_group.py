@@ -1,0 +1,148 @@
+"""Host-tensor collective backend over the GCS KV store.
+
+Role-equivalent of the reference's TorchGLOOGroup (util/collective — the CPU
+fallback backend): correct, dependency-free collectives for numpy/host
+arrays, rendezvoused and transported through the GCS internal KV (the same
+rendezvous channel the reference uses for NCCL unique ids,
+nccl_collective_group.py:29). Suitable for control-plane payloads and tests,
+not the tensor fast path — that's the XLA group.
+
+Protocol: every op gets a monotonically increasing sequence number agreed by
+construction order; rank r writes ``col:<group>:<seq>:<phase>:<r>`` and polls
+for peers. Keys from finished ops are deleted by rank 0 two ops later.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List
+
+import numpy as np
+
+from .. import _worker_api
+from .._internal import serialization
+from .base import BaseGroup, ReduceOp
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda arrs: np.sum(arrs, axis=0),
+    ReduceOp.PRODUCT: lambda arrs: np.prod(arrs, axis=0),
+    ReduceOp.MIN: lambda arrs: np.min(arrs, axis=0),
+    ReduceOp.MAX: lambda arrs: np.max(arrs, axis=0),
+}
+
+
+def _kv_call(method, *args):
+    worker = _worker_api.get_core_worker()
+    client = worker.client_pool.get(*worker.gcs_address)
+    return _worker_api.run_on_worker_loop(client.call(method, *args))
+
+
+class GcsStoreGroup(BaseGroup):
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        self._seq = 0
+        # point-to-point ops use per-(src,dst) counters so they don't
+        # desynchronize the group-wide collective sequence
+        self._p2p_seq = {}
+
+    def _key(self, seq: int, phase: str, rank: int) -> str:
+        return f"col:{self.group_name}:{seq}:{phase}:{rank}"
+
+    def _put(self, seq: int, phase: str, value: Any):
+        _kv_call("kv_put", self._key(seq, phase, self.rank),
+                 serialization.pack(value), True)
+
+    def _get_blocking(self, seq: int, phase: str, rank: int, timeout=120.0):
+        key = self._key(seq, phase, rank)
+        deadline = time.time() + timeout
+        delay = 0.002
+        while time.time() < deadline:
+            raw = _kv_call("kv_get", key)
+            if raw is not None:
+                return serialization.unpack(raw)
+            time.sleep(delay)
+            delay = min(delay * 1.5, 0.1)
+        raise TimeoutError(f"collective {self.group_name} seq={seq} rank={rank}")
+
+    def _gather_all(self, seq: int, phase: str) -> List[Any]:
+        return [
+            self._get_blocking(seq, phase, r) for r in range(self.world_size)
+        ]
+
+    def _cleanup(self, seq: int):
+        if self.rank == 0 and seq >= 2:
+            old = seq - 2
+            for phase in ("d", "s"):
+                for r in range(self.world_size):
+                    _kv_call("kv_del", self._key(old, phase, r))
+
+    def _next_seq(self) -> int:
+        seq = self._seq
+        self._seq += 1
+        self._cleanup(seq)
+        return seq
+
+    # -- ops ---------------------------------------------------------------
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        seq = self._next_seq()
+        arr = np.asarray(tensor)
+        self._put(seq, "d", arr)
+        return _REDUCERS[op](self._gather_all(seq, "d"))
+
+    def allgather(self, tensor) -> List[Any]:
+        seq = self._next_seq()
+        self._put(seq, "d", np.asarray(tensor))
+        return self._gather_all(seq, "d")
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        reduced = self.allreduce(tensor, op)
+        shards = np.array_split(reduced, self.world_size, axis=0)
+        return shards[self.rank]
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        seq = self._next_seq()
+        if self.rank == src_rank:
+            self._put(seq, "d", np.asarray(tensor))
+            return np.asarray(tensor)
+        return self._get_blocking(seq, "d", src_rank)
+
+    def _p2p_key(self, src: int, dst: int) -> tuple:
+        n = self._p2p_seq.get((src, dst), 0)
+        self._p2p_seq[(src, dst)] = n + 1
+        return n
+
+    def send(self, tensor, dst_rank: int):
+        n = self._p2p_key(self.rank, dst_rank)
+        key = f"col:{self.group_name}:p2p:{self.rank}:{dst_rank}:{n}"
+        _kv_call("kv_put", key, serialization.pack(np.asarray(tensor)), True)
+
+    def recv(self, src_rank: int):
+        n = self._p2p_key(src_rank, self.rank)
+        key = f"col:{self.group_name}:p2p:{src_rank}:{self.rank}:{n}"
+        deadline = time.time() + 120.0
+        delay = 0.002
+        while time.time() < deadline:
+            raw = _kv_call("kv_get", key)
+            if raw is not None:
+                _kv_call("kv_del", key)
+                return serialization.unpack(raw)
+            time.sleep(delay)
+            delay = min(delay * 1.5, 0.1)
+        raise TimeoutError(
+            f"recv from rank {src_rank} in group {self.group_name}"
+        )
+
+    def barrier(self):
+        seq = self._next_seq()
+        self._put(seq, "s", 1)
+        self._gather_all(seq, "s")
+
+    def destroy(self):
+        for seq in range(max(0, self._seq - 2), self._seq):
+            for phase in ("d", "s"):
+                for r in range(self.world_size):
+                    try:
+                        _kv_call("kv_del", self._key(seq, phase, r))
+                    except Exception:
+                        pass

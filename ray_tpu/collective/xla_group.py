@@ -1,0 +1,213 @@
+"""XLA/ICI collective backend — the tensor fast path.
+
+Role-equivalent of the reference's NCCLGroup
+(util/collective/collective_group/nccl_collective_group.py:121), redesigned
+for TPU: instead of NCCL communicators, ops lower to XLA collectives
+(jax.lax.psum / all_gather / psum_scatter / ppermute) over ICI.
+
+Two regimes:
+
+1. **In-graph (preferred)**: training code runs under jit on a Mesh; the
+   "collective" is just the lax op and XLA schedules it on ICI. This class's
+   static helpers expose that surface for shard_map code.
+
+2. **Out-of-graph**: `allreduce(array)` etc. called between jit programs,
+   matching the reference's eager `col.allreduce(tensor, group)` API. Within
+   one process the ops run as a jitted shard_map over this host's devices.
+   Across hosts the group bootstraps the jax.distributed runtime — the
+   coordinator address rendezvouses through the GCS KV, mirroring the NCCL
+   unique-id flow (nccl_collective_group.py:29) — after which jax sees the
+   global device set and the same jitted collectives span hosts over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .base import BaseGroup, ReduceOp
+
+_LAX_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+    # PRODUCT deliberately absent: XLA has no pprod collective
+}
+
+
+def _rendezvous_coordinator(group_name: str, rank: int, world_size: int,
+                            timeout: float = 60.0) -> Optional[str]:
+    """Agree on a jax.distributed coordinator address through the GCS KV
+    (reference: NCCL unique-id rendezvous through internal KV)."""
+    from .. import _worker_api
+
+    if not _worker_api.is_initialized():
+        return None
+    worker = _worker_api.get_core_worker()
+    client = worker.client_pool.get(*worker.gcs_address)
+    key = f"xla_coord:{group_name}"
+    if rank == 0:
+        import socket
+
+        host = socket.gethostbyname(socket.gethostname())
+        # deterministic port per group in the dynamic range
+        port = 20000 + (hash(group_name) % 20000)
+        addr = f"{host}:{port}"
+        _worker_api.run_on_worker_loop(client.call("kv_put", key, addr.encode(), True))
+        return addr
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        raw = _worker_api.run_on_worker_loop(client.call("kv_get", key))
+        if raw:
+            return raw.decode()
+        time.sleep(0.05)
+    raise TimeoutError(f"no coordinator for group {group_name}")
+
+
+class XlaGroup(BaseGroup):
+    """Out-of-graph collective group over this process's jax devices (and,
+    multi-host, the global device set after jax.distributed bootstrap)."""
+
+    def __init__(
+        self,
+        world_size: int,
+        rank: int,
+        group_name: str,
+        *,
+        bootstrap_distributed: bool = False,
+        devices: Optional[List] = None,
+    ):
+        super().__init__(world_size, rank, group_name)
+        self._host = None
+        if bootstrap_distributed and world_size > 1:
+            coord = _rendezvous_coordinator(group_name, rank, world_size)
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=world_size,
+                process_id=rank,
+            )
+        self.devices = list(devices if devices is not None else jax.devices())
+        self.mesh = Mesh(np.array(self.devices), ("g",))
+        n = len(self.devices)
+
+        spec = P("g")
+        rep = P()
+
+        @partial(jax.jit, static_argnums=(1,))
+        def _reduce(x, op_name):
+            fn = {
+                "sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
+            }[op_name]
+            return jax.shard_map(
+                lambda s: fn(s, "g"),
+                mesh=self.mesh, in_specs=spec, out_specs=rep, check_vma=False,
+            )(x)
+
+        self._reduce = _reduce
+
+        @jax.jit
+        def _allgather(x):
+            return jax.shard_map(
+                lambda s: jax.lax.all_gather(s, "g", axis=0, tiled=True),
+                mesh=self.mesh, in_specs=spec, out_specs=rep, check_vma=False,
+            )(x)
+
+        self._allgather = _allgather
+
+        @jax.jit
+        def _reducescatter(x):
+            return jax.shard_map(
+                lambda s: jax.lax.psum_scatter(s, "g", scatter_dimension=0, tiled=True),
+                mesh=self.mesh, in_specs=rep, out_specs=spec, check_vma=False,
+            )(x)
+
+        self._reducescatter = _reducescatter
+
+    def _device_shard(self, tensor):
+        """Shard a host array over the group axis (leading dim)."""
+        return jax.device_put(tensor, NamedSharding(self.mesh, P("g")))
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        # each device's shard is summed: for the eager API the input is the
+        # per-rank contribution replicated per device slot
+        if op == ReduceOp.PRODUCT:
+            raise NotImplementedError(
+                "PRODUCT has no XLA collective; use the cpu backend"
+            )
+        x = self._device_shard(tensor)
+        return self._reduce(x, op.value)
+
+    def allgather(self, tensor) -> Any:
+        return self._allgather(self._device_shard(tensor))
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        if op != ReduceOp.SUM:
+            raise NotImplementedError(
+                "XLA psum_scatter only reduces with SUM; use the cpu backend"
+            )
+        return self._reducescatter(jnp.asarray(tensor))
+
+    def _host_group(self):
+        # host-side control ops (broadcast/send/recv across processes)
+        # delegate to the GCS-KV backend; device meshes have no eager
+        # cross-process point-to-point path
+        if self._host is None:
+            from .cpu_group import GcsStoreGroup
+
+            self._host = GcsStoreGroup(
+                self.world_size, self.rank, f"{self.group_name}:host"
+            )
+        return self._host
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        if self.world_size == 1:
+            return jax.device_put(tensor, NamedSharding(self.mesh, P()))
+        value = self._host_group().broadcast(tensor, src_rank)
+        return jax.device_put(value, NamedSharding(self.mesh, P()))
+
+    def send(self, tensor, dst_rank: int):
+        if self.world_size == 1:
+            raise ValueError("send in a single-process group has no peer")
+        return self._host_group().send(tensor, dst_rank)
+
+    def recv(self, src_rank: int):
+        if self.world_size == 1:
+            raise ValueError("recv in a single-process group has no peer")
+        return self._host_group().recv(src_rank)
+
+    def barrier(self):
+        x = jnp.zeros((len(self.devices),), jnp.int32)
+        jax.block_until_ready(self._reduce(self._device_shard(x), "sum"))
+
+    # -- in-graph surface (use inside shard_map/jit) ------------------------
+
+    @staticmethod
+    def lax_allreduce(x, axis_name: str, op: ReduceOp = ReduceOp.SUM):
+        fn = _LAX_REDUCERS.get(op)
+        if fn is None:
+            raise NotImplementedError(f"{op} has no XLA collective")
+        return fn(x, axis_name)
+
+    @staticmethod
+    def lax_allgather(x, axis_name: str, axis: int = 0):
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+    @staticmethod
+    def lax_reducescatter(x, axis_name: str, axis: int = 0):
+        return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+    @staticmethod
+    def lax_ppermute(x, axis_name: str, perm):
+        return jax.lax.ppermute(x, axis_name, perm)
+
+    @staticmethod
+    def lax_all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+        )

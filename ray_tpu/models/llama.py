@@ -1,0 +1,297 @@
+"""LLaMA-family transformer, TPU-first.
+
+No reference analogue: the reference serves models through vLLM/torch
+(SURVEY P19); this framework owns the model-execution layer. Design:
+
+- flax.linen with *logical axis* annotations on every parameter
+  (nn.with_logical_partitioning); parallel/sharding.py's rule table maps
+  logical axes to mesh axes, XLA GSPMD inserts the collectives — TP/FSDP
+  come from the sharding annotations, not model code changes
+- attention runs the Pallas flash kernel; with a sequence-parallel mesh axis
+  it runs ring attention under shard_map (parallel/ring_attention.py)
+- bfloat16 activations, f32 params/optimizer by default; per-layer remat
+  (jax.checkpoint) to trade FLOPs for HBM
+- LoRA (q/k/v/o + optional mlp) for the Llama-2-7B fine-tune north-star
+  (BASELINE.json config 3)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.flash_attention import flash_attention
+from ..ops.rmsnorm import rmsnorm
+from ..ops.rope import apply_rope, rope_table
+from ..parallel.ring_attention import ring_attention
+from ..parallel.sharding import logical_to_spec
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    intermediate: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama2_13b(**kw) -> "LlamaConfig":
+        return LlamaConfig(
+            dim=5120, n_layers=40, n_heads=40, n_kv_heads=40, intermediate=13824, **kw
+        )
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            intermediate=14336, rope_theta=500000.0, **kw
+        )
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test-scale config: runs on CPU mesh in seconds."""
+        defaults = dict(
+            vocab_size=256, dim=128, n_layers=2, n_heads=4, n_kv_heads=4,
+            intermediate=256, max_seq_len=512, remat=False,
+        )
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+
+def _dense(features, logical_axes, name, param_dtype, dtype, use_bias=False):
+    return nn.DenseGeneral(
+        features=features,
+        use_bias=use_bias,
+        name=name,
+        dtype=dtype,  # bf16 compute on the MXU; params stay f32
+        param_dtype=param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), logical_axes
+        ),
+    )
+
+
+class LoRADense(nn.Module):
+    """Dense with optional low-rank adapter: y = xW + (alpha/r)·xAB.
+
+    The base kernel is annotated like a normal weight; A/B carry the
+    ``lora_rank`` logical axis (replicated by default rules). Training
+    freezes the base via an optimizer mask (train/lora.py)."""
+
+    features: int
+    logical_axes: Tuple[str, ...]
+    rank: int
+    alpha: float
+    param_dtype: Any
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        base = _dense(
+            self.features, self.logical_axes, "base", self.param_dtype, self.dtype
+        )(x)
+        if self.rank <= 0:
+            return base
+        in_dim = x.shape[-1]
+        a = self.param(
+            "lora_a",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), (self.logical_axes[0], "lora_rank")
+            ),
+            (in_dim, self.rank),
+            self.param_dtype,
+        )
+        b = self.param(
+            "lora_b",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("lora_rank", self.logical_axes[-1])
+            ),
+            (self.rank, self.features),
+            self.param_dtype,
+        )
+        scale = self.alpha / self.rank
+        delta = (x @ a.astype(x.dtype)) @ b.astype(x.dtype) * scale
+        return base + delta
+
+
+class Attention(nn.Module):
+    config: LlamaConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        cfg = self.config
+        b, s, _ = x.shape
+        h, hk, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+        def proj(n_out, name):
+            return LoRADense(
+                features=n_out,
+                logical_axes=("embed", "heads"),
+                rank=cfg.lora_rank,
+                alpha=cfg.lora_alpha,
+                param_dtype=cfg.param_dtype,
+                dtype=cfg.dtype,
+                name=name,
+            )
+
+        q = proj(h * d, "wq")(x).reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        k = proj(hk * d, "wk")(x).reshape(b, s, hk, d).transpose(0, 2, 1, 3)
+        v = proj(hk * d, "wv")(x).reshape(b, s, hk, d).transpose(0, 2, 1, 3)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        if self.mesh is not None:
+            # ring attention under shard_map: batch over data axes, heads
+            # over tp, sequence over sp (ICI neighbor exchanges)
+            qkv_spec = P(("dcn", "dp", "fsdp"), "tp", "sp", None)
+            attn = jax.shard_map(
+                partial(ring_attention, axis_name="sp"),
+                mesh=self.mesh,
+                in_specs=(qkv_spec, qkv_spec, qkv_spec),
+                out_specs=qkv_spec,
+                check_vma=False,
+            )
+            out = attn(q, k, v)
+        else:
+            out = flash_attention(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        return LoRADense(
+            features=cfg.dim,
+            logical_axes=("heads", "embed"),
+            rank=cfg.lora_rank,
+            alpha=cfg.lora_alpha,
+            param_dtype=cfg.param_dtype,
+            dtype=cfg.dtype,
+            name="wo",
+        )(out)
+
+
+class MLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate = _dense(
+            cfg.intermediate, ("embed", "mlp"), "w_gate", cfg.param_dtype, cfg.dtype
+        )(x)
+        up = _dense(
+            cfg.intermediate, ("embed", "mlp"), "w_up", cfg.param_dtype, cfg.dtype
+        )(x)
+        fused = nn.silu(gate) * up
+        return _dense(
+            cfg.dim, ("mlp", "embed"), "w_down", cfg.param_dtype, cfg.dtype
+        )(fused)
+
+
+class Block(nn.Module):
+    config: LlamaConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        cfg = self.config
+        attn_norm_w = self.param(
+            "attn_norm",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (cfg.dim,),
+            cfg.param_dtype,
+        )
+        h = x + Attention(cfg, self.mesh, name="attn")(
+            rmsnorm(x, attn_norm_w.astype(x.dtype), cfg.norm_eps), cos, sin
+        )
+        mlp_norm_w = self.param(
+            "mlp_norm",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (cfg.dim,),
+            cfg.param_dtype,
+        )
+        return h + MLP(cfg, name="mlp")(
+            rmsnorm(h, mlp_norm_w.astype(h.dtype), cfg.norm_eps)
+        )
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, tokens):  # (batch, seq) int32
+        cfg = self.config
+        embed = self.param(
+            "embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.dim),
+            cfg.param_dtype,
+        )
+        x = embed.astype(cfg.dtype)[tokens]
+        cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(
+                Block,
+                policy=jax.checkpoint_policies.save_only_these_names(),
+                prevent_cse=False,
+            )
+        for i in range(cfg.n_layers):
+            x = block(cfg, self.mesh, name=f"layer_{i}")(x, cos, sin)
+        final_norm_w = self.param(
+            "final_norm",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (cfg.dim,),
+            cfg.param_dtype,
+        )
+        x = rmsnorm(x, final_norm_w.astype(x.dtype), cfg.norm_eps)
+        head = self.param(
+            "lm_head",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("embed", "vocab")
+            ),
+            (cfg.dim, cfg.vocab_size),
+            cfg.param_dtype,
+        )
+        return x @ head.astype(x.dtype)
+
+
+def init_params(config: LlamaConfig, rng, mesh: Optional[Mesh] = None, seq: int = 8):
+    model = Llama(config, mesh)
+    tokens = jnp.zeros((1, seq), jnp.int32)
+    return model.init(rng, tokens)["params"]
+
+
+def next_token_loss(config: LlamaConfig, mesh, params, tokens):
+    """Causal LM loss: model sees the full (sp-divisible) sequence; the loss
+    pairs logits[:, :-1] with tokens[:, 1:]."""
+    model = Llama(config, mesh)
+    logits = model.apply({"params": params}, tokens)
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    targets = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()

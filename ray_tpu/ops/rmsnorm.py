@@ -1,0 +1,79 @@
+"""Fused RMSNorm as a Pallas TPU kernel with custom VJP.
+
+One HBM round-trip for x (vs separate mean-square, rsqrt, scale ops when XLA
+doesn't fuse); f32 statistics regardless of input dtype, matching the
+numerics LLaMA-family models expect.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    o_ref[...] = (x * inv * w_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _rmsnorm_fwd_impl(x2, w, eps, block_rows):
+    n, d = x2.shape
+    grid = (pl.cdiv(n, block_rows),)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
+        interpret=_use_interpret(),
+    )(x2, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rmsnorm(x2, w, eps):
+    return _rmsnorm_fwd_impl(x2, w, eps, block_rows=256)
+
+
+def _rmsnorm_fwd(x2, w, eps):
+    return _rmsnorm_fwd_impl(x2, w, eps, block_rows=256), (x2, w)
+
+
+def _rmsnorm_bwd(eps, res, g):
+    # backward in plain XLA: elementwise chains fuse well, and the extra
+    # rematerialized rsqrt is cheap relative to an extra pallas kernel here
+    x2, w = res
+    x = x2.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    d = x.shape[-1]
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    xhat = x * inv
+    dw = jnp.sum(gf * xhat, axis=0).astype(w.dtype)
+    gw = gf * wf
+    dx = inv * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
+    return dx.astype(x2.dtype), dw
+
+
+_rmsnorm.defvjp(_rmsnorm_fwd, _rmsnorm_bwd)
+
+
+def rmsnorm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm over the last axis; any leading shape."""
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    out = _rmsnorm(x2, weight, eps)
+    return out.reshape(shape)

@@ -1,0 +1,40 @@
+"""Rotary position embeddings (RoPE).
+
+Pure jnp: RoPE is elementwise mul/add on (seq, head_dim) — XLA fuses it into
+the surrounding projections, so a hand kernel buys nothing; the win is the
+precomputed frequency table and an offset argument for sequence-parallel
+shards (each sp rank applies its absolute positions)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_table(max_len: int, head_dim: int, theta: float = 10000.0):
+    """Returns (cos, sin) tables of shape (max_len, head_dim // 2), f32."""
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    pos = jnp.arange(max_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, freqs)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jax.Array,  # (batch, heads, seq, head_dim)
+    cos: jax.Array,
+    sin: jax.Array,
+    offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Rotate pairs (x[..., ::2], x[..., 1::2]); ``offset`` is the absolute
+    position of x's first token (nonzero on sp shards and in decode)."""
+    seq = x.shape[-2]
+    half = x.shape[-1] // 2
+    c = jax.lax.dynamic_slice_in_dim(cos, offset, seq, axis=0)[None, None]
+    s = jax.lax.dynamic_slice_in_dim(sin, offset, seq, axis=0)[None, None]
+    x1 = x[..., :half]
+    x2 = x[..., half:]
+    out1 = x1 * c - x2 * s
+    out2 = x2 * c + x1 * s
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)

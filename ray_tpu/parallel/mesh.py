@@ -1,0 +1,126 @@
+"""Device meshes: the TPU parallelism substrate.
+
+There is no analogue in the reference (Ray delegates tensor parallelism to
+vLLM/torch — SURVEY §2c); here the framework owns the model-execution layer,
+so the mesh is a first-class object. Axes follow the scaling-book convention:
+
+  dp    data parallelism (pure replication of params)
+  fsdp  fully-sharded data parallelism (params/optimizer sharded over batch axis)
+  tp    tensor parallelism (megatron-style weight sharding, rides fastest ICI axis)
+  sp    sequence/context parallelism (ring attention over ICI neighbors)
+  ep    expert parallelism (MoE all_to_all dispatch)
+  dcn   across-slice data parallelism (multislice; gradients cross DCN once/step)
+
+The mesh is constructed so the innermost (fastest-varying, ICI-adjacent)
+device dimension carries tp, then sp, then fsdp — collectives with the
+highest bandwidth demand ride the shortest links.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# canonical axis order: slowest (DCN) to fastest (ICI-minor)
+AXIS_ORDER = ("dcn", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. -1 for at most one axis means 'absorb remaining
+    devices'."""
+
+    dp: int = 1
+    fsdp: int = -1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    dcn: int = 1
+
+    def resolved_sizes(self, num_devices: int) -> Dict[str, int]:
+        sizes = {
+            "dcn": self.dcn,
+            "dp": self.dp,
+            "fsdp": self.fsdp,
+            "ep": self.ep,
+            "sp": self.sp,
+            "tp": self.tp,
+        }
+        fixed = 1
+        wild = None
+        for name, size in sizes.items():
+            if size == -1:
+                if wild is not None:
+                    raise ValueError("only one mesh axis may be -1")
+                wild = name
+            else:
+                fixed *= size
+        if wild is not None:
+            if num_devices % fixed != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes {fixed}"
+                )
+            sizes[wild] = num_devices // fixed
+        total = math.prod(sizes.values())
+        if total != num_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {total} devices, have {num_devices}"
+            )
+        return sizes
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        sizes = self.resolved_sizes(len(devices))
+        shape = tuple(sizes[a] for a in AXIS_ORDER)
+        array = np.array(devices).reshape(shape)
+        return Mesh(array, AXIS_ORDER)
+
+
+def make_mesh(
+    num_devices: Optional[int] = None,
+    *,
+    dp: int = 1,
+    fsdp: int = -1,
+    tp: int = 1,
+    sp: int = 1,
+    ep: int = 1,
+    dcn: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    spec = MeshSpec(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep, dcn=dcn)
+    devs = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return spec.build(devs)
+
+
+# data axes used for batch sharding: everything that splits the batch
+BATCH_AXES = ("dcn", "dp", "fsdp")
+
+
+def batch_spec() -> P:
+    return P(BATCH_AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def local_batch_size(mesh: Mesh, global_batch: int) -> int:
+    denom = math.prod(mesh.shape[a] for a in BATCH_AXES)
+    if global_batch % denom != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by {denom}")
+    return global_batch // denom

@@ -936,6 +936,16 @@ class CoreWorker:
     async def _execute_actor_task(self, spec: TaskSpec) -> TaskReply:
         if self._actor_instance is None:
             return self._error_reply(spec, RuntimeError("actor not initialized"))
+        if spec.function.qualname == "__init_collective__":
+            # declarative collective group setup (collective.create_collective_group)
+            from ...collective import init_collective_group
+
+            args, kwargs = await self._unflatten(spec)
+            try:
+                init_collective_group(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001
+                return self._error_reply(spec, e)
+            return await self._build_reply(spec, True)
         method = getattr(self._actor_instance, spec.function.qualname, None)
         if method is None:
             return self._error_reply(

@@ -21,6 +21,14 @@ async def main(args):
     from ..._internal.rpc import RpcClient
     from .core_worker import CoreWorker, WorkerMode
 
+    # test environments pin jax to a platform (the axon TPU plugin ignores
+    # JAX_PLATFORMS, but config.update applied before backend init wins)
+    platform = os.environ.get("RAY_TPU_JAX_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
     config = Config()
     if args.config:
         config = Config.from_json(args.config)

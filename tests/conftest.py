@@ -1,17 +1,25 @@
 """Test configuration.
 
 Multi-chip behavior is tested on a virtual 8-device CPU mesh (the driver
-separately dry-runs the multichip path); env must be set before jax imports.
+separately dry-runs the multichip path on real topologies). The axon TPU
+plugin registers itself in sitecustomize at interpreter startup and ignores
+the JAX_PLATFORMS env var, but jax.config.update("jax_platforms") still wins
+if applied before backend initialization — so it must run here, before any
+test imports jax.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+# worker subprocesses spawned by the runtime during tests pick this up
+# (worker_main applies it at startup)
+os.environ["RAY_TPU_JAX_PLATFORM"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

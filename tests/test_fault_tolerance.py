@@ -9,7 +9,7 @@ import time
 import pytest
 
 import ray_tpu
-from ray_tpu.exceptions import ActorDiedError, TaskError
+from ray_tpu.exceptions import ActorDiedError, GetTimeoutError, TaskError
 
 
 @pytest.fixture
@@ -73,9 +73,9 @@ def test_actor_restart(cluster):
     pid2 = None
     while time.time() < deadline:
         try:
-            pid2 = ray_tpu.get(p.pid.remote(), timeout=60)
+            pid2 = ray_tpu.get(p.pid.remote(), timeout=30)
             break
-        except ActorDiedError:
+        except (ActorDiedError, GetTimeoutError):
             time.sleep(0.5)
     assert pid2 is not None and pid2 != pid1
     assert ray_tpu.get(p.inc.remote(), timeout=120) == 1  # state reset
@@ -137,9 +137,9 @@ def test_node_death_detection():
         new_home = None
         while time.time() < deadline:
             try:
-                new_home = ray_tpu.get(a.where.remote(), timeout=60)
+                new_home = ray_tpu.get(a.where.remote(), timeout=30)
                 break
-            except ActorDiedError:
+            except (ActorDiedError, GetTimeoutError):
                 time.sleep(0.5)
         assert new_home is not None and new_home != extra_id
     finally:
