@@ -91,8 +91,10 @@ class GcsStoreGroup(BaseGroup):
         return _REDUCERS[op](self._gather_all(seq, "d"))
 
     def allgather(self, tensor) -> List[Any]:
+        # arbitrary python objects allowed (control-plane data), not just
+        # tensors — objects round-trip unchanged
         seq = self._next_seq()
-        self._put(seq, "d", np.asarray(tensor))
+        self._put(seq, "d", tensor)
         return self._gather_all(seq, "d")
 
     def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
@@ -101,11 +103,20 @@ class GcsStoreGroup(BaseGroup):
         return shards[self.rank]
 
     def broadcast(self, tensor, src_rank: int = 0):
+        # The src must not return until every receiver has read the payload:
+        # rank 0's _cleanup(seq-2) assumes all ranks completed seq-2, which
+        # gather-style ops guarantee but a fire-and-forget broadcast would
+        # not — a racing src could let cleanup delete a payload a slow rank
+        # never read. The ack phase makes broadcast synchronizing.
         seq = self._next_seq()
         if self.rank == src_rank:
-            self._put(seq, "d", np.asarray(tensor))
-            return np.asarray(tensor)
-        return self._get_blocking(seq, "d", src_rank)
+            self._put(seq, "d", tensor)
+            out = tensor
+        else:
+            out = self._get_blocking(seq, "d", src_rank)
+        self._put(seq, "s", 1)
+        self._gather_all(seq, "s")
+        return out
 
     def _p2p_key(self, src: int, dst: int) -> tuple:
         n = self._p2p_seq.get((src, dst), 0)
@@ -115,7 +126,7 @@ class GcsStoreGroup(BaseGroup):
     def send(self, tensor, dst_rank: int):
         n = self._p2p_key(self.rank, dst_rank)
         key = f"col:{self.group_name}:p2p:{self.rank}:{dst_rank}:{n}"
-        _kv_call("kv_put", key, serialization.pack(np.asarray(tensor)), True)
+        _kv_call("kv_put", key, serialization.pack(tensor), True)
 
     def recv(self, src_rank: int):
         n = self._p2p_key(src_rank, self.rank)

@@ -92,6 +92,16 @@ class XlaGroup(BaseGroup):
                 num_processes=world_size,
                 process_id=rank,
             )
+        elif world_size > 1 and jax.process_count() < world_size:
+            # without the distributed runtime each process would reduce over
+            # its local devices only — numerically wrong results with no
+            # error. Refuse instead.
+            raise ValueError(
+                f"XlaGroup world_size={world_size} but this jax runtime spans "
+                f"{jax.process_count()} process(es); pass "
+                f"bootstrap_distributed=True (or bootstrap jax.distributed "
+                f"yourself) so collectives span all ranks"
+            )
         self.devices = list(devices if devices is not None else jax.devices())
         self.mesh = Mesh(np.array(self.devices), ("g",))
         n = len(self.devices)

@@ -69,7 +69,16 @@ class WorkerPool:
         repo_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         )
-        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        # Ship the raylet process's import paths to workers so functions
+        # pickled by module reference (driver-side modules, test files)
+        # resolve in the worker (reference role: JobConfig code search path /
+        # runtime_env py_modules).
+        extra_paths = [p for p in sys.path if p and os.path.isdir(p)]
+        env["PYTHONPATH"] = os.pathsep.join(
+            p
+            for p in [repo_root, *extra_paths, env.get("PYTHONPATH", "")]
+            if p  # an empty entry would put the cwd on worker sys.path
+        )
         cmd = [
             sys.executable,
             "-m",

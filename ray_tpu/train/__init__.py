@@ -1,0 +1,50 @@
+"""ray_tpu.train: distributed training on TPU slices.
+
+Role-equivalent of the reference's Ray Train v2 (python/ray/train/v2) built
+TPU-first: JaxTrainer gang-schedules one ranked worker per slice host via a
+slice-reserving placement group, bootstraps jax.distributed, and the user
+loop compiles to pjit/GSPMD with collectives over ICI.
+"""
+
+from . import collective
+from .backend import BackendConfig, JaxConfig, TorchConfig
+from .callbacks import TPUReservationCallback, TrainCallback
+from .checkpoint import Checkpoint, CheckpointManager, load_latest_checkpoint
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .controller import Result, RunState, TrainController
+from .session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    in_session,
+    report,
+)
+from .trainer import DataParallelTrainer, JaxTrainer, TorchTrainer
+from .worker_group import WorkerGroup
+
+__all__ = [
+    "BackendConfig",
+    "JaxConfig",
+    "TorchConfig",
+    "TrainCallback",
+    "TPUReservationCallback",
+    "Checkpoint",
+    "CheckpointManager",
+    "load_latest_checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
+    "Result",
+    "RunState",
+    "TrainController",
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "TorchTrainer",
+    "WorkerGroup",
+    "get_context",
+    "get_checkpoint",
+    "get_dataset_shard",
+    "in_session",
+    "report",
+]

@@ -1,0 +1,70 @@
+"""Controller callbacks, including TPU slice reservation.
+
+Role-equivalent of the reference's Train v2 callbacks
+(train/v2/_internal/execution/callback.py) and in particular
+TPUReservationCallback (v2/_internal/execution/callback/
+tpu_reservation_callback.py:9): before the worker group starts, reserve a
+whole ICI slice and hand the worker group the slice's label selector so the
+ranked gang lands on it; release the slice on shutdown.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class TrainCallback:
+    """Hooks observed by the TrainController."""
+
+    def before_worker_group_start(self, scaling_config) -> Optional[dict]:
+        """May return overrides: {"bundle_label_selector": {...},
+        "placement_group_override": PlacementGroup}."""
+        return None
+
+    def after_worker_group_start(self, worker_group) -> None:
+        pass
+
+    def on_report(self, report) -> None:
+        pass
+
+    def before_worker_group_shutdown(self, worker_group) -> None:
+        pass
+
+    def after_run(self, result) -> None:
+        pass
+
+
+class TPUReservationCallback(TrainCallback):
+    """Reserve one slice per run (reference flow: reserve_tpu_slice →
+    bundle_label_selector, tpu_reservation_callback.py:12)."""
+
+    def __init__(self, timeout: float = 120.0):
+        self._timeout = timeout
+        self._reservation = None
+
+    def before_worker_group_start(self, scaling_config) -> Optional[dict]:
+        if not (scaling_config.use_tpu and scaling_config.topology):
+            return None
+        from ..util.tpu import reserve_tpu_slice
+
+        self._reservation = reserve_tpu_slice(
+            scaling_config.topology, timeout=self._timeout
+        )
+        logger.info(
+            "train run reserved TPU slice %s", self._reservation.slice_name
+        )
+        return {
+            "placement_group_override": self._reservation.workers_pg,
+            "slice_name": self._reservation.slice_name,
+        }
+
+    def before_worker_group_shutdown(self, worker_group) -> None:
+        if self._reservation is not None:
+            try:
+                self._reservation.release()
+            except Exception:
+                pass
+            self._reservation = None

@@ -1,0 +1,158 @@
+"""Checkpoints: directory handles + top-K retention.
+
+Role-equivalent of the reference's ray.train.Checkpoint
+(python/ray/train/_checkpoint.py:56 — a handle to a directory on pluggable
+storage) and the v2 CheckpointManager
+(v2/_internal/execution/checkpoint/checkpoint_manager.py — registers
+reported checkpoints, keeps the top-K by a score attribute).
+
+TPU-first: sharded model state is written with orbax (async, per-host
+shards) into the checkpoint directory; every rank reports into the same
+indexed directory so a slice-wide checkpoint is one logical dir.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .config import CheckpointConfig
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory on shared storage."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        """Copy checkpoint contents into a local directory and return it."""
+        if dest is None:
+            dest = tempfile.mkdtemp(prefix="ckpt_")
+        shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextmanager
+    def as_directory(self):
+        """Access the checkpoint as a local directory (no copy when the
+        storage is a local/shared filesystem, matching the reference's
+        fast path)."""
+        yield self.path
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Checkpoint) and other.path == self.path
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+@dataclass
+class _TrackedCheckpoint:
+    checkpoint: Checkpoint
+    index: int
+    metrics: Dict[str, Any] = field(default_factory=dict)
+
+    def score(self, attribute: str):
+        return self.metrics.get(attribute)
+
+
+class CheckpointManager:
+    """Controller-side bookkeeping of reported checkpoints."""
+
+    def __init__(self, run_dir: str, config: CheckpointConfig):
+        self._run_dir = run_dir
+        self._config = config
+        self._tracked: List[_TrackedCheckpoint] = []
+        self._latest: Optional[_TrackedCheckpoint] = None
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        return self._latest.checkpoint if self._latest else None
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        attr = self._config.checkpoint_score_attribute
+        if not attr or not self._tracked:
+            return self.latest_checkpoint
+        scored = [t for t in self._tracked if t.score(attr) is not None]
+        if not scored:
+            return self.latest_checkpoint
+        best = (max if self._config.checkpoint_score_order == "max" else min)(
+            scored, key=lambda t: t.score(attr)
+        )
+        return best.checkpoint
+
+    def register(self, checkpoint: Checkpoint, index: int, metrics: Dict[str, Any]):
+        for t in self._tracked:
+            if t.index == index:  # another rank of the same report
+                t.metrics.update(metrics)
+                # a lagging rank's report for an older index must not rewind
+                # the latest pointer past newer checkpoints
+                if self._latest is None or index >= self._latest.index:
+                    self._latest = t
+                self._write_manifest()
+                return
+        tracked = _TrackedCheckpoint(checkpoint, index, dict(metrics))
+        self._tracked.append(tracked)
+        self._latest = tracked
+        self._write_manifest()
+        self._prune()
+
+    def _prune(self):
+        keep = self._config.num_to_keep
+        if keep is None or len(self._tracked) <= keep:
+            return
+        attr = self._config.checkpoint_score_attribute
+        candidates = [t for t in self._tracked if t is not self._latest]
+        if attr:
+            reverse = self._config.checkpoint_score_order == "min"
+            candidates.sort(
+                key=lambda t: (t.score(attr) is None, t.score(attr) or 0),
+                reverse=reverse,
+            )
+        else:
+            candidates.sort(key=lambda t: t.index)
+        while len(self._tracked) > keep and candidates:
+            victim = candidates.pop(0)
+            self._tracked.remove(victim)
+            shutil.rmtree(victim.checkpoint.path, ignore_errors=True)
+        self._write_manifest()
+
+    def _write_manifest(self):
+        os.makedirs(self._run_dir, exist_ok=True)
+        manifest = {
+            "checkpoints": [
+                {"path": t.checkpoint.path, "index": t.index, "metrics": t.metrics}
+                for t in sorted(self._tracked, key=lambda t: t.index)
+            ],
+            "latest": self._latest.checkpoint.path if self._latest else None,
+        }
+        tmp = os.path.join(self._run_dir, ".manifest.tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=2, default=str)
+        os.replace(tmp, os.path.join(self._run_dir, "checkpoint_manifest.json"))
+
+
+def load_latest_checkpoint(run_dir: str) -> Optional[Checkpoint]:
+    """Resume support: recover the latest checkpoint recorded for a run."""
+    manifest_path = os.path.join(run_dir, "checkpoint_manifest.json")
+    if not os.path.exists(manifest_path):
+        return None
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    latest = manifest.get("latest")
+    if latest and os.path.isdir(latest):
+        return Checkpoint(latest)
+    return None

@@ -1,0 +1,46 @@
+"""Train-worker collectives (control-plane, host-side).
+
+Role-equivalent of the reference's ray.train.collective
+(train/collective/collectives.py:16,59 — broadcast_from_rank_zero / barrier
+through a sync actor). Here they ride the framework's GCS-KV collective
+group that every train worker joins at context init; device-plane
+collectives (gradient psum etc.) belong *inside* jit via jax.lax — these
+are only for small host-side control data (configs, coordinator addresses,
+early-stop flags).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .. import collective as _collective
+from .session import get_context
+
+
+def _group() -> str:
+    name = get_context().collective_group
+    if not name:
+        raise RuntimeError("no collective group for this training run")
+    return name
+
+
+def broadcast_from_rank_zero(data: Any = None) -> Any:
+    """Every worker calls this; all return rank 0's value (reference:
+    collectives.py:16)."""
+    return _collective.broadcast(data, src_rank=0, group_name=_group())
+
+
+def barrier() -> None:
+    """Block until every training worker arrives (reference:
+    collectives.py:59)."""
+    _collective.barrier(group_name=_group())
+
+
+def allreduce(value, op=None):
+    """Sum (default) a small host-side value across workers."""
+    kwargs = {} if op is None else {"op": op}
+    return _collective.allreduce(value, group_name=_group(), **kwargs)
+
+
+def allgather(value) -> list:
+    return _collective.allgather(value, group_name=_group())

@@ -1,0 +1,120 @@
+"""Train configuration dataclasses.
+
+Role-equivalent of the reference's Train v2 configs
+(python/ray/train/v2/api/config.py:30,70 — ScalingConfig with
+use_tpu/topology/accelerator_type; RunConfig with storage/checkpoint/failure
+config) re-shaped for TPU-first scheduling: a worker is one *host* of a
+slice, chips per host follow the pod type, and gang placement is a
+STRICT_SPREAD placement group pinned to one ICI domain.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .._internal.accelerators import chips_per_host, pod_type_num_hosts
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers and what each one holds.
+
+    With ``use_tpu=True`` and a ``topology`` (pod type, e.g. "v5e-16"),
+    ``num_workers`` defaults to the slice's host count and every worker gets
+    the host's full chip allotment — JAX SPMD requires exactly one process
+    per host, all running the same program (reference: ScalingConfig
+    v2/api/config.py:70, tpu.py topology tables).
+    """
+
+    num_workers: Optional[int] = None
+    use_tpu: bool = False
+    topology: Optional[str] = None
+    accelerator_type: Optional[str] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def __post_init__(self):
+        if self.topology is not None and not self.use_tpu:
+            raise ValueError("topology requires use_tpu=True")
+        if self.num_workers is None:
+            self.num_workers = (
+                pod_type_num_hosts(self.topology) if self.topology else 1
+            )
+        if self.use_tpu and self.topology and self.num_workers > 1:
+            # one ranked worker per slice host, spread across hosts
+            self.placement_strategy = "STRICT_SPREAD"
+
+    @property
+    def _resources_per_worker_not_none(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        if self.use_tpu:
+            n = chips_per_host(self.topology) if self.topology else 1
+            return {"CPU": 1.0, "TPU": float(n)}
+        return {"CPU": 1.0}
+
+    @property
+    def total_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for k, v in self._resources_per_worker_not_none.items():
+            out[k] = v * (self.num_workers or 1)
+        return out
+
+
+@dataclass
+class CheckpointConfig:
+    """Top-K checkpoint retention (reference:
+    train/v2/_internal/execution/checkpoint/checkpoint_manager.py)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive")
+
+
+@dataclass
+class FailureConfig:
+    """Worker-group-level retry budget (reference:
+    v2/_internal/execution/failure_handling/failure_policy.py).
+    ``max_failures=-1`` retries forever."""
+
+    max_failures: int = 0
+
+
+def _default_storage_path() -> str:
+    return os.environ.get(
+        "RAY_TPU_STORAGE_PATH",
+        os.path.join(os.path.expanduser("~"), "ray_tpu_results"),
+    )
+
+
+@dataclass
+class RunConfig:
+    """Where results/checkpoints go and how failures are handled
+    (reference: v2/api/config.py RunConfig)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    callbacks: List[Any] = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            self.storage_path = _default_storage_path()
+        if self.name is None:
+            import time
+            import uuid
+
+            self.name = f"train_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
+
+    @property
+    def run_dir(self) -> str:
+        return os.path.join(self.storage_path, self.name)

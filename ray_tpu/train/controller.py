@@ -1,0 +1,251 @@
+"""Train controller: the run's state machine.
+
+Role-equivalent of the reference's TrainController
+(train/v2/_internal/execution/controller/controller.py:100; control loop
+:396-509, states controller/state.py): bring up the worker group (with any
+TPU slice reservation from callbacks), bootstrap the backend, start the
+user loop everywhere, poll workers, register checkpoints, and apply the
+failure policy — restart the whole gang (SPMD requires all-or-nothing) up to
+``FailureConfig.max_failures`` times, resuming from the latest checkpoint.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .backend import BackendConfig
+from .checkpoint import Checkpoint, CheckpointManager, load_latest_checkpoint
+from .config import RunConfig, ScalingConfig
+from .session import TrainingReport
+from .worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class RunState(enum.Enum):
+    INITIALIZING = "INITIALIZING"
+    SCHEDULING = "SCHEDULING"
+    RUNNING = "RUNNING"
+    RESTARTING = "RESTARTING"
+    FINISHED = "FINISHED"
+    ERRORED = "ERRORED"
+
+
+@dataclass
+class Result:
+    """What fit() returns (reference: ray.train.Result)."""
+
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[Exception] = None
+    path: str = ""
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+
+
+class TrainController:
+    def __init__(
+        self,
+        train_fn: Callable,
+        train_fn_config: Optional[dict],
+        scaling_config: ScalingConfig,
+        run_config: RunConfig,
+        backend_config: BackendConfig,
+        datasets: Optional[Dict[str, Any]] = None,
+        poll_interval: float = 0.1,
+        callbacks: Optional[List[Any]] = None,
+    ):
+        self._train_fn = train_fn
+        self._train_fn_config = train_fn_config
+        self._scaling = scaling_config
+        self._run_config = run_config
+        self._backend_config = backend_config
+        self._datasets = datasets or {}
+        self._poll_interval = poll_interval
+        self._callbacks = (
+            callbacks if callbacks is not None else list(run_config.callbacks)
+        )
+        self.state = RunState.INITIALIZING
+        self._checkpoints = CheckpointManager(
+            run_config.run_dir, run_config.checkpoint_config
+        )
+        self._failures = 0
+        self._metrics_history: List[Dict[str, Any]] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self) -> Result:
+        import os
+
+        os.makedirs(self._run_config.run_dir, exist_ok=True)
+        max_failures = self._run_config.failure_config.max_failures
+        while True:
+            try:
+                result = self._run_attempt()
+                self.state = RunState.FINISHED
+                for cb in self._callbacks:
+                    _safe(cb.after_run, result)
+                return result
+            except _WorkerGroupFailure as f:
+                self._failures += 1
+                retriable = max_failures < 0 or self._failures <= max_failures
+                if not retriable:
+                    self.state = RunState.ERRORED
+                    result = Result(
+                        metrics=self._latest_metrics(),
+                        checkpoint=self._checkpoints.latest_checkpoint,
+                        error=f.error,
+                        path=self._run_config.run_dir,
+                        metrics_history=list(self._metrics_history),
+                    )
+                    for cb in self._callbacks:
+                        _safe(cb.after_run, result)
+                    return result
+                self.state = RunState.RESTARTING
+                logger.warning(
+                    "worker group failed (attempt %d/%s): %s — restarting from "
+                    "latest checkpoint",
+                    self._failures,
+                    "inf" if max_failures < 0 else max_failures,
+                    f.error,
+                )
+
+    def _run_attempt(self) -> Result:
+        self.state = RunState.SCHEDULING
+        overrides: Dict[str, Any] = {}
+        for cb in self._callbacks:
+            out = cb.before_worker_group_start(self._scaling)
+            if out:
+                overrides.update(out)
+        wg = WorkerGroup(
+            self._scaling,
+            placement_group_override=overrides.get("placement_group_override"),
+            bundle_label_selector=overrides.get("bundle_label_selector"),
+        )
+        try:
+            wg.create()
+            for cb in self._callbacks:
+                _safe(cb.after_worker_group_start, wg)
+            # attempt-scoped group name: a restarted gang must not read the
+            # failed attempt's stale rendezvous keys from the GCS KV
+            run_fields = dict(
+                experiment_name=self._run_config.name,
+                run_dir=self._run_config.run_dir,
+                collective_group=f"train:{self._run_config.name}:{self._failures}",
+            )
+            wg.init_contexts(run_fields)
+            self._setup_dataset_shards(wg)
+            backend = self._backend_config.backend()
+            backend.on_start(wg)
+            # resume: push the latest checkpoint into each worker context
+            resume = self._checkpoints.latest_checkpoint or load_latest_checkpoint(
+                self._run_config.run_dir
+            )
+            if resume is not None:
+                def _set_resume(ckpt=resume):
+                    from . import session
+
+                    session.get_context().latest_checkpoint = ckpt
+
+                wg.execute(_set_resume)
+            self.state = RunState.RUNNING
+            wg.start_training(self._train_fn, self._train_fn_config)
+            error = self._poll_until_done(wg)
+            backend.on_shutdown(wg)
+            if error is not None:
+                raise _WorkerGroupFailure(error)
+            return Result(
+                metrics=self._latest_metrics(),
+                checkpoint=self._checkpoints.latest_checkpoint,
+                error=None,
+                path=self._run_config.run_dir,
+                metrics_history=list(self._metrics_history),
+            )
+        finally:
+            for cb in self._callbacks:
+                _safe(cb.before_worker_group_shutdown, wg)
+            wg.shutdown()
+
+    def _poll_until_done(self, wg: WorkerGroup) -> Optional[Exception]:
+        """Drain reports until every worker finishes or one fails."""
+        while True:
+            try:
+                statuses = wg.poll()
+            except Exception as e:  # worker/actor died (node loss etc.)
+                return e
+            for status in statuses:
+                for report in status["reports"]:
+                    self._process_report(report)
+            for status in statuses:
+                if status["error"] is not None:
+                    exc = status.get("error_exc") or RuntimeError(status["error"])
+                    return exc
+            if all(s["done"] for s in statuses):
+                return None
+            time.sleep(self._poll_interval)
+
+    def _process_report(self, report: TrainingReport):
+        if report.metrics:
+            entry = dict(report.metrics)
+            entry["_world_rank"] = report.world_rank
+            entry["_report_index"] = report.index
+            self._metrics_history.append(entry)
+        if report.checkpoint is not None:
+            self._checkpoints.register(
+                report.checkpoint, report.index, report.metrics
+            )
+        for cb in self._callbacks:
+            _safe(cb.on_report, report)
+
+    def _setup_dataset_shards(self, wg: WorkerGroup):
+        if not self._datasets:
+            return
+        n = len(wg.workers)
+        for name, ds in self._datasets.items():
+            shards = _split_dataset(ds, n)
+            from .. import api as ray_api
+
+            ray_api.get(
+                [
+                    w.actor.set_dataset_shard.remote(name, shards[w.world_rank])
+                    for w in wg.workers
+                ]
+            )
+
+    def _latest_metrics(self) -> Dict[str, Any]:
+        # last report from rank 0, falling back to any rank
+        for entry in reversed(self._metrics_history):
+            if entry.get("_world_rank") == 0:
+                return {k: v for k, v in entry.items() if not k.startswith("_")}
+        if self._metrics_history:
+            return {
+                k: v
+                for k, v in self._metrics_history[-1].items()
+                if not k.startswith("_")
+            }
+        return {}
+
+
+def _split_dataset(ds, n: int):
+    """Split a dataset across n workers: ray_tpu.data datasets use
+    streaming_split; plain lists/iterables are sharded round-robin."""
+    if hasattr(ds, "streaming_split"):
+        return ds.streaming_split(n, equal=True)
+    items = list(ds)
+    return [items[i::n] for i in range(n)]
+
+
+class _WorkerGroupFailure(Exception):
+    def __init__(self, error: Exception):
+        super().__init__(str(error))
+        self.error = error
+
+
+def _safe(fn, *args):
+    try:
+        fn(*args)
+    except Exception:
+        logger.exception("train callback %s failed", fn)

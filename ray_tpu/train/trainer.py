@@ -1,0 +1,84 @@
+"""Trainers: the user-facing fit() entry points.
+
+Role-equivalent of the reference's DataParallelTrainer
+(train/v2/api/data_parallel_trainer.py:152) and JaxTrainer
+(train/v2/jax/jax_trainer.py:19): wrap a per-worker train loop, gang-launch
+it through the TrainController, and return a Result.
+
+TPU-first: JaxTrainer is the flagship — with ``ScalingConfig(use_tpu=True,
+topology="v5e-16")`` it reserves a slice via TPUReservationCallback, runs
+one ranked worker per host, bootstraps jax.distributed so the slice is a
+single SPMD program, and the user loop uses pjit/GSPMD shardings (see
+ray_tpu.parallel) with in-jit collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from .backend import BackendConfig, JaxConfig, TorchConfig
+from .callbacks import TPUReservationCallback
+from .config import RunConfig, ScalingConfig
+from .controller import Result, TrainController
+
+
+class DataParallelTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        backend_config: Optional[BackendConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        self._train_loop = train_loop_per_worker
+        self._train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.backend_config = backend_config or BackendConfig()
+        self.datasets = datasets
+
+    def _default_callbacks(self):
+        return []
+
+    def fit(self) -> Result:
+        # combined list built per-fit; the user's RunConfig is not mutated,
+        # so repeated fit() calls don't stack default callbacks
+        callbacks = self._default_callbacks() + list(self.run_config.callbacks)
+        controller = TrainController(
+            self._train_loop,
+            self._train_loop_config,
+            self.scaling_config,
+            self.run_config,
+            self.backend_config,
+            datasets=self.datasets,
+            callbacks=callbacks,
+        )
+        return controller.run()
+
+
+class JaxTrainer(DataParallelTrainer):
+    """JAX/TPU trainer (reference: v2/jax/jax_trainer.py:19)."""
+
+    def __init__(self, train_loop_per_worker, **kwargs):
+        scaling = kwargs.get("scaling_config") or ScalingConfig()
+        kwargs.setdefault(
+            "backend_config", JaxConfig(use_tpu=scaling.use_tpu)
+        )
+        super().__init__(train_loop_per_worker, **kwargs)
+
+    def _default_callbacks(self):
+        if self.scaling_config.use_tpu and self.scaling_config.topology:
+            return [TPUReservationCallback()]
+        return []
+
+
+class TorchTrainer(DataParallelTrainer):
+    """CPU/GPU torch trainer for reference parity
+    (train/torch/torch_trainer.py)."""
+
+    def __init__(self, train_loop_per_worker, **kwargs):
+        kwargs.setdefault("backend_config", TorchConfig())
+        super().__init__(train_loop_per_worker, **kwargs)

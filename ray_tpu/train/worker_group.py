@@ -1,0 +1,282 @@
+"""Ranked worker group gang-scheduled onto the cluster.
+
+Role-equivalent of the reference's Train v2 WorkerGroup
+(train/v2/_internal/execution/worker_group/worker_group.py:104): N actor
+workers placed by one placement group, assigned ranks sorted by node
+(worker_group.py:728-813 rank sorting), each running the user train fn on a
+background thread (worker_group/thread_runner.py) while the controller polls
+statuses.
+
+TPU-first: with a slice reservation the PG bundles carry the slice's label
+selector so every ranked worker lands on one ICI domain, one worker per
+host.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api as ray_api
+from ..util.placement_group import (
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+)
+from ..util.scheduling_strategies import PlacementGroupSchedulingStrategy
+from .config import ScalingConfig
+from .session import TrainContext, set_context
+
+logger = logging.getLogger(__name__)
+
+
+class TrainWorker:
+    """Actor hosting one ranked training process."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[str] = None
+        self._error_exc: Optional[Exception] = None
+        self._done = False
+        self._ctx: Optional[TrainContext] = None
+
+    def get_metadata(self) -> dict:
+        import os
+        import socket
+
+        from ..runtime_context import get_runtime_context
+
+        rc = get_runtime_context()
+        return {
+            "node_id": rc.get_node_id(),
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+            "tpu_chips": _visible_tpu_chips(),
+        }
+
+    def init_context(self, ctx_fields: dict):
+        self._ctx = TrainContext(**ctx_fields)
+        set_context(self._ctx)
+        if self._ctx.collective_group:
+            from .. import collective
+
+            collective.init_collective_group(
+                self._ctx.world_size,
+                self._ctx.world_rank,
+                backend="gcs",
+                group_name=self._ctx.collective_group,
+            )
+        return True
+
+    def set_dataset_shard(self, name: str, shard):
+        self._ctx.dataset_shards[name] = shard
+        return True
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        """Run an arbitrary function in this worker (backend setup etc.)."""
+        return fn(*args, **kwargs)
+
+    def start_training(self, train_fn: Callable, config: Optional[dict]):
+        """Launch the user loop on a thread so poll() stays responsive
+        (reference: thread_runner.py)."""
+        if self._thread is not None:
+            raise RuntimeError("training already started")
+
+        def _run():
+            try:
+                import inspect
+
+                sig = inspect.signature(train_fn)
+                if len(sig.parameters) >= 1:
+                    train_fn(config if config is not None else {})
+                else:
+                    train_fn()
+            except BaseException as e:  # noqa: BLE001
+                self._error = traceback.format_exc()
+                self._error_exc = e if isinstance(e, Exception) else RuntimeError(str(e))
+                logger.error("train fn failed:\n%s", self._error)
+            finally:
+                self._done = True
+
+        self._thread = threading.Thread(target=_run, daemon=True, name="train_fn")
+        self._thread.start()
+        return True
+
+    def poll(self) -> dict:
+        # read done/error BEFORE draining: if the train thread finishes
+        # between a drain and the done check, its final report would be
+        # dropped — capturing done first means a done=True answer can only
+        # accompany a complete drain
+        done = self._done
+        error = self._error
+        error_exc = self._error_exc
+        reports = self._ctx.drain_reports() if self._ctx else []
+        return {
+            "reports": reports,
+            "done": done,
+            "error": error,
+            "error_exc": error_exc,
+        }
+
+    def shutdown(self):
+        if self._ctx and self._ctx.collective_group:
+            from .. import collective
+
+            try:
+                collective.destroy_collective_group(self._ctx.collective_group)
+            except Exception:
+                pass
+        set_context(None)
+        return True
+
+
+def _visible_tpu_chips() -> int:
+    import glob
+
+    return len(glob.glob("/dev/accel*"))
+
+
+@dataclass
+class WorkerInfo:
+    actor: Any
+    world_rank: int
+    local_rank: int
+    node_rank: int
+    node_id: str
+    metadata: dict = field(default_factory=dict)
+
+
+class WorkerGroup:
+    """Create, rank, command, and tear down the gang of train workers."""
+
+    def __init__(
+        self,
+        scaling_config: ScalingConfig,
+        *,
+        placement_group_override: Optional[PlacementGroup] = None,
+        bundle_label_selector: Optional[Dict[str, str]] = None,
+    ):
+        self._scaling = scaling_config
+        self._pg: Optional[PlacementGroup] = placement_group_override
+        self._owns_pg = placement_group_override is None
+        self._label_selector = bundle_label_selector
+        self.workers: List[WorkerInfo] = []
+
+    def create(self, pg_timeout: float = 60.0):
+        n = self._scaling.num_workers
+        res = self._scaling._resources_per_worker_not_none
+        if self._pg is None:
+            selectors = (
+                [dict(self._label_selector) for _ in range(n)]
+                if self._label_selector
+                else None
+            )
+            self._pg = placement_group(
+                [dict(res) for _ in range(n)],
+                strategy=self._scaling.placement_strategy,
+                bundle_label_selector=selectors,
+            )
+        if not self._pg.ready(timeout=pg_timeout):
+            raise TimeoutError(
+                f"placement group for {n} train workers "
+                f"({res} each, {self._scaling.placement_strategy}) not ready "
+                f"in {pg_timeout}s — cluster lacks resources"
+            )
+        worker_cls = ray_api.remote(TrainWorker)
+        actors = []
+        for i in range(n):
+            actors.append(
+                worker_cls.options(
+                    num_cpus=res.get("CPU", 0),
+                    resources={k: v for k, v in res.items() if k != "CPU"},
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        self._pg, placement_group_bundle_index=i
+                    ),
+                ).remote()
+            )
+        metas = ray_api.get([a.get_metadata.remote() for a in actors])
+        # rank assignment: group by node, sort nodes by id for determinism,
+        # rank 0 first (reference: worker_group rank sorting :728-813)
+        order = sorted(range(n), key=lambda i: (metas[i]["node_id"], i))
+        node_ids: List[str] = []
+        self.workers = []
+        local_counts: Dict[str, int] = {}
+        for world_rank, idx in enumerate(order):
+            node_id = metas[idx]["node_id"]
+            if node_id not in node_ids:
+                node_ids.append(node_id)
+            local_rank = local_counts.get(node_id, 0)
+            local_counts[node_id] = local_rank + 1
+            self.workers.append(
+                WorkerInfo(
+                    actor=actors[idx],
+                    world_rank=world_rank,
+                    local_rank=local_rank,
+                    node_rank=node_ids.index(node_id),
+                    node_id=node_id,
+                    metadata=metas[idx],
+                )
+            )
+        return self
+
+    @property
+    def placement_group(self) -> Optional[PlacementGroup]:
+        return self._pg
+
+    def init_contexts(self, run_fields: dict):
+        local_sizes: Dict[str, int] = {}
+        for w in self.workers:
+            local_sizes[w.node_id] = local_sizes.get(w.node_id, 0) + 1
+        refs = []
+        for w in self.workers:
+            fields = dict(
+                world_rank=w.world_rank,
+                local_rank=w.local_rank,
+                node_rank=w.node_rank,
+                world_size=len(self.workers),
+                local_world_size=local_sizes[w.node_id],
+                **run_fields,
+            )
+            refs.append(w.actor.init_context.remote(fields))
+        ray_api.get(refs)
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        """Run fn on every worker, return results ordered by world rank."""
+        return ray_api.get(
+            [w.actor.execute.remote(fn, *args, **kwargs) for w in self.workers]
+        )
+
+    def execute_single(self, world_rank: int, fn: Callable, *args, **kwargs):
+        return ray_api.get(
+            self.workers[world_rank].actor.execute.remote(fn, *args, **kwargs)
+        )
+
+    def start_training(self, train_fn: Callable, config: Optional[dict]):
+        ray_api.get(
+            [w.actor.start_training.remote(train_fn, config) for w in self.workers]
+        )
+
+    def poll(self) -> List[dict]:
+        return ray_api.get([w.actor.poll.remote() for w in self.workers])
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_api.get(w.actor.shutdown.remote(), timeout=5)
+            except Exception:
+                pass
+        for w in self.workers:
+            try:
+                ray_api.kill(w.actor)
+            except Exception:
+                pass
+        self.workers = []
+        if self._pg is not None and self._owns_pg:
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+        self._pg = None
