@@ -1,0 +1,56 @@
+"""ray_tpu.data: streaming, distributed datasets (reference: python/ray/data).
+
+Lazy logical plans over columnar numpy blocks, executed as bounded pipelines
+of tasks/actors through the object store; per-host iterators feed TPU input
+pipelines via `iter_batches(device_put=...)` and `streaming_split`.
+"""
+
+from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from .dataset import (
+    Dataset,
+    GroupedData,
+    MaterializedDataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+from .datasource import Datasource, ReadTask
+from .executor import ActorPoolStrategy, DataContext
+from .iterator import DataIterator
+
+__all__ = [
+    "Dataset",
+    "MaterializedDataset",
+    "GroupedData",
+    "DataIterator",
+    "DataContext",
+    "ActorPoolStrategy",
+    "Datasource",
+    "ReadTask",
+    "AggregateFn",
+    "Count",
+    "Sum",
+    "Min",
+    "Max",
+    "Mean",
+    "Std",
+    "range",
+    "range_tensor",
+    "from_items",
+    "from_numpy",
+    "from_arrow",
+    "from_pandas",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_datasource",
+]

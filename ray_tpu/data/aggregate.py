@@ -1,0 +1,83 @@
+"""Aggregation functions for groupby/global aggregates.
+
+Role-equivalent of the reference's AggregateFn family
+(python/ray/data/aggregate.py — Count/Sum/Min/Max/Mean/Std). Each aggregate
+runs per hash partition inside a task: accumulate_block over the partition's
+rows for one key, then finalize.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+
+class AggregateFn:
+    name: str = "agg"
+
+    def accumulate_block(self, acc) -> Any:
+        raise NotImplementedError
+
+    def finalize(self, state: Any) -> Any:
+        return state
+
+
+class _ColumnAgg(AggregateFn):
+    def __init__(self, on: Optional[str] = None, alias_name: Optional[str] = None):
+        self.on = on
+        self.name = alias_name or f"{type(self).__name__.lower()}({on or ''})"
+
+    def _values(self, acc) -> np.ndarray:
+        batch = acc.to_batch()
+        if self.on is None:
+            numeric = [
+                v for v in batch.values() if np.issubdtype(v.dtype, np.number)
+            ]
+            if len(numeric) != 1:
+                raise ValueError(
+                    f"{self.name}: specify on= when the block has "
+                    f"{len(numeric)} numeric columns"
+                )
+            return numeric[0]
+        return batch[self.on]
+
+
+class Count(AggregateFn):
+    def __init__(self, alias_name: Optional[str] = None):
+        self.name = alias_name or "count()"
+
+    def accumulate_block(self, acc):
+        return acc.num_rows()
+
+
+class Sum(_ColumnAgg):
+    def accumulate_block(self, acc):
+        return self._values(acc).sum().item()
+
+
+class Min(_ColumnAgg):
+    def accumulate_block(self, acc):
+        return self._values(acc).min().item()
+
+
+class Max(_ColumnAgg):
+    def accumulate_block(self, acc):
+        return self._values(acc).max().item()
+
+
+class Mean(_ColumnAgg):
+    def accumulate_block(self, acc):
+        return self._values(acc).mean().item()
+
+
+class Std(_ColumnAgg):
+    def __init__(self, on=None, ddof: int = 1, alias_name=None):
+        super().__init__(on, alias_name)
+        self.ddof = ddof
+
+    def accumulate_block(self, acc):
+        v = self._values(acc)
+        if len(v) <= self.ddof:
+            return 0.0
+        return v.std(ddof=self.ddof).item()

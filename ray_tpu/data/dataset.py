@@ -1,0 +1,532 @@
+"""Dataset: lazy, streaming, distributed data pipelines.
+
+Role-equivalent of the reference's Dataset (python/ray/data/dataset.py) over
+the logical plan (plan.py) and streaming executor (executor.py). Transform
+calls build the plan lazily; execution happens on consumption (iterate /
+take / write / materialize), streaming blocks through the object store with
+bounded in-flight tasks.
+"""
+
+from __future__ import annotations
+
+import builtins as _builtins
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union as TUnion
+
+import numpy as np
+
+from .. import api
+from . import plan as planlib
+from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from .block import BlockAccessor, concat_blocks
+from .datasource import (
+    CSVDatasource,
+    Datasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+)
+from .executor import ActorPoolStrategy, DataContext, RefBundle, execute
+from .iterator import DataIterator
+
+
+class Dataset:
+    def __init__(self, op: planlib.Op):
+        self._op = op
+
+    # -- transforms (lazy) ---------------------------------------------------
+
+    def _with(self, op: planlib.Op) -> "Dataset":
+        return Dataset(op)
+
+    def map(self, fn: Callable, **ray_remote_args) -> "Dataset":
+        return self._with(
+            planlib.MapStage(
+                input_op=self._op,
+                transforms=[planlib.RowTransform("map", fn)],
+                ray_remote_args=ray_remote_args,
+                label=f"Map({_name(fn)})",
+            )
+        )
+
+    def filter(self, fn: Callable, **ray_remote_args) -> "Dataset":
+        return self._with(
+            planlib.MapStage(
+                input_op=self._op,
+                transforms=[planlib.RowTransform("filter", fn)],
+                ray_remote_args=ray_remote_args,
+                label=f"Filter({_name(fn)})",
+            )
+        )
+
+    def flat_map(self, fn: Callable, **ray_remote_args) -> "Dataset":
+        return self._with(
+            planlib.MapStage(
+                input_op=self._op,
+                transforms=[planlib.RowTransform("flat_map", fn)],
+                ray_remote_args=ray_remote_args,
+                label=f"FlatMap({_name(fn)})",
+            )
+        )
+
+    def map_batches(
+        self,
+        fn: TUnion[Callable, type],
+        *,
+        batch_size: Optional[int] = None,
+        compute: Optional[ActorPoolStrategy] = None,
+        fn_args: tuple = (),
+        fn_kwargs: Optional[dict] = None,
+        num_cpus: Optional[float] = None,
+        num_tpus: Optional[float] = None,
+        **ray_remote_args,
+    ) -> "Dataset":
+        if isinstance(fn, type) and compute is None:
+            raise ValueError(
+                "callable-class map_batches requires compute=ActorPoolStrategy"
+            )
+        if num_cpus is not None:
+            ray_remote_args["num_cpus"] = num_cpus
+        if num_tpus is not None:
+            ray_remote_args["num_tpus"] = num_tpus
+        return self._with(
+            planlib.MapStage(
+                input_op=self._op,
+                transforms=[
+                    planlib.BatchTransform(
+                        fn, batch_size, fn_args, fn_kwargs or {}
+                    )
+                ],
+                compute=compute,
+                ray_remote_args=ray_remote_args,
+                label=f"MapBatches({_name(fn)})",
+            )
+        )
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def _add(batch, name=name, fn=fn):
+            out = dict(batch)
+            out[name] = np.asarray(fn(batch))
+            return out
+
+        return self._with(
+            planlib.MapStage(
+                input_op=self._op,
+                transforms=[planlib.BatchTransform(_add, None)],
+                label=f"AddColumn({name})",
+            )
+        )
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def _drop(batch, cols=tuple(cols)):
+            return {k: v for k, v in batch.items() if k not in cols}
+
+        return self._with(
+            planlib.MapStage(
+                input_op=self._op,
+                transforms=[planlib.BatchTransform(_drop, None)],
+                label="DropColumns",
+            )
+        )
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def _select(batch, cols=tuple(cols)):
+            return {k: batch[k] for k in cols}
+
+        return self._with(
+            planlib.MapStage(
+                input_op=self._op,
+                transforms=[planlib.BatchTransform(_select, None)],
+                label="SelectColumns",
+            )
+        )
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def _rename(batch, mapping=dict(mapping)):
+            return {mapping.get(k, k): v for k, v in batch.items()}
+
+        return self._with(
+            planlib.MapStage(
+                input_op=self._op,
+                transforms=[planlib.BatchTransform(_rename, None)],
+                label="RenameColumns",
+            )
+        )
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(planlib.Limit(input_op=self._op, limit=n))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with(
+            planlib.Union(input_op=self._op, others=[o._op for o in others])
+        )
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(
+            planlib.Repartition(input_op=self._op, num_blocks=num_blocks)
+        )
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with(planlib.RandomShuffle(input_op=self._op, seed=seed))
+
+    def randomize_block_order(self, *, seed: Optional[int] = None) -> "Dataset":
+        # cheap stand-in: full shuffle of block order happens at iteration
+        return self.random_shuffle(seed=seed)
+
+    def sort(self, key, descending: bool = False) -> "Dataset":
+        return self._with(
+            planlib.Sort(input_op=self._op, key=key, descending=descending)
+        )
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with(planlib.Zip(input_op=self._op, other=other._op))
+
+    def groupby(self, key) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def random_sample(self, fraction: float, *, seed=None) -> "Dataset":
+        rng_seed = seed
+
+        def _sample(batch, fraction=fraction, rng_seed=rng_seed):
+            n = len(next(iter(batch.values()))) if batch else 0
+            rng = np.random.default_rng(rng_seed)
+            mask = rng.random(n) < fraction
+            return {k: v[mask] for k, v in batch.items()}
+
+        return self._with(
+            planlib.MapStage(
+                input_op=self._op,
+                transforms=[planlib.BatchTransform(_sample, None)],
+                label="RandomSample",
+            )
+        )
+
+    # -- consumption ---------------------------------------------------------
+
+    def iter_bundles(self) -> Iterator[RefBundle]:
+        return execute(self._op)
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(lambda: execute(self._op))
+
+    def iter_rows(self) -> Iterator[Any]:
+        return self.iterator().iter_rows()
+
+    def iter_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_batches(**kwargs)
+
+    def iter_torch_batches(self, **kwargs):
+        return self.iterator().iter_torch_batches(**kwargs)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        return sum(b.meta.num_rows for b in self.iter_bundles())
+
+    def sum(self, on: Optional[str] = None):
+        return self._global_agg(Sum(on))
+
+    def min(self, on: Optional[str] = None):
+        return self._global_agg(Min(on))
+
+    def max(self, on: Optional[str] = None):
+        return self._global_agg(Max(on))
+
+    def mean(self, on: Optional[str] = None):
+        s = self._global_agg(Sum(on))
+        c = self.count()
+        return s / c if c else None
+
+    def _global_agg(self, agg: AggregateFn):
+        vals = []
+        for block in self.iterator()._iter_blocks():
+            acc = BlockAccessor(block)
+            if acc.num_rows():
+                vals.append(agg.accumulate_block(acc))
+        if not vals:
+            return None
+        if isinstance(agg, Min):
+            return min(vals)
+        if isinstance(agg, Max):
+            return max(vals)
+        return sum(vals)
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        for bundle in self.iter_bundles():
+            if bundle.meta.schema:
+                return bundle.meta.schema
+        return None
+
+    def columns(self) -> Optional[List[str]]:
+        s = self.schema()
+        return list(s) if s else None
+
+    def num_blocks(self) -> int:
+        return sum(1 for _ in self.iter_bundles())
+
+    def size_bytes(self) -> int:
+        return sum(b.meta.size_bytes for b in self.iter_bundles())
+
+    def stats(self) -> str:
+        return planlib.plan_str(planlib.fuse(self._op))
+
+    def materialize(self) -> "MaterializedDataset":
+        bundles = list(self.iter_bundles())
+        return MaterializedDataset(
+            planlib.InputData(bundles=bundles), bundles
+        )
+
+    # -- splits --------------------------------------------------------------
+
+    def split(self, n: int) -> List["MaterializedDataset"]:
+        """Materialize and split into n datasets with equal block counts."""
+        bundles = list(self.repartition(n).iter_bundles())
+        out = []
+        for i in _builtins.range(n):
+            chunk = bundles[i::n] if len(bundles) != n else [bundles[i]]
+            out.append(
+                MaterializedDataset(planlib.InputData(bundles=chunk), chunk)
+            )
+        return out
+
+    def streaming_split(
+        self, n: int, *, equal: bool = False, locality_hints=None
+    ) -> List[DataIterator]:
+        """n coordinated iterators, each yielding a disjoint part of the
+        stream (reference: dataset.py:1863 streaming_split +
+        stream_split_iterator.py — used by Train to feed each worker)."""
+        from .split import make_split_iterators
+
+        return make_split_iterators(self, n, equal=equal)
+
+    def train_test_split(
+        self, test_size: float, *, shuffle: bool = False, seed=None
+    ):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        rows = ds.take_all()
+        n_test = int(len(rows) * test_size)
+        return (
+            from_items(rows[: len(rows) - n_test]),
+            from_items(rows[len(rows) - n_test :]),
+        )
+
+    # -- writes --------------------------------------------------------------
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(path, "json")
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "parquet")
+
+    def write_numpy(self, path: str, column: str = "data") -> List[str]:
+        return self._write(path, "numpy", column=column)
+
+    def _write(self, path: str, fmt: str, **kw) -> List[str]:
+        os.makedirs(path, exist_ok=True)
+        paths = []
+        for i, block in enumerate(self.iterator()._iter_blocks()):
+            out = os.path.join(path, f"part-{i:05d}.{_ext(fmt)}")
+            _write_block(block, out, fmt, **kw)
+            paths.append(out)
+        return paths
+
+    def __repr__(self):
+        return f"Dataset(plan=\n{planlib.plan_str(self._op)}\n)"
+
+
+class MaterializedDataset(Dataset):
+    def __init__(self, op: planlib.InputData, bundles: List[RefBundle]):
+        super().__init__(op)
+        self._bundles = bundles
+
+    def num_blocks(self) -> int:
+        return len(self._bundles)
+
+    def count(self) -> int:
+        return sum(b.meta.num_rows for b in self._bundles)
+
+
+class GroupedData:
+    """Result of Dataset.groupby (reference: data/grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, key):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        return Dataset(
+            planlib.GroupByAggregate(
+                input_op=self._ds._op, key=self._key, aggs=list(aggs)
+            )
+        )
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count())
+
+    def sum(self, on=None) -> Dataset:
+        return self.aggregate(Sum(on))
+
+    def min(self, on=None) -> Dataset:
+        return self.aggregate(Min(on))
+
+    def max(self, on=None) -> Dataset:
+        return self.aggregate(Max(on))
+
+    def mean(self, on=None) -> Dataset:
+        return self.aggregate(Mean(on))
+
+    def std(self, on=None) -> Dataset:
+        return self.aggregate(Std(on))
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """fn(batch_for_one_group) -> batch; implemented as sort + per-block
+        group walk."""
+        key = self._key
+
+        def _apply(batch, fn=fn, key=key):
+            acc = BlockAccessor(batch)
+            keys = batch[key]
+            outs = []
+            # batch is sorted by key, walk group runs
+            start = 0
+            for i in _builtins.range(1, len(keys) + 1):
+                if i == len(keys) or keys[i] != keys[start]:
+                    sub = BlockAccessor(acc.slice(start, i)).to_batch()
+                    outs.append(fn(sub))
+                    start = i
+            from .block import normalize_block
+
+            return concat_blocks([normalize_block(o) for o in outs])
+
+        sorted_ds = self._ds.sort(key).repartition(1)
+        return sorted_ds.map_batches(_apply)
+
+
+# -- read API ----------------------------------------------------------------
+
+
+def read_datasource(
+    datasource: Datasource, *, parallelism: int = -1
+) -> Dataset:
+    return Dataset(planlib.Read(datasource=datasource, parallelism=parallelism))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return read_datasource(RangeDatasource(n), parallelism=parallelism)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = -1) -> Dataset:
+    return read_datasource(
+        RangeDatasource(n, tuple(shape)), parallelism=parallelism
+    )
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    return read_datasource(ItemsDatasource(items), parallelism=parallelism)
+
+
+def from_numpy(arr: np.ndarray) -> Dataset:
+    return from_items([{"data": row} for row in arr])
+
+
+def from_arrow(table) -> Dataset:
+    batch = {
+        name: col.to_numpy(zero_copy_only=False)
+        for name, col in zip(table.column_names, table.columns)
+    }
+    from .block import columns_to_rows
+
+    return from_items(columns_to_rows(batch))
+
+def from_pandas(df) -> Dataset:
+    return from_items(df.to_dict("records"))
+
+
+def read_csv(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(CSVDatasource(paths), parallelism=parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(JSONDatasource(paths), parallelism=parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(NumpyDatasource(paths), parallelism=parallelism)
+
+
+def read_parquet(paths, *, columns=None, parallelism: int = -1) -> Dataset:
+    return read_datasource(
+        ParquetDatasource(paths, columns), parallelism=parallelism
+    )
+
+
+# -- write helpers -----------------------------------------------------------
+
+
+def _ext(fmt: str) -> str:
+    return {"csv": "csv", "json": "json", "parquet": "parquet", "numpy": "npy"}[
+        fmt
+    ]
+
+
+def _write_block(block, path: str, fmt: str, column: str = "data"):
+    acc = BlockAccessor(block)
+    if fmt == "csv":
+        import csv
+
+        batch = acc.to_batch()
+        with open(path, "w", newline="") as f:
+            writer = csv.writer(f)
+            writer.writerow(list(batch.keys()))
+            for row in acc.iter_rows():
+                writer.writerow([row[k] for k in batch.keys()])
+    elif fmt == "json":
+        import json
+
+        with open(path, "w") as f:
+            for row in acc.iter_rows():
+                f.write(json.dumps(_jsonable(row)) + "\n")
+    elif fmt == "parquet":
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        batch = acc.to_batch()
+        table = pa.table({k: pa.array(v) for k, v in batch.items()})
+        pq.write_table(table, path)
+    elif fmt == "numpy":
+        batch = acc.to_batch()
+        np.save(path, batch[column], allow_pickle=False)
+    else:
+        raise ValueError(fmt)
+
+
+def _jsonable(row):
+    if isinstance(row, dict):
+        return {k: _jsonable(v) for k, v in row.items()}
+    if isinstance(row, np.generic):
+        return row.item()
+    if isinstance(row, np.ndarray):
+        return row.tolist()
+    return row
+
+
+def _name(fn) -> str:
+    return getattr(fn, "__name__", type(fn).__name__)

@@ -1,0 +1,240 @@
+"""Datasources: pluggable readers producing ReadTasks.
+
+Role-equivalent of the reference's datasource layer
+(python/ray/data/datasource/datasource.py — Datasource.get_read_tasks,
+ReadTask) plus the built-in file readers (read_api.py). Each ReadTask is a
+plain function executed as a remote task that yields blocks; parallelism is
+decided up front from the requested override or the datasource's estimate.
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .block import Block, BlockMetadata, rows_to_columns
+
+
+@dataclass
+class ReadTask:
+    """A serializable unit of reading work: fn() -> iterable of blocks."""
+
+    fn: Callable[[], Iterable[Block]]
+    metadata: BlockMetadata
+
+
+class Datasource:
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def get_name(self) -> str:
+        return type(self).__name__.replace("Datasource", "")
+
+
+class RangeDatasource(Datasource):
+    """ray_tpu.data.range / range_tensor (reference: read_api.py range)."""
+
+    def __init__(self, n: int, tensor_shape: Optional[tuple] = None):
+        self._n = n
+        self._shape = tensor_shape
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        per = 8
+        if self._shape:
+            per = 8 * int(np.prod(self._shape))
+        return self._n * per
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self._n or 1))
+        tasks = []
+        for i in range(parallelism):
+            lo = (self._n * i) // parallelism
+            hi = (self._n * (i + 1)) // parallelism
+            shape = self._shape
+
+            def fn(lo=lo, hi=hi, shape=shape):
+                ids = np.arange(lo, hi, dtype=np.int64)
+                if shape is None:
+                    return [{"id": ids}]
+                data = np.broadcast_to(
+                    ids.reshape((-1,) + (1,) * len(shape)),
+                    (hi - lo,) + shape,
+                ).copy()
+                return [{"data": data}]
+
+            nbytes = (hi - lo) * 8 * (int(np.prod(shape)) if shape else 1)
+            tasks.append(
+                ReadTask(fn, BlockMetadata(num_rows=hi - lo, size_bytes=nbytes))
+            )
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    """from_items: local python objects become row blocks."""
+
+    def __init__(self, items: List[Any]):
+        self._items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self._items)
+        parallelism = max(1, min(parallelism, n or 1))
+        tasks = []
+        for i in range(parallelism):
+            lo = (n * i) // parallelism
+            hi = (n * (i + 1)) // parallelism
+            chunk = self._items[lo:hi]
+
+            def fn(chunk=chunk):
+                if chunk and isinstance(chunk[0], dict):
+                    return [rows_to_columns(chunk)]
+                return [list(chunk)]
+
+            tasks.append(
+                ReadTask(fn, BlockMetadata(num_rows=hi - lo, size_bytes=0))
+            )
+        return tasks
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(
+                sorted(
+                    os.path.join(p, f)
+                    for f in os.listdir(p)
+                    if not f.startswith(".")
+                )
+            )
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+class FileDatasource(Datasource):
+    """Base for per-file readers; one ReadTask per group of files."""
+
+    def __init__(self, paths):
+        self._paths = _expand_paths(paths)
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        files = self._paths
+        parallelism = max(1, min(parallelism, len(files)))
+        tasks = []
+        for i in range(parallelism):
+            lo = (len(files) * i) // parallelism
+            hi = (len(files) * (i + 1)) // parallelism
+            group = files[lo:hi]
+            reader = self._read_file
+
+            def fn(group=group, reader=reader):
+                blocks: List[Block] = []
+                for path in group:
+                    blocks.extend(reader(path))
+                return blocks
+
+            size = sum(os.path.getsize(f) for f in group if os.path.exists(f))
+            tasks.append(
+                ReadTask(
+                    fn,
+                    BlockMetadata(
+                        num_rows=0, size_bytes=size, input_files=group
+                    ),
+                )
+            )
+        return tasks
+
+
+class CSVDatasource(FileDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        import csv
+
+        with open(path, newline="") as f:
+            reader = csv.DictReader(f)
+            rows = list(reader)
+        if not rows:
+            return []
+        cols: Dict[str, list] = {k: [] for k in rows[0]}
+        for row in rows:
+            for k in cols:
+                cols[k].append(_coerce(row[k]))
+        return [{k: np.asarray(v) for k, v in cols.items()}]
+
+
+def _coerce(s: str):
+    try:
+        return int(s)
+    except (TypeError, ValueError):
+        pass
+    try:
+        return float(s)
+    except (TypeError, ValueError):
+        return s
+
+
+class JSONDatasource(FileDatasource):
+    """JSON-lines (one object per line) or a top-level JSON array."""
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        import json
+
+        with open(path) as f:
+            head = f.read(1)
+            f.seek(0)
+            if head == "[":
+                rows = json.load(f)
+            else:
+                rows = [json.loads(line) for line in f if line.strip()]
+        if rows and isinstance(rows[0], dict):
+            return [rows_to_columns(rows)]
+        return [rows]
+
+
+class NumpyDatasource(FileDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        arr = np.load(path, allow_pickle=False)
+        return [{"data": arr}]
+
+
+class ParquetDatasource(FileDatasource):
+    def __init__(self, paths, columns: Optional[List[str]] = None):
+        super().__init__(paths)
+        self._columns = columns
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        try:
+            import pyarrow.parquet as pq
+        except ImportError as e:
+            raise ImportError(
+                "read_parquet requires pyarrow, which is not available in "
+                "this environment"
+            ) from e
+        table = pq.read_table(path, columns=self._columns)
+        return [
+            {
+                name: col.to_numpy(zero_copy_only=False)
+                for name, col in zip(table.column_names, table.columns)
+            }
+        ]
+
+
+@dataclass
+class WriteResult:
+    paths: List[str] = field(default_factory=list)
+    num_rows: int = 0

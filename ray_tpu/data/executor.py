@@ -1,0 +1,508 @@
+"""Streaming executor: drives the fused plan as a bounded pipeline of tasks.
+
+Role-equivalent of the reference's StreamingExecutor
+(python/ray/data/_internal/execution/streaming_executor.py:67 — control loop
+:344) + physical operators (execution/operators/) + backpressure policies
+(backpressure_policy/concurrency_cap…). Design: each stage is a Python
+generator that pulls RefBundles from upstream, keeps at most
+``max_in_flight`` remote tasks outstanding, and yields output bundles as
+tasks finish — so block N of stage 3 can execute while block N+4 of stage 1
+is still being read, and the number of queued blocks (and hence object-store
+pressure) is bounded end-to-end.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .. import api
+from .block import (
+    Block,
+    BlockAccessor,
+    BlockMetadata,
+    concat_blocks,
+    rows_to_columns,
+)
+from . import plan as planlib
+from .plan import (
+    GroupByAggregate,
+    InputData,
+    Limit,
+    MapStage,
+    Op,
+    RandomShuffle,
+    Read,
+    Repartition,
+    Sort,
+    Union,
+    Zip,
+    apply_transforms,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class RefBundle:
+    """One block ref + its metadata (reference:
+    _internal/execution/interfaces/ref_bundle.py:30)."""
+
+    block_ref: Any
+    meta: BlockMetadata
+
+
+class DataContext:
+    """Per-process execution knobs (reference: data/context.py DataContext)."""
+
+    _instance: Optional["DataContext"] = None
+
+    def __init__(self):
+        self.read_parallelism = 8
+        self.max_in_flight_tasks = 0  # 0 => derive from cluster CPUs
+        self.actor_pool_in_flight_per_actor = 2
+        self.target_max_block_size = 128 * 1024 * 1024
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._instance is None:
+            cls._instance = DataContext()
+        return cls._instance
+
+    def resolved_max_in_flight(self) -> int:
+        if self.max_in_flight_tasks > 0:
+            return self.max_in_flight_tasks
+        try:
+            cpus = api.cluster_resources().get("CPU", 0)
+            return max(2, int(cpus))
+        except Exception:
+            return 4
+
+
+# -- remote task bodies ------------------------------------------------------
+# Defined lazily so importing ray_tpu.data never requires an initialized
+# cluster; created once per driver process.
+
+_REMOTES: Dict[str, Any] = {}
+
+
+def _remotes():
+    if _REMOTES:
+        return _REMOTES
+
+    def _read(task_fn) -> tuple:
+        blocks = list(task_fn())
+        block = concat_blocks(blocks) if len(blocks) != 1 else blocks[0]
+        return block, BlockAccessor(block).metadata()
+
+    def _map(transforms, *blocks) -> tuple:
+        block = blocks[0] if len(blocks) == 1 else concat_blocks(list(blocks))
+        out = apply_transforms(transforms, block)
+        return out, BlockAccessor(out).metadata()
+
+    def _truncate(block, n) -> tuple:
+        out = BlockAccessor(block).take(n)
+        return out, BlockAccessor(out).metadata()
+
+    def _split(block, n, mode, key, seed):
+        acc = BlockAccessor(block)
+        if mode == "range":
+            from .block import split_block
+
+            return tuple(split_block(block, n))
+        rows = acc.num_rows()
+        if mode == "random":
+            rng = np.random.default_rng(seed)
+            assign = rng.integers(0, n, size=rows)
+        elif mode == "hash":
+            keys = _key_values(acc, key)
+            assign = np.asarray([hash(k) % n for k in keys], dtype=np.int64)
+        else:
+            raise ValueError(mode)
+        parts = []
+        idx_all = np.arange(rows)
+        for i in range(n):
+            idx = idx_all[assign == i]
+            parts.append(_take_rows(acc, idx))
+        return tuple(parts)
+
+    def _concat(*parts) -> tuple:
+        out = concat_blocks(list(parts))
+        return out, BlockAccessor(out).metadata()
+
+    def _concat_shuffled(seed, *parts) -> tuple:
+        out = concat_blocks(list(parts))
+        acc = BlockAccessor(out)
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(acc.num_rows())
+        out = _take_rows(acc, idx)
+        return out, BlockAccessor(out).metadata()
+
+    def _sort_all(key, descending, n_out, *blocks):
+        merged = concat_blocks(list(blocks))
+        acc = BlockAccessor(merged)
+        keys = _key_values(acc, key)
+        order = np.argsort(np.asarray(keys), kind="stable")
+        if descending:
+            order = order[::-1]
+        merged = _take_rows(acc, order)
+        from .block import split_block
+
+        outs = split_block(merged, n_out)
+        flat = []
+        for b in outs:
+            flat.append(b)
+            flat.append(BlockAccessor(b).metadata())
+        return tuple(flat)
+
+    def _aggregate(key, aggs, *parts) -> tuple:
+        merged = concat_blocks(list(parts))
+        acc = BlockAccessor(merged)
+        if acc.num_rows() == 0:
+            return [], BlockMetadata(0, 0)
+        groups: Dict[Any, list] = {}
+        keys = _key_values(acc, key)
+        for i, k in enumerate(keys):
+            groups.setdefault(k, []).append(i)
+        out_rows = []
+        for k in sorted(groups.keys()):
+            idx = np.asarray(groups[k])
+            sub = BlockAccessor(_take_rows(acc, idx))
+            row = {key: k} if isinstance(key, str) else {"key": k}
+            for agg in aggs:
+                row[agg.name] = agg.finalize(agg.accumulate_block(sub))
+            out_rows.append(row)
+        out = rows_to_columns(out_rows)
+        return out, BlockAccessor(out).metadata()
+
+    def _zip_all(n_left, n_out, *blocks):
+        left = concat_blocks(list(blocks[:n_left]))
+        right = concat_blocks(list(blocks[n_left:]))
+        la, ra = BlockAccessor(left), BlockAccessor(right)
+        if la.num_rows() != ra.num_rows():
+            raise ValueError(
+                f"zip: row counts differ ({la.num_rows()} vs {ra.num_rows()})"
+            )
+        lb, rb = la.to_batch(), ra.to_batch()
+        out = dict(lb)
+        for k, v in rb.items():
+            out[k if k not in out else f"{k}_1"] = v
+        from .block import split_block
+
+        outs = split_block(out, n_out)
+        flat = []
+        for b in outs:
+            flat.append(b)
+            flat.append(BlockAccessor(b).metadata())
+        return tuple(flat)
+
+    _REMOTES.update(
+        read=api.remote(_read),
+        map=api.remote(_map),
+        truncate=api.remote(_truncate),
+        split=api.remote(_split),
+        concat=api.remote(_concat),
+        concat_shuffled=api.remote(_concat_shuffled),
+        sort_all=api.remote(_sort_all),
+        aggregate=api.remote(_aggregate),
+        zip_all=api.remote(_zip_all),
+    )
+    return _REMOTES
+
+
+def _key_values(acc: BlockAccessor, key):
+    if callable(key):
+        return [key(r) for r in acc.iter_rows()]
+    batch = acc.to_batch()
+    if key not in batch:
+        raise KeyError(f"sort/groupby key {key!r} not in columns {list(batch)}")
+    return list(batch[key])
+
+
+def _take_rows(acc: BlockAccessor, idx) -> Block:
+    if acc.is_columnar():
+        return {k: v[idx] for k, v in acc.block.items()}
+    rows = acc.to_rows()
+    return [rows[int(i)] for i in idx]
+
+
+# -- actor pool compute ------------------------------------------------------
+
+
+class ActorPoolStrategy:
+    """compute= argument for map_batches (reference:
+    data/_internal/compute.py ActorPoolStrategy)."""
+
+    def __init__(
+        self,
+        size: Optional[int] = None,
+        min_size: int = 1,
+        max_size: Optional[int] = None,
+        num_tpus: float = 0,
+        num_cpus: float = 1,
+    ):
+        self.size = size or max_size or min_size
+        self.num_tpus = num_tpus
+        self.num_cpus = num_cpus
+
+
+class _PoolWorker:
+    """Stateful map worker; holds callable-class instances across blocks."""
+
+    def __init__(self, transforms):
+        self._transforms = []
+        for t in transforms:
+            if isinstance(t, planlib.BatchTransform) and isinstance(t.fn, type):
+                inst = t.fn()
+                t = planlib.BatchTransform(
+                    inst, t.batch_size, t.fn_args, t.fn_kwargs
+                )
+            self._transforms.append(t)
+
+    def apply(self, *blocks):
+        block = blocks[0] if len(blocks) == 1 else concat_blocks(list(blocks))
+        out = apply_transforms(self._transforms, block)
+        return out, BlockAccessor(out).metadata()
+
+    def ping(self):
+        return True
+
+
+# -- the executor ------------------------------------------------------------
+
+
+def execute(op: Op) -> Iterator[RefBundle]:
+    """Execute a fused plan, yielding output bundles as they materialize."""
+    op = planlib.fuse(op)
+    return _exec(op)
+
+
+def _exec(op: Op) -> Iterator[RefBundle]:
+    if isinstance(op, InputData):
+        return iter(op.bundles)
+    if isinstance(op, Read):
+        return _exec_read(op)
+    if isinstance(op, MapStage):
+        if isinstance(op.compute, ActorPoolStrategy):
+            return _exec_map_actors(op)
+        return _exec_map_tasks(op)
+    if isinstance(op, Limit):
+        return _exec_limit(op)
+    if isinstance(op, Union):
+        return _exec_union(op)
+    if isinstance(op, Repartition):
+        return _exec_repartition(op)
+    if isinstance(op, RandomShuffle):
+        return _exec_random_shuffle(op)
+    if isinstance(op, Sort):
+        return _exec_sort(op)
+    if isinstance(op, GroupByAggregate):
+        return _exec_groupby(op)
+    if isinstance(op, Zip):
+        return _exec_zip(op)
+    raise NotImplementedError(f"no physical operator for {op}")
+
+
+def _ordered_pipeline(submissions, cap: int) -> Iterator[RefBundle]:
+    """Keep up to ``cap`` tasks in flight, yield results in submission order
+    (the reference's default: operators preserve block order; backpressure =
+    bounded in-flight, execution/backpressure_policy/concurrency_cap…).
+    Blocking on the FIFO head still overlaps: the tail keeps executing."""
+    from collections import deque
+
+    queue: deque = deque()
+    exhausted = False
+    while not exhausted or queue:
+        while not exhausted and len(queue) < cap:
+            try:
+                queue.append(next(submissions))
+            except StopIteration:
+                exhausted = True
+        if queue:
+            block_ref, meta_ref = queue.popleft()
+            yield RefBundle(block_ref, api.get(meta_ref))
+
+
+def _exec_read(op: Read) -> Iterator[RefBundle]:
+    ctx = DataContext.get_current()
+    parallelism = op.parallelism
+    if parallelism <= 0:
+        parallelism = ctx.read_parallelism
+    tasks = op.datasource.get_read_tasks(parallelism)
+    read = _remotes()["read"].options(num_returns=2)
+
+    def submit():
+        for t in tasks:
+            yield read.remote(t.fn)
+
+    return _ordered_pipeline(submit(), ctx.resolved_max_in_flight())
+
+
+def _exec_map_tasks(op: MapStage) -> Iterator[RefBundle]:
+    ctx = DataContext.get_current()
+    opts = dict(num_returns=2)
+    if op.ray_remote_args:
+        opts.update(op.ray_remote_args)
+    map_fn = _remotes()["map"].options(**opts)
+
+    def submit():
+        for bundle in _exec(op.input_op):
+            yield map_fn.remote(op.transforms, bundle.block_ref)
+
+    return _ordered_pipeline(submit(), ctx.resolved_max_in_flight())
+
+
+def _exec_map_actors(op: MapStage) -> Iterator[RefBundle]:
+    from .. import api as ray_api
+
+    strategy: ActorPoolStrategy = op.compute
+    ctx = DataContext.get_current()
+    PoolActor = ray_api.remote(
+        num_cpus=strategy.num_cpus, num_tpus=strategy.num_tpus
+    )(_PoolWorker)
+    actors = [PoolActor.remote(op.transforms) for _ in range(strategy.size)]
+    try:
+        api.get([a.ping.remote() for a in actors])
+        cap = len(actors) * ctx.actor_pool_in_flight_per_actor
+        rr = [0]
+
+        def submit():
+            for bundle in _exec(op.input_op):
+                i = rr[0] % len(actors)
+                rr[0] += 1
+                yield actors[i].apply.options(num_returns=2).remote(
+                    bundle.block_ref
+                )
+
+        yield from _ordered_pipeline(submit(), cap)
+    finally:
+        for a in actors:
+            try:
+                ray_api.kill(a)
+            except Exception:
+                pass
+
+
+def _exec_limit(op: Limit) -> Iterator[RefBundle]:
+    remaining = op.limit
+    truncate = _remotes()["truncate"].options(num_returns=2)
+    for bundle in _exec(op.input_op):
+        if remaining <= 0:
+            break
+        if bundle.meta.num_rows <= remaining:
+            remaining -= bundle.meta.num_rows
+            yield bundle
+        else:
+            block_ref, meta_ref = truncate.remote(bundle.block_ref, remaining)
+            remaining = 0
+            yield RefBundle(block_ref, api.get(meta_ref))
+            break
+
+
+def _exec_union(op: Union) -> Iterator[RefBundle]:
+    yield from _exec(op.input_op)
+    for other in op.others:
+        yield from _exec(other)
+
+
+def _collect(op: Op) -> List[RefBundle]:
+    return list(_exec(op))
+
+
+def _shuffle_two_phase(
+    bundles: List[RefBundle], n_out: int, mode: str, key=None, seed=None
+) -> Iterator[RefBundle]:
+    """split each input block into n_out partitions, then concat partition i
+    across inputs (reference: hash_shuffle / push-based shuffle operators)."""
+    if not bundles:
+        return
+    split = _remotes()["split"]
+    concat_name = "concat_shuffled" if mode == "random" else "concat"
+    parts_per_input = []
+    for j, b in enumerate(bundles):
+        s = seed + j if seed is not None else None
+        refs = split.options(num_returns=max(n_out, 1)).remote(
+            b.block_ref, n_out, mode, key, s
+        )
+        if n_out == 1:
+            refs = [refs]
+        parts_per_input.append(refs)
+    for i in range(n_out):
+        parts = [p[i] for p in parts_per_input]
+        if mode == "random":
+            c = _remotes()[concat_name].options(num_returns=2)
+            block_ref, meta_ref = c.remote(
+                (seed or 0) + 7919 * i if seed is not None else None, *parts
+            )
+        else:
+            c = _remotes()[concat_name].options(num_returns=2)
+            block_ref, meta_ref = c.remote(*parts)
+        yield RefBundle(block_ref, api.get(meta_ref))
+
+
+def _exec_repartition(op: Repartition) -> Iterator[RefBundle]:
+    bundles = _collect(op.input_op)
+    yield from _shuffle_two_phase(bundles, op.num_blocks, "range")
+
+
+def _exec_random_shuffle(op: RandomShuffle) -> Iterator[RefBundle]:
+    bundles = _collect(op.input_op)
+    n_out = op.num_blocks or max(len(bundles), 1)
+    seed = op.seed if op.seed is not None else 0
+    yield from _shuffle_two_phase(bundles, n_out, "random", seed=seed)
+
+
+def _exec_sort(op: Sort) -> Iterator[RefBundle]:
+    bundles = _collect(op.input_op)
+    if not bundles:
+        return
+    n_out = len(bundles)
+    fn = _remotes()["sort_all"].options(num_returns=2 * n_out)
+    refs = fn.remote(
+        op.key, op.descending, n_out, *[b.block_ref for b in bundles]
+    )
+    for i in range(n_out):
+        yield RefBundle(refs[2 * i], api.get(refs[2 * i + 1]))
+
+
+def _exec_groupby(op: GroupByAggregate) -> Iterator[RefBundle]:
+    bundles = _collect(op.input_op)
+    if not bundles:
+        return
+    n_parts = min(op.num_partitions, max(len(bundles), 1))
+    split = _remotes()["split"]
+    agg = _remotes()["aggregate"].options(num_returns=2)
+    parts_per_input = []
+    for b in bundles:
+        refs = split.options(num_returns=max(n_parts, 1)).remote(
+            b.block_ref, n_parts, "hash", op.key, None
+        )
+        if n_parts == 1:
+            refs = [refs]
+        parts_per_input.append(refs)
+    for i in range(n_parts):
+        parts = [p[i] for p in parts_per_input]
+        block_ref, meta_ref = agg.remote(op.key, op.aggs, *parts)
+        bundle = RefBundle(block_ref, api.get(meta_ref))
+        if bundle.meta.num_rows > 0:
+            yield bundle
+
+
+def _exec_zip(op: Zip) -> Iterator[RefBundle]:
+    left = _collect(op.input_op)
+    right = _collect(op.other)
+    if not left:
+        return
+    n_out = len(left)
+    fn = _remotes()["zip_all"].options(num_returns=2 * n_out)
+    refs = fn.remote(
+        len(left),
+        n_out,
+        *[b.block_ref for b in left],
+        *[b.block_ref for b in right],
+    )
+    for i in range(n_out):
+        yield RefBundle(refs[2 * i], api.get(refs[2 * i + 1]))

@@ -1,0 +1,166 @@
+"""DataIterator: consume a stream of bundles as rows/batches, TPU-first.
+
+Role-equivalent of the reference's DataIterator
+(python/ray/data/iterator.py — iter_batches/iter_rows/iter_torch_batches).
+TPU twist: ``iter_batches(device_put=...)`` moves each batch onto the chip
+(or a sharded mesh layout) with `jax.device_put` while the next batch's
+blocks are still being fetched — the host/device overlap the reference gets
+from its prefetching GPU dataloader.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .. import api
+from .block import BlockAccessor, concat_blocks
+
+
+class DataIterator:
+    """Iterates the output of a plan execution (a bundle-iterator factory)."""
+
+    def __init__(self, bundle_factory: Callable[[], Iterator]):
+        self._bundle_factory = bundle_factory
+
+    # -- rows ----------------------------------------------------------------
+
+    def iter_rows(self, prefetch_blocks: int = 2) -> Iterator[Any]:
+        for block in self._iter_blocks(prefetch_blocks):
+            yield from BlockAccessor(block).iter_rows()
+
+    # -- batches -------------------------------------------------------------
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        prefetch_blocks: int = 2,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+        device_put: Optional[Any] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Yield dict-of-array batches of exactly ``batch_size`` rows
+        (except possibly the last). ``device_put`` may be a jax Device,
+        Sharding, or True (default device)."""
+        carry = None
+        rng = (
+            np.random.default_rng(local_shuffle_seed)
+            if local_shuffle_buffer_size
+            else None
+        )
+        buffer: List[Any] = []
+        buffered_rows = 0
+
+        def emit(batch):
+            formatted = _format_batch(batch, batch_format)
+            if device_put is not None:
+                formatted = _device_put(formatted, device_put)
+            return formatted
+
+        for block in self._iter_blocks(prefetch_blocks):
+            acc = BlockAccessor(block)
+            if acc.num_rows() == 0:
+                continue
+            if rng is not None:
+                buffer.append(block)
+                buffered_rows += acc.num_rows()
+                if buffered_rows < local_shuffle_buffer_size:
+                    continue
+                merged = concat_blocks(buffer)
+                macc = BlockAccessor(merged)
+                idx = rng.permutation(macc.num_rows())
+                from .executor import _take_rows
+
+                block = _take_rows(macc, idx)
+                buffer, buffered_rows = [], 0
+                acc = BlockAccessor(block)
+            if carry is not None:
+                block = concat_blocks([carry, block])
+                acc = BlockAccessor(block)
+                carry = None
+            if batch_size is None:
+                yield emit(acc.to_batch())
+                continue
+            n = acc.num_rows()
+            lo = 0
+            while n - lo >= batch_size:
+                yield emit(BlockAccessor(acc.slice(lo, lo + batch_size)).to_batch())
+                lo += batch_size
+            if lo < n:
+                carry = acc.slice(lo, n)
+        if buffer:
+            merged = concat_blocks(buffer)
+            if carry is not None:
+                merged = concat_blocks([carry, merged])
+                carry = None
+            macc = BlockAccessor(merged)
+            idx = rng.permutation(macc.num_rows())
+            from .executor import _take_rows
+
+            merged = _take_rows(macc, idx)
+            acc = BlockAccessor(merged)
+            n = acc.num_rows()
+            lo = 0
+            while n - lo >= (batch_size or n):
+                yield emit(BlockAccessor(acc.slice(lo, lo + (batch_size or n))).to_batch())
+                lo += batch_size or n
+            if lo < n:
+                carry = acc.slice(lo, n)
+        if carry is not None and not drop_last:
+            yield emit(BlockAccessor(carry).to_batch())
+
+    def iter_torch_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        kwargs.setdefault("batch_format", "numpy")
+        device_put = kwargs.pop("device_put", None)
+        assert device_put is None, "use device= semantics via torch yourself"
+        import torch
+
+        for batch in self.iter_batches(**kwargs):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()}
+
+    # -- internals -----------------------------------------------------------
+
+    def _iter_blocks(self, prefetch_blocks: int = 2) -> Iterator[Any]:
+        """Fetch blocks with a sliding prefetch window: up to
+        ``prefetch_blocks`` refs are being pulled while the current block is
+        consumed."""
+        from ..object_ref import ObjectRef
+
+        bundles = self._bundle_factory()
+        window: List[Any] = []
+
+        def resolve(x):
+            return api.get(x) if isinstance(x, ObjectRef) else x
+
+        for bundle in bundles:
+            window.append(bundle.block_ref)
+            if len(window) > max(prefetch_blocks, 0):
+                yield resolve(window.pop(0))
+        for ref in window:
+            yield resolve(ref)
+
+
+def _format_batch(batch: Dict[str, np.ndarray], batch_format: str):
+    if batch_format in ("numpy", "default"):
+        return batch
+    if batch_format == "pandas":
+        import pandas as pd
+
+        return pd.DataFrame({k: list(v) for k, v in batch.items()})
+    if batch_format == "rows":
+        from .block import columns_to_rows
+
+        return columns_to_rows(batch)
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def _device_put(batch, spec):
+    import jax
+
+    if spec is True:
+        return {k: jax.device_put(v) for k, v in batch.items()}
+    return {k: jax.device_put(v, spec) for k, v in batch.items()}

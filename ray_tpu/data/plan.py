@@ -1,0 +1,208 @@
+"""Logical plan + operator fusion.
+
+Role-equivalent of the reference's logical layer
+(python/ray/data/_internal/logical/ — LogicalOperator nodes, optimizer rules)
+collapsed to the part that matters for streaming execution: a chain of
+operators where consecutive one-to-one transforms (map/filter/flat_map/
+map_batches) are **fused into a single task** so each block takes one
+serialization round-trip through the object store per fused stage, not per
+op (reference rule: OperatorFusionRule,
+_internal/logical/rules/operator_fusion.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .block import (
+    Block,
+    BlockAccessor,
+    concat_blocks,
+    normalize_block,
+    rows_to_columns,
+)
+
+
+# -- transforms (the payload of a fused map stage) ---------------------------
+
+
+@dataclass
+class RowTransform:
+    kind: str  # "map" | "filter" | "flat_map"
+    fn: Callable
+
+
+@dataclass
+class BatchTransform:
+    fn: Callable
+    batch_size: Optional[int]
+    fn_args: tuple = ()
+    fn_kwargs: dict = field(default_factory=dict)
+    zero_copy: bool = False
+
+
+Transform = Any  # RowTransform | BatchTransform
+
+
+def apply_transforms(transforms: List[Transform], block: Block) -> Block:
+    """Run a fused transform chain over one block (executes inside a task)."""
+    for t in transforms:
+        acc = BlockAccessor(block)
+        if isinstance(t, BatchTransform):
+            out_blocks: List[Block] = []
+            n = acc.num_rows()
+            bs = t.batch_size or max(n, 1)
+            for lo in range(0, max(n, 1), bs):
+                if n == 0:
+                    break
+                batch = BlockAccessor(acc.slice(lo, min(lo + bs, n))).to_batch()
+                result = t.fn(batch, *t.fn_args, **t.fn_kwargs)
+                out_blocks.append(normalize_block(result))
+            block = concat_blocks(out_blocks) if out_blocks else block
+        elif t.kind == "map":
+            rows = [t.fn(r) for r in acc.iter_rows()]
+            block = rows_to_columns(rows) if rows and isinstance(
+                rows[0], dict
+            ) else rows
+        elif t.kind == "filter":
+            rows = [r for r in acc.iter_rows() if t.fn(r)]
+            block = rows_to_columns(rows) if rows and isinstance(
+                rows[0], dict
+            ) else rows
+        elif t.kind == "flat_map":
+            rows = [o for r in acc.iter_rows() for o in t.fn(r)]
+            block = rows_to_columns(rows) if rows and isinstance(
+                rows[0], dict
+            ) else rows
+        else:
+            raise ValueError(f"unknown transform {t}")
+    return block
+
+
+# -- logical operators -------------------------------------------------------
+
+
+@dataclass
+class Op:
+    """Base logical operator. input_op is None only for sources."""
+
+    input_op: Optional["Op"] = None
+
+    def name(self) -> str:
+        return type(self).__name__
+
+
+@dataclass
+class Read(Op):
+    datasource: Any = None
+    parallelism: int = -1
+
+    def name(self):
+        return f"Read{self.datasource.get_name()}"
+
+
+@dataclass
+class InputData(Op):
+    """Pre-materialized bundles (used by MaterializedDataset re-execution)."""
+
+    bundles: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class MapStage(Op):
+    transforms: List[Transform] = field(default_factory=list)
+    compute: Any = None  # None => tasks; ActorPoolStrategy => actor pool
+    ray_remote_args: Dict[str, Any] = field(default_factory=dict)
+    label: str = "Map"
+
+    def name(self):
+        return self.label
+
+
+@dataclass
+class Limit(Op):
+    limit: int = 0
+
+
+@dataclass
+class Union(Op):
+    others: List[Op] = field(default_factory=list)
+
+
+@dataclass
+class Repartition(Op):
+    num_blocks: int = 1
+
+
+@dataclass
+class RandomShuffle(Op):
+    seed: Optional[int] = None
+    num_blocks: Optional[int] = None
+
+
+@dataclass
+class Sort(Op):
+    key: Any = None
+    descending: bool = False
+
+
+@dataclass
+class GroupByAggregate(Op):
+    key: Any = None
+    aggs: List[Any] = field(default_factory=list)
+    num_partitions: int = 8
+
+
+@dataclass
+class Zip(Op):
+    other: Op = None
+
+
+def fuse(op: Op) -> Op:
+    """Bottom-up fusion of adjacent compatible MapStages."""
+    if op is None:
+        return None
+    op.input_op = fuse(op.input_op)
+    if isinstance(op, Union):
+        op.others = [fuse(o) for o in op.others]
+    if isinstance(op, Zip) and op.other is not None:
+        op.other = fuse(op.other)
+    if (
+        isinstance(op, MapStage)
+        and isinstance(op.input_op, MapStage)
+        and _fusable(op.input_op, op)
+    ):
+        prev = op.input_op
+        return fuse(
+            MapStage(
+                input_op=prev.input_op,
+                transforms=prev.transforms + op.transforms,
+                compute=op.compute or prev.compute,
+                ray_remote_args={
+                    **prev.ray_remote_args,
+                    **op.ray_remote_args,
+                },
+                label=f"{prev.label}->{op.label}",
+            )
+        )
+    return op
+
+
+def _fusable(a: MapStage, b: MapStage) -> bool:
+    # Actor-pool stages keep their own pool; only fuse task-compute stages
+    # with identical resource requests.
+    if a.compute is not None or b.compute is not None:
+        return False
+    return a.ray_remote_args == b.ray_remote_args
+
+
+def plan_str(op: Op, indent: int = 0) -> str:
+    lines = []
+    while op is not None:
+        lines.append("  " * indent + "+- " + op.name())
+        if isinstance(op, Union):
+            for o in op.others:
+                lines.append(plan_str(o, indent + 1))
+        op = op.input_op
+    return "\n".join(lines)

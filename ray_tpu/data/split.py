@@ -1,0 +1,111 @@
+"""streaming_split: one executing stream fanned out to n consumers.
+
+Role-equivalent of the reference's StreamSplitDataIterator
+(python/ray/data/_internal/execution/stream_split_iterator.py:35): the
+pipeline executes once and each consumer (e.g. a Train worker on its own
+host) receives a disjoint sequence of blocks on demand.
+
+Design: the driver pumps the stream in a background thread and pushes block
+*values* into a queue actor (bounded per-consumer, so object-store pressure
+stays capped); consumers — in any process — poll the actor. Blocks are
+assigned to the consumer with the fewest rows so far, which keeps ``equal=
+True`` splits balanced; JAX SPMD training needs every host to step the same
+number of times or collectives deadlock, so balanced feeds matter more here
+than in the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from .. import api
+from .iterator import DataIterator
+
+
+class _SplitQueue:
+    """Queue actor between the driver's pump thread and n consumers."""
+
+    def __init__(self, n: int, max_queued_per_consumer: int = 4):
+        self._queues: List[list] = [[] for _ in range(n)]
+        self._done = False
+        self._error: Optional[str] = None
+        self._cap = max_queued_per_consumer
+
+    def put_block(self, consumer: int, block) -> bool:
+        """Returns False when the consumer's queue is full (backpressure)."""
+        if len(self._queues[consumer]) >= self._cap:
+            return False
+        self._queues[consumer].append(block)
+        return True
+
+    def finish(self, error: Optional[str] = None):
+        self._error = error
+        self._done = True
+
+    def next_block(self, consumer: int):
+        """("block", value) | ("wait",) | ("done",) | ("error", msg)."""
+        if self._error:
+            return ("error", self._error)
+        q = self._queues[consumer]
+        if q:
+            return ("block", q.pop(0))
+        if self._done:
+            return ("done",)
+        return ("wait",)
+
+    def ping(self):
+        return True
+
+
+def make_split_iterators(dataset, n: int, *, equal: bool = False):
+    Queue = api.remote(num_cpus=0)(_SplitQueue)
+    coord = Queue.remote(n)
+    api.get(coord.ping.remote())
+
+    def pump():
+        import time
+
+        rows_fed = [0] * n
+        try:
+            for bundle in dataset.iter_bundles():
+                i = min(range(n), key=lambda j: rows_fed[j])
+                block = api.get(bundle.block_ref)
+                while not api.get(coord.put_block.remote(i, block)):
+                    time.sleep(0.02)
+                rows_fed[i] += bundle.meta.num_rows
+            api.get(coord.finish.remote())
+        except Exception as e:  # noqa: BLE001
+            try:
+                api.get(coord.finish.remote(repr(e)))
+            except Exception:
+                pass
+
+    threading.Thread(target=pump, daemon=True, name="split-pump").start()
+
+    def factory(i: int):
+        def gen():
+            import time
+
+            from .block import BlockAccessor
+            from .executor import RefBundle
+
+            while True:
+                result = api.get(coord.next_block.remote(i))
+                if result[0] == "block":
+                    block = result[1]
+                    # literal block (not a ref): DataIterator._iter_blocks
+                    # passes it through without an object-store round-trip
+                    yield RefBundle(block, BlockAccessor(block).metadata())
+                elif result[0] == "wait":
+                    time.sleep(0.02)
+                elif result[0] == "error":
+                    raise RuntimeError(
+                        f"streaming_split failed: {result[1]}"
+                    )
+                else:
+                    return
+
+        return gen
+
+    return [DataIterator(factory(i)) for i in range(n)]

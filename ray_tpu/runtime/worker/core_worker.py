@@ -243,6 +243,16 @@ class CoreWorker:
                 except Exception:
                     pass
 
+    def _pin_task_args(self, spec: TaskSpec) -> List[ObjectID]:
+        """Pin a task's by-ref args until the call completes. Without this a
+        GC'd submitter-side ObjectRef can free the arg out of the memory
+        store before the executor fetches it and the call hangs (reference:
+        ReferenceCounter submitted-task references, reference_counter.h:44).
+        Pair with _release_for_task when the task reaches a terminal state."""
+        arg_ids = [a.object_id for a in spec.args if a.object_id is not None]
+        self._retain_for_task(arg_ids)
+        return arg_ids
+
     def _retain_for_task(self, object_ids: List[ObjectID]):
         with self._ref_lock:
             for oid in object_ids:
@@ -469,8 +479,7 @@ class CoreWorker:
             self._owned.add(oid)
             self.memory_store.entry(oid)  # create pending entry
         self._pending_tasks[spec.task_id] = spec
-        arg_ids = [a.object_id for a in spec.args if a.object_id is not None]
-        self._retain_for_task(arg_ids)
+        arg_ids = self._pin_task_args(spec)
         asyncio.ensure_future(self._submit_pipeline(spec, arg_ids))
         return return_ids
 
@@ -714,6 +723,7 @@ class CoreWorker:
         for oid in return_ids:
             self._owned.add(oid)
             self.memory_store.entry(oid)
+        arg_ids = self._pin_task_args(spec)
         spec.sequence_number = state.seq
         state.seq += 1
         fut: asyncio.Future = self.loop.create_future()
@@ -723,7 +733,7 @@ class CoreWorker:
             state.queue.append((spec, fut))
         else:
             asyncio.ensure_future(self._push_actor_task(state, spec, fut))
-        asyncio.ensure_future(self._finish_actor_task(spec, fut))
+        asyncio.ensure_future(self._finish_actor_task(spec, fut, arg_ids))
         return return_ids
 
     async def _push_actor_task(self, state, spec: TaskSpec, fut: asyncio.Future):
@@ -762,12 +772,16 @@ class CoreWorker:
             spec.max_task_retries -= 1
         return True
 
-    async def _finish_actor_task(self, spec: TaskSpec, fut: asyncio.Future):
+    async def _finish_actor_task(
+        self, spec: TaskSpec, fut: asyncio.Future, arg_ids: List[ObjectID]
+    ):
         try:
             reply: TaskReply = await fut
         except Exception as e:  # noqa: BLE001
             self._fail_task(spec, e)
             return
+        finally:
+            self._release_for_task(arg_ids)
         if reply.error is not None:
             err = serialization.unpack(reply.error)
             if not isinstance(err, Exception):

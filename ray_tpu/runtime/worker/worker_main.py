@@ -77,6 +77,11 @@ if __name__ == "__main__":
     parser.add_argument("--session", default="")
     parser.add_argument("--config", default="")
     args = parser.parse_args()
+    # debugging hook: `kill -USR1 <worker pid>` dumps all thread stacks
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "WARNING"),
         format=f"[worker {os.getpid()}] %(levelname)s %(name)s: %(message)s",

@@ -1,0 +1,225 @@
+"""Tests for ray_tpu.data (reference test model: python/ray/data/tests/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, resources={"TPU": 4})
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_count_take(cluster):
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert rows == [{"id": i} for i in range(5)]
+
+
+def test_from_items_and_schema(cluster):
+    ds = rd.from_items([{"a": i, "b": float(i)} for i in range(10)])
+    schema = ds.schema()
+    assert set(schema) == {"a", "b"}
+    assert ds.count() == 10
+
+
+def test_map_filter_flatmap_fusion(cluster):
+    ds = (
+        rd.range(50, parallelism=4)
+        .map(lambda r: {"id": r["id"] * 2})
+        .filter(lambda r: r["id"] % 4 == 0)
+    )
+    # both stages fuse into one task stage
+    assert "->" in ds.stats()
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == [i * 2 for i in range(50) if (i * 2) % 4 == 0]
+
+    ds2 = rd.from_items([1, 2]).flat_map(lambda x: [x, x * 10])
+    assert sorted(ds2.take_all()) == [1, 2, 10, 20]
+
+
+def test_map_batches_tasks(cluster):
+    ds = rd.range(64, parallelism=4).map_batches(
+        lambda b: {"id": b["id"] + 1}, batch_size=8
+    )
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(1, 65))
+
+
+def test_map_batches_actor_pool(cluster):
+    class Doubler:
+        def __call__(self, batch):
+            return {"id": batch["id"] * 2}
+
+    ds = rd.range(32, parallelism=4).map_batches(
+        Doubler, compute=rd.ActorPoolStrategy(size=2), batch_size=16
+    )
+    assert sorted(r["id"] for r in ds.take_all()) == [2 * i for i in range(32)]
+
+
+def test_limit_stops_stream(cluster):
+    ds = rd.range(1000, parallelism=8).limit(17)
+    assert ds.count() == 17
+
+
+def test_repartition_and_num_blocks(cluster):
+    ds = rd.range(100, parallelism=4).repartition(7)
+    assert ds.num_blocks() == 7
+    assert ds.count() == 100
+
+
+def test_random_shuffle_preserves_multiset(cluster):
+    ds = rd.range(60, parallelism=3).random_shuffle(seed=7)
+    vals = [r["id"] for r in ds.take_all()]
+    assert sorted(vals) == list(range(60))
+    assert vals != list(range(60))  # actually shuffled
+
+
+def test_sort(cluster):
+    ds = rd.from_items([{"x": i % 10, "y": i} for i in range(40)]).sort("x")
+    xs = [r["x"] for r in ds.take_all()]
+    assert xs == sorted(xs)
+    ds_desc = rd.range(20, parallelism=2).sort("id", descending=True)
+    assert [r["id"] for r in ds_desc.take_all()] == list(reversed(range(20)))
+
+
+def test_groupby_aggregate(cluster):
+    ds = rd.from_items(
+        [{"k": i % 3, "v": float(i)} for i in range(30)]
+    )
+    out = ds.groupby("k").sum("v").take_all()
+    by_key = {r["k"]: r["sum(v)"] for r in out}
+    for k in (0, 1, 2):
+        assert by_key[k] == sum(float(i) for i in range(30) if i % 3 == k)
+
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+
+
+def test_global_aggregates(cluster):
+    ds = rd.range(10, parallelism=2)
+    assert ds.sum("id") == 45
+    assert ds.min("id") == 0
+    assert ds.max("id") == 9
+    assert ds.mean("id") == 4.5
+
+
+def test_union_zip(cluster):
+    a = rd.range(5, parallelism=1)
+    b = rd.range(5, parallelism=1).map(lambda r: {"id": r["id"] + 5})
+    assert sorted(r["id"] for r in a.union(b).take_all()) == list(range(10))
+
+    left = rd.range(6, parallelism=2)
+    right = rd.range(6, parallelism=2).map(lambda r: {"w": r["id"] * 10})
+    rows = left.zip(right).take_all()
+    assert sorted((r["id"], r["w"]) for r in rows) == [
+        (i, 10 * i) for i in range(6)
+    ]
+
+
+def test_iter_batches_exact_sizes(cluster):
+    ds = rd.range(100, parallelism=4)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
+    assert sizes == [32, 32, 32, 4]
+    sizes = [
+        len(b["id"]) for b in ds.iter_batches(batch_size=32, drop_last=True)
+    ]
+    assert sizes == [32, 32, 32]
+
+
+def test_iter_torch_batches(cluster):
+    import torch
+
+    ds = rd.range(8, parallelism=2)
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+
+
+def test_add_select_drop_rename(cluster):
+    ds = (
+        rd.range(10, parallelism=2)
+        .add_column("sq", lambda b: b["id"] ** 2)
+        .rename_columns({"id": "n"})
+    )
+    row = ds.sort("n").take(1)[0]
+    assert row == {"n": 0, "sq": 0}
+    assert ds.select_columns(["sq"]).schema() and ds.drop_columns(
+        ["sq"]
+    ).columns() == ["n"]
+
+
+def test_materialize_reuse(cluster):
+    ds = rd.range(20, parallelism=2).map(lambda r: {"id": r["id"] + 1})
+    mat = ds.materialize()
+    assert mat.count() == 20
+    assert mat.count() == 20  # second consumption reuses blocks
+    assert sorted(r["id"] for r in mat.take_all()) == list(range(1, 21))
+
+
+def test_split(cluster):
+    parts = rd.range(30, parallelism=3).split(3)
+    all_vals = []
+    for p in parts:
+        all_vals.extend(r["id"] for r in p.take_all())
+    assert sorted(all_vals) == list(range(30))
+
+
+def test_streaming_split_disjoint_complete(cluster):
+    its = rd.range(40, parallelism=4).streaming_split(2, equal=True)
+    import threading
+
+    results = [[], []]
+
+    def consume(i):
+        for b in its[i].iter_batches(batch_size=None):
+            results[i].extend(b["id"].tolist())
+
+    threads = [
+        threading.Thread(target=consume, args=(i,)) for i in (0, 1)
+    ]
+    [t.start() for t in threads]
+    [t.join(timeout=60) for t in threads]
+    assert sorted(results[0] + results[1]) == list(range(40))
+    assert results[0] and results[1]
+
+
+def test_csv_json_roundtrip(cluster, tmp_path):
+    ds = rd.from_items([{"a": i, "b": i * 0.5} for i in range(12)])
+    csv_dir = str(tmp_path / "csv")
+    ds.write_csv(csv_dir)
+    back = rd.read_csv(csv_dir)
+    assert back.count() == 12
+    assert back.sum("a") == sum(range(12))
+
+    json_dir = str(tmp_path / "json")
+    ds.write_json(json_dir)
+    back = rd.read_json(json_dir)
+    assert back.count() == 12
+
+
+def test_numpy_roundtrip(cluster, tmp_path):
+    arr = np.arange(24, dtype=np.float32).reshape(6, 4)
+    ds = rd.from_numpy(arr)
+    out_dir = str(tmp_path / "npy")
+    ds.write_numpy(out_dir)
+    back = rd.read_numpy(out_dir)
+    total = sum(b["data"].sum() for b in back.iter_batches(batch_size=None))
+    assert float(total) == float(arr.sum())
+
+
+def test_random_sample(cluster):
+    ds = rd.range(1000, parallelism=4).random_sample(0.1, seed=3)
+    n = ds.count()
+    assert 40 < n < 250
+
+
+def test_device_put_batches(cluster):
+    import jax
+
+    ds = rd.range_tensor(8, shape=(4,), parallelism=2)
+    batches = list(ds.iter_batches(batch_size=4, device_put=True))
+    assert all(isinstance(b["data"], jax.Array) for b in batches)
