@@ -138,6 +138,7 @@ class CoreWorker:
         self._function_cache: Dict[str, Callable] = {}
         self._actor_instance: Any = None
         self._actor_spec: Optional[TaskSpec] = None
+        self._actor_semaphore: Optional[asyncio.Semaphore] = None
         self._executor_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
         # per-caller ordered queues for actor tasks
         self._caller_expected_seq: Dict[WorkerID, int] = defaultdict(int)
@@ -940,12 +941,28 @@ class CoreWorker:
             ev = asyncio.Event()
             parked[spec.sequence_number] = ev
             await ev.wait()
-        reply = await self._execute_actor_task(spec)
-        self._caller_expected_seq[caller] = spec.sequence_number + 1
-        nxt = self._caller_parked[caller].pop(spec.sequence_number + 1, None)
-        if nxt is not None:
-            nxt.set()
-        return reply
+
+        def _advance():
+            self._caller_expected_seq[caller] = spec.sequence_number + 1
+            nxt = self._caller_parked[caller].pop(spec.sequence_number + 1, None)
+            if nxt is not None:
+                nxt.set()
+
+        max_conc = self._actor_spec.max_concurrency if self._actor_spec else 1
+        if max_conc > 1:
+            # concurrent actor (reference: async/threaded actors via
+            # OutOfOrderActorSchedulingQueue): ordering guarantees start
+            # order only — release the next task as soon as this one begins;
+            # a semaphore still caps in-flight executions at max_concurrency
+            if self._actor_semaphore is None:
+                self._actor_semaphore = asyncio.Semaphore(max_conc)
+            _advance()
+            async with self._actor_semaphore:
+                return await self._execute_actor_task(spec)
+        try:
+            return await self._execute_actor_task(spec)
+        finally:
+            _advance()
 
     async def _execute_actor_task(self, spec: TaskSpec) -> TaskReply:
         if self._actor_instance is None:

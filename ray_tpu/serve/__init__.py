@@ -1,0 +1,39 @@
+"""ray_tpu.serve: scalable model serving (reference: python/ray/serve).
+
+Controller actor reconciles deployments into replica actors; handles route
+requests with power-of-two-choices; an aiohttp proxy terminates HTTP; the
+queue-length autoscaler resizes replica sets — including TPU replicas that
+reserve chips via ``ray_actor_options={"num_tpus": N}``.
+"""
+
+from .api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from .config import AutoscalingConfig, DeploymentConfig
+from .handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "run",
+    "start",
+    "delete",
+    "shutdown",
+    "status",
+    "get_app_handle",
+    "get_deployment_handle",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "AutoscalingConfig",
+    "DeploymentConfig",
+]

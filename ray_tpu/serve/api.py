@@ -1,0 +1,250 @@
+"""Serve public API.
+
+Role-equivalent of the reference's serve API (python/ray/serve/api.py —
+serve.deployment, serve.run :681, serve.delete, serve.status,
+serve.get_app_handle). ``@serve.deployment`` wraps a class/function into a
+Deployment; ``.bind()`` builds the app graph; ``serve.run`` ships it to the
+ServeController actor and returns a handle.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, List, Optional
+
+from .. import api as ray_api
+from .._internal import serialization
+from .config import ApplicationStatus, AutoscalingConfig, DeploymentConfig
+from .controller import CONTROLLER_NAME, ServeController
+from .handle import DeploymentHandle, DeploymentResponse
+
+_state: Dict[str, Any] = {"controller": None, "proxy": None, "ingress": {}}
+
+
+class Application:
+    """A bound deployment graph rooted at the ingress deployment."""
+
+    def __init__(self, root: "_BoundDeployment"):
+        self.root = root
+
+    def _collect(self) -> List["_BoundDeployment"]:
+        seen: Dict[str, _BoundDeployment] = {}
+
+        def walk(node):
+            if isinstance(node, Application):
+                node = node.root
+            if isinstance(node, _BoundDeployment):
+                if node.deployment.name not in seen:
+                    seen[node.deployment.name] = node
+                    for a in list(node.init_args) + list(
+                        node.init_kwargs.values()
+                    ):
+                        walk(a)
+            elif isinstance(node, (list, tuple)):
+                for x in node:
+                    walk(x)
+            elif isinstance(node, dict):
+                for x in node.values():
+                    walk(x)
+
+        walk(self.root)
+        return list(seen.values())
+
+
+class _BoundDeployment:
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, target, config: DeploymentConfig):
+        self._target = target
+        self._config = config
+
+    @property
+    def name(self) -> str:
+        return self._config.name
+
+    def options(self, **overrides) -> "Deployment":
+        import dataclasses
+
+        cfg = dataclasses.replace(self._config)
+        for k, v in overrides.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown deployment option {k!r}")
+            setattr(cfg, k, v)
+        return Deployment(self._target, cfg)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(_BoundDeployment(self, args, kwargs))
+
+
+def deployment(_target=None, **options):
+    """@serve.deployment / @serve.deployment(num_replicas=2, ...)"""
+
+    def wrap(target):
+        if isinstance(options.get("autoscaling_config"), dict):
+            options["autoscaling_config"] = AutoscalingConfig(
+                **options["autoscaling_config"]
+            )
+        cfg = DeploymentConfig(
+            name=options.pop("name", None) or target.__name__, **options
+        )
+        return Deployment(target, cfg)
+
+    if _target is not None:
+        return wrap(_target)
+    return wrap
+
+
+# -- controller / proxy management -------------------------------------------
+
+
+def start(
+    *, http_host: str = "127.0.0.1", http_port: int = 8000, proxy: bool = True
+):
+    """Start (or connect to) the Serve control plane (reference:
+    serve.start): a detached-ish named controller actor plus one HTTP proxy
+    actor."""
+    if _state["controller"] is None:
+        try:
+            controller = ray_api.get_actor(CONTROLLER_NAME)
+        except ValueError:
+            Controller = ray_api.remote(num_cpus=0, name=CONTROLLER_NAME)(
+                ServeController
+            )
+            controller = Controller.remote()
+            ray_api.get(controller.ping.remote())
+        _state["controller"] = controller
+    if proxy and _state["proxy"] is None:
+        from .proxy import HTTPProxy
+
+        Proxy = ray_api.remote(num_cpus=0)(HTTPProxy)
+        p = Proxy.remote(_state["controller"], http_host, http_port)
+        ray_api.get(p.ping.remote())
+        _state["proxy"] = p
+    return _state["controller"]
+
+
+def run(
+    app: Application,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = None,
+    _blocking: bool = True,
+    _proxy: bool = True,
+) -> DeploymentHandle:
+    """Deploy an application and wait until it is RUNNING (reference:
+    serve.run serve/api.py:681)."""
+    controller = start(proxy=_proxy)
+    nodes = app._collect()
+    ingress_name = app.root.deployment.name
+    payload = []
+    for node in nodes:
+        cfg = node.deployment._config
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg)
+        if route_prefix is not None and node is app.root:
+            cfg.route_prefix = route_prefix
+        # nested bound deployments become handles at replica init time
+        init_args = _replace_bound(node.init_args, controller, name)
+        init_kwargs = _replace_bound(node.init_kwargs, controller, name)
+        payload.append(
+            dict(
+                config=cfg,
+                cls_bytes=serialization.dumps(node.deployment._target),
+                init_args=init_args,
+                init_kwargs=init_kwargs,
+            )
+        )
+    ray_api.get(controller.deploy_application.remote(name, payload))
+    _state["ingress"][name] = ingress_name
+    handle = DeploymentHandle(controller, name, ingress_name)
+    if _blocking:
+        _wait_healthy(name)
+    return handle
+
+
+def _replace_bound(obj, controller, app_name):
+    if isinstance(obj, Application):
+        obj = obj.root
+    if isinstance(obj, _BoundDeployment):
+        return DeploymentHandle(controller, app_name, obj.deployment.name)
+    if isinstance(obj, tuple):
+        return tuple(_replace_bound(x, controller, app_name) for x in obj)
+    if isinstance(obj, list):
+        return [_replace_bound(x, controller, app_name) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _replace_bound(v, controller, app_name) for k, v in obj.items()}
+    return obj
+
+
+def _wait_healthy(app_name: str, timeout_s: float = 60.0):
+    import time
+
+    controller = _state["controller"]
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        st = ray_api.get(controller.status.remote())
+        app = st.get(app_name)
+        if app is not None and app.status == "RUNNING":
+            return
+        time.sleep(0.2)
+    raise TimeoutError(f"application {app_name!r} not healthy in {timeout_s}s")
+
+
+def status() -> Dict[str, ApplicationStatus]:
+    controller = _require_controller()
+    return ray_api.get(controller.status.remote())
+
+
+def get_app_handle(name: str = "default", _controller=None) -> DeploymentHandle:
+    controller = _controller or _require_controller()
+    ingress = _state["ingress"].get(name)
+    if ingress is None:
+        table = ray_api.get(controller.get_routing_table.remote(name))
+        if not table:
+            raise ValueError(f"no application named {name!r}")
+        ingress = next(iter(table.keys()))
+    return DeploymentHandle(controller, name, ingress)
+
+
+def get_deployment_handle(
+    deployment_name: str, app_name: str = "default"
+) -> DeploymentHandle:
+    return DeploymentHandle(_require_controller(), app_name, deployment_name)
+
+
+def delete(name: str = "default"):
+    controller = _require_controller()
+    ray_api.get(controller.delete_application.remote(name))
+    _state["ingress"].pop(name, None)
+
+
+def shutdown():
+    controller = _state["controller"]
+    if controller is not None:
+        try:
+            ray_api.get(controller.shutdown.remote(), timeout=30)
+            ray_api.kill(controller)
+        except Exception:
+            pass
+    proxy = _state["proxy"]
+    if proxy is not None:
+        try:
+            ray_api.kill(proxy)
+        except Exception:
+            pass
+    _state.update(controller=None, proxy=None, ingress={})
+
+
+def _require_controller():
+    if _state["controller"] is None:
+        try:
+            _state["controller"] = ray_api.get_actor(CONTROLLER_NAME)
+        except ValueError:
+            raise RuntimeError("serve is not running; call serve.run first")
+    return _state["controller"]

@@ -1,0 +1,73 @@
+"""Serve configuration dataclasses.
+
+Role-equivalent of the reference's deployment/autoscaling configs
+(python/ray/serve/config.py — AutoscalingConfig, DeploymentConfig;
+serve/_private/autoscaling_policy.py:12 _calculate_desired_num_replicas).
+TPU twist: replicas can reserve TPU chips (``num_tpus`` in
+``ray_actor_options``) so a deployment's replica set maps onto chips the
+same way the reference maps GPU replicas via NVIDIA visible devices.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_ongoing_requests: float = 2.0
+    # smoothing / stability knobs (reference: autoscaling_policy.py)
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 10.0
+    metrics_interval_s: float = 0.5
+
+    def desired_replicas(
+        self, total_ongoing: float, current: int
+    ) -> int:
+        """reference: _calculate_desired_num_replicas
+        (serve/_private/autoscaling_policy.py:12) — scale so each replica
+        carries ~target_ongoing_requests."""
+        if current <= 0:
+            return self.min_replicas
+        raw = total_ongoing / max(self.target_ongoing_requests, 1e-9)
+        desired = int(math.ceil(raw))
+        return max(self.min_replicas, min(self.max_replicas, desired))
+
+
+@dataclass
+class DeploymentConfig:
+    name: str = ""
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    user_config: Optional[Any] = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    route_prefix: Optional[str] = None
+    health_check_period_s: float = 2.0
+    graceful_shutdown_timeout_s: float = 5.0
+
+
+@dataclass
+class ReplicaStatus:
+    replica_id: str
+    state: str  # STARTING | RUNNING | STOPPING | DEAD
+    queue_len: int = 0
+
+
+@dataclass
+class DeploymentStatus:
+    name: str
+    status: str  # UPDATING | HEALTHY | UNHEALTHY
+    replicas: list = field(default_factory=list)
+    message: str = ""
+
+
+@dataclass
+class ApplicationStatus:
+    name: str
+    status: str  # DEPLOYING | RUNNING | DELETING | NOT_STARTED
+    deployments: Dict[str, DeploymentStatus] = field(default_factory=dict)

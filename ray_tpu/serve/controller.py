@@ -1,0 +1,334 @@
+"""ServeController: the control-plane actor reconciling deployments.
+
+Role-equivalent of the reference's ServeController
+(python/ray/serve/_private/controller.py:102; reconcile loop :395) +
+DeploymentState manager (deployment_state.py) + the queue-length autoscaler
+(autoscaling_policy.py:85, autoscaling_state.py). A reconcile thread
+compares target replica counts (static or autoscaler-driven) with live
+replicas, starts/stops replica actors, polls queue metrics, and exposes the
+replica directory to routers, which poll ``get_routing_table`` keyed by a
+membership version (reference: LongPollClient snapshot ids).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List
+
+from .config import (
+    ApplicationStatus,
+    AutoscalingConfig,
+    DeploymentConfig,
+    DeploymentStatus,
+    ReplicaStatus,
+)
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _ReplicaState:
+    def __init__(self, replica_id: str, handle):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.state = "STARTING"
+        self.queue_len = 0
+        self.consecutive_health_failures = 0
+
+
+class _DeploymentState:
+    def __init__(self, config: DeploymentConfig, cls_bytes, init_args, init_kwargs):
+        self.config = config
+        self.cls_bytes = cls_bytes
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.replicas: Dict[str, _ReplicaState] = {}
+        self.next_replica_idx = 0
+        self.target_replicas = config.num_replicas
+        if config.autoscaling_config:
+            self.target_replicas = config.autoscaling_config.min_replicas
+        self.last_scale_up = 0.0
+        self.last_scale_down = 0.0
+        # bumped whenever replica membership changes, so routers cheap-poll
+        self.version = 0
+
+
+class ServeController:
+    def __init__(self):
+        self._apps: Dict[str, Dict[str, str]] = {}  # app -> short -> full name
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._lock = threading.RLock()
+        self._running = True
+        self._reconcile_interval_s = 0.25
+        self._thread = threading.Thread(
+            target=self._run_control_loop, daemon=True, name="serve-reconcile"
+        )
+        self._thread.start()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _run_control_loop(self):
+        """reference: ServeController.run_control_loop (controller.py:395)."""
+        while self._running:
+            try:
+                self._reconcile_once()
+            except Exception:
+                logger.exception("serve reconcile iteration failed")
+            time.sleep(self._reconcile_interval_s)
+
+    def shutdown(self):
+        self._running = False
+        with self._lock:
+            deps = list(self._deployments.values())
+            self._deployments.clear()
+            self._apps.clear()
+        for dep in deps:
+            dep.target_replicas = 0
+            for rid in list(dep.replicas):
+                self._stop_replica(dep, rid)
+        return True
+
+    # -- deploy API ----------------------------------------------------------
+
+    def deploy_application(self, app_name: str, deployments: List[dict]) -> bool:
+        """deployments: [{config, cls_bytes, init_args, init_kwargs}];
+        re-deploy updates in place (reference: serve.run upsert)."""
+        with self._lock:
+            names = {}
+            for d in deployments:
+                config: DeploymentConfig = d["config"]
+                full = f"{app_name}#{config.name}"
+                names[config.name] = full
+                existing = self._deployments.get(full)
+                if existing is None:
+                    self._deployments[full] = _DeploymentState(
+                        config, d["cls_bytes"], d["init_args"], d["init_kwargs"]
+                    )
+                else:
+                    old_user_config = existing.config.user_config
+                    existing.config = config
+                    if not config.autoscaling_config:
+                        existing.target_replicas = config.num_replicas
+                    if config.user_config != old_user_config:
+                        # push new user_config without replica restarts
+                        # (reference: reconfigure path)
+                        for r in existing.replicas.values():
+                            try:
+                                r.handle.reconfigure.remote(config.user_config)
+                            except Exception:
+                                pass
+            self._apps[app_name] = names
+        return True
+
+    def delete_application(self, app_name: str) -> bool:
+        with self._lock:
+            names = self._apps.pop(app_name, {})
+            deps = [
+                self._deployments.pop(full)
+                for full in names.values()
+                if full in self._deployments
+            ]
+        for dep in deps:
+            for rid in list(dep.replicas):
+                self._stop_replica(dep, rid)
+        return True
+
+    # -- reconcile -----------------------------------------------------------
+
+    def _reconcile_once(self):
+        from .. import api
+
+        with self._lock:
+            items = list(self._deployments.items())
+        for full_name, dep in items:
+            self._poll_replicas(dep)
+            if dep.config.autoscaling_config:
+                self._autoscale(dep)
+            self._converge(full_name, dep)
+
+    def _poll_replicas(self, dep: _DeploymentState):
+        from .. import api
+
+        for rid, replica in list(dep.replicas.items()):
+            if replica.state != "RUNNING":
+                continue
+            try:
+                metrics = api.get(replica.handle.get_metrics.remote(), timeout=5)
+                replica.queue_len = metrics["queue_len"]
+                replica.consecutive_health_failures = 0
+            except Exception:
+                replica.consecutive_health_failures += 1
+                if replica.consecutive_health_failures >= 3:
+                    logger.warning("replica %s unhealthy; replacing", rid)
+                    with self._lock:
+                        dep.replicas.pop(rid, None)
+                        dep.version += 1
+
+    def _autoscale(self, dep: _DeploymentState):
+        cfg: AutoscalingConfig = dep.config.autoscaling_config
+        running = [r for r in dep.replicas.values() if r.state == "RUNNING"]
+        if not running:
+            return
+        total_ongoing = sum(r.queue_len for r in running)
+        desired = cfg.desired_replicas(total_ongoing, len(running))
+        now = time.time()
+        if desired > dep.target_replicas:
+            if now - dep.last_scale_up >= cfg.upscale_delay_s:
+                logger.info(
+                    "autoscale %s: %d -> %d (ongoing=%.1f)",
+                    dep.config.name, dep.target_replicas, desired, total_ongoing,
+                )
+                dep.target_replicas = desired
+                dep.last_scale_up = now
+        elif desired < dep.target_replicas:
+            if now - dep.last_scale_down >= cfg.downscale_delay_s:
+                dep.target_replicas = desired
+                dep.last_scale_down = now
+        else:
+            dep.last_scale_up = now
+            dep.last_scale_down = now
+
+    def _converge(self, full_name: str, dep: _DeploymentState):
+        from .. import api
+
+        live = len(dep.replicas)
+        if live < dep.target_replicas:
+            for _ in range(dep.target_replicas - live):
+                self._start_replica(full_name, dep)
+        elif live > dep.target_replicas:
+            excess = live - dep.target_replicas
+            victims = sorted(dep.replicas.values(), key=lambda r: r.queue_len)[
+                :excess
+            ]
+            for v in victims:
+                self._stop_replica(dep, v.replica_id)
+        for replica in list(dep.replicas.values()):
+            if replica.state == "STARTING":
+                try:
+                    if api.get(replica.handle.check_health.remote(), timeout=20):
+                        with self._lock:
+                            replica.state = "RUNNING"
+                            dep.version += 1
+                except TimeoutError:
+                    pass
+                except Exception:
+                    logger.exception(
+                        "replica %s failed to start", replica.replica_id
+                    )
+                    with self._lock:
+                        dep.replicas.pop(replica.replica_id, None)
+
+    def _start_replica(self, full_name: str, dep: _DeploymentState):
+        from .. import api
+        from .replica import Replica
+
+        rid = f"{full_name}#{dep.next_replica_idx}"
+        dep.next_replica_idx += 1
+        opts = dict(dep.config.ray_actor_options or {})
+        opts.setdefault("num_cpus", 1)
+        opts.setdefault("max_concurrency", dep.config.max_ongoing_requests)
+        ReplicaActor = api.remote(**opts)(Replica)
+        handle = ReplicaActor.remote(
+            dep.config.name,
+            rid,
+            dep.cls_bytes,
+            dep.init_args,
+            dep.init_kwargs,
+            dep.config.user_config,
+        )
+        with self._lock:
+            dep.replicas[rid] = _ReplicaState(rid, handle)
+
+    def _stop_replica(self, dep: _DeploymentState, rid: str):
+        from .. import api
+
+        with self._lock:
+            replica = dep.replicas.pop(rid, None)
+            if replica is None:
+                return
+            dep.version += 1
+        try:
+            api.get(
+                replica.handle.prepare_for_shutdown.remote(
+                    dep.config.graceful_shutdown_timeout_s
+                ),
+                timeout=dep.config.graceful_shutdown_timeout_s + 2,
+            )
+        except Exception:
+            pass
+        try:
+            api.kill(replica.handle)
+        except Exception:
+            pass
+
+    # -- router / status API -------------------------------------------------
+
+    def get_routing_table(self, app_name: str) -> Dict[str, Any]:
+        """deployment short-name -> {version, replicas: [(rid, handle)]}."""
+        with self._lock:
+            out = {}
+            for short, full in self._apps.get(app_name, {}).items():
+                dep = self._deployments.get(full)
+                if dep is None:
+                    continue
+                out[short] = {
+                    "version": dep.version,
+                    "replicas": [
+                        (r.replica_id, r.handle, r.queue_len)
+                        for r in dep.replicas.values()
+                        if r.state == "RUNNING"
+                    ],
+                }
+            return out
+
+    def list_applications(self) -> List[str]:
+        with self._lock:
+            return list(self._apps.keys())
+
+    def get_app_route_prefixes(self) -> Dict[str, str]:
+        """route prefix -> app name, for the HTTP proxy."""
+        with self._lock:
+            out = {}
+            for app_name, names in self._apps.items():
+                prefix = f"/{app_name}"
+                for short, full in names.items():
+                    dep = self._deployments.get(full)
+                    if dep and dep.config.route_prefix:
+                        prefix = dep.config.route_prefix
+                out[prefix] = app_name
+            return out
+
+    def status(self) -> Dict[str, ApplicationStatus]:
+        with self._lock:
+            out = {}
+            for app_name, names in self._apps.items():
+                deps = {}
+                app_healthy = True
+                for short, full in names.items():
+                    dep = self._deployments.get(full)
+                    if dep is None:
+                        continue
+                    replicas = [
+                        ReplicaStatus(r.replica_id, r.state, r.queue_len)
+                        for r in dep.replicas.values()
+                    ]
+                    n_running = sum(1 for r in replicas if r.state == "RUNNING")
+                    healthy = n_running >= max(1, dep.target_replicas)
+                    app_healthy = app_healthy and healthy
+                    deps[short] = DeploymentStatus(
+                        name=short,
+                        status="HEALTHY" if healthy else "UPDATING",
+                        replicas=replicas,
+                    )
+                out[app_name] = ApplicationStatus(
+                    name=app_name,
+                    status="RUNNING" if app_healthy else "DEPLOYING",
+                    deployments=deps,
+                )
+            return out
+
+    def ping(self):
+        return True

@@ -1,0 +1,122 @@
+"""DeploymentHandle + Router: the request data plane.
+
+Role-equivalent of the reference's DeploymentHandle/Router
+(python/ray/serve/handle.py, serve/_private/router.py) with the
+power-of-two-choices replica picker
+(request_router/pow_2_router.py:27): each call samples two running
+replicas and routes to the one with the shorter queue, using queue lengths
+from the controller's routing table (refreshed on a version poll). Works
+from any process — handles serialize (controller handle + names only).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .. import api
+
+
+class DeploymentResponse:
+    """Future for one request (reference: serve/handle.py
+    DeploymentResponse): .result() blocks; ._to_object_ref() exposes the ref
+    for composition with ray_tpu.get/wait."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None):
+        return api.get(self._ref, timeout=timeout_s)
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class Router:
+    """Per-process replica picker for one application."""
+
+    _REFRESH_S = 1.0
+
+    def __init__(self, controller, app_name: str):
+        self._controller = controller
+        self._app_name = app_name
+        self._table: Dict[str, dict] = {}
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+        self._rr = 0
+
+    def _refresh(self, force: bool = False):
+        now = time.time()
+        if not force and now - self._last_refresh < self._REFRESH_S:
+            return
+        table = api.get(
+            self._controller.get_routing_table.remote(self._app_name),
+            timeout=30,
+        )
+        with self._lock:
+            self._table = table
+            self._last_refresh = now
+
+    def pick(self, deployment: str):
+        """Power-of-two-choices on reported queue length."""
+        self._refresh()
+        deadline = time.time() + 30
+        while True:
+            with self._lock:
+                entry = self._table.get(deployment)
+                replicas = entry["replicas"] if entry else []
+            if replicas:
+                break
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"no running replicas for deployment {deployment!r}"
+                )
+            time.sleep(0.1)
+            self._refresh(force=True)
+        if len(replicas) == 1:
+            return replicas[0][1]
+        # two random candidates, shorter controller-reported queue wins;
+        # round-robin counter breaks ties so equal queues still spread
+        a, b = random.sample(replicas, 2)
+        qa, qb = a[2], b[2]
+        if qa == qb:
+            self._rr += 1
+            return (a if self._rr % 2 else b)[1]
+        return (a if qa < qb else b)[1]
+
+
+class DeploymentHandle:
+    def __init__(self, controller, app_name: str, deployment: str, method: str = "__call__"):
+        self._controller = controller
+        self._app_name = app_name
+        self._deployment = deployment
+        self._method = method
+        self._router: Optional[Router] = None
+
+    def options(self, *, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._controller, self._app_name, self._deployment, method_name
+        )
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        # handle.other_method.remote(...) sugar
+        return DeploymentHandle(
+            self._controller, self._app_name, self._deployment, name
+        )
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        if self._router is None:
+            self._router = Router(self._controller, self._app_name)
+        replica = self._router.pick(self._deployment)
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref)
+
+    def __reduce__(self):
+        return (
+            DeploymentHandle,
+            (self._controller, self._app_name, self._deployment, self._method),
+        )

@@ -1,0 +1,147 @@
+"""HTTP proxy actor: the cluster's ingress.
+
+Role-equivalent of the reference's ProxyActor (python/ray/serve/_private/
+proxy.py:1153; HTTP handling :709): terminates HTTP, resolves the route
+prefix to an application, forwards the request body to the app's ingress
+deployment through a DeploymentHandle, and streams the response back.
+aiohttp replaces uvicorn; JSON in/out is the default content type.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class HTTPProxy:
+    """Actor: runs an aiohttp server in a dedicated thread+loop."""
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000):
+        self._controller = controller
+        self._host = host
+        self._port = port
+        self._routes: Dict[str, str] = {}
+        self._handles: Dict[str, object] = {}
+        self._ready = threading.Event()
+        self._error: Optional[str] = None
+        self._thread = threading.Thread(
+            target=self._serve_forever, daemon=True, name="http-proxy"
+        )
+        self._thread.start()
+        if not self._ready.wait(timeout=15):
+            raise RuntimeError(f"HTTP proxy failed to start: {self._error}")
+
+    # -- server --------------------------------------------------------------
+
+    def _serve_forever(self):
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(self._start_server())
+            loop.run_forever()
+        except Exception as e:  # noqa: BLE001
+            self._error = repr(e)
+            self._ready.set()
+
+    async def _start_server(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/-/routes", self._handle_routes)
+        app.router.add_route("*", "/-/healthz", self._handle_health)
+        app.router.add_route("*", "/{tail:.*}", self._handle_request)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, self._host, self._port)
+        await site.start()
+        self._ready.set()
+
+    async def _handle_health(self, request):
+        from aiohttp import web
+
+        return web.json_response({"status": "ok"})
+
+    async def _handle_routes(self, request):
+        from aiohttp import web
+
+        self._refresh_routes()
+        return web.json_response(self._routes)
+
+    def _refresh_routes(self):
+        from .. import api
+
+        try:
+            self._routes = api.get(
+                self._controller.get_app_route_prefixes.remote(), timeout=10
+            )
+        except Exception:
+            logger.exception("route refresh failed")
+
+    def _resolve(self, path: str):
+        """Longest-prefix route match -> (app_name, remaining path)."""
+        best = None
+        for prefix, app_name in self._routes.items():
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") or (
+                prefix == "/" and best is None
+            ):
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, app_name)
+        return best
+
+    async def _handle_request(self, request):
+        from aiohttp import web
+
+        path = "/" + request.match_info["tail"]
+        match = self._resolve(path)
+        if match is None:
+            self._refresh_routes()
+            match = self._resolve(path)
+        if match is None:
+            return web.json_response(
+                {"error": f"no app for path {path}"}, status=404
+            )
+        prefix, app_name = match
+        body: object = None
+        if request.body_exists:
+            raw = await request.read()
+            if raw:
+                try:
+                    body = json.loads(raw)
+                except json.JSONDecodeError:
+                    body = raw.decode("utf-8", "replace")
+        # forward to the app's ingress deployment off-loop (the handle API
+        # is blocking); one thread per in-flight request keeps the proxy
+        # loop responsive
+        result = await asyncio.get_event_loop().run_in_executor(
+            None, self._call_ingress, app_name, path, prefix, body
+        )
+        if isinstance(result, Exception):
+            return web.json_response({"error": repr(result)}, status=500)
+        if isinstance(result, (dict, list, int, float, str, bool)) or result is None:
+            return web.json_response({"result": result})
+        return web.Response(body=bytes(result))
+
+    def _call_ingress(self, app_name: str, path: str, prefix: str, body):
+        from .api import get_app_handle
+
+        try:
+            handle = self._handles.get(app_name)
+            if handle is None:
+                handle = get_app_handle(app_name, _controller=self._controller)
+                self._handles[app_name] = handle
+            return handle.remote(body).result(timeout_s=60)
+        except Exception as e:  # noqa: BLE001
+            return e
+
+    # -- control -------------------------------------------------------------
+
+    def address(self):
+        return (self._host, self._port)
+
+    def ping(self):
+        return True

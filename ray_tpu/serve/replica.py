@@ -1,0 +1,101 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Role-equivalent of the reference's ReplicaActor
+(python/ray/serve/_private/replica.py:1210): runs user __init__ once,
+serves requests while tracking ongoing-request count (the autoscaling
+metric), supports reconfigure(user_config) and health checks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+
+class Replica:
+    """The actor class; created by the controller via make_actor_class."""
+
+    def __init__(
+        self,
+        deployment_name: str,
+        replica_id: str,
+        cls_or_fn_bytes: bytes,
+        init_args: tuple,
+        init_kwargs: dict,
+        user_config: Any,
+    ):
+        from .._internal import serialization
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._deployment_name = deployment_name
+        self._replica_id = replica_id
+        self._ongoing = 0
+        self._total_served = 0
+        self._pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix=f"replica-{replica_id}"
+        )
+        target = serialization.loads(cls_or_fn_bytes)
+        if inspect.isclass(target):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            self._callable = target
+        self._is_function = not inspect.isclass(target)
+        if user_config is not None:
+            self._reconfigure_sync(user_config)
+
+    # -- request path --------------------------------------------------------
+
+    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+        self._ongoing += 1
+        try:
+            if self._is_function:
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method or "__call__")
+            if inspect.iscoroutinefunction(fn):
+                return await fn(*args, **kwargs)
+            # sync user code must not block the worker's event loop (it
+            # services RPC + heartbeats); run it on the request pool
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                self._pool, lambda: fn(*args, **kwargs)
+            )
+        finally:
+            self._ongoing -= 1
+            self._total_served += 1
+
+    # -- control plane -------------------------------------------------------
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "replica_id": self._replica_id,
+            "queue_len": self._ongoing,
+            "total_served": self._total_served,
+        }
+
+    def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if user_check is not None:
+            user_check()
+        return True
+
+    def _reconfigure_sync(self, user_config):
+        rec = getattr(self._callable, "reconfigure", None)
+        if rec is not None:
+            rec(user_config)
+
+    def reconfigure(self, user_config) -> bool:
+        self._reconfigure_sync(user_config)
+        return True
+
+    async def prepare_for_shutdown(self, timeout_s: float = 5.0) -> bool:
+        """Drain: wait for ongoing requests to finish (reference:
+        graceful_shutdown_timeout_s semantics)."""
+        deadline = time.time() + timeout_s
+        while self._ongoing > 0 and time.time() < deadline:
+            await asyncio.sleep(0.05)
+        shutdown = getattr(self._callable, "__del__", None)
+        return self._ongoing == 0

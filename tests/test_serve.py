@@ -1,0 +1,261 @@
+"""Tests for ray_tpu.serve (reference model: python/ray/serve/tests/)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=6, resources={"TPU": 4})
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_apps():
+    yield
+    # delete apps between tests but keep controller/proxy warm
+    try:
+        for app in list(serve.status().keys()):
+            serve.delete(app)
+    except Exception:
+        pass
+
+
+def test_basic_deployment_and_handle(cluster):
+    @serve.deployment
+    class Greeter:
+        def __call__(self, name):
+            return f"hello {name}"
+
+    handle = serve.run(Greeter.bind(), name="greet", _proxy=False)
+    assert handle.remote("tpu").result(timeout_s=30) == "hello tpu"
+
+    st = serve.status()["greet"]
+    assert st.status == "RUNNING"
+    assert st.deployments["Greeter"].status == "HEALTHY"
+
+
+def test_function_deployment(cluster):
+    @serve.deployment
+    def square(x):
+        return x * x
+
+    handle = serve.run(square.bind(), name="sq", _proxy=False)
+    assert handle.remote(7).result(timeout_s=30) == 49
+
+
+def test_multi_replica_load_balancing(cluster):
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self, _):
+            return self.pid
+
+    handle = serve.run(WhoAmI.bind(), name="who", _proxy=False)
+    pids = {handle.remote(None).result(timeout_s=30) for _ in range(20)}
+    assert len(pids) == 2  # both replicas served traffic
+
+
+def test_composition_nested_handles(cluster):
+    @serve.deployment
+    class Adder:
+        def __init__(self, increment):
+            self.increment = increment
+
+        def __call__(self, x):
+            return x + self.increment
+
+    @serve.deployment
+    class Chain:
+        def __init__(self, adder):
+            self.adder = adder
+
+        def __call__(self, x):
+            partial = self.adder.remote(x).result(timeout_s=30)
+            return partial * 10
+
+    app = Chain.bind(Adder.bind(3))
+    handle = serve.run(app, name="chain", _proxy=False)
+    assert handle.remote(4).result(timeout_s=30) == 70
+
+
+def test_method_routing(cluster):
+    @serve.deployment
+    class Multi:
+        def __call__(self, x):
+            return ("call", x)
+
+        def other(self, x):
+            return ("other", x)
+
+    handle = serve.run(Multi.bind(), name="multi", _proxy=False)
+    assert handle.remote(1).result(timeout_s=30) == ("call", 1)
+    assert handle.other.remote(2).result(timeout_s=30) == ("other", 2)
+
+
+def test_user_config_reconfigure(cluster):
+    @serve.deployment(user_config={"threshold": 1})
+    class Configurable:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, _):
+            return self.threshold
+
+    handle = serve.run(Configurable.bind(), name="cfg", _proxy=False)
+    assert handle.remote(None).result(timeout_s=30) == 1
+
+    @serve.deployment(user_config={"threshold": 5})
+    class Configurable2:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, _):
+            return self.threshold
+
+    Configurable2._config.name = "Configurable"
+    serve.run(Configurable2.bind(), name="cfg", _proxy=False)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if handle.remote(None).result(timeout_s=30) == 5:
+            break
+        time.sleep(0.3)
+    assert handle.remote(None).result(timeout_s=30) == 5
+
+
+def test_replica_failure_recovery(cluster):
+    @serve.deployment
+    class Fragile:
+        def __call__(self, x):
+            if x == "die":
+                import os
+
+                os._exit(1)
+            return "alive"
+
+    handle = serve.run(Fragile.bind(), name="fragile", _proxy=False)
+    assert handle.remote("ok").result(timeout_s=30) == "alive"
+    try:
+        handle.remote("die").result(timeout_s=10)
+    except Exception:
+        pass
+    # controller should replace the dead replica
+    deadline = time.time() + 60
+    ok = False
+    while time.time() < deadline:
+        try:
+            if handle.remote("ok").result(timeout_s=10) == "alive":
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.5)
+    assert ok, "replica was not replaced after crash"
+
+
+def test_http_proxy_end_to_end(cluster):
+    @serve.deployment
+    class Echo:
+        def __call__(self, body):
+            return {"echo": body}
+
+    serve.run(Echo.bind(), name="echo_app", route_prefix="/echo")
+    deadline = time.time() + 30
+    result = None
+    while time.time() < deadline:
+        try:
+            req = urllib.request.Request(
+                "http://127.0.0.1:8000/echo",
+                data=json.dumps({"msg": "hi"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                result = json.loads(resp.read())
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert result == {"result": {"echo": {"msg": "hi"}}}, result
+
+    with urllib.request.urlopen(
+        "http://127.0.0.1:8000/-/healthz", timeout=10
+    ) as resp:
+        assert json.loads(resp.read())["status"] == "ok"
+
+
+def test_autoscaling_up_and_down(cluster):
+    @serve.deployment(
+        autoscaling_config=dict(
+            min_replicas=1,
+            max_replicas=3,
+            target_ongoing_requests=1,
+            upscale_delay_s=0.5,
+            downscale_delay_s=2.0,
+        ),
+        max_ongoing_requests=10,
+    )
+    class Slow:
+        def __call__(self, _):
+            time.sleep(1.5)
+            return "done"
+
+    handle = serve.run(Slow.bind(), name="auto", _proxy=False)
+
+    def n_running():
+        st = serve.status()["auto"].deployments["Slow"]
+        return sum(1 for r in st.replicas if r.state == "RUNNING")
+
+    assert n_running() == 1
+    # flood with concurrent requests to drive queue length up
+    responses = [handle.remote(None) for _ in range(12)]
+    deadline = time.time() + 45
+    scaled = False
+    while time.time() < deadline:
+        if n_running() >= 2:
+            scaled = True
+            break
+        responses.extend(handle.remote(None) for _ in range(3))
+        time.sleep(0.5)
+    assert scaled, "deployment did not scale up under load"
+    for r in responses:
+        try:
+            r.result(timeout_s=60)
+        except Exception:
+            pass
+    # idle: should scale back toward min_replicas
+    deadline = time.time() + 60
+    downscaled = False
+    while time.time() < deadline:
+        if n_running() <= 2:
+            downscaled = True
+            break
+        time.sleep(0.5)
+    assert downscaled, "deployment did not scale down when idle"
+
+
+def test_delete_application(cluster):
+    @serve.deployment
+    class Temp:
+        def __call__(self, _):
+            return 1
+
+    serve.run(Temp.bind(), name="temp", _proxy=False)
+    assert "temp" in serve.status()
+    serve.delete("temp")
+    assert "temp" not in serve.status()
