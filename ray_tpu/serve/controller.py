@@ -37,6 +37,7 @@ class _ReplicaState:
         self.state = "STARTING"
         self.queue_len = 0
         self.consecutive_health_failures = 0
+        self.started_at = time.time()
 
 
 class _DeploymentState:
@@ -120,7 +121,17 @@ class ServeController:
                                 r.handle.reconfigure.remote(config.user_config)
                             except Exception:
                                 pass
+            # deployments dropped by the re-deploy must not keep replicas
+            old_names = self._apps.get(app_name, {})
+            removed = [
+                self._deployments.pop(full)
+                for short, full in old_names.items()
+                if short not in names and full in self._deployments
+            ]
             self._apps[app_name] = names
+        for dep in removed:
+            for rid in list(dep.replicas):
+                self._stop_replica(dep, rid)
         return True
 
     def delete_application(self, app_name: str) -> bool:
@@ -166,6 +177,10 @@ class ServeController:
                     with self._lock:
                         dep.replicas.pop(rid, None)
                         dep.version += 1
+                    try:
+                        api.kill(replica.handle)
+                    except Exception:
+                        pass
 
     def _autoscale(self, dep: _DeploymentState):
         cfg: AutoscalingConfig = dep.config.autoscaling_config
@@ -207,19 +222,29 @@ class ServeController:
                 self._stop_replica(dep, v.replica_id)
         for replica in list(dep.replicas.values()):
             if replica.state == "STARTING":
+                # short probe per iteration: a slow-loading replica stays
+                # STARTING without stalling reconcile for other deployments
                 try:
-                    if api.get(replica.handle.check_health.remote(), timeout=20):
+                    if api.get(replica.handle.check_health.remote(), timeout=2):
                         with self._lock:
                             replica.state = "RUNNING"
                             dep.version += 1
                 except TimeoutError:
-                    pass
+                    if time.time() - replica.started_at > 120:
+                        logger.warning(
+                            "replica %s startup timed out", replica.replica_id
+                        )
+                        self._stop_replica(dep, replica.replica_id)
                 except Exception:
                     logger.exception(
                         "replica %s failed to start", replica.replica_id
                     )
                     with self._lock:
                         dep.replicas.pop(replica.replica_id, None)
+                    try:
+                        api.kill(replica.handle)
+                    except Exception:
+                        pass
 
     def _start_replica(self, full_name: str, dep: _DeploymentState):
         from .. import api
@@ -229,7 +254,12 @@ class ServeController:
         dep.next_replica_idx += 1
         opts = dict(dep.config.ray_actor_options or {})
         opts.setdefault("num_cpus", 1)
-        opts.setdefault("max_concurrency", dep.config.max_ongoing_requests)
+        # headroom above max_ongoing_requests so control-plane calls
+        # (get_metrics/check_health) are not starved behind a saturated
+        # data plane and falsely mark the replica unhealthy
+        opts.setdefault(
+            "max_concurrency", dep.config.max_ongoing_requests + 8
+        )
         ReplicaActor = api.remote(**opts)(Replica)
         handle = ReplicaActor.remote(
             dep.config.name,

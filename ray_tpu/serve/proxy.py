@@ -69,8 +69,15 @@ class HTTPProxy:
     async def _handle_routes(self, request):
         from aiohttp import web
 
-        self._refresh_routes()
+        await self._refresh_routes_async()
         return web.json_response(self._routes)
+
+    async def _refresh_routes_async(self):
+        # the blocking handle API must stay off the aiohttp loop, or one
+        # slow controller call freezes every in-flight HTTP request
+        await asyncio.get_event_loop().run_in_executor(
+            None, self._refresh_routes
+        )
 
     def _refresh_routes(self):
         from .. import api
@@ -99,7 +106,7 @@ class HTTPProxy:
         path = "/" + request.match_info["tail"]
         match = self._resolve(path)
         if match is None:
-            self._refresh_routes()
+            await self._refresh_routes_async()
             match = self._resolve(path)
         if match is None:
             return web.json_response(

@@ -97,5 +97,15 @@ class Replica:
         deadline = time.time() + timeout_s
         while self._ongoing > 0 and time.time() < deadline:
             await asyncio.sleep(0.05)
-        shutdown = getattr(self._callable, "__del__", None)
+        # run user cleanup before the controller hard-kills this actor
+        for hook in ("__del__", "shutdown"):
+            fn = getattr(type(self._callable), hook, None)
+            if fn is not None:
+                try:
+                    result = fn(self._callable)
+                    if inspect.iscoroutine(result):
+                        await result
+                except Exception:
+                    pass
+                break
         return self._ongoing == 0

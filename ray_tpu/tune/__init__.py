@@ -1,0 +1,46 @@
+"""ray_tpu.tune: hyperparameter tuning (reference: python/ray/tune).
+
+Tuner expands a search space into trial actors, a controller loop polls
+reported metrics, ASHA prunes underperformers, and with_resources gang-
+places TPU trials.
+"""
+
+from ._session import report
+from .schedulers import ASHAScheduler, FIFOScheduler, MedianStoppingRule
+from .search import (
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .tuner import (
+    Result,
+    ResultGrid,
+    RunConfig,
+    TuneConfig,
+    Tuner,
+    with_resources,
+)
+
+__all__ = [
+    "Tuner",
+    "TuneConfig",
+    "RunConfig",
+    "Result",
+    "ResultGrid",
+    "report",
+    "with_resources",
+    "uniform",
+    "loguniform",
+    "quniform",
+    "randint",
+    "choice",
+    "grid_search",
+    "sample_from",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "ASHAScheduler",
+]

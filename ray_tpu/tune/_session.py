@@ -1,0 +1,39 @@
+"""Per-trial session: tune.report from inside the trainable.
+
+Role-equivalent of the reference's tune session (ray.tune.report /
+train.report inside a trainable): thread-local binding between the user
+function and its _TrialRunner actor.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+_local = threading.local()
+
+
+class StopTrial(Exception):
+    """Raised inside the trainable when the scheduler stopped the trial."""
+
+
+def _set(runner):
+    _local.runner = runner
+
+
+def _get():
+    runner = getattr(_local, "runner", None)
+    if runner is None:
+        raise RuntimeError(
+            "tune.report() called outside a running trial"
+        )
+    return runner
+
+
+def report(metrics: Dict[str, Any], **kw_metrics: Any):
+    runner = _get()
+    merged = dict(metrics or {})
+    merged.update(kw_metrics)
+    runner._report(merged)
+    if runner._should_stop():
+        raise StopTrial()

@@ -1,0 +1,125 @@
+"""Trial schedulers: FIFO and ASHA.
+
+Role-equivalent of the reference's TrialScheduler family
+(python/ray/tune/schedulers/ — FIFOScheduler, AsyncHyperBandScheduler/ASHA
+in async_hyperband.py): on every reported result the scheduler decides
+CONTINUE or STOP; ASHA keeps successive-halving rungs and stops trials that
+fall below the top ``1/reduction_factor`` quantile at each rung.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str):
+        pass
+
+
+class MedianStoppingRule:
+    """Stop trials whose running-average metric falls below the median of
+    completed averages (reference: schedulers/median_stopping_rule.py)."""
+
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        grace_period: int = 5,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self._sums: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        self._sums[trial_id] += float(value)
+        self._counts[trial_id] += 1
+        if t < self.grace_period or len(self._counts) < 3:
+            return CONTINUE
+        avgs = sorted(
+            self._sums[k] / self._counts[k] for k in self._counts
+        )
+        median = avgs[len(avgs) // 2]
+        mine = self._sums[trial_id] / self._counts[trial_id]
+        if self.mode == "max":
+            return CONTINUE if mine >= median else STOP
+        return CONTINUE if mine <= median else STOP
+
+    def on_trial_complete(self, trial_id: str):
+        pass
+
+
+class ASHAScheduler:
+    """Asynchronous successive halving (reference:
+    schedulers/async_hyperband.py AsyncHyperBandScheduler)."""
+
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+    ):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestones: grace, grace*rf, grace*rf^2, ... < max_t
+        self.milestones: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.milestones.append(t)
+            t *= reduction_factor
+        # milestone -> list of recorded metric values at that rung
+        self.rungs: Dict[int, List[float]] = defaultdict(list)
+        # trial -> milestones already recorded (reports may skip exact
+        # milestone values, so rungs trigger on first crossing, not ==)
+        self._recorded: Dict[str, set] = defaultdict(set)
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        decision = CONTINUE
+        for milestone in self.milestones:
+            if t >= milestone and milestone not in self._recorded[trial_id]:
+                self._recorded[trial_id].add(milestone)
+                rung = self.rungs[milestone]
+                rung.append(float(value))
+                if len(rung) >= self.rf:
+                    decision = self._cutoff_decision(rung, float(value))
+        return decision
+
+    def _cutoff_decision(self, rung: List[float], value: float) -> str:
+        ordered = sorted(rung, reverse=(self.mode == "max"))
+        k = max(1, len(ordered) // self.rf)
+        cutoff = ordered[k - 1]
+        if self.mode == "max":
+            return CONTINUE if value >= cutoff else STOP
+        return CONTINUE if value <= cutoff else STOP
+
+    def on_trial_complete(self, trial_id: str):
+        pass

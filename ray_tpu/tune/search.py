@@ -1,0 +1,154 @@
+"""Search spaces + trial variant generation.
+
+Role-equivalent of the reference's sample-space API and basic searcher
+(python/ray/tune/search/sample.py — uniform/loguniform/choice/randint/
+grid_search; search/basic_variant.py BasicVariantGenerator): grid_search
+entries expand to the cross product; distribution entries are sampled
+``num_samples`` times per grid point.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        if low <= 0 or high <= 0:
+            raise ValueError("loguniform bounds must be positive")
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+class QUniform(Domain):
+    def __init__(self, low: float, high: float, q: float):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        return round(rng.uniform(self.low, self.high) / self.q) * self.q
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class GridSearch:
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+class SampleFrom:
+    """tune.sample_from(lambda spec: ...) — callable over the resolved config."""
+
+    def __init__(self, fn: Callable[[Dict[str, Any]], Any]):
+        self.fn = fn
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def quniform(low, high, q) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def sample_from(fn) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def generate_variants(
+    param_space: Dict[str, Any],
+    num_samples: int = 1,
+    seed: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """Expand a param space into concrete trial configs (reference:
+    BasicVariantGenerator semantics: full grid cross-product × num_samples
+    random draws)."""
+    rng = random.Random(seed)
+    grid_keys: List[tuple] = []
+    grid_values: List[List[Any]] = []
+
+    def find_grids(prefix: tuple, space: Dict[str, Any]):
+        for k, v in space.items():
+            if isinstance(v, GridSearch):
+                grid_keys.append(prefix + (k,))
+                grid_values.append(v.values)
+            elif isinstance(v, dict):
+                find_grids(prefix + (k,), v)
+
+    find_grids((), param_space)
+
+    def resolve(space: Dict[str, Any], grid_assignment: Dict[tuple, Any], prefix=()):
+        out = {}
+        deferred = []
+        for k, v in space.items():
+            path = prefix + (k,)
+            if isinstance(v, GridSearch):
+                out[k] = grid_assignment[path]
+            elif isinstance(v, Domain):
+                out[k] = v.sample(rng)
+            elif isinstance(v, SampleFrom):
+                deferred.append((k, v))
+            elif isinstance(v, dict):
+                out[k] = resolve(v, grid_assignment, path)
+            else:
+                out[k] = v
+        for k, v in deferred:
+            out[k] = v.fn(out)
+        return out
+
+    combos = (
+        list(itertools.product(*grid_values)) if grid_values else [()]
+    )
+    variants = []
+    for combo in combos:
+        assignment = dict(zip(grid_keys, combo))
+        for _ in range(num_samples):
+            variants.append(resolve(param_space, assignment))
+    return variants

@@ -1,0 +1,391 @@
+"""Tuner + TuneController: run many trials, keep the best.
+
+Role-equivalent of the reference's Tuner (python/ray/tune/tuner.py:43,312)
+and TuneController event loop (tune/execution/tune_controller.py:68): expand
+the param space into trials, run up to ``max_concurrent_trials`` trial
+actors at once, poll their reported results, let the scheduler stop
+underperformers, retry failed trials, and return a ResultGrid.
+
+Trials are actors so a trial can reserve TPU chips
+(``tune.with_resources(fn, {"TPU": 1})``) and the controller's polling is
+identical for CPU and TPU trials.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from .schedulers import CONTINUE, STOP, FIFOScheduler
+from .search import generate_variants
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0  # 0 => derive from cluster CPUs
+    scheduler: Any = None
+    seed: Optional[int] = None
+    max_failures: int = 1
+
+
+@dataclass
+class RunConfig:
+    name: str = ""
+    storage_path: str = ""
+    stop: Optional[Dict[str, Any]] = None  # e.g. {"training_iteration": 10}
+
+
+@dataclass
+class Result:
+    config: Dict[str, Any]
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    error: Optional[str] = None
+    trial_id: str = ""
+    path: str = ""
+
+    @property
+    def terminated(self) -> bool:
+        return self.error is None
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], metric: Optional[str], mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def num_errors(self) -> int:
+        return sum(1 for r in self._results if r.error is not None)
+
+    def get_best_result(
+        self, metric: Optional[str] = None, mode: Optional[str] = None
+    ) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("specify metric= (none set in TuneConfig)")
+        candidates = [
+            r for r in self._results if r.error is None and metric in r.metrics
+        ]
+        if not candidates:
+            raise RuntimeError("no successful trials with the given metric")
+        return (max if mode == "max" else min)(
+            candidates, key=lambda r: r.metrics[metric]
+        )
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = {f"config/{k}": v for k, v in _flatten(r.config).items()}
+            row.update(r.metrics)
+            row["trial_id"] = r.trial_id
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+def _flatten(d: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "/"))
+        else:
+            out[key] = v
+    return out
+
+
+class _TrialRunner:
+    """Actor: runs one trial's function in a thread, queues its reports
+    (reference: tune trainable wrapped in thread + result queue)."""
+
+    def __init__(self):
+        self._reports: List[dict] = []
+        self._lock = threading.Lock()
+        self._done = False
+        self._error: Optional[str] = None
+        self._stop_requested = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(
+        self, fn_bytes: bytes, config: dict, stop_criteria: dict = None
+    ) -> bool:
+        from .._internal import serialization
+        from . import _session
+
+        self._stop_criteria = dict(stop_criteria or {})
+        self._iteration = 0
+        fn = serialization.loads(fn_bytes)
+
+        def _run():
+            _session._set(self)
+            try:
+                fn(config)
+            except _session.StopTrial:
+                pass
+            except Exception as e:  # noqa: BLE001
+                import traceback
+
+                with self._lock:
+                    self._error = traceback.format_exc()
+            finally:
+                _session._set(None)
+                with self._lock:
+                    self._done = True
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        return True
+
+    def _report(self, metrics: dict):
+        """Queue a report; evaluate user stop criteria trial-side so fast
+        loops stop at the right iteration instead of overrunning while the
+        controller polls (reference: Trainable stop conditions checked
+        inside the trial)."""
+        report = dict(metrics)
+        with self._lock:
+            self._iteration += 1
+            report.setdefault("training_iteration", self._iteration)
+            self._reports.append(report)
+        if any(
+            k in report and report[k] >= v
+            for k, v in self._stop_criteria.items()
+        ):
+            self._stop_requested = True
+
+    def _should_stop(self) -> bool:
+        return self._stop_requested
+
+    def request_stop(self):
+        self._stop_requested = True
+        return True
+
+    def poll(self) -> dict:
+        with self._lock:
+            reports, self._reports = self._reports, []
+            return {
+                "reports": reports,
+                "done": self._done,
+                "error": self._error,
+            }
+
+
+@dataclass
+class _Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    resources: Dict[str, float]
+    state: str = "PENDING"  # PENDING RUNNING TERMINATED ERROR STOPPED
+    runner: Any = None
+    last_metrics: Dict[str, Any] = field(default_factory=dict)
+    iterations: int = 0
+    failures: int = 0
+    error: Optional[str] = None
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        if isinstance(trainable, _WithResources):
+            self._resources = trainable.resources
+            self._trainable = trainable.fn
+        else:
+            self._resources = {"CPU": 1.0}
+            self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        from .._internal import serialization
+
+        cfg = self._tune_config
+        scheduler = cfg.scheduler or FIFOScheduler()
+        if getattr(scheduler, "metric", None) is None and hasattr(
+            scheduler, "metric"
+        ):
+            scheduler.metric = cfg.metric
+        variants = generate_variants(
+            self._param_space, cfg.num_samples, cfg.seed
+        )
+        trials = [
+            _Trial(
+                trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:6]}",
+                config=v,
+                resources=dict(self._resources),
+            )
+            for i, v in enumerate(variants)
+        ]
+        fn_bytes = serialization.dumps(self._trainable)
+        max_concurrent = cfg.max_concurrent_trials
+        if max_concurrent <= 0:
+            try:
+                max_concurrent = max(
+                    1, int(api.cluster_resources().get("CPU", 2)) - 1
+                )
+            except Exception:
+                max_concurrent = 2
+        stop_criteria = self._run_config.stop or {}
+
+        Runner = api.remote(
+            num_cpus=self._resources.get("CPU", 1),
+            num_tpus=self._resources.get("TPU", 0),
+            resources={
+                k: v
+                for k, v in self._resources.items()
+                if k not in ("CPU", "TPU")
+            },
+        )(_TrialRunner)
+
+        pending = list(trials)
+        running: List[_Trial] = []
+        finished: List[_Trial] = []
+        while pending or running:
+            while pending and len(running) < max_concurrent:
+                trial = pending.pop(0)
+                trial.runner = Runner.remote()
+                try:
+                    api.get(
+                        trial.runner.start.remote(
+                            fn_bytes, trial.config, stop_criteria
+                        ),
+                        timeout=60,
+                    )
+                except Exception:
+                    # runner could not schedule (e.g. TPU-constrained trials
+                    # under a CPU-derived concurrency cap): back off, requeue
+                    # without charging a failure, and launch fewer at once
+                    self._kill_runner(trial)
+                    pending.insert(0, trial)
+                    max_concurrent = max(1, len(running))
+                    break
+                trial.state = "RUNNING"
+                running.append(trial)
+            time.sleep(0.1)
+            still_running: List[_Trial] = []
+            for trial in running:
+                try:
+                    update = api.get(trial.runner.poll.remote(), timeout=30)
+                except Exception as e:  # runner actor died
+                    self._on_trial_crash(trial, repr(e), pending)
+                    if trial.state == "ERROR":
+                        finished.append(trial)
+                    continue
+                stop_now = False
+                for report in update["reports"]:
+                    trial.iterations = report["training_iteration"]
+                    trial.last_metrics = report
+                    decision = scheduler.on_result(trial.trial_id, report)
+                    if decision == STOP or self._hits_stop_criteria(
+                        report, stop_criteria
+                    ):
+                        stop_now = True
+                        break  # later reports are past the stop point
+                if stop_now and not update["done"]:
+                    try:
+                        trial.runner.request_stop.remote()
+                    except Exception:
+                        pass
+                    trial.state = "STOPPED"
+                    self._kill_runner(trial)
+                    scheduler.on_trial_complete(trial.trial_id)
+                    finished.append(trial)
+                elif update["done"]:
+                    if update["error"] is not None:
+                        trial.failures += 1
+                        if trial.failures <= cfg.max_failures:
+                            logger.warning(
+                                "trial %s failed (attempt %d); retrying",
+                                trial.trial_id,
+                                trial.failures,
+                            )
+                            self._kill_runner(trial)
+                            trial.state = "PENDING"
+                            pending.append(trial)
+                        else:
+                            trial.state = "ERROR"
+                            trial.error = update["error"]
+                            self._kill_runner(trial)
+                            finished.append(trial)
+                    else:
+                        trial.state = "TERMINATED"
+                        self._kill_runner(trial)
+                        scheduler.on_trial_complete(trial.trial_id)
+                        finished.append(trial)
+                else:
+                    still_running.append(trial)
+            running = still_running
+        results = [
+            Result(
+                config=t.config,
+                metrics=t.last_metrics,
+                error=t.error,
+                trial_id=t.trial_id,
+            )
+            for t in finished
+        ]
+        return ResultGrid(results, cfg.metric, cfg.mode)
+
+    def _on_trial_crash(self, trial: _Trial, err: str, pending: list):
+        trial.failures += 1
+        self._kill_runner(trial)
+        if trial.failures <= self._tune_config.max_failures:
+            trial.state = "PENDING"
+            pending.append(trial)
+        else:
+            trial.state = "ERROR"
+            trial.error = err
+
+    @staticmethod
+    def _hits_stop_criteria(report: dict, criteria: dict) -> bool:
+        return any(
+            k in report and report[k] >= v for k, v in criteria.items()
+        )
+
+    @staticmethod
+    def _kill_runner(trial: _Trial):
+        if trial.runner is not None:
+            try:
+                api.kill(trial.runner)
+            except Exception:
+                pass
+            trial.runner = None
+
+
+class _WithResources:
+    def __init__(self, fn, resources: Dict[str, float]):
+        self.fn = fn
+        self.resources = resources
+
+
+def with_resources(fn: Callable, resources: Dict[str, float]) -> _WithResources:
+    """reference: tune.with_resources — per-trial resource request (the TPU
+    path: {"TPU": chips} gang-places each trial on chips)."""
+    return _WithResources(fn, resources)

@@ -1,0 +1,158 @@
+"""Tests for ray_tpu.tune (reference model: python/ray/tune/tests/)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, resources={"TPU": 4})
+    yield
+    ray_tpu.shutdown()
+
+
+def test_variant_generation_grid_and_sample():
+    from ray_tpu.tune.search import generate_variants
+
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "bs": tune.choice([16, 32]),
+        "nested": {"depth": tune.grid_search([2, 4])},
+        "fixed": 7,
+    }
+    variants = generate_variants(space, num_samples=3, seed=0)
+    assert len(variants) == 2 * 2 * 3
+    assert all(v["fixed"] == 7 for v in variants)
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    assert {v["nested"]["depth"] for v in variants} == {2, 4}
+    assert all(v["bs"] in (16, 32) for v in variants)
+
+
+def test_sample_domains():
+    from ray_tpu.tune.search import generate_variants
+
+    space = {
+        "u": tune.uniform(0, 1),
+        "lu": tune.loguniform(1e-4, 1e-1),
+        "ri": tune.randint(0, 10),
+        "q": tune.quniform(0, 1, 0.25),
+        "dep": tune.sample_from(lambda cfg: cfg["ri"] * 2),
+    }
+    (v,) = generate_variants(space, seed=42)
+    assert 0 <= v["u"] <= 1
+    assert 1e-4 <= v["lu"] <= 1e-1
+    assert v["ri"] in range(10)
+    assert v["q"] in (0, 0.25, 0.5, 0.75, 1.0)
+    assert v["dep"] == v["ri"] * 2
+
+
+def test_tuner_grid_best_result(cluster):
+    def objective(config):
+        score = -((config["x"] - 3) ** 2)
+        tune.report({"score": score})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4, 5])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    )
+    results = tuner.fit()
+    assert len(results) == 6
+    assert results.num_errors == 0
+    best = results.get_best_result()
+    assert best.config["x"] == 3
+    assert best.metrics["score"] == 0
+
+
+def test_tuner_multi_iteration_and_stop_criteria(cluster):
+    def train_fn(config):
+        for i in range(100):
+            tune.report({"loss": 1.0 / (i + 1)})
+
+    tuner = tune.Tuner(
+        train_fn,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=tune.RunConfig(stop={"training_iteration": 5}),
+    )
+    results = tuner.fit()
+    assert len(results) == 2
+    for r in results:
+        assert r.metrics["training_iteration"] <= 10  # stopped early
+
+
+def test_asha_prunes_bad_trials(cluster):
+    def train_fn(config):
+        for i in range(20):
+            tune.report({"acc": config["quality"] * (i + 1)})
+
+    tuner = tune.Tuner(
+        train_fn,
+        # best-first order: later (worse) trials land below the rung cutoff
+        # set by earlier ones — ASHA's asynchronous pruning in action
+        param_space={"quality": tune.grid_search([1.0, 0.5, 0.1, 0.0])},
+        tune_config=tune.TuneConfig(
+            metric="acc",
+            mode="max",
+            scheduler=tune.ASHAScheduler(
+                metric="acc",
+                mode="max",
+                max_t=20,
+                grace_period=2,
+                reduction_factor=2,
+            ),
+            max_concurrent_trials=2,
+        ),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    best = results.get_best_result()
+    assert best.config["quality"] == 1.0
+    # at least one bad trial must have been stopped before max_t
+    iters = [r.metrics.get("training_iteration", 0) for r in results]
+    assert min(iters) < 20
+
+
+def test_trial_failure_retry_then_error(cluster):
+    def flaky(config):
+        raise RuntimeError("boom")
+
+    tuner = tune.Tuner(
+        flaky,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=tune.TuneConfig(metric="m", mode="max", max_failures=1),
+    )
+    results = tuner.fit()
+    assert len(results) == 1
+    assert results.num_errors == 1
+    assert "boom" in results[0].error
+
+
+def test_with_resources(cluster):
+    def probe(config):
+        tune.report({"ok": 1})
+
+    tuner = tune.Tuner(
+        tune.with_resources(probe, {"CPU": 1, "TPU": 1}),
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+    )
+    results = tuner.fit()
+    assert results.num_errors == 0
+    assert len(results) == 2
+
+
+def test_result_dataframe(cluster):
+    def objective(config):
+        tune.report({"score": config["x"]})
+
+    results = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    ).fit()
+    df = results.get_dataframe()
+    assert len(df) == 3
+    assert set(df["config/x"]) == {1, 2, 3}
