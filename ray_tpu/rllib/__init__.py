@@ -1,0 +1,22 @@
+"""ray_tpu.rllib: reinforcement learning (reference: python/ray/rllib).
+
+PPO with CPU env-runner actors and a JAX learner whose whole update epoch is
+one jitted lax.scan — the rollout/learner split the reference implements as
+EnvRunnerGroup (env_runner_group.py:70) + LearnerGroup (learner_group.py:101),
+with the learner compiling to the TPU instead of torch DDP.
+"""
+
+from .algorithm import PPO, PPOConfig, as_trainable
+from .env import VectorEnv, make_env
+from .env_runner import EnvRunner
+from .learner import PPOLearner
+
+__all__ = [
+    "PPO",
+    "PPOConfig",
+    "as_trainable",
+    "PPOLearner",
+    "EnvRunner",
+    "VectorEnv",
+    "make_env",
+]

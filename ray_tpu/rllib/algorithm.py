@@ -1,0 +1,269 @@
+"""Algorithm + PPOConfig: the user-facing RL training loop.
+
+Role-equivalent of the reference's Algorithm/AlgorithmConfig
+(rllib/algorithms/algorithm.py:212, algorithm_config.py) scoped to PPO:
+a builder config (``PPOConfig().environment(...).env_runners(...)
+.training(...)``), an EnvRunnerGroup of rollout actors, a driver-side JAX
+learner (on the TPU when present), train()/save/restore, and Tune
+integration via ``as_trainable``.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .. import api
+from .env import make_env, space_dims
+from .env_runner import EnvRunner
+from .learner import PPOLearner
+from .models import compute_gae
+
+
+class PPOConfig:
+    def __init__(self):
+        self.env_spec: Union[str, Callable, None] = None
+        self.env_config: Dict[str, Any] = {}
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_len = 64
+        self.gamma = 0.99
+        self.lam = 0.95
+        self.lr = 3e-4
+        self.clip_param = 0.2
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_epochs = 4
+        self.minibatch_size = 128
+        self.max_grad_norm = 0.5
+        self.seed = 0
+        self.num_cpus_per_runner = 1.0
+        self.num_tpus_for_learner = 0.0
+
+    # -- builder API (reference: AlgorithmConfig fluent methods) -----------
+
+    def environment(self, env, env_config: Optional[dict] = None) -> "PPOConfig":
+        self.env_spec = env
+        self.env_config = dict(env_config or {})
+        return self
+
+    def env_runners(
+        self,
+        num_env_runners: Optional[int] = None,
+        num_envs_per_env_runner: Optional[int] = None,
+        rollout_fragment_length: Optional[int] = None,
+        num_cpus_per_env_runner: Optional[float] = None,
+    ) -> "PPOConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_len = rollout_fragment_length
+        if num_cpus_per_env_runner is not None:
+            self.num_cpus_per_runner = num_cpus_per_env_runner
+        return self
+
+    def training(self, **kwargs) -> "PPOConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def resources(self, num_tpus_for_learner: float = 0) -> "PPOConfig":
+        self.num_tpus_for_learner = num_tpus_for_learner
+        return self
+
+    def debugging(self, seed: Optional[int] = None) -> "PPOConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(copy.deepcopy(self))
+
+    # legacy alias used by reference examples
+    build_algo = build
+
+
+class PPO:
+    """PPO with CPU rollout actors + driver-side JAX learner (the learner
+    compiles to the TPU when one is attached — the split the reference
+    implements as EnvRunnerGroup + LearnerGroup)."""
+
+    def __init__(self, config: PPOConfig):
+        if config.env_spec is None:
+            raise ValueError("config.environment(...) is required")
+        self.config = config
+        self.iteration = 0
+        # probe spaces locally (cheap env instance)
+        probe = make_env(config.env_spec, config.env_config)()
+        obs_dim, act_dim, discrete = space_dims(
+            probe.observation_space, probe.action_space
+        )
+        try:
+            probe.close()
+        except Exception:
+            pass
+        self.learner = PPOLearner(
+            obs_dim,
+            act_dim,
+            discrete,
+            lr=config.lr,
+            clip_param=config.clip_param,
+            vf_coeff=config.vf_coeff,
+            entropy_coeff=config.entropy_coeff,
+            num_epochs=config.num_epochs,
+            minibatch_size=config.minibatch_size,
+            max_grad_norm=config.max_grad_norm,
+            seed=config.seed,
+        )
+        Runner = api.remote(num_cpus=config.num_cpus_per_runner)(EnvRunner)
+        self.runners = [
+            Runner.remote(
+                config.env_spec,
+                config.env_config,
+                config.num_envs_per_runner,
+                config.rollout_len,
+                config.seed + 1000 * (i + 1),
+            )
+            for i in range(config.num_env_runners)
+        ]
+        api.get([r.ping.remote() for r in self.runners])
+        self._ep_return_window: List[float] = []
+
+    # -- training -----------------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: parallel rollouts -> GAE -> learner update
+        (reference: Algorithm.step / training_step)."""
+        t0 = time.time()
+        params = self.learner.get_params()
+        rollouts = api.get(
+            [r.sample.remote(params) for r in self.runners]
+        )
+        batch, ep_returns, ep_lengths = self._postprocess(rollouts)
+        stats = self.learner.update(batch)
+        self.iteration += 1
+        self._ep_return_window.extend(ep_returns)
+        self._ep_return_window = self._ep_return_window[-100:]
+        mean_return = (
+            float(np.mean(self._ep_return_window))
+            if self._ep_return_window
+            else float("nan")
+        )
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_return,
+            "num_episodes": len(ep_returns),
+            "episode_len_mean": float(np.mean(ep_lengths))
+            if ep_lengths
+            else float("nan"),
+            "num_env_steps_sampled": batch["obs"].shape[0],
+            "time_this_iter_s": time.time() - t0,
+            **stats,
+        }
+
+    def _postprocess(self, rollouts):
+        obs, actions, logp, adv, ret = [], [], [], [], []
+        ep_returns, ep_lengths = [], []
+        for ro in rollouts:
+            a, r = compute_gae(
+                ro["rewards"],
+                ro["values"],
+                ro["dones"],
+                ro["last_values"],
+                self.config.gamma,
+                self.config.lam,
+            )
+            T, N = ro["rewards"].shape
+            obs.append(ro["obs"].reshape(T * N, -1))
+            actions.append(ro["actions"].reshape(T * N, *ro["actions"].shape[2:]))
+            logp.append(ro["logp"].reshape(T * N))
+            adv.append(a.reshape(T * N))
+            ret.append(r.reshape(T * N))
+            ep_returns.extend(ro["episode_returns"])
+            ep_lengths.extend(ro["episode_lengths"])
+        batch = {
+            "obs": np.concatenate(obs).astype(np.float32),
+            "actions": np.concatenate(actions),
+            "logp_old": np.concatenate(logp),
+            "advantages": np.concatenate(adv),
+            "returns": np.concatenate(ret),
+        }
+        return batch, ep_returns, ep_lengths
+
+    # -- checkpointing (reference: Checkpointable) --------------------------
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(
+                {
+                    "learner": self.learner.state_dict(),
+                    "iteration": self.iteration,
+                },
+                f,
+            )
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str):
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.learner.load_state_dict(state["learner"])
+        self.iteration = state["iteration"]
+
+    def get_policy_params(self):
+        return self.learner.get_params()
+
+    def compute_single_action(self, obs):
+        import jax
+        import jax.numpy as jnp
+
+        from .models import sample_actions
+
+        key = jax.random.PRNGKey(self.iteration)
+        actions, _, _ = sample_actions(
+            self.learner.model,
+            self.learner.params,
+            jnp.asarray(obs, jnp.float32)[None],
+            key,
+        )
+        return np.asarray(actions)[0]
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                api.kill(r)
+            except Exception:
+                pass
+        self.runners = []
+
+
+def as_trainable(config: PPOConfig):
+    """Adapt to a Tune trainable: tune.Tuner(rllib.as_trainable(cfg), ...).
+    Overrides from the trial's param space are applied onto the config."""
+
+    def _train_fn(trial_config: dict):
+        from .. import tune
+
+        cfg = copy.deepcopy(config)
+        for k, v in trial_config.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        algo = cfg.build()
+        try:
+            while True:
+                tune.report(algo.train())
+        finally:
+            algo.stop()
+
+    return _train_fn

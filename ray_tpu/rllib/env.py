@@ -1,0 +1,102 @@
+"""Environment interface + vectorization.
+
+Role-equivalent of the reference's env layer (rllib/env/ — gymnasium-based
+single-agent envs wrapped for vector rollout, env/single_agent_env_runner.py
+builds a gymnasium vector env). Envs follow the gymnasium 5-tuple step API;
+``make_env`` accepts a gymnasium id string or an env-factory callable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import numpy as np
+
+
+class VectorEnv:
+    """N independent env copies stepped together (autoreset on episode end,
+    matching gymnasium's vector semantics)."""
+
+    def __init__(self, env_fns: List[Callable[[], Any]]):
+        self.envs = [fn() for fn in env_fns]
+        self.num_envs = len(self.envs)
+        first = self.envs[0]
+        self.observation_space = first.observation_space
+        self.action_space = first.action_space
+
+    def reset(self, seed: Optional[int] = None):
+        obs = []
+        for i, env in enumerate(self.envs):
+            o, _ = env.reset(seed=None if seed is None else seed + i)
+            obs.append(o)
+        return np.stack(obs)
+
+    def step(self, actions) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Returns (obs, rewards, terminateds, truncateds); terminated/
+        truncated envs are reset and their next obs replaces the terminal
+        one (the terminal obs is not needed by PPO's bootstrap because
+        value targets cut at dones)."""
+        obs, rewards, terms, truncs = [], [], [], []
+        for env, a in zip(self.envs, actions):
+            o, r, term, trunc, _ = env.step(a)
+            if term or trunc:
+                o, _ = env.reset()
+            obs.append(o)
+            rewards.append(r)
+            terms.append(term)
+            truncs.append(trunc)
+        return (
+            np.stack(obs),
+            np.asarray(rewards, np.float32),
+            np.asarray(terms),
+            np.asarray(truncs),
+        )
+
+    def close(self):
+        for env in self.envs:
+            try:
+                env.close()
+            except Exception:
+                pass
+
+
+def make_env(env: Union[str, Callable[[], Any]], env_config: Optional[dict] = None):
+    """Factory-of-factories: returns a zero-arg callable building one env."""
+    if callable(env):
+        cfg = dict(env_config or {})
+        return lambda: env(cfg) if _wants_config(env) else env()
+    if isinstance(env, str):
+        def _make():
+            import gymnasium as gym
+
+            return gym.make(env, **(env_config or {}))
+
+        return _make
+    raise TypeError(f"env must be a gymnasium id or callable, got {type(env)}")
+
+
+def _wants_config(fn) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    return len(sig.parameters) >= 1
+
+
+def space_dims(observation_space, action_space) -> Tuple[int, int, bool]:
+    """(obs_dim, action_dim, discrete) from gymnasium spaces."""
+    import gymnasium as gym
+
+    if isinstance(observation_space, gym.spaces.Box):
+        obs_dim = int(np.prod(observation_space.shape))
+    elif isinstance(observation_space, gym.spaces.Discrete):
+        obs_dim = int(observation_space.n)
+    else:
+        raise ValueError(f"unsupported obs space {observation_space}")
+    if isinstance(action_space, gym.spaces.Discrete):
+        return obs_dim, int(action_space.n), True
+    if isinstance(action_space, gym.spaces.Box):
+        return obs_dim, int(np.prod(action_space.shape)), False
+    raise ValueError(f"unsupported action space {action_space}")

@@ -1,0 +1,111 @@
+"""EnvRunner actor: collects rollouts with the current policy.
+
+Role-equivalent of the reference's SingleAgentEnvRunner
+(rllib/env/single_agent_env_runner.py:68) inside an EnvRunnerGroup
+(env/env_runner_group.py:70): each runner holds a vector of env copies and
+a CPU copy of the policy; ``sample(params)`` steps ``rollout_len`` times
+and returns [T, N] trajectory arrays. Runners are plain actors, so CPU
+rollout actors coexist with TPU learners in one cluster — the split the
+reference achieves with CPU workers + GPU learner group.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class EnvRunner:
+    def __init__(
+        self,
+        env_spec,
+        env_config: Optional[dict],
+        num_envs: int,
+        rollout_len: int,
+        seed: int,
+    ):
+        import jax
+
+        from .env import VectorEnv, make_env, space_dims
+        from .models import init_actor_critic, sample_actions
+
+        factory = make_env(env_spec, env_config)
+        self._vec = VectorEnv([factory for _ in range(num_envs)])
+        self._rollout_len = rollout_len
+        obs_dim, act_dim, discrete = space_dims(
+            self._vec.observation_space, self._vec.action_space
+        )
+        self._model, _ = init_actor_critic(obs_dim, act_dim, discrete, seed)
+        self._key = jax.random.PRNGKey(seed)
+        self._obs = self._vec.reset(seed=seed)
+        self._discrete = discrete
+        # episode-return bookkeeping
+        self._ep_returns = np.zeros(num_envs, np.float32)
+        self._ep_lengths = np.zeros(num_envs, np.int64)
+        self._completed: list = []
+        self._sample_fn = jax.jit(
+            lambda params, obs, key: sample_actions(
+                self._model, params, obs, key
+            )
+        )
+
+    def sample(self, params) -> Dict[str, Any]:
+        """Roll ``rollout_len`` steps; returns [T, N] arrays + last values
+        for bootstrap + episode stats."""
+        import jax
+
+        T, N = self._rollout_len, self._vec.num_envs
+        obs_buf = np.zeros((T, N) + self._obs.shape[1:], np.float32)
+        act_buf = None
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), bool)
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            actions, logp, values = self._sample_fn(
+                params, self._obs.astype(np.float32), sub
+            )
+            actions = np.asarray(actions)
+            obs_buf[t] = self._obs
+            if act_buf is None:
+                act_buf = np.zeros((T, N) + actions.shape[1:], actions.dtype)
+            act_buf[t] = actions
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(values)
+            next_obs, rewards, terms, truncs = self._vec.step(actions)
+            dones = terms | truncs
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+            self._ep_returns += rewards
+            self._ep_lengths += 1
+            for i in np.nonzero(dones)[0]:
+                self._completed.append(
+                    (float(self._ep_returns[i]), int(self._ep_lengths[i]))
+                )
+                self._ep_returns[i] = 0.0
+                self._ep_lengths[i] = 0
+            self._obs = next_obs
+        _, _, last_values = self._sample_fn(
+            params, self._obs.astype(np.float32), self._key
+        )
+        completed, self._completed = self._completed, []
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "logp": logp_buf,
+            "values": val_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "last_values": np.asarray(last_values),
+            "episode_returns": [r for r, _ in completed],
+            "episode_lengths": [l for _, l in completed],
+        }
+
+    def ping(self):
+        return True
+
+    def stop(self):
+        self._vec.close()
+        return True

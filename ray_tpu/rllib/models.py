@@ -1,0 +1,113 @@
+"""RLModule: policy/value networks in flax.
+
+Role-equivalent of the reference's RLModule (rllib/core/rl_module/ — torch
+actor-critic modules). TPU-first: one flax module computes logits and value
+in a single forward (fused matmuls on the MXU), parameters are a pytree
+ready for pjit sharding, and action sampling/log-prob are pure jax
+functions usable under jit on both the learner and the env runners.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+class ActorCritic(nn.Module):
+    action_dim: int
+    discrete: bool
+    hidden: Tuple[int, ...] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden:
+            x = nn.tanh(nn.Dense(h)(x))
+        logits = nn.Dense(self.action_dim)(x)
+        v = nn.Dense(1)(x)
+        if not self.discrete:
+            log_std = self.param(
+                "log_std", nn.initializers.zeros, (self.action_dim,)
+            )
+            return (logits, log_std), jnp.squeeze(v, -1)
+        return logits, jnp.squeeze(v, -1)
+
+
+def init_actor_critic(obs_dim: int, action_dim: int, discrete: bool, seed: int = 0):
+    model = ActorCritic(action_dim=action_dim, discrete=discrete)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, obs_dim), jnp.float32)
+    )["params"]
+    return model, params
+
+
+def sample_actions(model, params, obs, key):
+    """jit-able: obs [B, D] -> (actions, log_probs, values)."""
+    out, values = model.apply({"params": params}, obs)
+    if model.discrete:
+        logits = out
+        actions = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), actions
+        ]
+        return actions, logp, values
+    mean, log_std = out
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mean.shape)
+    actions = mean + std * eps
+    logp = _gaussian_logp(actions, mean, log_std)
+    return actions, logp, values
+
+
+def log_prob_entropy(model_discrete: bool, out, actions):
+    """Differentiable log-prob + entropy for the PPO loss."""
+    if model_discrete:
+        logits = out
+        all_logp = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            all_logp, actions[:, None].astype(jnp.int32), axis=1
+        )[:, 0]
+        probs = jnp.exp(all_logp)
+        entropy = -jnp.sum(probs * all_logp, axis=-1)
+        return logp, entropy
+    mean, log_std = out
+    logp = _gaussian_logp(actions, mean, log_std)
+    entropy = jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+    entropy = jnp.broadcast_to(entropy, logp.shape)
+    return logp, entropy
+
+
+def _gaussian_logp(x, mean, log_std):
+    std = jnp.exp(log_std)
+    return jnp.sum(
+        -0.5 * ((x - mean) / std) ** 2 - log_std - 0.5 * jnp.log(2 * jnp.pi),
+        axis=-1,
+    )
+
+
+def compute_gae(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    dones: np.ndarray,
+    last_values: np.ndarray,
+    gamma: float,
+    lam: float,
+):
+    """Generalized advantage estimation over [T, N] rollouts (reference:
+    rllib/evaluation/postprocessing.py compute_advantages)."""
+    T, N = rewards.shape
+    adv = np.zeros((T, N), np.float32)
+    last_gae = np.zeros(N, np.float32)
+    next_values = last_values
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t].astype(np.float32)
+        delta = rewards[t] + gamma * next_values * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_values = values[t]
+    returns = adv + values
+    return adv, returns

@@ -49,6 +49,9 @@ class DeploymentConfig:
     route_prefix: Optional[str] = None
     health_check_period_s: float = 2.0
     graceful_shutdown_timeout_s: float = 5.0
+    # replicas still STARTING after this are replaced (raise for slow model
+    # loads; reference: initial_health_check_timeout_s semantics)
+    startup_timeout_s: float = 300.0
 
 
 @dataclass

@@ -230,7 +230,10 @@ class ServeController:
                             replica.state = "RUNNING"
                             dep.version += 1
                 except TimeoutError:
-                    if time.time() - replica.started_at > 120:
+                    if (
+                        time.time() - replica.started_at
+                        > dep.config.startup_timeout_s
+                    ):
                         logger.warning(
                             "replica %s startup timed out", replica.replica_id
                         )

@@ -97,8 +97,9 @@ class Replica:
         deadline = time.time() + timeout_s
         while self._ongoing > 0 and time.time() < deadline:
             await asyncio.sleep(0.05)
-        # run user cleanup before the controller hard-kills this actor
-        for hook in ("__del__", "shutdown"):
+        # run user cleanup before the controller hard-kills this actor;
+        # an explicit shutdown() wins over __del__ (which GC may also run)
+        for hook in ("shutdown", "__del__"):
             fn = getattr(type(self._callable), hook, None)
             if fn is not None:
                 try:

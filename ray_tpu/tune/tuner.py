@@ -201,6 +201,7 @@ class _Trial:
     last_metrics: Dict[str, Any] = field(default_factory=dict)
     iterations: int = 0
     failures: int = 0
+    start_timeouts: int = 0
     error: Optional[str] = None
 
 
@@ -228,10 +229,10 @@ class Tuner:
 
         cfg = self._tune_config
         scheduler = cfg.scheduler or FIFOScheduler()
-        if getattr(scheduler, "metric", None) is None and hasattr(
-            scheduler, "metric"
-        ):
+        if hasattr(scheduler, "metric") and scheduler.metric is None:
             scheduler.metric = cfg.metric
+            if hasattr(scheduler, "mode"):
+                scheduler.mode = cfg.mode
         variants = generate_variants(
             self._param_space, cfg.num_samples, cfg.seed
         )
@@ -278,13 +279,23 @@ class Tuner:
                         ),
                         timeout=60,
                     )
-                except Exception:
+                except Exception as e:
                     # runner could not schedule (e.g. TPU-constrained trials
-                    # under a CPU-derived concurrency cap): back off, requeue
-                    # without charging a failure, and launch fewer at once
+                    # under a CPU-derived concurrency cap): back off and
+                    # launch fewer at once — but give up after repeated
+                    # timeouts so unsatisfiable resources fail, not hang
                     self._kill_runner(trial)
-                    pending.insert(0, trial)
-                    max_concurrent = max(1, len(running))
+                    trial.start_timeouts += 1
+                    if trial.start_timeouts >= 3 and not running:
+                        trial.state = "ERROR"
+                        trial.error = (
+                            f"trial could not be scheduled (resources "
+                            f"{trial.resources}): {e!r}"
+                        )
+                        finished.append(trial)
+                    else:
+                        pending.insert(0, trial)
+                        max_concurrent = max(1, len(running))
                     break
                 trial.state = "RUNNING"
                 running.append(trial)
@@ -327,7 +338,7 @@ class Tuner:
                                 trial.failures,
                             )
                             self._kill_runner(trial)
-                            trial.state = "PENDING"
+                            self._reset_for_retry(trial)
                             pending.append(trial)
                         else:
                             trial.state = "ERROR"
@@ -357,11 +368,20 @@ class Tuner:
         trial.failures += 1
         self._kill_runner(trial)
         if trial.failures <= self._tune_config.max_failures:
-            trial.state = "PENDING"
+            self._reset_for_retry(trial)
             pending.append(trial)
         else:
             trial.state = "ERROR"
             trial.error = err
+
+    @staticmethod
+    def _reset_for_retry(trial: _Trial):
+        """Fresh trial id per attempt: scheduler rung/average state from the
+        aborted attempt must not leak into the retry."""
+        trial.state = "PENDING"
+        trial.iterations = 0
+        base = trial.trial_id.split("@attempt")[0]
+        trial.trial_id = f"{base}@attempt{trial.failures}"
 
     @staticmethod
     def _hits_stop_criteria(report: dict, criteria: dict) -> bool:

@@ -1,0 +1,131 @@
+"""Tests for ray_tpu.rllib (reference model: rllib/algorithms/ppo tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, resources={"TPU": 4})
+    yield
+    ray_tpu.shutdown()
+
+
+def test_gae_matches_reference_recursion():
+    from ray_tpu.rllib.models import compute_gae
+
+    T, N = 5, 2
+    rng = np.random.default_rng(0)
+    rewards = rng.normal(size=(T, N)).astype(np.float32)
+    values = rng.normal(size=(T, N)).astype(np.float32)
+    dones = np.zeros((T, N), bool)
+    dones[2, 0] = True
+    last_values = rng.normal(size=N).astype(np.float32)
+    adv, ret = compute_gae(rewards, values, dones, last_values, 0.9, 0.8)
+    # brute-force single env check
+    for n in range(N):
+        v_next = last_values[n]
+        last = 0.0
+        expect = np.zeros(T)
+        for t in range(T - 1, -1, -1):
+            nonterm = 0.0 if dones[t, n] else 1.0
+            delta = rewards[t, n] + 0.9 * v_next * nonterm - values[t, n]
+            last = delta + 0.9 * 0.8 * nonterm * last
+            expect[t] = last
+            v_next = values[t, n]
+        np.testing.assert_allclose(adv[:, n], expect, rtol=1e-5)
+    np.testing.assert_allclose(ret, adv + values, rtol=1e-6)
+
+
+def test_learner_update_reduces_loss():
+    from ray_tpu.rllib.learner import PPOLearner
+
+    rng = np.random.default_rng(1)
+    B, D, A = 256, 6, 3
+    learner = PPOLearner(D, A, True, lr=1e-2, num_epochs=2, minibatch_size=64)
+    obs = rng.normal(size=(B, D)).astype(np.float32)
+    batch = {
+        "obs": obs,
+        "actions": rng.integers(0, A, size=B),
+        "logp_old": np.full(B, -np.log(A), np.float32),
+        "advantages": rng.normal(size=B).astype(np.float32),
+        "returns": rng.normal(size=B).astype(np.float32),
+    }
+    first = learner.update(batch)
+    for _ in range(5):
+        last = learner.update(batch)
+    assert last["vf_loss"] < first["vf_loss"]
+
+
+def test_vector_env_autoreset():
+    from ray_tpu.rllib.env import VectorEnv, make_env
+
+    vec = VectorEnv([make_env("CartPole-v1") for _ in range(3)])
+    obs = vec.reset(seed=0)
+    assert obs.shape == (3, 4)
+    for _ in range(50):
+        obs, rew, term, trunc = vec.step(np.zeros(3, np.int64))
+        assert obs.shape == (3, 4)  # autoreset keeps shapes stable
+    vec.close()
+
+
+def test_ppo_cartpole_improves(cluster):
+    from ray_tpu import rllib
+
+    config = (
+        rllib.PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                     rollout_fragment_length=64)
+        .training(lr=3e-3, num_epochs=4, minibatch_size=128,
+                  entropy_coeff=0.01)
+        .debugging(seed=3)
+    )
+    algo = config.build()
+    first_returns = None
+    best = -np.inf
+    for i in range(25):
+        result = algo.train()
+        if not np.isnan(result["episode_return_mean"]):
+            if first_returns is None:
+                first_returns = result["episode_return_mean"]
+            best = max(best, result["episode_return_mean"])
+    algo.stop()
+    assert first_returns is not None
+    # CartPole random policy ~20; PPO should clearly improve within budget
+    assert best > first_returns + 30, (first_returns, best)
+    assert best > 60, best
+
+
+def test_ppo_checkpoint_roundtrip(cluster, tmp_path):
+    from ray_tpu import rllib
+
+    config = (
+        rllib.PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=2,
+                     rollout_fragment_length=16)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    algo.train()
+    ckpt = algo.save(str(tmp_path / "ckpt"))
+    it = algo.iteration
+    params_before = algo.get_policy_params()
+    algo.stop()
+
+    algo2 = config.build()
+    algo2.restore(ckpt)
+    assert algo2.iteration == it
+    params_after = algo2.get_policy_params()
+    import jax
+
+    for a, b in zip(
+        jax.tree.leaves(params_before), jax.tree.leaves(params_after)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    act = algo2.compute_single_action(np.zeros(4, np.float32))
+    assert act in (0, 1)
+    algo2.stop()
