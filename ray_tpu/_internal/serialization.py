@@ -97,6 +97,49 @@ def unpack(data: memoryview | bytes) -> Any:
     return deserialize(meta, bufs)
 
 
+def unpack_with_release(data: memoryview | bytes, release_cb) -> Any:
+    """Zero-copy deserialize from a store mapping, calling ``release_cb``
+    once no deserialized value aliases the mapping anymore.
+
+    Out-of-band buffers are wrapped in uint8 numpy arrays with GC
+    finalizers; arrays reconstructed from them keep the wrapper in their
+    ``.base`` chain, so the store pin is released exactly when the last
+    aliasing array dies — the invariant plasma enforces with client-side
+    buffer refcounts (reference: plasma client.h Get/Release)."""
+    import weakref
+
+    import numpy as np
+
+    mv = memoryview(data)
+    meta_len, nbuf = struct.unpack_from("<IQ", mv, 0)
+    off = 12
+    meta = bytes(mv[off : off + meta_len])
+    off += meta_len
+    sizes = []
+    for _ in range(nbuf):
+        (n,) = struct.unpack_from("<Q", mv, off)
+        sizes.append(n)
+        off += 8
+    if not sizes:
+        value = deserialize(meta, [])
+        release_cb()
+        return value
+    remaining = [len(sizes)]
+
+    def _one_dead():
+        remaining[0] -= 1
+        if remaining[0] == 0:
+            release_cb()
+
+    bufs = []
+    for n in sizes:
+        arr = np.frombuffer(mv[off : off + n], dtype=np.uint8)
+        weakref.finalize(arr, _one_dead)
+        bufs.append(arr)
+        off += n
+    return deserialize(meta, bufs)
+
+
 def dumps(value: Any) -> bytes:
     """Plain cloudpickle for control-plane payloads (function defs, specs)."""
     return cloudpickle.dumps(value)

@@ -1,0 +1,45 @@
+"""Build the native store library (g++ -> libray_tpu_store.so).
+
+Invoked lazily on import of ray_tpu._native.lib (and manually:
+``python ray_tpu/_native/build.py``). Rebuilds when the source is newer
+than the library. No external deps — plain g++ + pthread.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "store.cc")
+LIB = os.path.join(_DIR, "libray_tpu_store.so")
+
+
+def build(force: bool = False) -> str:
+    """Compile if missing/stale; returns the library path."""
+    if (
+        not force
+        and os.path.exists(LIB)
+        and os.path.getmtime(LIB) >= os.path.getmtime(SRC)
+    ):
+        return LIB
+    cmd = [
+        "g++",
+        "-std=c++17",
+        "-O2",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+        "-o",
+        LIB + ".tmp",
+        SRC,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(LIB + ".tmp", LIB)  # atomic: concurrent builders race safely
+    return LIB
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv)
+    print(path)

@@ -1,0 +1,293 @@
+// Native shared-memory object store core.
+//
+// Role-equivalent of the reference's Plasma store internals
+// (src/ray/object_manager/plasma/store.h, object_store.h, eviction_policy.h,
+// dlmalloc-over-mmap arenas): ONE file-backed mmap arena per node, a
+// first-fit free-list allocator with coalescing, an object table with
+// pin counts and primary-copy protection, and LRU eviction of sealed,
+// unpinned objects when an allocation needs space.
+//
+// Exposed as a C API consumed via ctypes from the raylet process (the only
+// writer of the table); workers mmap the same arena file and read/write at
+// offsets handed to them over the raylet RPC — the zero-copy path the
+// reference gets from fd-passing (plasma fling.cc).
+//
+// Build: g++ -O2 -shared -fPIC -o libray_tpu_store.so store.cc
+
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Entry {
+  uint64_t offset = 0;
+  uint64_t size = 0;
+  bool sealed = false;
+  bool primary = false;
+  int32_t pins = 0;
+  uint64_t last_access = 0;  // monotonically increasing logical clock
+};
+
+struct Arena {
+  int fd = -1;
+  uint8_t* base = nullptr;
+  uint64_t capacity = 0;
+  uint64_t used = 0;
+  uint64_t clock = 0;
+  std::string path;
+  // free list keyed by offset -> length; invariant: no two adjacent blocks
+  std::map<uint64_t, uint64_t> free_blocks;
+  std::unordered_map<std::string, Entry> objects;
+  std::mutex mu;
+};
+
+std::mutex g_mu;
+std::vector<Arena*> g_arenas;
+
+constexpr uint64_t kAlign = 64;  // cache-line align objects
+
+uint64_t align_up(uint64_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+Arena* arena(int h) {
+  std::lock_guard<std::mutex> l(g_mu);
+  if (h < 0 || h >= static_cast<int>(g_arenas.size())) return nullptr;
+  return g_arenas[h];
+}
+
+// first-fit allocation from the free list
+int64_t alloc_block(Arena* a, uint64_t need) {
+  for (auto it = a->free_blocks.begin(); it != a->free_blocks.end(); ++it) {
+    if (it->second >= need) {
+      uint64_t off = it->first;
+      uint64_t len = it->second;
+      a->free_blocks.erase(it);
+      if (len > need) a->free_blocks.emplace(off + need, len - need);
+      a->used += need;
+      return static_cast<int64_t>(off);
+    }
+  }
+  return -1;
+}
+
+// return a block, coalescing with neighbors
+void free_block(Arena* a, uint64_t off, uint64_t len) {
+  a->used -= len;
+  auto next = a->free_blocks.lower_bound(off);
+  // merge with previous block if adjacent
+  if (next != a->free_blocks.begin()) {
+    auto prev = std::prev(next);
+    if (prev->first + prev->second == off) {
+      off = prev->first;
+      len += prev->second;
+      a->free_blocks.erase(prev);
+    }
+  }
+  // merge with next block if adjacent
+  if (next != a->free_blocks.end() && off + len == next->first) {
+    len += next->second;
+    a->free_blocks.erase(next);
+  }
+  a->free_blocks.emplace(off, len);
+}
+
+// evict sealed, unpinned, non-primary objects in LRU order until a block of
+// `need` bytes can be carved (reference: EvictionPolicy::ChooseObjectsToEvict)
+bool evict_until(Arena* a, uint64_t need) {
+  while (true) {
+    // retry after every eviction: coalescing may have opened a large block
+    for (auto& kv : a->free_blocks)
+      if (kv.second >= need) return true;
+    const std::string* victim = nullptr;
+    uint64_t oldest = UINT64_MAX;
+    for (auto& kv : a->objects) {
+      const Entry& e = kv.second;
+      if (e.sealed && e.pins == 0 && !e.primary && e.last_access < oldest) {
+        oldest = e.last_access;
+        victim = &kv.first;
+      }
+    }
+    if (victim == nullptr) return false;
+    auto it = a->objects.find(*victim);
+    free_block(a, it->second.offset, it->second.size);
+    a->objects.erase(it);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (or overwrite) the arena file and mmap it shared. Returns a handle
+// >= 0, or -1 on failure.
+int rt_store_open(const char* path, uint64_t capacity) {
+  int fd = ::open(path, O_RDWR | O_CREAT, 0644);
+  if (fd < 0) return -1;
+  if (::ftruncate(fd, static_cast<off_t>(capacity)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  void* base =
+      ::mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    ::close(fd);
+    return -1;
+  }
+  Arena* a = new Arena();
+  a->fd = fd;
+  a->base = static_cast<uint8_t*>(base);
+  a->capacity = capacity;
+  a->path = path;
+  a->free_blocks.emplace(0, capacity);
+  std::lock_guard<std::mutex> l(g_mu);
+  g_arenas.push_back(a);
+  return static_cast<int>(g_arenas.size()) - 1;
+}
+
+void rt_store_close(int h) {
+  Arena* a = arena(h);
+  if (!a) return;
+  ::munmap(a->base, a->capacity);
+  ::close(a->fd);
+  ::unlink(a->path.c_str());
+  {
+    std::lock_guard<std::mutex> l(g_mu);
+    g_arenas[h] = nullptr;
+  }
+  delete a;
+}
+
+// Allocate space for an object. Returns the offset, or:
+//   -1 out of memory (even after eviction), -2 already exists
+int64_t rt_create(int h, const char* oid, uint64_t size) {
+  Arena* a = arena(h);
+  if (!a) return -1;
+  std::lock_guard<std::mutex> l(a->mu);
+  std::string key(oid);
+  if (a->objects.count(key)) return -2;
+  uint64_t need = align_up(size == 0 ? 1 : size);
+  if (need > a->capacity) return -1;
+  int64_t off = alloc_block(a, need);
+  if (off < 0) {
+    if (!evict_until(a, need)) return -1;
+    off = alloc_block(a, need);
+    if (off < 0) return -1;
+  }
+  Entry e;
+  e.offset = static_cast<uint64_t>(off);
+  e.size = need;
+  e.last_access = ++a->clock;
+  a->objects.emplace(std::move(key), e);
+  return off;
+}
+
+int rt_seal(int h, const char* oid) {
+  Arena* a = arena(h);
+  if (!a) return -1;
+  std::lock_guard<std::mutex> l(a->mu);
+  auto it = a->objects.find(oid);
+  if (it == a->objects.end()) return -1;
+  it->second.sealed = true;
+  it->second.last_access = ++a->clock;
+  return 0;
+}
+
+// Pin + locate. 0 ok, -1 missing, -2 not sealed yet.
+int rt_get(int h, const char* oid, uint64_t* offset, uint64_t* size) {
+  Arena* a = arena(h);
+  if (!a) return -1;
+  std::lock_guard<std::mutex> l(a->mu);
+  auto it = a->objects.find(oid);
+  if (it == a->objects.end()) return -1;
+  if (!it->second.sealed) return -2;
+  it->second.pins++;
+  it->second.last_access = ++a->clock;
+  *offset = it->second.offset;
+  *size = it->second.size;
+  return 0;
+}
+
+void rt_release(int h, const char* oid) {
+  Arena* a = arena(h);
+  if (!a) return;
+  std::lock_guard<std::mutex> l(a->mu);
+  auto it = a->objects.find(oid);
+  if (it != a->objects.end() && it->second.pins > 0) it->second.pins--;
+}
+
+void rt_pin_primary(int h, const char* oid) {
+  Arena* a = arena(h);
+  if (!a) return;
+  std::lock_guard<std::mutex> l(a->mu);
+  auto it = a->objects.find(oid);
+  if (it != a->objects.end()) it->second.primary = true;
+}
+
+int rt_contains(int h, const char* oid) {
+  Arena* a = arena(h);
+  if (!a) return 0;
+  std::lock_guard<std::mutex> l(a->mu);
+  auto it = a->objects.find(oid);
+  return (it != a->objects.end() && it->second.sealed) ? 1 : 0;
+}
+
+int rt_free(int h, const char* oid) {
+  Arena* a = arena(h);
+  if (!a) return -1;
+  std::lock_guard<std::mutex> l(a->mu);
+  auto it = a->objects.find(oid);
+  if (it == a->objects.end()) return -1;
+  free_block(a, it->second.offset, it->second.size);
+  a->objects.erase(it);
+  return 0;
+}
+
+uint64_t rt_used(int h) {
+  Arena* a = arena(h);
+  if (!a) return 0;
+  std::lock_guard<std::mutex> l(a->mu);
+  return a->used;
+}
+
+uint64_t rt_num_objects(int h) {
+  Arena* a = arena(h);
+  if (!a) return 0;
+  std::lock_guard<std::mutex> l(a->mu);
+  return a->objects.size();
+}
+
+// LRU spill victim: primary copies are exempt from eviction, so when the
+// arena fills with live primaries the raylet spills them to disk instead
+// (reference: LocalObjectManager::SpillObjects, local_object_manager.h:115).
+// Writes the victim's id into out (NUL-terminated). Returns 1 if found.
+int rt_lru_spillable(int h, char* out, int out_len) {
+  Arena* a = arena(h);
+  if (!a) return 0;
+  std::lock_guard<std::mutex> l(a->mu);
+  const std::string* victim = nullptr;
+  uint64_t oldest = UINT64_MAX;
+  for (auto& kv : a->objects) {
+    const Entry& e = kv.second;
+    if (e.sealed && e.pins == 0 && e.primary && e.last_access < oldest) {
+      oldest = e.last_access;
+      victim = &kv.first;
+    }
+  }
+  if (victim == nullptr ||
+      static_cast<int>(victim->size()) + 1 > out_len)
+    return 0;
+  std::memcpy(out, victim->c_str(), victim->size() + 1);
+  return 1;
+}
+
+}  // extern "C"

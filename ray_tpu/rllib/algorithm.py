@@ -104,6 +104,8 @@ class PPO:
         self.iteration = 0
         # probe spaces locally (cheap env instance)
         probe = make_env(config.env_spec, config.env_config)()
+        self.observation_space = probe.observation_space
+        self.action_space = probe.action_space
         obs_dim, act_dim, discrete = space_dims(
             probe.observation_space, probe.action_space
         )
@@ -228,13 +230,15 @@ class PPO:
         import jax
         import jax.numpy as jnp
 
+        from .env import encode_obs
         from .models import sample_actions
 
         key = jax.random.PRNGKey(self.iteration)
+        encoded = encode_obs(self.observation_space, np.asarray(obs)[None])
         actions, _, _ = sample_actions(
             self.learner.model,
             self.learner.params,
-            jnp.asarray(obs, jnp.float32)[None],
+            jnp.asarray(encoded),
             key,
         )
         return np.asarray(actions)[0]

@@ -85,6 +85,20 @@ def _wants_config(fn) -> bool:
     return len(sig.parameters) >= 1
 
 
+def encode_obs(observation_space, obs: np.ndarray) -> np.ndarray:
+    """Batch of raw observations -> float32 feature matrix [N, obs_dim]
+    (Discrete obs are one-hot encoded to match space_dims' obs_dim=n)."""
+    import gymnasium as gym
+
+    if isinstance(observation_space, gym.spaces.Discrete):
+        n = int(observation_space.n)
+        idx = np.asarray(obs).astype(np.int64).reshape(-1)
+        out = np.zeros((len(idx), n), np.float32)
+        out[np.arange(len(idx)), idx] = 1.0
+        return out
+    return np.asarray(obs, np.float32).reshape(len(obs), -1)
+
+
 def space_dims(observation_space, action_space) -> Tuple[int, int, bool]:
     """(obs_dim, action_dim, discrete) from gymnasium spaces."""
     import gymnasium as gym

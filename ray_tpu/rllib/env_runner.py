@@ -27,7 +27,7 @@ class EnvRunner:
     ):
         import jax
 
-        from .env import VectorEnv, make_env, space_dims
+        from .env import VectorEnv, encode_obs, make_env, space_dims
         from .models import init_actor_critic, sample_actions
 
         factory = make_env(env_spec, env_config)
@@ -38,7 +38,8 @@ class EnvRunner:
         )
         self._model, _ = init_actor_critic(obs_dim, act_dim, discrete, seed)
         self._key = jax.random.PRNGKey(seed)
-        self._obs = self._vec.reset(seed=seed)
+        self._encode = lambda o: encode_obs(self._vec.observation_space, o)
+        self._obs = self._encode(self._vec.reset(seed=seed))
         self._discrete = discrete
         # episode-return bookkeeping
         self._ep_returns = np.zeros(num_envs, np.float32)
@@ -75,6 +76,7 @@ class EnvRunner:
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(values)
             next_obs, rewards, terms, truncs = self._vec.step(actions)
+            next_obs = self._encode(next_obs)
             dones = terms | truncs
             rew_buf[t] = rewards
             done_buf[t] = dones

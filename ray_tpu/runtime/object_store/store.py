@@ -85,8 +85,6 @@ class ObjectStore:
         self._entries: Dict[ObjectID, _Entry] = {}
         self._used = 0
         self._seq = 0
-        # spill callback: async fn(entries) -> None, set by LocalObjectManager
-        self.spill_handler = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -182,6 +180,11 @@ class ObjectStore:
         entry.last_access = time.time()
         return entry.shm.buf[: entry.size]
 
+    def write_view(self, object_id: ObjectID) -> memoryview:
+        """Writable view of an unsealed object for in-raylet transfers."""
+        entry = self._entries[object_id]
+        return entry.shm.buf[: entry.size]
+
     # -- eviction ----------------------------------------------------------
 
     def _evict_until(self, need: int):
@@ -206,6 +209,18 @@ class ObjectStore:
                 "all remaining objects pinned"
             )
 
+    def lru_spillable(self) -> Optional[ObjectID]:
+        """Least-recently-used primary copy eligible for spilling
+        (sealed, unpinned; primaries are exempt from plain eviction)."""
+        victims = [
+            e
+            for e in self._entries.values()
+            if e.sealed and e.pin_count == 0 and e.primary
+        ]
+        if not victims:
+            return None
+        return min(victims, key=lambda e: e.last_access).object_id
+
     def stats(self) -> dict:
         return {
             "capacity": self.capacity,
@@ -220,40 +235,74 @@ class ObjectStore:
 
 class StoreClient:
     """Client side, used by workers/driver to read and write segments
-    (reference: plasma/client.h — mmap'd client). Attach/close only; the
-    lifecycle RPCs go through the raylet client."""
+    (reference: plasma/client.h — mmap'd client). Two segment-ref forms:
+    a bare shm name (python per-segment store) and ``arena:<path>:<offset>``
+    (native C++ arena store — the whole arena file is mmapped once and
+    sliced, the client analogue of plasma's single shared mapping)."""
 
     def __init__(self):
         self._attached: Dict[str, shared_memory.SharedMemory] = {}
+        self._arenas: Dict[str, "mmap.mmap"] = {}
 
-    def write(self, segment_name: str, meta: bytes, bufs, packed_size: int):
+    def _arena_view(self, path: str, offset: int, length: int):
+        import mmap as mmap_mod
+
+        mm = self._arenas.get(path)
+        if mm is None:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                mm = mmap_mod.mmap(fd, 0)
+            finally:
+                os.close(fd)
+            self._arenas[path] = mm
+        return memoryview(mm)[offset : offset + length]
+
+    def _view(self, segment_ref: str, size: int):
+        if segment_ref.startswith("arena:"):
+            _, path, offset = segment_ref.rsplit(":", 2)
+            return self._arena_view(path, int(offset), size)
+        shm = self._attached.get(segment_ref)
+        if shm is None:
+            shm = _Segment(name=segment_ref)
+            self._attached[segment_ref] = shm
+        return shm.buf[:size]
+
+    def write(self, segment_ref: str, meta: bytes, bufs, packed_size: int):
         from ..._internal import serialization
 
-        shm = _Segment(name=segment_name)
+        if segment_ref.startswith("arena:"):
+            view = self._view(segment_ref, packed_size)
+            serialization.pack_into(meta, bufs, view)
+            return
+        shm = _Segment(name=segment_ref)
         try:
             serialization.pack_into(meta, bufs, shm.buf[:packed_size])
         finally:
             shm.close()
 
-    def read(self, segment_name: str, size: int):
-        """Returns a memoryview aliasing shared memory. The segment stays
-        attached until released; numpy arrays deserialized from it alias the
-        store (zero-copy get)."""
-        shm = self._attached.get(segment_name)
-        if shm is None:
-            shm = _Segment(name=segment_name)
-            self._attached[segment_name] = shm
-        return shm.buf[:size]
+    def read(self, segment_ref: str, size: int):
+        """Returns a memoryview aliasing shared memory. The mapping stays
+        attached; numpy arrays deserialized from it alias the store
+        (zero-copy get)."""
+        return self._view(segment_ref, size)
 
-    def detach(self, segment_name: str):
-        shm = self._attached.pop(segment_name, None)
+    def detach(self, segment_ref: str):
+        if segment_ref.startswith("arena:"):
+            return  # arena mapping is shared across objects; keep it
+        shm = self._attached.pop(segment_ref, None)
         if shm is not None:
             try:
                 shm.close()
             except BufferError:
                 # a deserialized array still aliases the buffer; leave attached
-                self._attached[segment_name] = shm
+                self._attached[segment_ref] = shm
 
     def close(self):
         for name in list(self._attached):
             self.detach(name)
+        for path, mm in list(self._arenas.items()):
+            try:
+                mm.close()
+            except (BufferError, ValueError):
+                pass  # zero-copy arrays may still alias the mapping
+        self._arenas.clear()

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import os
 import logging
 import time
 from collections import defaultdict, deque
@@ -39,7 +40,7 @@ from ..._internal.protocol import (
 from ..._internal.rpc import ClientPool, RpcServer
 from ...exceptions import ObjectStoreFullError
 from ..gcs.pubsub import SubscriberClient
-from ..object_store.store import ObjectStore
+from ..object_store.native_store import create_object_store
 from .resources import Allocation, LocalResourceManager
 from .worker_pool import WorkerHandle, WorkerPool
 
@@ -75,7 +76,7 @@ class Raylet:
         self.server = RpcServer(f"raylet-{self.node_id.hex()[:6]}")
         self.client_pool = ClientPool("raylet-out")
         self.resources = LocalResourceManager(resources, labels)
-        self.store = ObjectStore(
+        self.store = create_object_store(
             object_store_memory or config.object_store_memory,
             f"{session_id}_{self.node_id.hex()[:6]}",
         )
@@ -83,6 +84,8 @@ class Raylet:
         self.address: Optional[Tuple[str, int]] = None
 
         self._leases: Dict[UniqueID, Lease] = {}
+        # spilled primary copies: object id -> file path (reference: N14)
+        self._spilled: Dict[ObjectID, str] = {}
         self._lease_seq = itertools.count()
         # scheduling-class FIFO queues of pending lease requests
         # (reference: scheduling classes, scheduling_class_util.h)
@@ -396,9 +399,66 @@ class Raylet:
 
     async def handle_store_create(self, object_id: ObjectID, size: int):
         try:
-            return {"ok": True, "segment": self.store.create(object_id, size)}
+            return {
+                "ok": True,
+                "segment": await self._create_with_spill(object_id, size),
+            }
         except ObjectStoreFullError as e:
             return {"ok": False, "error": str(e)}
+
+    # -- spilling (reference: LocalObjectManager::SpillObjects
+    # raylet/local_object_manager.h:115 + external storage
+    # _private/external_storage.py FileSystemStorage) -----------------------
+
+    def _spill_dir(self) -> str:
+        path = f"/tmp/ray_tpu_spill_{self.session_id}_{self.node_id.hex()[:6]}"
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    async def _create_with_spill(self, object_id: ObjectID, size: int) -> str:
+        """store.create, spilling LRU primary copies to disk under memory
+        pressure instead of failing."""
+        while True:
+            try:
+                return self.store.create(object_id, size)
+            except ObjectStoreFullError:
+                victim = self.store.lru_spillable()
+                if victim is None or victim == object_id:
+                    raise
+                await self._spill_object(victim)
+
+    async def _spill_object(self, object_id: ObjectID):
+        view = self.store.read_local(object_id)
+        if view is None:
+            raise ObjectStoreFullError("spill victim vanished")
+        path = os.path.join(self._spill_dir(), object_id.hex())
+        # copy out, then write off-loop: disk I/O on the event loop would
+        # stall heartbeats and lease dispatch (reference: spill workers are
+        # separate IO processes, worker_pool.h io worker pool)
+        data = bytes(view)
+        del view
+        await asyncio.to_thread(_write_file, path, data)
+        self.store.free(object_id)
+        self._spilled[object_id] = path
+        logger.info("spilled %s (%d bytes) to %s", object_id, len(data), path)
+
+    async def _restore_spilled(self, object_id: ObjectID) -> bool:
+        """Bring a spilled object back into the arena (reference:
+        AsyncRestoreSpilledObject, local_object_manager.h:127)."""
+        path = self._spilled.get(object_id)
+        if path is None:
+            return False
+        data = await asyncio.to_thread(_read_file, path)
+        await self._create_with_spill(object_id, len(data))
+        self.store.write_view(object_id)[: len(data)] = data
+        self.store.seal(object_id)
+        self.store.pin_primary(object_id)  # restored copy stays primary
+        self._spilled.pop(object_id, None)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return True
 
     async def handle_store_seal(self, object_id: ObjectID, is_primary: bool = False):
         self.store.seal(object_id)
@@ -421,6 +481,15 @@ class Raylet:
             result = await self.store.get(object_id, timeout=0.1)
             if result is not None:
                 return {"ok": True, "segment": result[0], "size": result[1]}
+        if object_id in self._spilled:
+            try:
+                restored = await self._restore_spilled(object_id)
+            except ObjectStoreFullError:
+                return {"ok": False, "error": "store full during restore"}
+            if restored:
+                result = await self.store.get(object_id, timeout=1.0)
+                if result is not None:
+                    return {"ok": True, "segment": result[0], "size": result[1]}
         if owner_address is not None:
             pulled = await self._pull_object(object_id, owner_address)
             if pulled:
@@ -439,6 +508,12 @@ class Raylet:
     async def handle_free_objects(self, object_ids: List[ObjectID]):
         for oid in object_ids:
             self.store.free(oid)
+            path = self._spilled.pop(oid, None)
+            if path is not None:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
         return True
 
     async def handle_fetch_object(self, object_id: ObjectID, offset: int, length: int):
@@ -472,8 +547,8 @@ class Raylet:
                 if first is None:
                     continue
                 total = first["total"]
-                segment = self.store.create(object_id, total)
-                view = self.store._entries[object_id].shm.buf
+                segment = await self._create_with_spill(object_id, total)
+                view = self.store.write_view(object_id)
                 view[: len(first["data"])] = first["data"]
                 offset = len(first["data"])
                 while offset < total:
@@ -520,3 +595,13 @@ class Raylet:
         gcs = self.client_pool.get(*self.gcs_address)
         await gcs.call("unregister_node", self.node_id)
         return True
+
+
+def _write_file(path: str, data: bytes):
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def _read_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()

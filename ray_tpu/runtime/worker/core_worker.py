@@ -351,11 +351,29 @@ class CoreWorker:
         if not reply["ok"]:
             raise ObjectLostError(ref.id, "object not found in any store")
         view = self.store_client.read(reply["segment"], reply["size"])
-        value = serialization.unpack(view)
-        # release the pin: the mapping stays valid in this process even if the
-        # store later evicts the segment (POSIX shm unlink semantics)
-        await raylet.call_oneway("store_release", ref.id)
-        return value
+        # the pin must outlive every zero-copy array aliasing the mapping:
+        # the arena store reuses blocks in place after eviction/spill, so an
+        # early release would let a live numpy view silently change contents
+        object_id = ref.id
+        loop = self.loop
+        client_pool = self.client_pool
+        raylet_address = self.raylet_address
+
+        def _release_pin():
+            try:
+                if loop.is_closed():
+                    return
+                loop.call_soon_threadsafe(
+                    lambda: asyncio.ensure_future(
+                        client_pool.get(*raylet_address).call_oneway(
+                            "store_release", object_id
+                        )
+                    )
+                )
+            except RuntimeError:
+                pass  # interpreter/loop teardown
+
+        return serialization.unpack_with_release(view, _release_pin)
 
     async def _get_from_owner(self, ref: ObjectRef, timeout: Optional[float]):
         owner = self.client_pool.get(*ref.owner_address)
