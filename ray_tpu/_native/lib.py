@@ -52,6 +52,8 @@ def load() -> Optional[ctypes.CDLL]:
     lib.rt_contains.restype = ctypes.c_int
     lib.rt_free.argtypes = [ctypes.c_int, ctypes.c_char_p]
     lib.rt_free.restype = ctypes.c_int
+    lib.rt_free_if_unpinned.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.rt_free_if_unpinned.restype = ctypes.c_int
     lib.rt_used.argtypes = [ctypes.c_int]
     lib.rt_used.restype = ctypes.c_uint64
     lib.rt_num_objects.argtypes = [ctypes.c_int]

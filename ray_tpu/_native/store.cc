@@ -252,6 +252,20 @@ int rt_free(int h, const char* oid) {
   return 0;
 }
 
+// Free only when no reader holds a pin: the spill path must not reallocate
+// a block a concurrent get just handed out. 0 freed, -1 missing, -2 pinned.
+int rt_free_if_unpinned(int h, const char* oid) {
+  Arena* a = arena(h);
+  if (!a) return -1;
+  std::lock_guard<std::mutex> l(a->mu);
+  auto it = a->objects.find(oid);
+  if (it == a->objects.end()) return -1;
+  if (it->second.pins > 0) return -2;
+  free_block(a, it->second.offset, it->second.size);
+  a->objects.erase(it);
+  return 0;
+}
+
 uint64_t rt_used(int h) {
   Arena* a = arena(h);
   if (!a) return 0;

@@ -51,6 +51,11 @@ class GcsServer:
         self._kv: Dict[str, bytes] = {}
         self._jobs: Dict[JobID, dict] = {}
         self._next_job = 1
+        # task-event store (reference: GcsTaskManager, gcs_task_manager.h:97):
+        # latest state per task, bounded
+        self._task_events: Dict[str, dict] = {}
+        self._task_events_order: List[str] = []
+        self._task_events_cap = 10000
         self._runner: Optional[PeriodicRunner] = None
         self.address: Optional[Tuple[str, int]] = None
 
@@ -218,6 +223,51 @@ class GcsServer:
         return True
 
     # -- jobs --------------------------------------------------------------
+
+    # -- task events (reference: TaskEventBuffer -> GcsTaskManager ->
+    # state API `ray list tasks`) -----------------------------------------
+
+    _TASK_STATE_RANK = {
+        "PENDING": 0,
+        "RUNNING": 1,
+        "FINISHED": 2,
+        "FAILED": 2,
+    }
+
+    async def handle_report_task_events(self, events: List[dict]):
+        for ev in events:
+            tid = ev["task_id"]
+            cur = self._task_events.get(tid)
+            if cur is None:
+                self._task_events[tid] = dict(ev)
+                self._task_events_order.append(tid)
+                if len(self._task_events_order) > self._task_events_cap:
+                    drop = self._task_events_order.pop(0)
+                    self._task_events.pop(drop, None)
+            else:
+                # events arrive from different processes on independent
+                # flush cadences: never let a late RUNNING (executor) regress
+                # a FINISHED/FAILED (owner) state
+                new_state = ev.get("state")
+                if new_state is not None and self._TASK_STATE_RANK.get(
+                    new_state, 0
+                ) < self._TASK_STATE_RANK.get(cur.get("state"), 0):
+                    ev = {k: v for k, v in ev.items() if k != "state"}
+                cur.update(ev)
+        return True
+
+    async def handle_list_task_events(
+        self, filters: Optional[dict] = None, limit: int = 1000
+    ):
+        out = []
+        for tid in reversed(self._task_events_order):
+            ev = self._task_events[tid]
+            if filters and any(ev.get(k) != v for k, v in filters.items()):
+                continue
+            out.append(dict(ev))
+            if len(out) >= limit:
+                break
+        return out
 
     async def handle_register_job(self, metadata: dict) -> JobID:
         job_id = JobID.from_int(self._next_job)

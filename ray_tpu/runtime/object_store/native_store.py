@@ -144,6 +144,13 @@ class NativeObjectStore:
         self._lib.rt_free(self._h, self._key(object_id))
         self._gc_mirrors(object_id)
 
+    def free_if_unpinned(self, object_id: ObjectID) -> bool:
+        rc = self._lib.rt_free_if_unpinned(self._h, self._key(object_id))
+        if rc == -2:
+            return False
+        self._gc_mirrors(object_id)
+        return True
+
     def read_local(self, object_id: ObjectID) -> Optional[memoryview]:
         if not self.contains(object_id):
             return None

@@ -172,6 +172,13 @@ class ObjectStore:
                 # above already reclaimed the name, mapping dies with readers
                 pass
 
+    def free_if_unpinned(self, object_id: ObjectID) -> bool:
+        entry = self._entries.get(object_id)
+        if entry is not None and entry.pin_count > 0:
+            return False
+        self.free(object_id)
+        return True
+
     def read_local(self, object_id: ObjectID) -> Optional[memoryview]:
         """Zero-copy view for in-process readers (the raylet's own transfers)."""
         entry = self._entries.get(object_id)

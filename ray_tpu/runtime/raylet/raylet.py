@@ -418,19 +418,27 @@ class Raylet:
     async def _create_with_spill(self, object_id: ObjectID, size: int) -> str:
         """store.create, spilling LRU primary copies to disk under memory
         pressure instead of failing."""
+        if size > self.store.capacity:
+            # reject up front — spilling the whole store could never help
+            raise ObjectStoreFullError(
+                f"object of {size} bytes exceeds store capacity "
+                f"{self.store.capacity}"
+            )
+        tried: set = set()
         while True:
             try:
                 return self.store.create(object_id, size)
             except ObjectStoreFullError:
                 victim = self.store.lru_spillable()
-                if victim is None or victim == object_id:
+                if victim is None or victim == object_id or victim in tried:
                     raise
+                tried.add(victim)
                 await self._spill_object(victim)
 
     async def _spill_object(self, object_id: ObjectID):
         view = self.store.read_local(object_id)
         if view is None:
-            raise ObjectStoreFullError("spill victim vanished")
+            return  # vanished (freed/evicted) — space may already be back
         path = os.path.join(self._spill_dir(), object_id.hex())
         # copy out, then write off-loop: disk I/O on the event loop would
         # stall heartbeats and lease dispatch (reference: spill workers are
@@ -438,7 +446,14 @@ class Raylet:
         data = bytes(view)
         del view
         await asyncio.to_thread(_write_file, path, data)
-        self.store.free(object_id)
+        # a reader may have pinned the object during the await; freeing then
+        # would reallocate a block a live zero-copy view still aliases
+        if not self.store.free_if_unpinned(object_id):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return
         self._spilled[object_id] = path
         logger.info("spilled %s (%d bytes) to %s", object_id, len(data), path)
 

@@ -129,6 +129,8 @@ class CoreWorker:
         self._task_index = 0
         self._pending_tasks: Dict[TaskID, TaskSpec] = {}
         self._task_done_events: Dict[TaskID, asyncio.Event] = {}
+        self._task_event_buffer: List[dict] = []
+        self._event_flush_task: Optional[asyncio.Task] = None
 
         # actor submission state
         self._actors: Dict[ActorID, _ActorClientState] = {}
@@ -158,7 +160,26 @@ class CoreWorker:
             self.client_pool.get(*self.gcs_address),
             f"worker-{self.worker_id.hex()}",
         )
+        self._event_flush_task = asyncio.ensure_future(self._flush_task_events())
         return self.address
+
+    # -- task events (reference: TaskEventBuffer, task_event_buffer.h:297) --
+
+    def record_task_event(self, task_id, **fields):
+        ev = {"task_id": task_id.hex(), "ts": time.time(), **fields}
+        self._task_event_buffer.append(ev)
+
+    async def _flush_task_events(self):
+        while True:
+            await asyncio.sleep(1.0)
+            if not self._task_event_buffer:
+                continue
+            batch, self._task_event_buffer = self._task_event_buffer, []
+            try:
+                gcs = self.client_pool.get(*self.gcs_address)
+                await gcs.call_oneway("report_task_events", batch)
+            except Exception:
+                pass  # events are best-effort observability
 
     def _register_handlers(self):
         s = self.server
@@ -196,6 +217,8 @@ class CoreWorker:
                 await gcs.call("finish_job", self.job_id, timeout=5.0)
             except Exception:
                 pass
+        if self._event_flush_task:
+            self._event_flush_task.cancel()
         if self._subscriber:
             await self._subscriber.close()
         await self.server.stop()
@@ -499,6 +522,13 @@ class CoreWorker:
             self.memory_store.entry(oid)  # create pending entry
         self._pending_tasks[spec.task_id] = spec
         arg_ids = self._pin_task_args(spec)
+        self.record_task_event(
+            spec.task_id,
+            state="PENDING",
+            name=spec.function.qualname,
+            type="NORMAL_TASK",
+            job_id=spec.job_id.hex(),
+        )
         asyncio.ensure_future(self._submit_pipeline(spec, arg_ids))
         return return_ids
 
@@ -652,11 +682,15 @@ class CoreWorker:
             elif ret.in_plasma:
                 node_addr = ret.node_id
                 self.memory_store.put_plasma(ret.object_id, ret.size, node_addr)
+        self.record_task_event(spec.task_id, state="FINISHED")
 
     def _fail_task(self, spec: TaskSpec, error: Exception):
         packed = serialization.pack(error)
         for oid in spec.return_object_ids():
             self.memory_store.put_error(oid, packed)
+        self.record_task_event(
+            spec.task_id, state="FAILED", error=type(error).__name__
+        )
 
     # ------------------------------------------------------------------
     # actor submission (reference: actor_task_submitter.h)
@@ -834,6 +868,10 @@ class CoreWorker:
         """Execute a normal task and reply with its returns."""
         prev_task = self._current_task_id
         self._current_task_id = spec.task_id
+        self.record_task_event(
+            spec.task_id, state="RUNNING", node_id=self.node_id.hex()
+            if self.node_id else "", worker_pid=os.getpid(),
+        )
         try:
             fn = await self._load_function(spec.function)
             args, kwargs = await self._unflatten(spec)

@@ -1,0 +1,157 @@
+"""CLI: ``python -m ray_tpu.scripts.cli <command>``.
+
+Role-equivalent of the reference's ray CLI (python/ray/scripts/scripts.py —
+ray start :684 / stop :1227 / status, plus `ray list ...` from the state
+CLI util/state/state_cli.py). ``start --head`` runs a standalone head node
+(GCS + raylet) that remote drivers join with
+``ray_tpu.init(address="host:port")``; ``start --address`` joins an
+existing head as a worker node.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def cmd_start(args):
+    from .._internal.config import Config
+    from ..runtime.node import Node
+
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    if args.num_tpus is not None:
+        resources["TPU"] = float(args.num_tpus)
+    labels = json.loads(args.labels) if args.labels else {}
+
+    config = Config()
+    if args.head:
+        node = Node(
+            config,
+            head=True,
+            resources=resources,
+            labels=labels,
+            object_store_memory=args.object_store_memory,
+        )
+        host, port = node.gcs_address
+        print(f"ray_tpu head started; connect with:")
+        print(f'  ray_tpu.init(address="{host}:{port}")')
+    else:
+        if not args.address:
+            print("worker nodes need --address host:port", file=sys.stderr)
+            return 1
+        host, port = args.address.rsplit(":", 1)
+        node = Node(
+            config,
+            head=False,
+            gcs_address=(host, int(port)),
+            resources=resources,
+            labels=labels,
+            object_store_memory=args.object_store_memory,
+        )
+        print(f"ray_tpu node joined {args.address}")
+    if args.block:
+        stop = []
+        signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+        signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+        while not stop:
+            time.sleep(0.5)
+        node.stop()
+        return 0
+    print(f"(pid {os.getpid()} keeps the node alive; kill it to stop)")
+    while True:  # non-daemonized v1: block regardless
+        time.sleep(3600)
+
+
+def _connected(args):
+    import ray_tpu
+
+    ray_tpu.init(address=args.address)
+    return ray_tpu
+
+
+def cmd_status(args):
+    _connected(args)
+    from ..util import state
+
+    summary = state.cluster_summary()
+    print(json.dumps(summary, indent=2, default=str))
+    return 0
+
+
+def cmd_list(args):
+    _connected(args)
+    from ..util import state
+
+    fn = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "tasks": state.list_tasks,
+        "jobs": state.list_jobs,
+        "placement-groups": state.list_placement_groups,
+        "objects": state.list_objects,
+    }[args.what]
+    rows = fn()
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_summary(args):
+    _connected(args)
+    from ..util import state
+
+    print(json.dumps(state.summarize_tasks(), indent=2))
+    return 0
+
+
+def cmd_metrics(args):
+    _connected(args)
+    from ..util.metrics import prometheus_text
+
+    print(prometheus_text())
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None, help="head host:port to join")
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--resources", default=None, help="JSON resource map")
+    p.add_argument("--labels", default=None, help="JSON label map")
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.add_argument("--block", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    for name, fn in (
+        ("status", cmd_status),
+        ("summary", cmd_summary),
+        ("metrics", cmd_metrics),
+    ):
+        p = sub.add_parser(name)
+        p.add_argument("--address", required=True, help="head host:port")
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument(
+        "what",
+        choices=["nodes", "actors", "tasks", "jobs", "placement-groups", "objects"],
+    )
+    p.add_argument("--address", required=True, help="head host:port")
+    p.set_defaults(fn=cmd_list)
+
+    args = parser.parse_args(argv)
+    return args.fn(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,223 @@
+"""User-facing metrics: Counter / Gauge / Histogram.
+
+Role-equivalent of the reference's ray.util.metrics (python/ray/util/
+metrics.py backed by the per-node metrics agent + Prometheus export,
+_private/metrics_agent.py). Metrics record locally and are pushed to the
+GCS KV under ``metrics:<worker>`` every few seconds; ``prometheus_text()``
+aggregates every worker's push into Prometheus exposition format.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+_pusher_started = False
+
+
+class Metric:
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        tag_keys: Tuple[str, ...] = (),
+    ):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+        _ensure_pusher()
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _tag_tuple(self, tags: Optional[Dict[str, str]]) -> Tuple[str, ...]:
+        merged = {**self._default_tags, **(tags or {})}
+        return tuple(merged.get(k, "") for k in self._tag_keys)
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "name": self._name,
+                "type": type(self).__name__.lower(),
+                "description": self._description,
+                "tag_keys": self._tag_keys,
+                "values": {json.dumps(k): v for k, v in self._values.items()},
+            }
+
+
+class Counter(Metric):
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        key = self._tag_tuple(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[self._tag_tuple(tags)] = float(value)
+
+
+class Histogram(Metric):
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Optional[List[float]] = None,
+        tag_keys: Tuple[str, ...] = (),
+    ):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = sorted(boundaries or [0.1, 1, 10, 100, 1000])
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._tag_tuple(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self._boundaries) + 1)
+            )
+            counts[bisect.bisect_left(self._boundaries, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._values[key] = self._sums[key]
+
+    def _snapshot(self) -> dict:
+        snap = super()._snapshot()
+        with self._lock:
+            snap["boundaries"] = self._boundaries
+            snap["counts"] = {
+                json.dumps(k): v for k, v in self._counts.items()
+            }
+        return snap
+
+
+def _ensure_pusher():
+    """Background thread pushing this process's metrics to the GCS KV."""
+    global _pusher_started
+    if _pusher_started:
+        return
+    _pusher_started = True
+
+    def _push_loop():
+        from .. import _worker_api
+
+        while True:
+            time.sleep(3.0)
+            worker = _worker_api.maybe_get_core_worker()
+            if worker is None:
+                continue
+            with _registry_lock:
+                snaps = [m._snapshot() for m in _registry.values()]
+            if not snaps:
+                continue
+            try:
+                _worker_api.run_on_worker_loop(
+                    worker.client_pool.get(*worker.gcs_address).call(
+                        "kv_put",
+                        f"metrics:{worker.worker_id.hex()}",
+                        json.dumps(snaps).encode(),
+                        True,
+                    ),
+                    timeout=5,
+                )
+            except Exception:
+                pass
+
+    threading.Thread(target=_push_loop, daemon=True, name="metrics-push").start()
+
+
+def prometheus_text() -> str:
+    """Aggregate all workers' pushed metrics into Prometheus exposition
+    format (reference: metrics agent -> /metrics endpoint). Samples with the
+    same (name, labels) across workers are summed into ONE series —
+    duplicate series make a scrape invalid; histograms render cumulative
+    ``_bucket``/``_sum``/``_count`` series as the format requires."""
+    from .. import _worker_api
+
+    worker = _worker_api.get_core_worker()
+    keys = _worker_api.run_on_worker_loop(
+        worker.client_pool.get(*worker.gcs_address).call("kv_keys", "metrics:")
+    )
+    # merged[name] = {"snap": first snapshot, "values": {label_tuple: sum},
+    #                 "counts": {label_tuple: [bucket sums]}, "sums": {...}}
+    merged: Dict[str, dict] = {}
+    for key in keys:
+        raw = _worker_api.run_on_worker_loop(
+            worker.client_pool.get(*worker.gcs_address).call("kv_get", key)
+        )
+        if raw is None:
+            continue
+        for snap in json.loads(raw):
+            name = snap["name"]
+            m = merged.setdefault(
+                name, {"snap": snap, "values": {}, "counts": {}}
+            )
+            for tag_json, value in snap["values"].items():
+                m["values"][tag_json] = m["values"].get(tag_json, 0.0) + value
+            for tag_json, counts in snap.get("counts", {}).items():
+                cur = m["counts"].get(tag_json)
+                if cur is None:
+                    m["counts"][tag_json] = list(counts)
+                else:
+                    m["counts"][tag_json] = [
+                        a + b for a, b in zip(cur, counts)
+                    ]
+    lines: List[str] = []
+    for name, m in merged.items():
+        snap = m["snap"]
+        kind = {"counter": "counter", "gauge": "gauge"}.get(
+            snap["type"], "histogram"
+        )
+        lines.append(f"# HELP {name} {snap['description']}")
+        lines.append(f"# TYPE {name} {kind}")
+        for tag_json in m["values"]:
+            label_pairs = [
+                (k, v)
+                for k, v in zip(snap["tag_keys"], json.loads(tag_json))
+                if v
+            ]
+            if kind == "histogram":
+                counts = m["counts"].get(tag_json, [])
+                bounds = snap.get("boundaries", [])
+                cum = 0
+                for bound, c in zip(bounds, counts):
+                    cum += c
+                    lines.append(
+                        _sample(
+                            f"{name}_bucket",
+                            label_pairs + [("le", str(bound))],
+                            cum,
+                        )
+                    )
+                cum += counts[len(bounds)] if len(counts) > len(bounds) else 0
+                lines.append(
+                    _sample(
+                        f"{name}_bucket", label_pairs + [("le", "+Inf")], cum
+                    )
+                )
+                lines.append(_sample(f"{name}_count", label_pairs, cum))
+                lines.append(
+                    _sample(f"{name}_sum", label_pairs, m["values"][tag_json])
+                )
+            else:
+                lines.append(
+                    _sample(name, label_pairs, m["values"][tag_json])
+                )
+    return "\n".join(lines) + "\n"
+
+
+def _sample(name: str, label_pairs, value) -> str:
+    labels = ",".join(f'{k}="{v}"' for k, v in label_pairs)
+    label_str = f"{{{labels}}}" if labels else ""
+    return f"{name}{label_str} {value}"

@@ -1,0 +1,116 @@
+"""Cluster state API: list live tasks/actors/nodes/objects programmatically.
+
+Role-equivalent of the reference's state API (python/ray/util/state/api.py —
+list_tasks/list_actors/list_nodes/... backed by StateAggregator +
+GcsTaskManager). Queries go straight to the GCS; task rows come from the
+task-event store fed by every worker's event buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .. import _worker_api
+
+
+def _gcs_call(method: str, *args):
+    worker = _worker_api.get_core_worker()
+    return _worker_api.run_on_worker_loop(
+        worker.client_pool.get(*worker.gcs_address).call(method, *args)
+    )
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return [
+        {
+            "node_id": n.node_id.hex(),
+            "state": "ALIVE" if n.alive else "DEAD",
+            "address": f"{n.address[0]}:{n.address[1]}",
+            "resources_total": n.resources_total,
+            "labels": n.labels,
+            "is_head_node": n.is_head,
+        }
+        for n in _gcs_call("get_all_nodes")
+    ]
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    return [
+        {
+            "actor_id": a.actor_id.hex(),
+            "state": a.state.name if hasattr(a.state, "name") else str(a.state),
+            "name": a.name,
+            "class_name": (
+                a.creation_spec.function.qualname if a.creation_spec else ""
+            ),
+            "node_address": f"{a.address[0]}:{a.address[1]}" if a.address else "",
+            "restarts": a.num_restarts,
+            "max_restarts": a.max_restarts,
+        }
+        for a in _gcs_call("list_actors")
+    ]
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return _gcs_call("list_jobs")
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return [
+        {
+            "placement_group_id": pg.placement_group_id.hex(),
+            "name": pg.name,
+            "state": pg.state.name
+            if hasattr(pg.state, "name")
+            else str(pg.state),
+            "strategy": pg.strategy.name
+            if hasattr(pg.strategy, "name")
+            else str(pg.strategy),
+            "bundles": [getattr(b, "resources", b) for b in pg.bundles],
+        }
+        for pg in _gcs_call("list_placement_groups")
+    ]
+
+
+def list_tasks(
+    filters: Optional[Dict[str, Any]] = None, limit: int = 1000
+) -> List[Dict[str, Any]]:
+    return _gcs_call("list_task_events", filters, limit)
+
+
+def summarize_tasks() -> Dict[str, int]:
+    """state -> count (reference: `ray summary tasks`)."""
+    out: Dict[str, int] = {}
+    for ev in list_tasks(limit=100000):
+        out[ev.get("state", "UNKNOWN")] = out.get(ev.get("state", "UNKNOWN"), 0) + 1
+    return out
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Objects in the local node's store (reference: `ray list objects` is
+    cluster-wide via object locations; store-level view here)."""
+    node = _worker_api.get_node()
+    if node is None:
+        return []
+    store = node.raylet.store
+    stats = store.stats()
+    return [
+        {
+            "store": stats,
+            "spilled": {
+                oid.hex(): path
+                for oid, path in getattr(node.raylet, "_spilled", {}).items()
+            },
+        }
+    ]
+
+
+def cluster_summary() -> Dict[str, Any]:
+    nodes = list_nodes()
+    return {
+        "nodes": len(nodes),
+        "alive_nodes": sum(1 for n in nodes if n["state"] == "ALIVE"),
+        "actors": len(list_actors()),
+        "placement_groups": len(list_placement_groups()),
+        "tasks": summarize_tasks(),
+    }

@@ -1,0 +1,172 @@
+"""Tests for state API, task events, metrics, CLI (reference model:
+python/ray/util/state tests + tests/test_metrics_agent.py)."""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, resources={"TPU": 4})
+    yield
+    ray_tpu.shutdown()
+
+
+def test_list_nodes(cluster):
+    nodes = state.list_nodes()
+    assert len(nodes) == 1
+    assert nodes[0]["state"] == "ALIVE"
+    assert nodes[0]["is_head_node"] is True
+    assert nodes[0]["resources_total"]["CPU"] == 4.0
+
+
+def test_task_events_flow(cluster):
+    @ray_tpu.remote
+    def tracked(x):
+        return x + 1
+
+    refs = [tracked.remote(i) for i in range(3)]
+    assert ray_tpu.get(refs) == [1, 2, 3]
+
+    @ray_tpu.remote
+    def failing():
+        raise ValueError("nope")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(failing.options(max_retries=0).remote())
+
+    deadline = time.time() + 10
+    tasks = []
+    while time.time() < deadline:
+        tasks = state.list_tasks()
+        finished = [t for t in tasks if t.get("state") == "FINISHED"]
+        failed = [t for t in tasks if t.get("state") == "FAILED"]
+        if len(finished) >= 3 and len(failed) >= 1:
+            break
+        time.sleep(0.5)
+    names = {t.get("name") for t in tasks}
+    assert "tracked" in names
+    assert any(t.get("state") == "FAILED" for t in tasks)
+    summary = state.summarize_tasks()
+    assert summary.get("FINISHED", 0) >= 3
+
+
+def test_list_actors_and_pgs(cluster):
+    @ray_tpu.remote
+    class Named:
+        def ping(self):
+            return 1
+
+    a = Named.options(name="observable").remote()
+    assert ray_tpu.get(a.ping.remote()) == 1
+    actors = state.list_actors()
+    assert any(x["name"] == "observable" for x in actors)
+
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+    pgs = state.list_placement_groups()
+    assert len(pgs) >= 1
+    remove_placement_group(pg)
+    ray_tpu.kill(a)
+
+
+def test_cluster_summary(cluster):
+    summary = state.cluster_summary()
+    assert summary["nodes"] == 1
+    assert summary["alive_nodes"] == 1
+    assert "tasks" in summary
+
+
+def test_metrics_push_and_prometheus(cluster):
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram, prometheus_text
+
+    c = Counter("test_requests_total", "reqs", tag_keys=("route",))
+    c.inc(3, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/b"})
+    g = Gauge("test_queue_len", "queue")
+    g.set(7)
+    h = Histogram("test_latency", "lat", boundaries=[1, 10])
+    h.observe(0.5)
+    h.observe(5)
+
+    deadline = time.time() + 15
+    text = ""
+    while time.time() < deadline:
+        text = prometheus_text()
+        if "test_requests_total" in text and "test_queue_len 7" in text:
+            break
+        time.sleep(1)
+    assert 'test_requests_total{route="/a"} 3' in text
+    assert "test_queue_len 7" in text
+
+
+def test_metrics_from_workers(cluster):
+    @ray_tpu.remote
+    def record():
+        from ray_tpu.util.metrics import Counter
+
+        c = Counter("worker_side_counter", "from a task")
+        c.inc(5)
+        time.sleep(4)  # let the pusher flush
+        return True
+
+    assert ray_tpu.get(record.remote())
+    from ray_tpu.util.metrics import prometheus_text
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if "worker_side_counter" in prometheus_text():
+            break
+        time.sleep(1)
+    assert "worker_side_counter 5" in prometheus_text()
+
+
+def test_cli_status_and_list(cluster):
+    node = ray_tpu._worker_api.get_node()
+    host, port = node.gcs_address
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "ray_tpu.scripts.cli",
+            "status",
+            "--address",
+            f"{host}:{port}",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        env={**__import__("os").environ, "RAY_TPU_JAX_PLATFORM": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    summary = json.loads(out.stdout)
+    assert summary["alive_nodes"] >= 1
+
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "ray_tpu.scripts.cli",
+            "list",
+            "nodes",
+            "--address",
+            f"{host}:{port}",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        env={**__import__("os").environ, "RAY_TPU_JAX_PLATFORM": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    nodes = json.loads(out.stdout)
+    assert len(nodes) >= 1
