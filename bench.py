@@ -8,32 +8,57 @@ Llama config in bfloat16 with the Pallas flash-attention kernel. K steps run
 inside one jitted lax.scan so device compute dominates and per-dispatch
 tunnel/host latency is amortized away.
 
+TPU detection goes through ray_tpu._internal.platform.is_tpu_backend (device
+platform/device_kind, accepting the "axon" remote-dispatch plugin) — NOT
+jax.default_backend(), which reports the plugin name and sent round 1 down
+the interpret-mode path.
+
+The run keeps a wall-clock budget (RAY_TPU_BENCH_BUDGET_S, default 420s):
+it always produces a JSON line from whatever measurements completed rather
+than overrunning the driver's timeout.
+
 The reference publishes no throughput numbers (BASELINE.md: "published" is
-empty), so vs_baseline is the ratio against a fixed MFU target recorded
-below — it rises as the kernels/schedule improve across rounds.
+empty), so vs_baseline is the ratio against a fixed 40% MFU target — it
+rises as the kernels/schedule improve across rounds.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+
+BUDGET_S = float(os.environ.get("RAY_TPU_BENCH_BUDGET_S", "420"))
+_T0 = time.perf_counter()
+
+
+def _log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def _remaining() -> float:
+    return BUDGET_S - (time.perf_counter() - _T0)
 
 
 def main():
     import jax
-    import jax.numpy as jnp
+    import jax.numpy as jnp  # noqa: F401
     import optax
 
+    from ray_tpu._internal.platform import is_tpu_backend
     from ray_tpu.models.llama import LlamaConfig, init_params, next_token_loss
     from ray_tpu.parallel.sharding import unbox_params
 
-    on_tpu = jax.default_backend() == "tpu"
+    _log(f"devices={jax.devices()}")
+    on_tpu = is_tpu_backend()
+    _log(f"on_tpu={on_tpu}")
     if on_tpu:
         cfg = LlamaConfig(
             vocab_size=32000, dim=1024, n_layers=8, n_heads=16, n_kv_heads=16,
             intermediate=2816, max_seq_len=1024, remat=False,
         )
-        batch, steps = 8, 20
+        batch, steps = 8, 16
     else:  # smoke fallback for dev boxes
         cfg = LlamaConfig.tiny()
         batch, steps = 2, 3
@@ -42,6 +67,7 @@ def main():
     params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
     optimizer = optax.adamw(1e-3)
     opt_state = optimizer.init(params)
+    _log("params initialized")
 
     def loss_fn(p, tokens):
         return next_token_loss(cfg, None, p, tokens)
@@ -57,30 +83,46 @@ def main():
         (p2, s2), losses = jax.lax.scan(one_step, (p, s), data)
         return p2, s2, losses
 
-    # Timing through the remote-execution tunnel: block_until_ready does not
-    # round-trip, so force scalar materialization, and cancel the fixed
-    # dispatch overhead by timing two different step counts and using the
-    # slope (dt(2K steps) - dt(K steps)) / K.
-    def timed(n_steps, seed):
-        def make_data(s):
-            return jax.random.randint(
-                jax.random.PRNGKey(s), (n_steps, batch, seq), 0, cfg.vocab_size
-            )
+    def make_data(n_steps, s):
+        return jax.random.randint(
+            jax.random.PRNGKey(s), (n_steps, batch, seq), 0, cfg.vocab_size
+        )
 
-        _, _, losses = run(params, opt_state, make_data(seed + 1000))
+    # Timing through the remote-execution tunnel: block_until_ready does not
+    # round-trip, so force scalar materialization. Time two different step
+    # counts and use the slope (dt(2K) - dt(K)) / K to cancel the fixed
+    # per-dispatch overhead — but only if the wall-clock budget allows the
+    # second compile; otherwise report the conservative single measurement.
+    def timed(n_steps, seed):
+        _log(f"compile+warm n_steps={n_steps}")
+        tc0 = time.perf_counter()
+        _, _, losses = run(params, opt_state, make_data(n_steps, seed + 1000))
         float(losses[-1])  # compile + warm
+        compile_s = time.perf_counter() - tc0
+        _log(f"warm done n_steps={n_steps} ({compile_s:.1f}s); timing")
         # time with DIFFERENT data: the tunnel may serve repeated identical
         # dispatches from cache
         t0 = time.perf_counter()
-        _, _, losses = run(params, opt_state, make_data(seed))
+        _, _, losses = run(params, opt_state, make_data(n_steps, seed))
         float(losses[-1])
-        return time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        _log(f"n_steps={n_steps} dt={dt:.3f}s")
+        return dt, compile_s
 
-    t_short = timed(steps, seed=1)
-    t_long = timed(2 * steps, seed=2)
-    dt = max(t_long - t_short, 1e-9)
+    t_short, compile_short = timed(steps, seed=1)
+    # second (2K) measurement needs one more compile of similar cost to the
+    # first plus ~2*t_short of run time; bail to the K-only estimate (which
+    # conservatively includes dispatch overhead) if the budget is shy
+    if _remaining() > compile_short + 3 * t_short + 20:
+        t_long, _ = timed(2 * steps, seed=2)
+        dt = max(t_long - t_short, 1e-9)
+        eff_steps = steps
+    else:
+        _log("budget short: skipping 2K run, using K-only timing")
+        dt = max(t_short, 1e-9)
+        eff_steps = steps
 
-    tokens_per_sec = steps * batch * seq / dt
+    tokens_per_sec = eff_steps * batch * seq / dt
 
     # rough model FLOPs/token (6 * params for fwd+bwd, attention extra)
     n_params = sum(x.size for x in jax.tree.leaves(params))
@@ -90,6 +132,7 @@ def main():
     mfu = achieved / peak
     # vs_baseline: achieved MFU against a 40% MFU target for this model size
     vs_baseline = mfu / 0.40
+    _log(f"tokens/s={tokens_per_sec:.1f} mfu={mfu:.4f}")
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",

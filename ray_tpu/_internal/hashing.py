@@ -1,0 +1,19 @@
+"""Process-stable hashing.
+
+Python's builtin ``hash()`` is randomized per process for str/bytes
+(PYTHONHASHSEED), so any value derived from it — shuffle partition
+assignment, rendezvous ports — silently disagrees across worker processes.
+The reference partitions by a process-stable key hash; everything here that
+must agree across processes routes through this helper instead.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def stable_hash(value) -> int:
+    """Deterministic 64-bit hash of a (reprable) value, stable across
+    processes and runs."""
+    data = repr(value).encode() if not isinstance(value, bytes) else value
+    return int.from_bytes(hashlib.md5(data).digest()[:8], "little")

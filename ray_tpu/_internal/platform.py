@@ -1,0 +1,39 @@
+"""TPU platform detection.
+
+The reference gates accelerator paths on CUDA visibility
+(python/ray/_private/accelerators/tpu.py detects TPU via env/device files).
+Here the question is narrower: "is the default JAX backend a real TPU?" —
+used to decide whether Pallas kernels compile natively or run in interpret
+mode, and which benchmark config to use.
+
+Detection must NOT use ``jax.default_backend() == "tpu"``: some TPU
+environments expose the chip through a plugin whose platform name differs
+(e.g. the remote-dispatch "axon" plugin, where platform == "axon" but the
+device is a real v5e chip and Pallas lowers natively). Instead look at the
+actual device list: platform name, device_kind, or an explicit env override.
+"""
+
+from __future__ import annotations
+
+import os
+
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def is_tpu_backend() -> bool:
+    """True iff the default JAX backend drives real TPU hardware."""
+    override = os.environ.get("RAY_TPU_FORCE_PLATFORM")
+    if override:
+        return override in _TPU_PLATFORMS
+
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    platform = getattr(dev, "platform", "") or ""
+    if platform.lower() in _TPU_PLATFORMS:
+        return True
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    return "tpu" in kind

@@ -56,8 +56,11 @@ def _rendezvous_coordinator(group_name: str, rank: int, world_size: int,
         import socket
 
         host = socket.gethostbyname(socket.gethostname())
-        # deterministic port per group in the dynamic range
-        port = 20000 + (hash(group_name) % 20000)
+        # deterministic port per group in the dynamic range (stable_hash:
+        # builtin hash() is per-process randomized, ranks would disagree)
+        from .._internal.hashing import stable_hash
+
+        port = 20000 + (stable_hash(group_name) % 20000)
         addr = f"{host}:{port}"
         _worker_api.run_on_worker_loop(client.call("kv_put", key, addr.encode(), True))
         return addr

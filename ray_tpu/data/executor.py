@@ -118,8 +118,15 @@ def _remotes():
             rng = np.random.default_rng(seed)
             assign = rng.integers(0, n, size=rows)
         elif mode == "hash":
+            from ray_tpu._internal.hashing import stable_hash
+
+            # builtin hash() is per-process randomized for strings: split
+            # tasks run in different workers, so the same key would land in
+            # different partitions across blocks (duplicate groups)
             keys = _key_values(acc, key)
-            assign = np.asarray([hash(k) % n for k in keys], dtype=np.int64)
+            assign = np.asarray(
+                [stable_hash(k) % n for k in keys], dtype=np.int64
+            )
         else:
             raise ValueError(mode)
         parts = []

@@ -31,7 +31,9 @@ _NEG_INF = -1e30
 
 
 def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ray_tpu._internal.platform import is_tpu_backend
+
+    return not is_tpu_backend()
 
 
 # ---------------------------------------------------------------------------

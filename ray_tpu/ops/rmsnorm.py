@@ -16,7 +16,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ray_tpu._internal.platform import is_tpu_backend
+
+    return not is_tpu_backend()
 
 
 def _fwd_kernel(x_ref, w_ref, o_ref, *, eps: float):

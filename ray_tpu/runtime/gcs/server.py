@@ -247,12 +247,23 @@ class GcsServer:
             else:
                 # events arrive from different processes on independent
                 # flush cadences: never let a late RUNNING (executor) regress
-                # a FINISHED/FAILED (owner) state
+                # a FINISHED/FAILED (owner) state, and never let a stale
+                # duplicate flush flip one terminal state into the other —
+                # terminal->different-terminal only applies with a newer
+                # attempt number
                 new_state = ev.get("state")
-                if new_state is not None and self._TASK_STATE_RANK.get(
-                    new_state, 0
-                ) < self._TASK_STATE_RANK.get(cur.get("state"), 0):
-                    ev = {k: v for k, v in ev.items() if k != "state"}
+                if new_state is not None:
+                    new_rank = self._TASK_STATE_RANK.get(new_state, 0)
+                    cur_rank = self._TASK_STATE_RANK.get(cur.get("state"), 0)
+                    regress = new_rank < cur_rank
+                    terminal_flip = (
+                        new_rank == 2
+                        and cur_rank == 2
+                        and new_state != cur.get("state")
+                        and ev.get("attempt", 0) <= cur.get("attempt", 0)
+                    )
+                    if regress or terminal_flip:
+                        ev = {k: v for k, v in ev.items() if k != "state"}
                 cur.update(ev)
         return True
 

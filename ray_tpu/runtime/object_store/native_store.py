@@ -144,10 +144,16 @@ class NativeObjectStore:
         self._lib.rt_free(self._h, self._key(object_id))
         self._gc_mirrors(object_id)
 
-    def free_if_unpinned(self, object_id: ObjectID) -> bool:
+    def free_if_unpinned(self, object_id: ObjectID):
+        """True = freed now, False = pinned, None = wasn't present (a
+        concurrent free already removed it — callers spilling must not
+        record a spill copy for a vanished object)."""
         rc = self._lib.rt_free_if_unpinned(self._h, self._key(object_id))
         if rc == -2:
             return False
+        if rc == -1:
+            self._gc_mirrors(object_id)
+            return None
         self._gc_mirrors(object_id)
         return True
 

@@ -172,9 +172,14 @@ class ObjectStore:
                 # above already reclaimed the name, mapping dies with readers
                 pass
 
-    def free_if_unpinned(self, object_id: ObjectID) -> bool:
+    def free_if_unpinned(self, object_id: ObjectID):
+        """True = freed now, False = pinned, None = wasn't present (a
+        concurrent free already removed it — callers spilling must not
+        record a spill copy for a vanished object)."""
         entry = self._entries.get(object_id)
-        if entry is not None and entry.pin_count > 0:
+        if entry is None:
+            return None
+        if entry.pin_count > 0:
             return False
         self.free(object_id)
         return True

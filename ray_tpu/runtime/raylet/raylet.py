@@ -86,6 +86,7 @@ class Raylet:
         self._leases: Dict[UniqueID, Lease] = {}
         # spilled primary copies: object id -> file path (reference: N14)
         self._spilled: Dict[ObjectID, str] = {}
+        self._restore_locks: Dict[ObjectID, asyncio.Lock] = {}
         self._lease_seq = itertools.count()
         # scheduling-class FIFO queues of pending lease requests
         # (reference: scheduling classes, scheduling_class_util.h)
@@ -447,8 +448,12 @@ class Raylet:
         del view
         await asyncio.to_thread(_write_file, path, data)
         # a reader may have pinned the object during the await; freeing then
-        # would reallocate a block a live zero-copy view still aliases
-        if not self.store.free_if_unpinned(object_id):
+        # would reallocate a block a live zero-copy view still aliases.
+        # freed is None when the object vanished during the write (a
+        # concurrent free already ran) — recording a spill copy then would
+        # resurrect a freed object on a later stale get
+        freed = self.store.free_if_unpinned(object_id)
+        if freed is not True:
             try:
                 os.remove(path)
             except OSError:
@@ -459,21 +464,39 @@ class Raylet:
 
     async def _restore_spilled(self, object_id: ObjectID) -> bool:
         """Bring a spilled object back into the arena (reference:
-        AsyncRestoreSpilledObject, local_object_manager.h:127)."""
-        path = self._spilled.get(object_id)
-        if path is None:
-            return False
-        data = await asyncio.to_thread(_read_file, path)
-        await self._create_with_spill(object_id, len(data))
-        self.store.write_view(object_id)[: len(data)] = data
-        self.store.seal(object_id)
-        self.store.pin_primary(object_id)  # restored copy stays primary
-        self._spilled.pop(object_id, None)
+        AsyncRestoreSpilledObject, local_object_manager.h:127).
+
+        Restores are serialized per object id: two concurrent gets both see
+        the id in _spilled, the first restore deletes the spill file, and an
+        unserialized second restore would FileNotFoundError even though the
+        object is now in the store."""
+        lock = self._restore_locks.setdefault(object_id, asyncio.Lock())
         try:
-            os.remove(path)
-        except OSError:
-            pass
-        return True
+            async with lock:
+                if self.store.contains(object_id):
+                    return True  # a concurrent restore won
+                path = self._spilled.get(object_id)
+                if path is None:
+                    return self.store.contains(object_id)
+                try:
+                    data = await asyncio.to_thread(_read_file, path)
+                except OSError:
+                    # file vanished (concurrent free / external cleanup)
+                    self._spilled.pop(object_id, None)
+                    return self.store.contains(object_id)
+                await self._create_with_spill(object_id, len(data))
+                self.store.write_view(object_id)[: len(data)] = data
+                self.store.seal(object_id)
+                self.store.pin_primary(object_id)  # restored copy stays primary
+                self._spilled.pop(object_id, None)
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                return True
+        finally:
+            if not lock.locked() and not getattr(lock, "_waiters", None):
+                self._restore_locks.pop(object_id, None)
 
     async def handle_store_seal(self, object_id: ObjectID, is_primary: bool = False):
         self.store.seal(object_id)
@@ -500,11 +523,22 @@ class Raylet:
             try:
                 restored = await self._restore_spilled(object_id)
             except ObjectStoreFullError:
-                return {"ok": False, "error": "store full during restore"}
+                restored = False
             if restored:
                 result = await self.store.get(object_id, timeout=1.0)
                 if result is not None:
                     return {"ok": True, "segment": result[0], "size": result[1]}
+            else:
+                # arena is full of pinned readers: serve the payload inline
+                # from the spill file (a copy) rather than failing the get —
+                # the object is durably here, only zero-copy is impossible
+                path = self._spilled.get(object_id)
+                if path is not None:
+                    try:
+                        data = await asyncio.to_thread(_read_file, path)
+                        return {"ok": True, "data": data}
+                    except OSError:
+                        pass  # raced with a concurrent restore; fall through
         if owner_address is not None:
             pulled = await self._pull_object(object_id, owner_address)
             if pulled:
@@ -533,10 +567,29 @@ class Raylet:
 
     async def handle_fetch_object(self, object_id: ObjectID, offset: int, length: int):
         """Serve one chunk of a local object to a pulling peer (reference:
-        ObjectManager::Push chunking)."""
+        ObjectManager::Push chunking).
+
+        A spilled primary copy is still durably here — the owner's location
+        table lists this node — so serve chunks straight from the spill file
+        rather than returning None (which would surface as ObjectLostError
+        at the puller)."""
         view = self.store.read_local(object_id)
         if view is None:
-            return None
+            path = self._spilled.get(object_id)
+            if path is not None:
+                try:
+                    total, chunk = await asyncio.to_thread(
+                        _read_file_range, path, offset, length
+                    )
+                    return {"total": total, "data": chunk}
+                except OSError:
+                    pass  # spill file raced with restore/free; fall through
+            # a concurrent restore may have just completed (and popped the
+            # _spilled entry + deleted the file): retry the store before
+            # declaring the object absent
+            view = self.store.read_local(object_id)
+            if view is None:
+                return None
         total = len(view)
         chunk = bytes(view[offset : offset + length])
         return {"total": total, "data": chunk}
@@ -620,3 +673,13 @@ def _write_file(path: str, data: bytes):
 def _read_file(path: str) -> bytes:
     with open(path, "rb") as f:
         return f.read()
+
+
+def _read_file_range(path: str, offset: int, length: int):
+    """(total_size, bytes at [offset, offset+length)) without reading the
+    whole spill file per chunk."""
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        total = f.tell()
+        f.seek(offset)
+        return total, f.read(length)

@@ -373,6 +373,10 @@ class CoreWorker:
         )
         if not reply["ok"]:
             raise ObjectLostError(ref.id, "object not found in any store")
+        if reply.get("data") is not None:
+            # spilled object served inline (arena full of pinned readers):
+            # plain copy, no pin to manage
+            return serialization.unpack(reply["data"])
         view = self.store_client.read(reply["segment"], reply["size"])
         # the pin must outlive every zero-copy array aliasing the mapping:
         # the arena store reuses blocks in place after eviction/spill, so an
@@ -551,7 +555,7 @@ class CoreWorker:
             err = last_error or WorkerCrashedError(
                 f"task {spec.task_id} failed after {attempts} attempts"
             )
-            self._fail_task(spec, err)
+            self._fail_task(spec, err, attempt=attempts - 1)
         except Exception as e:  # noqa: BLE001
             self._fail_task(spec, e)
         finally:
@@ -607,9 +611,9 @@ class CoreWorker:
                 err_obj = TaskError(spec.function.qualname, str(err_obj))
             if spec.retry_exceptions and attempt < spec.max_retries:
                 return False
-            self._fail_task(spec, err_obj)
+            self._fail_task(spec, err_obj, attempt=attempt)
             return True
-        self._process_reply(spec, reply)
+        self._process_reply(spec, reply, attempt=attempt)
         return True
 
     async def _acquire_lease(self, spec: TaskSpec) -> dict:
@@ -675,21 +679,22 @@ class CoreWorker:
                 return n.address
         return None
 
-    def _process_reply(self, spec: TaskSpec, reply: TaskReply):
+    def _process_reply(self, spec: TaskSpec, reply: TaskReply, attempt: int = 0):
         for ret in reply.returns:
             if ret.value is not None:
                 self.memory_store.put_value(ret.object_id, ret.value)
             elif ret.in_plasma:
                 node_addr = ret.node_id
                 self.memory_store.put_plasma(ret.object_id, ret.size, node_addr)
-        self.record_task_event(spec.task_id, state="FINISHED")
+        self.record_task_event(spec.task_id, state="FINISHED", attempt=attempt)
 
-    def _fail_task(self, spec: TaskSpec, error: Exception):
+    def _fail_task(self, spec: TaskSpec, error: Exception, attempt: int = 0):
         packed = serialization.pack(error)
         for oid in spec.return_object_ids():
             self.memory_store.put_error(oid, packed)
         self.record_task_event(
-            spec.task_id, state="FAILED", error=type(error).__name__
+            spec.task_id, state="FAILED", error=type(error).__name__,
+            attempt=attempt,
         )
 
     # ------------------------------------------------------------------
