@@ -253,17 +253,54 @@ class GcsServer:
                 # attempt number
                 new_state = ev.get("state")
                 if new_state is not None:
-                    new_rank = self._TASK_STATE_RANK.get(new_state, 0)
-                    cur_rank = self._TASK_STATE_RANK.get(cur.get("state"), 0)
-                    regress = new_rank < cur_rank
-                    terminal_flip = (
-                        new_rank == 2
-                        and cur_rank == 2
-                        and new_state != cur.get("state")
-                        and ev.get("attempt", 0) <= cur.get("attempt", 0)
-                    )
-                    if regress or terminal_flip:
-                        ev = {k: v for k, v in ev.items() if k != "state"}
+                    new_attempt = ev.get("attempt", 0)
+                    cur_attempt = cur.get("attempt", 0)
+                    if new_attempt < cur_attempt:
+                        # an older attempt's event (late flush from a worker
+                        # the task was retried away from): its state/node/
+                        # worker describe the wrong attempt and must not
+                        # overwrite anything — but attempt-invariant fields
+                        # the record still lacks (name/type/job_id, carried
+                        # only by the owner's PENDING event) are kept
+                        for k, v in ev.items():
+                            if (
+                                k
+                                not in (
+                                    "state",
+                                    "attempt",
+                                    "error",
+                                    "ts",
+                                    "node_id",
+                                    "worker_pid",
+                                )
+                                and k not in cur
+                            ):
+                                cur[k] = v
+                        continue
+                    if new_attempt == cur_attempt:
+                        new_rank = self._TASK_STATE_RANK.get(new_state, 0)
+                        cur_rank = self._TASK_STATE_RANK.get(
+                            cur.get("state"), 0
+                        )
+                        regress = new_rank < cur_rank
+                        terminal_flip = (
+                            new_rank == 2
+                            and cur_rank == 2
+                            and new_state != cur.get("state")
+                        )
+                        if regress or terminal_flip:
+                            # same attempt, stale ordering (executor's
+                            # RUNNING flush landing after the owner's
+                            # terminal event): keep the terminal state but
+                            # merge the metadata only the executor knows
+                            # (node_id/worker_pid)
+                            ev = {
+                                k: v
+                                for k, v in ev.items()
+                                if k
+                                not in ("state", "attempt", "error", "ts")
+                            }
+                    # new_attempt > cur_attempt: newer attempt wins outright
                 cur.update(ev)
         return True
 

@@ -87,6 +87,7 @@ class Raylet:
         # spilled primary copies: object id -> file path (reference: N14)
         self._spilled: Dict[ObjectID, str] = {}
         self._restore_locks: Dict[ObjectID, asyncio.Lock] = {}
+        self._restore_lock_holds: Dict[ObjectID, int] = {}
         self._lease_seq = itertools.count()
         # scheduling-class FIFO queues of pending lease requests
         # (reference: scheduling classes, scheduling_class_util.h)
@@ -471,6 +472,9 @@ class Raylet:
         unserialized second restore would FileNotFoundError even though the
         object is now in the store."""
         lock = self._restore_locks.setdefault(object_id, asyncio.Lock())
+        self._restore_lock_holds[object_id] = (
+            self._restore_lock_holds.get(object_id, 0) + 1
+        )
         try:
             async with lock:
                 if self.store.contains(object_id):
@@ -495,8 +499,15 @@ class Raylet:
                     pass
                 return True
         finally:
-            if not lock.locked() and not getattr(lock, "_waiters", None):
+            # drop the per-object lock only when no other coroutine is
+            # holding or waiting on it, tracked with an explicit counter
+            # (asyncio.Lock has no public waiter count)
+            holds = self._restore_lock_holds.get(object_id, 1) - 1
+            if holds <= 0:
+                self._restore_lock_holds.pop(object_id, None)
                 self._restore_locks.pop(object_id, None)
+            else:
+                self._restore_lock_holds[object_id] = holds
 
     async def handle_store_seal(self, object_id: ObjectID, is_primary: bool = False):
         self.store.seal(object_id)
@@ -624,6 +635,11 @@ class Raylet:
                     if part is None:
                         break
                     data = part["data"]
+                    if not data:
+                        # peer returned an empty chunk (e.g. a concurrent
+                        # restore/re-spill rewrote the file under the read);
+                        # looping again with the same offset would busy-spin
+                        break
                     view[offset : offset + len(data)] = data
                     offset += len(data)
                 if offset >= total:

@@ -592,7 +592,7 @@ class CoreWorker:
         try:
             worker = self.client_pool.get(*worker_addr)
             reply: TaskReply = await worker.call(
-                "push_task", spec, timeout=None
+                "push_task", spec, attempt, timeout=None
             )
         except RpcError as e:
             worker_failed = True
@@ -869,13 +869,14 @@ class CoreWorker:
             self._function_cache[descriptor.function_hash] = fn
         return fn
 
-    async def _handle_push_task(self, spec: TaskSpec) -> TaskReply:
+    async def _handle_push_task(self, spec: TaskSpec, attempt: int = 0) -> TaskReply:
         """Execute a normal task and reply with its returns."""
         prev_task = self._current_task_id
         self._current_task_id = spec.task_id
         self.record_task_event(
-            spec.task_id, state="RUNNING", node_id=self.node_id.hex()
-            if self.node_id else "", worker_pid=os.getpid(),
+            spec.task_id, state="RUNNING", attempt=attempt,
+            node_id=self.node_id.hex() if self.node_id else "",
+            worker_pid=os.getpid(),
         )
         try:
             fn = await self._load_function(spec.function)
