@@ -71,6 +71,13 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> "ActorHandle":
         return self._remote(args, kwargs, self._options)
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy-actor DAG node (reference: actor.py bind ->
+        dag.ClassNode)."""
+        from .dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
     def _ensure_exported(self, worker) -> str:
         if self._pickled is None:
             self._pickled = serialization.dumps(self._cls)
@@ -144,6 +151,11 @@ class _BoundActorClass:
     def remote(self, *args, **kwargs) -> "ActorHandle":
         return self._base._remote(args, kwargs, self._options)
 
+    def bind(self, *args, **kwargs):
+        from .dag import ClassNode
+
+        return ClassNode(self._base, args, kwargs, options=self._options)
+
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, options: dict):
@@ -153,6 +165,15 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         return self._handle._submit(self._name, args, kwargs, self._options)
+
+    def bind(self, *args, **kwargs):
+        """Build a DAG node for this actor method (reference: actor.py
+        ActorMethod.bind -> dag.ClassMethodNode)."""
+        from .dag import ClassMethodNode
+
+        return ClassMethodNode(
+            None, self._handle, self._name, args, kwargs, options=self._options
+        )
 
     def options(self, **opts):
         return ActorMethod(self._handle, self._name, {**self._options, **opts})

@@ -1,0 +1,38 @@
+"""Compiled graphs (aDAG): lazy task/actor DAGs with a compiled fast path.
+
+Role-equivalent of the reference's ``ray.dag`` (python/ray/dag/dag_node.py,
+compiled_dag_node.py) and the channel layer under
+python/ray/experimental/channel/: ``.bind(...)`` builds a static graph,
+``execute()`` runs it through the normal task path, and
+``experimental_compile()`` pins each node to its actor and replaces per-call
+task submission with persistent execution loops connected by seq-ordered
+push channels (direct worker-to-worker RPC, no scheduler/GCS on the hot
+path). On TPU, device tensors annotated with ``TensorType(transport="xla")``
+move through a collective group instead of the host object path.
+"""
+
+from .dag_node import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+from .compiled import CompiledDAG, CompiledDAGRef
+from .communicator import Communicator, TensorType
+
+__all__ = [
+    "DAGNode",
+    "InputNode",
+    "InputAttributeNode",
+    "MultiOutputNode",
+    "FunctionNode",
+    "ClassNode",
+    "ClassMethodNode",
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "Communicator",
+    "TensorType",
+]

@@ -1,0 +1,120 @@
+"""Worker-side compiled-DAG execution loops.
+
+Role-equivalent of the reference's ExecutableTask loop
+(python/ray/dag/compiled_dag_node.py:478 ExecutableTask + the actor-resident
+``do_exec_tasks`` loop): each DAG node pinned to this actor becomes a
+persistent asyncio task that reads its input channels in order, invokes the
+bound method, and pushes the result downstream. Unlike the reference —
+where the compiled loop occupies the actor's main thread and blocks normal
+calls — loops here run on the worker's event loop, so the actor stays
+responsive to regular ``.remote()`` calls; sync methods still serialize
+through the actor's executor pool, preserving the single-threaded actor
+model.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List
+
+from .channel import STOP, ChannelClosed, DagError, ensure_channel_manager
+
+logger = logging.getLogger(__name__)
+
+# per-process: dag_id -> list[asyncio.Task]
+_dag_loops: Dict[int, List[asyncio.Task]] = {}
+_dag_channels: Dict[int, List[str]] = {}
+
+
+async def handle_dag_init(worker, instance, dag_id: int, plans: List[dict],
+                          buffer_size: int) -> bool:
+    """Install one execution loop per DAG node assigned to this actor."""
+    mgr = ensure_channel_manager(worker)
+    loops = _dag_loops.setdefault(dag_id, [])
+    chans = _dag_channels.setdefault(dag_id, [])
+    for plan in plans:
+        for _uuid, cid in plan["inputs"]:
+            mgr.ensure_queue(cid, buffer_size)
+            chans.append(cid)
+        loops.append(
+            asyncio.ensure_future(_node_loop(worker, instance, mgr, plan))
+        )
+    return True
+
+
+async def handle_dag_teardown(worker, instance, dag_id: int) -> bool:
+    for task in _dag_loops.pop(dag_id, []):
+        task.cancel()
+    mgr = ensure_channel_manager(worker)
+    for cid in _dag_channels.pop(dag_id, []):
+        mgr.close(cid)
+    return True
+
+
+async def _node_loop(worker, instance, mgr, plan: dict):
+    method = getattr(instance, plan["method"], None)
+    inputs: List = plan["inputs"]  # [(upstream_uuid, chan_id)]
+    outputs: List = plan["outputs"]  # [(reader_address, chan_id)]
+    seq = 0
+    try:
+        while True:
+            values: Dict[Any, Any] = {}
+            stopped = False
+            for upstream_uuid, cid in inputs:
+                try:
+                    values[upstream_uuid] = await mgr.read(cid)
+                except ChannelClosed:
+                    stopped = True
+                    break
+            if stopped:
+                await _fan_out(worker, mgr, outputs, -1, STOP)
+                return
+            result = await _run_node(worker, instance, method, plan, values)
+            await _fan_out(worker, mgr, outputs, seq, result)
+            seq += 1
+    except asyncio.CancelledError:
+        return
+    except Exception:
+        logger.exception("compiled-dag loop for %s crashed", plan["method"])
+
+
+async def _run_node(worker, instance, method, plan: dict, values: Dict):
+    # an upstream error short-circuits: forward it without executing
+    for v in values.values():
+        if isinstance(v, DagError):
+            return v
+    if method is None:
+        return DagError(
+            AttributeError(f"actor has no method {plan['method']!r}")
+        )
+    args = [
+        values[ref] if kind == "chan" else ref
+        for kind, ref in plan["args"]
+    ]
+    kwargs = {
+        k: (values[ref] if kind == "chan" else ref)
+        for k, (kind, ref) in plan["kwargs"].items()
+    }
+    try:
+        if asyncio.iscoroutinefunction(method):
+            return await method(*args, **kwargs)
+        return await worker.loop.run_in_executor(
+            worker._executor_pool, lambda: method(*args, **kwargs)
+        )
+    except Exception as e:  # noqa: BLE001 — user error travels in-band
+        return DagError(e)
+
+
+async def _fan_out(worker, mgr, outputs, seq: int, payload):
+    tasks = []
+    for reader_address, cid in outputs:
+        try:
+            tasks.append(await mgr.push_remote(reader_address, cid, seq, payload))
+        except Exception:
+            logger.exception("compiled-dag push to %s failed", cid)
+    for t in tasks:
+        try:
+            await t
+        except Exception:
+            logger.exception("compiled-dag push failed")

@@ -1,0 +1,132 @@
+"""Seq-ordered push channels for compiled DAGs.
+
+Role-equivalent of the reference's shared-memory channels
+(python/ray/experimental/channel/shared_memory_channel.py and
+common.ChannelInterface): a single-writer, bounded, ordered pipe between two
+workers. The reference implements them as mutable plasma objects with
+versioned reads; here a channel is a bounded asyncio queue on the reader's
+CoreWorker fed by direct worker-to-worker RPC pushes — the compiled fast
+path rides the persistent RPC connections and skips the scheduler, GCS, and
+object store entirely. Backpressure is the reader's bounded buffer: the
+``chan_push`` reply is withheld until the value is enqueued, and the writer
+caps unacknowledged pushes with a send window.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Tuple
+
+
+class ChannelClosed(Exception):
+    """Raised by reads/writes on a torn-down channel (reference:
+    experimental/channel/common.py ChannelInterface.close semantics)."""
+
+
+class _Stop:
+    """In-band teardown sentinel propagated through the graph."""
+
+    def __repr__(self):
+        return "<dag-stop>"
+
+
+STOP = _Stop()
+
+
+class DagError:
+    """Wrapper carrying a user exception through channels so one failed
+    execution poisons only its own result (reference:
+    compiled_dag_node.py exception propagation via RayTaskError)."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class ChannelManager:
+    """Per-CoreWorker registry of reader-side channel buffers plus the
+    writer-side push windows."""
+
+    def __init__(self, worker, default_buffer: int = 8):
+        self._worker = worker
+        self._default_buffer = default_buffer
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._closed: set = set()
+        # writer-side send windows: (chan_id) -> semaphore
+        self._windows: Dict[str, asyncio.Semaphore] = {}
+        self._window_size = default_buffer
+
+    # -- reader side ---------------------------------------------------------
+
+    def ensure_queue(self, chan_id: str, maxsize: int = 0) -> asyncio.Queue:
+        q = self._queues.get(chan_id)
+        if q is None:
+            q = asyncio.Queue(maxsize=maxsize or self._default_buffer)
+            self._queues[chan_id] = q
+        return q
+
+    async def handle_push(self, chan_id: str, seq: int, payload: Any) -> bool:
+        """RPC handler: block until buffered (backpressure travels to the
+        writer as a delayed reply)."""
+        if chan_id in self._closed:
+            raise ChannelClosed(chan_id)
+        await self.ensure_queue(chan_id).put((seq, payload))
+        return True
+
+    async def read(self, chan_id: str) -> Any:
+        if chan_id in self._closed:
+            raise ChannelClosed(chan_id)
+        seq, payload = await self.ensure_queue(chan_id).get()
+        if isinstance(payload, _Stop):
+            raise ChannelClosed(chan_id)
+        return payload
+
+    def close(self, chan_id: str):
+        self._closed.add(chan_id)
+        q = self._queues.pop(chan_id, None)
+        if q is not None:
+            # wake parked readers
+            try:
+                q.put_nowait((-1, STOP))
+            except asyncio.QueueFull:
+                pass
+
+    def close_all(self):
+        for chan_id in list(self._queues):
+            self.close(chan_id)
+
+    # -- writer side ----------------------------------------------------------
+
+    async def push_remote(
+        self, reader_address: Tuple[str, int], chan_id: str, seq: int, payload: Any
+    ):
+        """Send one value to a reader. Pushes on one channel are pipelined up
+        to the send window; frame order over the persistent connection plus
+        the reader's FIFO buffer preserve seq order."""
+        window = self._windows.get(chan_id)
+        if window is None:
+            window = asyncio.Semaphore(self._window_size)
+            self._windows[chan_id] = window
+        await window.acquire()
+        client = self._worker.client_pool.get(*reader_address)
+
+        async def _push():
+            try:
+                await client.call("chan_push", chan_id, seq, payload, timeout=None)
+            finally:
+                window.release()
+
+        # fire pipelined; caller may await the returned task for a barrier
+        return asyncio.ensure_future(_push())
+
+
+def ensure_channel_manager(worker) -> ChannelManager:
+    """Attach a ChannelManager to a CoreWorker (driver or executor) and
+    register its RPC surface, idempotently."""
+    mgr = getattr(worker, "_channel_manager", None)
+    if mgr is None:
+        mgr = ChannelManager(worker)
+        worker._channel_manager = mgr
+        worker.server.register("chan_push", mgr.handle_push)
+    return mgr

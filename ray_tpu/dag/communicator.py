@@ -1,0 +1,88 @@
+"""Tensor-transport annotations and the Communicator ABC.
+
+Role-equivalent of the reference's Communicator ABC
+(python/ray/experimental/channel/communicator.py:18) and TorchTensorType
+(experimental/channel/torch_tensor_type.py). The reference moves GPU tensors
+between compiled-graph actors over NCCL; the TPU-native counterpart routes
+device arrays through a ``ray_tpu.collective`` group (XLA collectives over
+ICI) so the bytes never bounce through host plasma. Host transport
+(``object_store``) is the default and always correct — channel payloads ride
+the serialization layer, which handles jax.Array via host DMA.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+
+class TensorType:
+    """Per-node output annotation selecting the tensor transport
+    (reference: TorchTensorType; here transports are "object_store" and
+    "xla")."""
+
+    OBJECT_STORE = "object_store"
+    XLA = "xla"
+
+    def __init__(self, transport: str = OBJECT_STORE):
+        if transport not in (self.OBJECT_STORE, self.XLA):
+            raise ValueError(f"unknown tensor transport {transport!r}")
+        self.transport = transport
+
+
+class Communicator(abc.ABC):
+    """Peer-to-peer + collective surface used by channels to move device
+    tensors (reference: communicator.py:18 — send/recv/allreduce plus
+    rank/world introspection)."""
+
+    @abc.abstractmethod
+    def get_rank(self) -> int: ...
+
+    @abc.abstractmethod
+    def get_world_size(self) -> int: ...
+
+    @abc.abstractmethod
+    def send(self, tensor: Any, peer_rank: int) -> None: ...
+
+    @abc.abstractmethod
+    def recv(self, shape, dtype, peer_rank: int) -> Any: ...
+
+    @abc.abstractmethod
+    def allreduce(self, tensor: Any, op: str = "sum") -> Any: ...
+
+    def destroy(self) -> None:
+        pass
+
+
+class CollectiveCommunicator(Communicator):
+    """Communicator backed by a ``ray_tpu.collective`` group (XLA over ICI
+    on TPU, the CPU ring group in tests) — the equivalent of the
+    reference's _NcclGroup (experimental/channel/nccl_group.py:21)."""
+
+    def __init__(self, group_name: str = "default"):
+        from .. import collective
+
+        self._collective = collective
+        self._group_name = group_name
+
+    def _group(self):
+        return self._collective.get_group(self._group_name)
+
+    def get_rank(self) -> int:
+        return self._group().rank
+
+    def get_world_size(self) -> int:
+        return self._group().world_size
+
+    def send(self, tensor, peer_rank: int):
+        self._collective.send(tensor, peer_rank, self._group_name)
+
+    def recv(self, shape, dtype, peer_rank: int):
+        return self._collective.recv(peer_rank, self._group_name)
+
+    def allreduce(self, tensor, op: str = "sum"):
+        from ..collective import ReduceOp
+
+        return self._collective.allreduce(
+            tensor, self._group_name, op=ReduceOp(op)
+        )

@@ -1,0 +1,349 @@
+"""CompiledDAG: driver-side compilation and execution.
+
+Role-equivalent of the reference's CompiledDAG
+(python/ray/dag/compiled_dag_node.py:805): validates that every computation
+node is an actor method, allocates one channel per graph edge, installs a
+persistent execution loop on each participating actor (worker side:
+dag/_worker.py), and then drives executions by pushing inputs and reading
+result channels — no per-call task submission.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import _worker_api
+from .channel import STOP, ChannelClosed, DagError, ensure_channel_manager
+from .dag_node import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+    _DAGInputData,
+)
+
+_dag_counter = itertools.count()
+
+
+class _NodePlan:
+    """Per-ClassMethodNode compiled form shipped to its actor."""
+
+    __slots__ = (
+        "node_uuid",
+        "method_name",
+        "arg_template",
+        "kwarg_template",
+        "input_chans",
+        "outputs",
+    )
+
+    def __init__(self, node_uuid, method_name):
+        self.node_uuid = node_uuid
+        self.method_name = method_name
+        # templates: ("const", value) | ("chan", upstream_uuid)
+        self.arg_template: List[tuple] = []
+        self.kwarg_template: Dict[str, tuple] = {}
+        # ordered upstream reads: [(upstream_uuid, chan_id)]
+        self.input_chans: List[Tuple[int, str]] = []
+        # [(reader_address, chan_id)]
+        self.outputs: List[Tuple[Tuple[str, int], str]] = []
+
+
+class CompiledDAGRef:
+    """Future for one compiled execution (reference:
+    compiled_dag_ref.py CompiledDAGRef)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._consumed = False
+
+    def get(self, timeout: Optional[float] = None):
+        if self._consumed:
+            raise ValueError("CompiledDAGRef can only be consumed once")
+        self._consumed = True
+        return self._dag._fetch_result(self._seq, timeout)
+
+    def __repr__(self):
+        return f"CompiledDAGRef(seq={self._seq})"
+
+
+class CompiledDAG:
+    def __init__(self, max_inflight: int, buffer_size: int):
+        self.dag_id = next(_dag_counter)
+        self._max_inflight = max_inflight
+        self._buffer_size = buffer_size
+        self._worker = None
+        self._chanmgr = None
+        # input edges: [(actor_address, chan_id, projection_key | None)]
+        self._input_edges: List[tuple] = []
+        # result channels in output order: [chan_id]
+        self._result_chans: List[str] = []
+        self._multi_output = False
+        self._actors: List = []  # ActorHandles participating
+        self._seq = 0
+        self._results: Dict[int, Any] = {}
+        self._next_result_seq = 0
+        self._lock = threading.Lock()
+        self._torn_down = False
+
+    # -- execution ----------------------------------------------------------
+
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("CompiledDAG has been torn down")
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            if seq - self._next_result_seq >= self._max_inflight:
+                raise RuntimeError(
+                    f"too many in-flight executions (>{self._max_inflight}); "
+                    "consume results with .get() before submitting more"
+                )
+        input_data = _DAGInputData.from_call(args, kwargs)
+        _worker_api.run_on_worker_loop(self._push_inputs(seq, input_data))
+        return CompiledDAGRef(self, seq)
+
+    async def _push_inputs(self, seq: int, input_data: _DAGInputData):
+        tasks = []
+        for address, chan_id, key in self._input_edges:
+            value = (
+                input_data.root_value() if key is None else input_data.project(key)
+            )
+            tasks.append(
+                await self._chanmgr.push_remote(address, chan_id, seq, value)
+            )
+        # waiting for the pipelined pushes keeps execute() backpressured
+        for t in tasks:
+            await t
+
+    def _fetch_result(self, seq: int, timeout: Optional[float]):
+        value = _worker_api.run_on_worker_loop(self._read_until(seq), timeout)
+        if isinstance(value, DagError):
+            raise value.exc
+        if self._multi_output:
+            out = []
+            for v in value:
+                if isinstance(v, DagError):
+                    raise v.exc
+                out.append(v)
+            return out
+        return value
+
+    async def _read_until(self, seq: int):
+        while seq not in self._results:
+            vals = []
+            for chan_id in self._result_chans:
+                vals.append(await self._chanmgr.read(chan_id))
+            got = self._next_result_seq
+            self._next_result_seq += 1
+            self._results[got] = vals if self._multi_output else vals[0]
+        return self._results.pop(seq)
+
+    # -- teardown -----------------------------------------------------------
+
+    def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        if not _worker_api.is_initialized():
+            return
+
+        async def _stop():
+            for address, chan_id, _key in self._input_edges:
+                try:
+                    t = await self._chanmgr.push_remote(address, chan_id, -1, STOP)
+                    await t
+                except Exception:
+                    pass
+            self._chanmgr.close_all()
+
+        try:
+            _worker_api.run_on_worker_loop(_stop(), timeout=10.0)
+        except Exception:
+            pass
+        from ..actor import ActorMethod
+
+        for actor in self._actors:
+            try:
+                ActorMethod(actor, "__ray_dag_teardown__", {}).remote(self.dag_id)
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+
+def compile_dag(root: DAGNode, max_inflight: int, buffer_size: int) -> CompiledDAG:
+    """Validate + lower a bound DAG (reference: compiled_dag_node.py
+    build_compiled_dag / _preprocess)."""
+    worker = _worker_api.get_core_worker()
+    dag = CompiledDAG(max_inflight, buffer_size)
+    dag._worker = worker
+    dag._chanmgr = ensure_channel_manager(worker)
+
+    nodes = root._walk()
+    input_nodes = [n for n in nodes if type(n) is InputNode]
+    if len(input_nodes) > 1:
+        raise ValueError("compiled DAGs take at most one InputNode")
+
+    # Materialize lazy ClassNode actors through the interpreted path.
+    cache: Dict[int, Any] = {}
+    for node in nodes:
+        if isinstance(node, ClassNode):
+            cache[node._stable_uuid] = node._execute_impl(cache, None)
+        elif isinstance(node, FunctionNode):
+            raise ValueError(
+                "compiled DAGs support actor methods only; FunctionNode "
+                f"'{node._remote_function.__name__}' cannot be compiled "
+                "(reference: compiled graphs require actor-bound nodes)"
+            )
+
+    method_nodes = [n for n in nodes if isinstance(n, ClassMethodNode)]
+    if not method_nodes:
+        raise ValueError("compiled DAG contains no actor method nodes")
+
+    output_node = nodes[-1]
+    leaves = (
+        list(output_node._bound_args)
+        if isinstance(output_node, MultiOutputNode)
+        else [output_node]
+    )
+    for leaf in leaves:
+        if not isinstance(leaf, ClassMethodNode):
+            raise ValueError("compiled DAG outputs must be actor method nodes")
+    dag._multi_output = isinstance(output_node, MultiOutputNode)
+
+    # Resolve actor handle + worker address per method node.
+    handles: Dict[int, Any] = {}
+    addresses: Dict[int, Tuple[str, int]] = {}
+    for node in method_nodes:
+        handle = node._actor(cache)
+        handles[node._stable_uuid] = handle
+        addresses[node._stable_uuid] = _actor_address(worker, handle)
+
+    driver_address = worker.address
+    plans: Dict[int, _NodePlan] = {}  # keyed by node uuid
+    plan_owner: Dict[int, Any] = {}  # node uuid -> handle
+
+    def chan_name(writer_uuid, reader_uuid) -> str:
+        return f"dag{dag.dag_id}:{writer_uuid}->{reader_uuid}"
+
+    for node in method_nodes:
+        plan = _NodePlan(node._stable_uuid, node._method_name)
+        seen_upstream: Dict[int, str] = {}
+
+        def template_entry(arg):
+            if isinstance(arg, ClassMethodNode):
+                cid = seen_upstream.get(arg._stable_uuid)
+                if cid is None:
+                    cid = chan_name(arg._stable_uuid, node._stable_uuid)
+                    seen_upstream[arg._stable_uuid] = cid
+                    plan.input_chans.append((arg._stable_uuid, cid))
+                    # register as an output edge of the upstream plan later
+                return ("chan", arg._stable_uuid)
+            if isinstance(arg, (InputNode, InputAttributeNode)):
+                cid = seen_upstream.get(arg._stable_uuid)
+                if cid is None:
+                    cid = chan_name("in", node._stable_uuid) + f":{arg._stable_uuid}"
+                    seen_upstream[arg._stable_uuid] = cid
+                    plan.input_chans.append((arg._stable_uuid, cid))
+                    key = arg._key if isinstance(arg, InputAttributeNode) else None
+                    dag._input_edges.append(
+                        (addresses[node._stable_uuid], cid, key)
+                    )
+                return ("chan", arg._stable_uuid)
+            if isinstance(arg, DAGNode):
+                raise ValueError(f"cannot compile arg node {type(arg).__name__}")
+            return ("const", arg)
+
+        for arg in node._call_args:
+            plan.arg_template.append(template_entry(arg))
+        for k, v in node._bound_kwargs.items():
+            plan.kwarg_template[k] = template_entry(v)
+        if not plan.input_chans:
+            raise ValueError(
+                f"compiled node {node._method_name!r} has no upstream edges; "
+                "compiled DAGs must be driven from an InputNode (a node with "
+                "no inputs would run unsynchronized)"
+            )
+        plans[node._stable_uuid] = plan
+        plan_owner[node._stable_uuid] = handles[node._stable_uuid]
+
+    # Wire actor-to-actor output edges.
+    for node in method_nodes:
+        plan = plans[node._stable_uuid]
+        for upstream_uuid, cid in plan.input_chans:
+            upstream_plan = plans.get(upstream_uuid)
+            if upstream_plan is not None:
+                upstream_plan.outputs.append(
+                    (addresses[node._stable_uuid], cid)
+                )
+
+    # Wire leaf -> driver result channels (one per leaf, fan-out safe).
+    for i, leaf in enumerate(leaves):
+        cid = f"dag{dag.dag_id}:out{i}"
+        plans[leaf._stable_uuid].outputs.append((driver_address, cid))
+        dag._result_chans.append(cid)
+        dag._chanmgr.ensure_queue(cid, buffer_size)
+
+    # Group plans per actor and install loops.
+    per_actor: Dict[Any, List[_NodePlan]] = {}
+    actor_of: Dict[int, Any] = {}
+    for uuid, handle in plan_owner.items():
+        per_actor.setdefault(id(handle), []).append(plans[uuid])
+        actor_of[id(handle)] = handle
+
+    init_refs = []
+    for key, actor_plans in per_actor.items():
+        handle = actor_of[key]
+        dag._actors.append(handle)
+        payload = [
+            {
+                "node_uuid": p.node_uuid,
+                "method": p.method_name,
+                "args": p.arg_template,
+                "kwargs": p.kwarg_template,
+                "inputs": p.input_chans,
+                "outputs": p.outputs,
+            }
+            for p in actor_plans
+        ]
+        from ..actor import ActorMethod
+
+        init_refs.append(
+            ActorMethod(handle, "__ray_dag_init__", {}).remote(
+                dag.dag_id, payload, buffer_size
+            )
+        )
+    from ..api import get
+
+    get(init_refs)
+    return dag
+
+
+def _actor_address(worker, handle) -> Tuple[str, int]:
+    """Resolve an actor's worker RPC address through the GCS."""
+    import time as _time
+
+    from .._internal.protocol import ActorState
+
+    deadline = _time.monotonic() + 60.0
+    while _time.monotonic() < deadline:
+        info = _worker_api.run_on_worker_loop(
+            worker.client_pool.get(*worker.gcs_address).call(
+                "get_actor", handle._actor_id
+            )
+        )
+        if info is not None and info.state == ActorState.ALIVE and info.address:
+            return tuple(info.address)
+        _time.sleep(0.05)
+    raise TimeoutError(f"actor {handle} did not become ALIVE for compilation")

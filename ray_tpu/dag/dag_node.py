@@ -1,0 +1,279 @@
+"""DAG node types and the interpreted execution path.
+
+Reference: python/ray/dag/dag_node.py (DAGNode base, :279
+experimental_compile), function_node.py, class_node.py, input_node.py
+(InputNode/InputAttributeNode), output_node.py (MultiOutputNode). The bind
+API mirrors the reference exactly: ``fn.bind(...)``, ``ActorClass.bind(...)``,
+``handle.method.bind(...)``, with ``InputNode`` as the runtime-argument
+placeholder.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+_node_counter = itertools.count()
+
+
+class DAGNode:
+    """One vertex of a lazily-built task graph. Bound args may contain other
+    DAGNodes; ``execute`` resolves the graph through ordinary ``.remote``
+    calls while ``experimental_compile`` lowers it to channel loops."""
+
+    def __init__(self, bound_args: tuple, bound_kwargs: dict):
+        self._bound_args = bound_args
+        self._bound_kwargs = bound_kwargs
+        self._stable_uuid = next(_node_counter)
+
+    # -- graph introspection ------------------------------------------------
+
+    def _upstream_nodes(self) -> List["DAGNode"]:
+        """Direct DAGNode dependencies, in bound-arg order (deduplicated)."""
+        seen = {}
+        for arg in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(arg, DAGNode) and arg._stable_uuid not in seen:
+                seen[arg._stable_uuid] = arg
+        return list(seen.values())
+
+    def _walk(self) -> List["DAGNode"]:
+        """All nodes reachable from this one, topologically sorted
+        (dependencies first)."""
+        order: List[DAGNode] = []
+        seen = set()
+
+        def visit(node: DAGNode):
+            if node._stable_uuid in seen:
+                return
+            seen.add(node._stable_uuid)
+            for dep in node._upstream_nodes():
+                visit(dep)
+            order.append(node)
+
+        visit(self)
+        return order
+
+    # -- execution ----------------------------------------------------------
+
+    def execute(self, *args, **kwargs):
+        """Interpreted execution: resolve every node through the normal task
+        path, passing ObjectRefs straight through as downstream args
+        (reference: dag_node.py DAGNode.execute)."""
+        input_value = _DAGInputData.from_call(args, kwargs)
+        cache: Dict[int, Any] = {}
+        result = None
+        for node in self._walk():
+            result = node._execute_impl(cache, input_value)
+            cache[node._stable_uuid] = result
+        return result
+
+    def _resolve_args(self, cache) -> Tuple[tuple, dict]:
+        args = tuple(
+            cache[a._stable_uuid] if isinstance(a, DAGNode) else a
+            for a in self._bound_args
+        )
+        kwargs = {
+            k: cache[v._stable_uuid] if isinstance(v, DAGNode) else v
+            for k, v in self._bound_kwargs.items()
+        }
+        return args, kwargs
+
+    def _execute_impl(self, cache, input_value):
+        raise NotImplementedError
+
+    def experimental_compile(
+        self,
+        _max_inflight_executions: int = 10,
+        _buffer_size: int = 8,
+    ) -> "CompiledDAG":
+        """Lower the DAG to persistent per-actor loops joined by channels
+        (reference: dag_node.py:279 -> compiled_dag_node.py:805)."""
+        from .compiled import compile_dag
+
+        return compile_dag(
+            self,
+            max_inflight=_max_inflight_executions,
+            buffer_size=_buffer_size,
+        )
+
+    def with_tensor_transport(self, transport: str = "object_store"):
+        """Annotate this node's output tensor transport (reference:
+        experimental/channel/torch_tensor_type.py used via
+        with_tensor_transport)."""
+        from .communicator import TensorType
+
+        self._tensor_type = TensorType(transport=transport)
+        return self
+
+
+class _DAGInputData:
+    """The value fed to InputNode for one execution; supports attribute and
+    key projection for InputAttributeNode (reference: input_node.py:~DAGInputData)."""
+
+    __slots__ = ("args", "kwargs", "single")
+
+    def __init__(self, args: tuple, kwargs: dict):
+        self.args = args
+        self.kwargs = kwargs
+        self.single = len(args) == 1 and not kwargs
+
+    @classmethod
+    def from_call(cls, args, kwargs):
+        return cls(tuple(args), dict(kwargs))
+
+    def root_value(self):
+        if self.single:
+            return self.args[0]
+        return self
+
+    def project(self, key):
+        if isinstance(key, int) and not self.kwargs:
+            return self.args[key]
+        if key in self.kwargs:
+            return self.kwargs[key]
+        if self.single:
+            value = self.args[0]
+            if isinstance(key, str) and hasattr(value, key):
+                return getattr(value, key)
+            return value[key]
+        return self.args[key]
+
+
+class InputNode(DAGNode):
+    """Placeholder for the runtime argument of ``execute`` (reference:
+    input_node.py InputNode; used as ``with InputNode() as inp:``)."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __getattr__(self, key):
+        if key.startswith("_"):
+            raise AttributeError(key)
+        return InputAttributeNode(self, key)
+
+    def __getitem__(self, key):
+        return InputAttributeNode(self, key)
+
+    def _execute_impl(self, cache, input_value: _DAGInputData):
+        return input_value.root_value()
+
+
+class InputAttributeNode(DAGNode):
+    """Projection of the input: ``inp.x`` / ``inp[0]`` (reference:
+    input_node.py InputAttributeNode)."""
+
+    def __init__(self, input_node: InputNode, key):
+        super().__init__((input_node,), {})
+        self._key = key
+
+    def _execute_impl(self, cache, input_value: _DAGInputData):
+        return input_value.project(self._key)
+
+
+class FunctionNode(DAGNode):
+    """``remote_fn.bind(...)`` (reference: function_node.py). Only valid on
+    the interpreted path; compiled DAGs require actor methods."""
+
+    def __init__(self, remote_function, args, kwargs, options=None):
+        super().__init__(args, kwargs)
+        self._remote_function = remote_function
+        self._options = options or {}
+
+    def _execute_impl(self, cache, input_value):
+        args, kwargs = self._resolve_args(cache)
+        fn = self._remote_function
+        if self._options:
+            fn = fn.options(**self._options)
+        return fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """``ActorClass.bind(...)``: lazily-created actor (reference:
+    class_node.py ClassNode). Method binds hang off it; at execution the
+    actor is created once and cached on the node."""
+
+    def __init__(self, actor_class, args, kwargs, options=None):
+        super().__init__(args, kwargs)
+        self._actor_class = actor_class
+        self._options = options or {}
+        self._cached_handle = None
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _UnboundClassMethod(self, name)
+
+    def _execute_impl(self, cache, input_value):
+        if self._cached_handle is None:
+            args, kwargs = self._resolve_args(cache)
+            cls = self._actor_class
+            if self._options:
+                cls = cls.options(**self._options)
+            self._cached_handle = cls.remote(*args, **kwargs)
+        return self._cached_handle
+
+
+class _UnboundClassMethod:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(
+            self._class_node, None, self._method_name, args, kwargs
+        )
+
+
+class ClassMethodNode(DAGNode):
+    """``handle.method.bind(...)`` (reference: class_node.py
+    ClassMethodNode). ``parent`` is either a ClassNode (lazy actor) or an
+    existing ActorHandle."""
+
+    def __init__(self, class_node, actor_handle, method_name, args, kwargs,
+                 options=None):
+        deps = args
+        if class_node is not None:
+            deps = (class_node,) + tuple(args)
+        super().__init__(tuple(deps), kwargs)
+        self._class_node = class_node
+        self._actor_handle = actor_handle
+        self._method_name = method_name
+        self._options = dict(options or {})
+        # the actual call args exclude the class-node dependency
+        self._call_args = tuple(args)
+
+    def _actor(self, cache):
+        if self._actor_handle is not None:
+            return self._actor_handle
+        return cache[self._class_node._stable_uuid]
+
+    def _execute_impl(self, cache, input_value):
+        actor = self._actor(cache)
+        args = tuple(
+            cache[a._stable_uuid] if isinstance(a, DAGNode) else a
+            for a in self._call_args
+        )
+        kwargs = {
+            k: cache[v._stable_uuid] if isinstance(v, DAGNode) else v
+            for k, v in self._bound_kwargs.items()
+        }
+        bound = getattr(actor, self._method_name)
+        if self._options:
+            bound = bound.options(**self._options)
+        return bound.remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Terminal node returning several leaves (reference: output_node.py)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+
+    def _execute_impl(self, cache, input_value):
+        return [cache[n._stable_uuid] for n in self._bound_args]

@@ -88,6 +88,13 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, self._options)
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of executing (reference:
+        remote_function.py bind -> dag.FunctionNode)."""
+        from .dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     # -- internals ---------------------------------------------------------
 
     def _ensure_exported(self, worker) -> str:
@@ -155,9 +162,15 @@ class _BoundRemoteFunction:
     def __init__(self, base: RemoteFunction, options: Dict[str, Any]):
         self._base = base
         self._options = options
+        self.__name__ = base.__name__
 
     def remote(self, *args, **kwargs):
         return self._base._remote(args, kwargs, self._options)
+
+    def bind(self, *args, **kwargs):
+        from .dag import FunctionNode
+
+        return FunctionNode(self._base, args, kwargs, options=self._options)
 
 
 def make_remote_function(function, **task_options) -> RemoteFunction:

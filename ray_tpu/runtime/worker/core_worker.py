@@ -1029,6 +1029,22 @@ class CoreWorker:
     async def _execute_actor_task(self, spec: TaskSpec) -> TaskReply:
         if self._actor_instance is None:
             return self._error_reply(spec, RuntimeError("actor not initialized"))
+        if spec.function.qualname in ("__ray_dag_init__", "__ray_dag_teardown__"):
+            # compiled-graph loop install/teardown (reference: the
+            # actor-resident do_exec_tasks loop, dag/compiled_dag_node.py)
+            from ...dag import _worker as dag_worker
+
+            args, kwargs = await self._unflatten(spec)
+            handler = (
+                dag_worker.handle_dag_init
+                if spec.function.qualname == "__ray_dag_init__"
+                else dag_worker.handle_dag_teardown
+            )
+            try:
+                result = await handler(self, self._actor_instance, *args, **kwargs)
+            except Exception as e:  # noqa: BLE001
+                return self._error_reply(spec, e)
+            return await self._build_reply(spec, result)
         if spec.function.qualname == "__init_collective__":
             # declarative collective group setup (collective.create_collective_group)
             from ...collective import init_collective_group

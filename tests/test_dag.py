@@ -1,0 +1,164 @@
+"""Compiled graphs (ray_tpu.dag) tests.
+
+Models the reference's python/ray/dag/tests/experimental coverage: bind API,
+interpreted execute, compile, multi-execution pipelining, multi-output,
+actor-to-actor edges, error propagation, and teardown.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, bias=0):
+        self.bias = bias
+
+    def add(self, x, y=0):
+        return x + y + self.bias
+
+    def boom(self, x):
+        raise ValueError(f"boom {x}")
+
+    def echo(self, x):
+        return x
+
+
+@ray_tpu.remote
+def double(x):
+    return 2 * x
+
+
+def test_interpreted_function_dag(ray_start_regular):
+    with InputNode() as inp:
+        dag = double.bind(double.bind(inp))
+    assert ray_tpu.get(dag.execute(3)) == 12
+
+
+def test_interpreted_actor_dag(ray_start_regular):
+    a = Adder.remote(10)
+    with InputNode() as inp:
+        dag = a.add.bind(inp, 5)
+    assert ray_tpu.get(dag.execute(1)) == 16
+
+
+def test_interpreted_class_node(ray_start_regular):
+    with InputNode() as inp:
+        node = Adder.bind(100)
+        dag = node.add.bind(inp)
+    assert ray_tpu.get(dag.execute(1)) == 101
+    # the lazy actor is cached across executions
+    assert ray_tpu.get(dag.execute(2)) == 102
+
+
+def test_compiled_single_actor(ray_start_regular):
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(41).get() == 42
+        assert compiled.execute(-1).get() == 0
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_pipelined_executions(ray_start_regular):
+    a = Adder.remote()
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        refs = [compiled.execute(i) for i in range(8)]
+        assert [r.get() for r in refs] == list(range(8))
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_actor_chain(ray_start_regular):
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(0).get() == 11
+        assert compiled.execute(5).get() == 16
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_multi_output(ray_start_regular):
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.add.bind(inp), b.add.bind(inp)])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(10).get() == [11, 12]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_input_attribute(ray_start_regular):
+    a = Adder.remote()
+    with InputNode() as inp:
+        dag = a.add.bind(inp[0], inp[1])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(3, 4).get() == 7
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_error_propagation(ray_start_regular):
+    a = Adder.remote()
+    b = Adder.remote()
+    with InputNode() as inp:
+        dag = b.echo.bind(a.boom.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="boom 1"):
+            compiled.execute(1).get()
+        # the pipeline survives a failed execution
+        with pytest.raises(ValueError, match="boom 2"):
+            compiled.execute(2).get()
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_actor_still_callable(ray_start_regular):
+    """Unlike the reference, normal .remote() calls keep working while a
+    compiled loop is installed."""
+    a = Adder.remote(5)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(1).get() == 6
+        assert ray_tpu.get(a.add.remote(10)) == 15
+    finally:
+        compiled.teardown()
+
+
+def test_compile_rejects_function_nodes(ray_start_regular):
+    with InputNode() as inp:
+        dag = double.bind(inp)
+    with pytest.raises(ValueError, match="actor method"):
+        dag.experimental_compile()
+
+
+def test_ref_single_consumption(ray_start_regular):
+    a = Adder.remote()
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        ref = compiled.execute(1)
+        assert ref.get() == 1
+        with pytest.raises(ValueError):
+            ref.get()
+    finally:
+        compiled.teardown()
