@@ -1,0 +1,28 @@
+"""Autoscaler v2: demand-driven cluster scaling.
+
+Role-equivalent of the reference's autoscaler v2
+(python/ray/autoscaler/v2/): a head-side monitor polls the GCS for the
+cluster resource state (nodes + pending demands + pending placement
+groups), a resource scheduler bin-packs the unmet demand onto configured
+node types, and an instance manager reconciles the desired node set through
+a pluggable NodeProvider. TPU twist: node types are slice-granular — a
+"v5e-8" node type carries the whole host's chips and its slice labels, so
+gang demands (placement groups with TPU bundles) scale whole ICI-connected
+slices instead of individual VMs.
+"""
+
+from .config import NodeTypeConfig, AutoscalingConfig
+from .node_provider import NodeProvider, FakeMultiNodeProvider
+from .scheduler import ResourceScheduler, SchedulingDecision
+from .autoscaler import Autoscaler, AutoscalerMonitor
+
+__all__ = [
+    "NodeTypeConfig",
+    "AutoscalingConfig",
+    "NodeProvider",
+    "FakeMultiNodeProvider",
+    "ResourceScheduler",
+    "SchedulingDecision",
+    "Autoscaler",
+    "AutoscalerMonitor",
+]

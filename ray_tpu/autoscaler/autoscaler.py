@@ -1,0 +1,175 @@
+"""The autoscaler reconcile loop.
+
+Role-equivalent of the reference's Autoscaler + Reconciler + monitor
+process (python/ray/autoscaler/v2/autoscaler.py:47 update_autoscaling_state,
+v2/monitor.py:53 AutoscalerMonitor, v2/instance_manager/reconciler.py):
+every tick it pulls GetClusterResourceState from the GCS, asks the
+ResourceScheduler what to launch, enforces min/max workers, terminates
+nodes idle past the timeout, and reports its state back to the GCS for
+observability (ReportAutoscalingState, autoscaler.proto:199).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from .config import AutoscalingConfig
+from .node_provider import NodeProvider
+from .scheduler import ResourceScheduler
+
+logger = logging.getLogger(__name__)
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        config: AutoscalingConfig,
+        provider: NodeProvider,
+        gcs_call,
+    ):
+        """``gcs_call(method, *args)`` is a sync bridge to GCS RPC — the
+        monitor supplies one bound to the head's address."""
+        self._config = config
+        self._provider = provider
+        self._gcs_call = gcs_call
+        self._scheduler = ResourceScheduler(config)
+        self._idle_since: Dict[str, float] = {}  # instance_id -> ts
+
+    def update(self) -> dict:
+        """One reconcile tick (reference: autoscaler.py:169
+        update_autoscaling_state)."""
+        state = self._gcs_call("get_cluster_resource_state")
+        instances = self._provider.non_terminated_nodes()
+        counts: Dict[str, int] = {}
+        for inst in instances:
+            counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
+
+        # enforce min_workers
+        launches: Dict[str, int] = {}
+        for t in self._config.node_types:
+            deficit = t.min_workers - counts.get(t.name, 0)
+            if deficit > 0:
+                launches[t.name] = deficit
+
+        decision = self._scheduler.schedule(
+            state, {**counts, **launches}
+        )
+        for name, n in decision.launches.items():
+            launches[name] = launches.get(name, 0) + n
+
+        launched = []
+        for name, n in launches.items():
+            for _ in range(n):
+                try:
+                    inst = self._provider.create_node(name)
+                    launched.append(inst.instance_id)
+                except Exception:
+                    logger.exception("launch of %s failed", name)
+
+        terminated = self._terminate_idle(state, instances, counts)
+
+        report = {
+            "ts": time.time(),
+            "launches": launches,
+            "launched": launched,
+            "terminated": terminated,
+            "infeasible": decision.infeasible,
+            "node_count": len(instances) + len(launched) - len(terminated),
+        }
+        try:
+            self._gcs_call("report_autoscaling_state", report)
+        except Exception:
+            pass
+        return report
+
+    def _terminate_idle(self, state, instances, counts) -> list:
+        """Scale down nodes idle past the timeout, respecting min_workers
+        (reference: instance_manager termination for idle nodes)."""
+        now = time.time()
+        # idle = all resources available == total (nothing running/leased)
+        idle_node_ids = set()
+        for node in state.get("nodes", []):
+            if not node.get("alive") or node.get("is_head"):
+                continue
+            total = node.get("resources_total", {})
+            avail = node.get("available", {})
+            if total and all(
+                abs(avail.get(k, 0.0) - v) < 1e-9 for k, v in total.items()
+            ):
+                idle_node_ids.add(node["node_id"])
+
+        terminated = []
+        for inst in instances:
+            node_id = getattr(self._provider, "node_id_of", lambda _i: None)(
+                inst.instance_id
+            )
+            if node_id is None or node_id not in idle_node_ids:
+                self._idle_since.pop(inst.instance_id, None)
+                continue
+            since = self._idle_since.setdefault(inst.instance_id, now)
+            if now - since < self._config.idle_timeout_s:
+                continue
+            node_type = self._config.type_by_name(inst.node_type)
+            if (
+                node_type is not None
+                and counts.get(inst.node_type, 0) <= node_type.min_workers
+            ):
+                continue
+            try:
+                self._provider.terminate_node(inst.instance_id)
+                counts[inst.node_type] = counts.get(inst.node_type, 1) - 1
+                terminated.append(inst.instance_id)
+                self._idle_since.pop(inst.instance_id, None)
+            except Exception:
+                logger.exception("terminate of %s failed", inst.instance_id)
+        return terminated
+
+
+class AutoscalerMonitor:
+    """Background thread running the reconcile loop against a live GCS
+    (reference: v2/monitor.py:53 — the head-node monitor process)."""
+
+    def __init__(self, config: AutoscalingConfig, provider: NodeProvider,
+                 gcs_address):
+        self._gcs_address = tuple(gcs_address)
+        self._interval = config.update_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        from .._internal.event_loop import LoopThread
+
+        self._loop_thread = LoopThread("autoscaler-monitor")
+        self.autoscaler = Autoscaler(config, provider, self._gcs_call)
+
+    def _gcs_call(self, method, *args):
+        from .._internal.rpc import RpcClient
+
+        async def _call():
+            client = RpcClient(*self._gcs_address, name="autoscaler")
+            try:
+                return await client.call(method, *args, timeout=10.0)
+            finally:
+                await client.close()
+
+        return self._loop_thread.run(_call(), timeout=15.0)
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.autoscaler.update()
+            except Exception:
+                logger.exception("autoscaler update failed")
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._loop_thread.stop()

@@ -1,0 +1,37 @@
+"""Autoscaling configuration.
+
+Role-equivalent of the reference's cluster-config node_types section
+(python/ray/autoscaler/v2/schema.py NodeTypeConfig / ClusterConfig): each
+node type declares the resources and labels one launched node contributes,
+with min/max counts. TPU slice types set ``labels`` to the slice topology
+keys (ray.io/tpu-pod-type etc., reference: common/constants.h:131-142) so
+label-selector demands scale the right slice kind.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class NodeTypeConfig:
+    name: str
+    resources: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class AutoscalingConfig:
+    node_types: List[NodeTypeConfig]
+    max_workers: int = 20  # cluster-wide cap, excluding the head
+    idle_timeout_s: float = 60.0
+    update_interval_s: float = 1.0
+
+    def type_by_name(self, name: str) -> Optional[NodeTypeConfig]:
+        for t in self.node_types:
+            if t.name == name:
+                return t
+        return None

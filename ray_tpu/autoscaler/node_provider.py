@@ -1,0 +1,81 @@
+"""Node providers: the cloud-facing side of the autoscaler.
+
+Role-equivalent of the reference's NodeProvider interface
+(python/ray/autoscaler/node_provider.py) and the FakeMultiNodeProvider
+(autoscaler/_private/fake_multi_node/node_provider.py:237) that "launches"
+nodes as local processes so the full autoscaler loop is testable on one
+machine. Here a fake-launched node is an in-process raylet (runtime.node.
+Node) joined to the head GCS — the same substrate cluster_utils.Cluster
+uses for multi-node tests.
+"""
+
+from __future__ import annotations
+
+import abc
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class NodeInstance:
+    """Provider-side record of one launched node."""
+
+    def __init__(self, instance_id: str, node_type: str):
+        self.instance_id = instance_id
+        self.node_type = node_type
+
+
+class NodeProvider(abc.ABC):
+    """Minimal provider surface the reconciler drives (reference:
+    node_provider.py create_node/terminate_node/non_terminated_nodes)."""
+
+    @abc.abstractmethod
+    def create_node(self, node_type_name: str) -> NodeInstance: ...
+
+    @abc.abstractmethod
+    def terminate_node(self, instance_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def non_terminated_nodes(self) -> List[NodeInstance]: ...
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches worker nodes as in-process raylets against a live cluster
+    (reference: FakeMultiNodeProvider launching local processes)."""
+
+    def __init__(self, cluster, config):
+        self._cluster = cluster  # cluster_utils.Cluster
+        self._config = config  # AutoscalingConfig
+        self._counter = itertools.count()
+        self._lock = threading.Lock()
+        self._instances: Dict[str, tuple] = {}  # instance_id -> (NodeInstance, Node)
+
+    def create_node(self, node_type_name: str) -> NodeInstance:
+        node_type = self._config.type_by_name(node_type_name)
+        if node_type is None:
+            raise ValueError(f"unknown node type {node_type_name!r}")
+        node = self._cluster.add_node(
+            resources=dict(node_type.resources),
+            labels={**node_type.labels, "ray.io/node-type": node_type_name},
+        )
+        instance_id = f"fake-{node_type_name}-{next(self._counter)}"
+        inst = NodeInstance(instance_id, node_type_name)
+        with self._lock:
+            self._instances[instance_id] = (inst, node)
+        return inst
+
+    def terminate_node(self, instance_id: str) -> None:
+        with self._lock:
+            entry = self._instances.pop(instance_id, None)
+        if entry is not None:
+            self._cluster.remove_node(entry[1], graceful=True)
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        with self._lock:
+            return [inst for inst, _node in self._instances.values()]
+
+    def node_id_of(self, instance_id: str):
+        """Raylet NodeID for an instance (used to match GCS idle state)."""
+        with self._lock:
+            entry = self._instances.get(instance_id)
+        return entry[1].node_id if entry else None

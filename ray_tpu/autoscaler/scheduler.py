@@ -1,0 +1,152 @@
+"""Resource scheduler: bin-pack pending demand onto node types.
+
+Role-equivalent of the reference's IResourceScheduler
+(python/ray/autoscaler/v2/scheduler.py:88): given the current cluster
+state and the unmet resource demands, decide which node types to launch.
+The bin-packing mirrors the reference's approach — first fit demands onto
+existing free capacity, then onto already-planned launches, then open a new
+node of the smallest feasible type. Placement-group demands are handled
+gang-wise: all bundles of a pending group must fit on the planned node set
+or the group contributes launches for every bundle (STRICT_SPREAD gets one
+node per bundle).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .config import AutoscalingConfig, NodeTypeConfig
+
+
+@dataclass
+class SchedulingDecision:
+    launches: Dict[str, int] = field(default_factory=dict)  # node type -> count
+    infeasible: List[dict] = field(default_factory=list)
+
+    def total_launches(self) -> int:
+        return sum(self.launches.values())
+
+
+def _fits(capacity: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(capacity.get(k, 0.0) >= v - 1e-9 for k, v in demand.items())
+
+
+def _labels_match(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in (selector or {}).items())
+
+
+def _consume(capacity: Dict[str, float], demand: Dict[str, float]):
+    for k, v in demand.items():
+        capacity[k] = capacity.get(k, 0.0) - v
+
+
+class _PlannedNode:
+    __slots__ = ("type_name", "capacity", "labels")
+
+    def __init__(self, type_name: str, capacity: Dict[str, float], labels):
+        self.type_name = type_name
+        self.capacity = dict(capacity)
+        self.labels = dict(labels)
+
+
+class ResourceScheduler:
+    def __init__(self, config: AutoscalingConfig):
+        self._config = config
+
+    def schedule(
+        self,
+        cluster_state: dict,
+        current_counts: Dict[str, int],
+    ) -> SchedulingDecision:
+        """cluster_state is the GCS GetClusterResourceState reply;
+        current_counts is launched-but-maybe-not-yet-registered nodes per
+        type (so in-flight launches aren't double-counted)."""
+        decision = SchedulingDecision()
+
+        # Free capacity on live nodes.
+        free: List[_PlannedNode] = []
+        for node in cluster_state.get("nodes", []):
+            if not node.get("alive"):
+                continue
+            free.append(
+                _PlannedNode("__existing__", node.get("available", {}),
+                             node.get("labels", {}))
+            )
+        planned: List[_PlannedNode] = []
+        planned_counts: Dict[str, int] = dict(current_counts)
+
+        def try_place(resources: Dict[str, float], selector) -> bool:
+            for node in free + planned:
+                if _labels_match(node.labels, selector) and _fits(
+                    node.capacity, resources
+                ):
+                    _consume(node.capacity, resources)
+                    return True
+            return self._open_node(resources, selector, planned,
+                                   planned_counts, decision)
+
+        # Plain task/actor demands.
+        for demand in cluster_state.get("pending_demands", []):
+            resources = demand.get("resources", {})
+            selector = demand.get("label_selector", {})
+            for _ in range(demand.get("count", 1)):
+                if not try_place(resources, selector):
+                    decision.infeasible.append(demand)
+                    break
+
+        # Pending placement groups: place each bundle; STRICT_SPREAD means
+        # one fresh planned node per bundle (reference: bundle PACK/SPREAD
+        # policies, policy/bundle_scheduling_policy.h:29-97).
+        for pg in cluster_state.get("pending_placement_groups", []):
+            strategy = str(pg.get("strategy", ""))
+            strict_spread = "STRICT_SPREAD" in strategy.upper()
+            used: List[_PlannedNode] = []
+            for bundle in pg.get("bundles", []):
+                placed = False
+                pool = free + planned
+                if strict_spread:
+                    pool = [n for n in pool if n not in used]
+                for node in pool:
+                    if _fits(node.capacity, bundle):
+                        _consume(node.capacity, bundle)
+                        used.append(node)
+                        placed = True
+                        break
+                if not placed:
+                    if self._open_node(bundle, {}, planned, planned_counts,
+                                       decision):
+                        used.append(planned[-1])
+                    else:
+                        decision.infeasible.append({"resources": bundle})
+        return decision
+
+    def _open_node(self, resources, selector, planned, planned_counts,
+                   decision) -> bool:
+        """Launch the smallest feasible node type for this demand."""
+        candidates: List[NodeTypeConfig] = []
+        for t in self._config.node_types:
+            labels = {**t.labels, "ray.io/node-type": t.name}
+            if not _labels_match(labels, selector):
+                continue
+            if not _fits(dict(t.resources), resources):
+                continue
+            if planned_counts.get(t.name, 0) >= t.max_workers:
+                continue
+            candidates.append(t)
+        if not candidates:
+            return False
+        total_planned = sum(planned_counts.values())
+        if total_planned >= self._config.max_workers:
+            return False
+        best = min(candidates, key=lambda t: sum(t.resources.values()))
+        planned_counts[best.name] = planned_counts.get(best.name, 0) + 1
+        decision.launches[best.name] = decision.launches.get(best.name, 0) + 1
+        node = _PlannedNode(
+            best.name,
+            best.resources,
+            {**best.labels, "ray.io/node-type": best.name},
+        )
+        _consume(node.capacity, resources)  # the demand that opened this node
+        planned.append(node)
+        return True

@@ -89,3 +89,58 @@ class Cluster:
             node.stop()
         self._nodes.clear()
         self.head_node = None
+
+
+class AutoscalingCluster:
+    """A head node plus a real autoscaler driving a fake node provider
+    (reference: cluster_utils.py:26 AutoscalingCluster over
+    FakeMultiNodeProvider) — worker nodes appear and disappear based on
+    resource demand, all inside one host process."""
+
+    def __init__(
+        self,
+        head_resources: Optional[Dict[str, float]] = None,
+        worker_node_types: Optional[list] = None,
+        idle_timeout_s: float = 60.0,
+        update_interval_s: float = 0.25,
+        max_workers: int = 20,
+    ):
+        from .autoscaler import (
+            AutoscalerMonitor,
+            AutoscalingConfig,
+            FakeMultiNodeProvider,
+            NodeTypeConfig,
+        )
+
+        self.cluster = Cluster(
+            initialize_head=True,
+            head_node_args={"resources": dict(head_resources or {"CPU": 1})},
+        )
+        node_types = [
+            t if isinstance(t, NodeTypeConfig) else NodeTypeConfig(**t)
+            for t in (worker_node_types or [])
+        ]
+        self.config = AutoscalingConfig(
+            node_types=node_types,
+            idle_timeout_s=idle_timeout_s,
+            update_interval_s=update_interval_s,
+            max_workers=max_workers,
+        )
+        self.provider = FakeMultiNodeProvider(self.cluster, self.config)
+        self.monitor = AutoscalerMonitor(
+            self.config, self.provider, self.cluster.gcs_address
+        )
+
+    def start(self):
+        self.monitor.start()
+
+    @property
+    def address(self) -> str:
+        return self.cluster.address
+
+    def connect(self, **init_kwargs):
+        return self.cluster.connect(**init_kwargs)
+
+    def shutdown(self):
+        self.monitor.stop()
+        self.cluster.shutdown()

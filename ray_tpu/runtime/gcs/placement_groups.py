@@ -202,6 +202,18 @@ class GcsPlacementGroupManager:
         pg_id = self._named.get(name)
         return self._groups.get(pg_id) if pg_id else None
 
+    def pending_infos(self):
+        """Groups still waiting for placement — autoscaler demand input
+        (reference: pending queue, gcs_placement_group_manager.h:42)."""
+        return [
+            info
+            for info in self._groups.values()
+            if info.state in (
+                PlacementGroupState.PENDING,
+                PlacementGroupState.RESCHEDULING,
+            )
+        ]
+
     def list_groups(self):
         return list(self._groups.values())
 

@@ -56,6 +56,9 @@ class GcsServer:
         self._task_events: Dict[str, dict] = {}
         self._task_events_order: List[str] = []
         self._task_events_cap = 10000
+        # autoscaler state (reference: GcsAutoscalerStateManager)
+        self._node_demands: Dict[NodeID, list] = {}
+        self._autoscaling_state: Optional[dict] = None
         self._runner: Optional[PeriodicRunner] = None
         self.address: Optional[Tuple[str, int]] = None
 
@@ -146,17 +149,62 @@ class GcsServer:
         return list(self._nodes.values())
 
     async def handle_report_resources(
-        self, node_id: NodeID, available: Dict[str, float]
+        self, node_id: NodeID, available: Dict[str, float], demands=None
     ):
         """Periodic resource view from each raylet (role of RaySyncer
         RESOURCE_VIEW streams, ray_syncer.h:89). Deltas are re-broadcast to
-        subscribed raylets for spillback decisions."""
+        subscribed raylets for spillback decisions. ``demands`` carries the
+        raylet's queued lease requests for the autoscaler (reference:
+        GcsAutoscalerStateManager, gcs_autoscaler_state_manager.h:41)."""
         self._node_last_seen[node_id] = time.time()
         prev = self._node_available.get(node_id)
         self._node_available[node_id] = available
+        if demands is not None:
+            self._node_demands[node_id] = demands
         if prev != available:
             self.publisher.publish("resource_view", (node_id, available))
         return True
+
+    async def handle_get_cluster_resource_state(self) -> dict:
+        """Autoscaler view of the cluster (reference:
+        GetClusterResourceState RPC, protobuf/autoscaler.proto:187)."""
+        nodes = []
+        for node_id, info in self._nodes.items():
+            nodes.append(
+                {
+                    "node_id": node_id,
+                    "alive": info.alive,
+                    "is_head": info.is_head,
+                    "resources_total": dict(info.resources_total),
+                    "available": dict(self._node_available.get(node_id, {})),
+                    "labels": dict(info.labels),
+                }
+            )
+        demands = []
+        for node_demands in self._node_demands.values():
+            demands.extend(node_demands)
+        pending_pgs = [
+            {
+                "pg_id": info.placement_group_id,
+                "strategy": info.strategy,
+                "bundles": [dict(b.resources) for b in info.bundles],
+            }
+            for info in self.pg_manager.pending_infos()
+        ]
+        return {
+            "nodes": nodes,
+            "pending_demands": demands,
+            "pending_placement_groups": pending_pgs,
+        }
+
+    async def handle_report_autoscaling_state(self, state: dict):
+        """Autoscaler posts its view for observability (reference:
+        ReportAutoscalingState RPC, autoscaler.proto:199)."""
+        self._autoscaling_state = state
+        return True
+
+    async def handle_get_autoscaling_state(self):
+        return self._autoscaling_state
 
     async def _health_check(self):
         """Mark nodes dead when they stop reporting (reference:

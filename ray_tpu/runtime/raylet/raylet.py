@@ -86,6 +86,8 @@ class Raylet:
         self._leases: Dict[UniqueID, Lease] = {}
         # spilled primary copies: object id -> file path (reference: N14)
         self._spilled: Dict[ObjectID, str] = {}
+        # unmet demands for the autoscaler: task_id -> (resources, selector, ts)
+        self._infeasible_demands: Dict[TaskID, tuple] = {}
         self._restore_locks: Dict[ObjectID, asyncio.Lock] = {}
         self._restore_lock_holds: Dict[ObjectID, int] = {}
         self._lease_seq = itertools.count()
@@ -164,10 +166,46 @@ class Raylet:
         avail = self.resources.available_float()
         gcs = self.client_pool.get(*self.gcs_address)
         try:
-            await gcs.call("report_resources", self.node_id, avail)
+            await gcs.call(
+                "report_resources", self.node_id, avail, self._pending_demands()
+            )
         except Exception:
             pass
         self._last_reported = avail
+
+    def _pending_demands(self) -> List[dict]:
+        """Aggregate queued lease requests into resource-demand buckets for
+        the autoscaler (reference: SchedulerResourceReporter feeding
+        GcsAutoscalerStateManager's cluster resource state)."""
+        buckets: Dict[tuple, dict] = {}
+
+        def add(resources, selector):
+            key = (
+                tuple(sorted(resources.items())),
+                tuple(sorted((selector or {}).items())),
+            )
+            entry = buckets.get(key)
+            if entry is None:
+                buckets[key] = entry = {
+                    "resources": dict(resources),
+                    "label_selector": dict(selector or {}),
+                    "count": 0,
+                }
+            entry["count"] += 1
+
+        for queue in self._queues.values():
+            for spec, fut in queue:
+                if not fut.done():
+                    add(spec.resources, spec.label_selector)
+        now = time.time()
+        for task_id, (resources, selector, ts) in list(
+            self._infeasible_demands.items()
+        ):
+            if now - ts > 5.0:  # owner stopped retrying (done or gone)
+                del self._infeasible_demands[task_id]
+                continue
+            add(resources, selector)
+        return list(buckets.values())
 
     def _reap_idle_workers(self):
         self.worker_pool.reap_idle(
@@ -337,6 +375,14 @@ class Raylet:
             ) and label_match(info.labels, spec.label_selector)
             if feasible:
                 return {"granted": False, "spillback": (node_id, info.address)}
+        # Remember the unmet demand so the autoscaler sees it even though the
+        # owner polls (each retry refreshes the TTL; reference: infeasible
+        # tasks stay queued and are reported as pending demand).
+        self._infeasible_demands[spec.task_id] = (
+            dict(spec.resources),
+            dict(spec.label_selector or {}),
+            time.time(),
+        )
         return {"granted": False, "infeasible": True,
                 "reason": f"no node satisfies {spec.resources} {spec.label_selector}"}
 

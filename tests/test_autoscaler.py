@@ -1,0 +1,204 @@
+"""Autoscaler v2 tests.
+
+Models the reference's autoscaler/v2 test approach: unit-test the
+bin-packing scheduler with synthetic cluster states, then run the full
+monitor loop against an in-process AutoscalingCluster with the fake node
+provider (reference: tests using FakeMultiNodeProvider / AutoscalingCluster).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    AutoscalingConfig,
+    NodeTypeConfig,
+    ResourceScheduler,
+)
+from ray_tpu.cluster_utils import AutoscalingCluster
+
+
+def _state(nodes=(), demands=(), pgs=()):
+    return {
+        "nodes": list(nodes),
+        "pending_demands": list(demands),
+        "pending_placement_groups": list(pgs),
+    }
+
+
+def _node(total, avail=None, labels=None, alive=True, head=False):
+    return {
+        "node_id": object(),
+        "alive": alive,
+        "is_head": head,
+        "resources_total": total,
+        "available": total if avail is None else avail,
+        "labels": labels or {},
+    }
+
+
+CFG = AutoscalingConfig(
+    node_types=[
+        NodeTypeConfig("cpu-small", {"CPU": 4}, max_workers=5),
+        NodeTypeConfig("tpu-v5e-8", {"CPU": 8, "TPU": 8},
+                       labels={"ray.io/tpu-pod-type": "v5litepod-8"},
+                       max_workers=4),
+    ],
+    max_workers=10,
+)
+
+
+class TestScheduler:
+    def test_no_demand_no_launch(self):
+        s = ResourceScheduler(CFG)
+        d = s.schedule(_state(nodes=[_node({"CPU": 4})]), {})
+        assert d.launches == {}
+
+    def test_fits_existing_capacity(self):
+        s = ResourceScheduler(CFG)
+        d = s.schedule(
+            _state(nodes=[_node({"CPU": 4})],
+                   demands=[{"resources": {"CPU": 2}, "count": 2}]),
+            {},
+        )
+        assert d.launches == {}
+
+    def test_launches_smallest_feasible_type(self):
+        s = ResourceScheduler(CFG)
+        d = s.schedule(
+            _state(demands=[{"resources": {"CPU": 2}, "count": 1}]), {}
+        )
+        assert d.launches == {"cpu-small": 1}
+
+    def test_tpu_demand_launches_tpu_type(self):
+        s = ResourceScheduler(CFG)
+        d = s.schedule(
+            _state(demands=[{"resources": {"TPU": 8}, "count": 1}]), {}
+        )
+        assert d.launches == {"tpu-v5e-8": 1}
+
+    def test_label_selector_routes_to_labeled_type(self):
+        s = ResourceScheduler(CFG)
+        d = s.schedule(
+            _state(demands=[{
+                "resources": {"CPU": 1},
+                "label_selector": {"ray.io/tpu-pod-type": "v5litepod-8"},
+                "count": 1,
+            }]),
+            {},
+        )
+        assert d.launches == {"tpu-v5e-8": 1}
+
+    def test_bin_packs_multiple_demands_one_node(self):
+        s = ResourceScheduler(CFG)
+        d = s.schedule(
+            _state(demands=[{"resources": {"CPU": 1}, "count": 4}]), {}
+        )
+        assert d.launches == {"cpu-small": 1}
+
+    def test_max_workers_cap(self):
+        s = ResourceScheduler(CFG)
+        d = s.schedule(
+            _state(demands=[{"resources": {"CPU": 4}, "count": 20}]), {}
+        )
+        assert d.launches["cpu-small"] == 5  # per-type cap
+        assert d.infeasible
+
+    def test_infeasible_demand_reported(self):
+        s = ResourceScheduler(CFG)
+        d = s.schedule(
+            _state(demands=[{"resources": {"GPU": 1}, "count": 1}]), {}
+        )
+        assert d.launches == {}
+        assert d.infeasible
+
+    def test_strict_spread_pg_one_node_per_bundle(self):
+        s = ResourceScheduler(CFG)
+        d = s.schedule(
+            _state(pgs=[{
+                "strategy": "STRICT_SPREAD",
+                "bundles": [{"CPU": 2}, {"CPU": 2}, {"CPU": 2}],
+            }]),
+            {},
+        )
+        assert d.launches == {"cpu-small": 3}
+
+    def test_pack_pg_shares_nodes(self):
+        s = ResourceScheduler(CFG)
+        d = s.schedule(
+            _state(pgs=[{
+                "strategy": "PACK",
+                "bundles": [{"CPU": 2}, {"CPU": 2}],
+            }]),
+            {},
+        )
+        assert d.launches == {"cpu-small": 1}
+
+    def test_inflight_launches_counted(self):
+        s = ResourceScheduler(CFG)
+        d = s.schedule(
+            _state(demands=[{"resources": {"CPU": 4}, "count": 5}]),
+            {"cpu-small": 4},
+        )
+        assert d.launches.get("cpu-small", 0) <= 1
+
+
+@pytest.fixture
+def autoscaling_cluster():
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1},
+        worker_node_types=[
+            dict(name="cpu-worker", resources={"CPU": 2}, max_workers=3),
+            dict(name="tpu-worker", resources={"CPU": 2, "TPU": 4},
+                 labels={"ray.io/tpu-pod-type": "v5litepod-4"},
+                 max_workers=2),
+        ],
+        idle_timeout_s=2.0,
+        update_interval_s=0.25,
+    )
+    cluster.start()
+    cluster.connect()
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_scale_up_on_demand(autoscaling_cluster):
+    """An infeasible-now TPU task triggers a tpu-worker launch and runs."""
+
+    @ray_tpu.remote(num_cpus=1, num_tpus=4)
+    def tpu_task():
+        return "ran"
+
+    ref = tpu_task.remote()
+    assert ray_tpu.get(ref, timeout=60) == "ran"
+    types = {
+        i.node_type for i in autoscaling_cluster.provider.non_terminated_nodes()
+    }
+    assert "tpu-worker" in types
+
+
+def test_scale_up_many_tasks(autoscaling_cluster):
+    @ray_tpu.remote(num_cpus=2)
+    def heavy(i):
+        time.sleep(0.2)
+        return i
+
+    refs = [heavy.remote(i) for i in range(6)]
+    assert sorted(ray_tpu.get(refs, timeout=60)) == list(range(6))
+    assert len(autoscaling_cluster.provider.non_terminated_nodes()) >= 1
+
+
+def test_scale_down_when_idle(autoscaling_cluster):
+    @ray_tpu.remote(num_cpus=2)
+    def quick():
+        return 1
+
+    assert ray_tpu.get(quick.remote(), timeout=60) == 1
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if not autoscaling_cluster.provider.non_terminated_nodes():
+            break
+        time.sleep(0.5)
+    assert not autoscaling_cluster.provider.non_terminated_nodes()
