@@ -22,7 +22,11 @@ from ._internal.protocol import (
     TaskType,
 )
 from .object_ref import ObjectRef
-from .remote_function import build_resources, prepare_args
+from .remote_function import (
+    _normalize_runtime_env,
+    build_resources,
+    prepare_args,
+)
 
 _DEFAULT_ACTOR_OPTIONS = dict(
     num_cpus=1.0,
@@ -132,7 +136,7 @@ class ActorClass:
             max_concurrency=options["max_concurrency"],
             namespace=options.get("namespace") or "",
             actor_name=options.get("name") or "",
-            runtime_env=options.get("runtime_env"),
+            runtime_env=_normalize_runtime_env(options.get("runtime_env"), worker),
         )
         _worker_api.run_on_worker_loop(worker.create_actor(spec, detached))
         return ActorHandle(
@@ -208,6 +212,16 @@ class ActorHandle:
         return ActorMethod(self, name, options)
 
     def _submit(self, method_name: str, args, kwargs, options: dict):
+        from .util import tracing
+
+        if tracing.is_tracing_enabled():
+            with tracing.trace_span(
+                f"submit:{method_name}", category="ray_tpu.actor_task"
+            ):
+                return self._submit_impl(method_name, args, kwargs, options)
+        return self._submit_impl(method_name, args, kwargs, options)
+
+    def _submit_impl(self, method_name: str, args, kwargs, options: dict):
         worker = _worker_api.get_core_worker()
         task_args = prepare_args(worker, args, kwargs)
         num_returns = options.get("num_returns", 1)

@@ -33,6 +33,7 @@ def init(
     labels: Optional[Dict[str, str]] = None,
     object_store_memory: Optional[int] = None,
     namespace: str = "",
+    runtime_env: Optional[Dict[str, Any]] = None,
     ignore_reinit_error: bool = False,
     log_to_driver: bool = True,
     _system_config: Optional[Dict[str, Any]] = None,
@@ -90,6 +91,9 @@ def init(
     )
     loop_thread.run(worker.start(), timeout=30)
     loop_thread.run(worker.register_driver_job({"namespace": namespace}), timeout=30)
+    # job-level default runtime env, merged under per-task envs (reference:
+    # ray.init(runtime_env=...) becoming the JobConfig default)
+    worker.job_runtime_env = dict(runtime_env) if runtime_env else None
     _worker_api.set_core_worker(worker, config, loop_thread=loop_thread, node=node)
     atexit.register(_atexit_shutdown)
     return node

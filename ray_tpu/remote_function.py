@@ -53,6 +53,26 @@ def build_resources(options: Dict[str, Any]) -> Dict[str, float]:
     return resources
 
 
+def _normalize_runtime_env(runtime_env, worker):
+    """Package + validate a runtime_env option at submission time, merging
+    the job-level default under it (reference: runtime-env upload in
+    remote_function/_private + JobConfig default merging)."""
+    job_env = getattr(worker, "job_runtime_env", None)
+    if job_env:
+        merged = dict(job_env)
+        merged.update(runtime_env or {})
+        env_vars = {**(job_env.get("env_vars") or {}),
+                    **((runtime_env or {}).get("env_vars") or {})}
+        if env_vars:
+            merged["env_vars"] = env_vars
+        runtime_env = merged
+    if not runtime_env:
+        return None
+    from ._internal.runtime_env import normalize_cached
+
+    return normalize_cached(runtime_env, worker)
+
+
 def prepare_args(worker, args: tuple, kwargs: dict) -> List[TaskArg]:
     """Flatten into TaskArgs: slot 0 is the pickled structure, the rest are
     top-level by-reference args."""
@@ -111,6 +131,16 @@ class RemoteFunction:
         return self._hash
 
     def _remote(self, args: tuple, kwargs: dict, options: Dict[str, Any]):
+        from .util import tracing
+
+        if tracing.is_tracing_enabled():
+            with tracing.trace_span(
+                f"submit:{self.__name__}", category="ray_tpu.task"
+            ):
+                return self._remote_impl(args, kwargs, options)
+        return self._remote_impl(args, kwargs, options)
+
+    def _remote_impl(self, args: tuple, kwargs: dict, options: Dict[str, Any]):
         worker = _worker_api.get_core_worker()
         fn_hash = self._ensure_exported(worker)
         task_args = prepare_args(worker, args, kwargs)
@@ -145,7 +175,7 @@ class RemoteFunction:
             retry_exceptions=bool(options["retry_exceptions"]),
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_index,
-            runtime_env=options.get("runtime_env"),
+            runtime_env=_normalize_runtime_env(options.get("runtime_env"), worker),
         )
         return_ids = _worker_api.run_on_worker_loop(worker.submit_task(spec))
         refs = [ObjectRef(oid, worker.address) for oid in return_ids]

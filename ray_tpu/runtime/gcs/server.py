@@ -285,6 +285,11 @@ class GcsServer:
     async def handle_report_task_events(self, events: List[dict]):
         for ev in events:
             tid = ev["task_id"]
+            # keep a per-state timestamp so the timeline view can compute
+            # durations (reference: per-state ts in GcsTaskManager events
+            # feeding `ray timeline` chrome traces)
+            if ev.get("state") and "ts" in ev:
+                ev = {**ev, f"ts_{ev['state'].lower()}": ev["ts"]}
             cur = self._task_events.get(tid)
             if cur is None:
                 self._task_events[tid] = dict(ev)

@@ -231,9 +231,10 @@ class Raylet:
     # -- worker registration / death --------------------------------------
 
     async def handle_register_worker(
-        self, worker_id: WorkerID, address: Tuple[str, int], pid: int
+        self, worker_id: WorkerID, address: Tuple[str, int], pid: int,
+        env_key: str = ""
     ):
-        self.worker_pool.on_worker_registered(worker_id, address, pid)
+        self.worker_pool.on_worker_registered(worker_id, address, pid, env_key)
         return {"node_id": self.node_id, "store_session": self.store.session_id}
 
     async def _on_connection_lost(self, peer_meta):
@@ -342,7 +343,13 @@ class Raylet:
         allocation = self.resources.allocate(spec.resources, bundle=bundle)
         if allocation is None:
             return None
-        worker = await self.worker_pool.pop(timeout=60.0)
+        from ..._internal.runtime_env import env_key as _env_key
+
+        worker = await self.worker_pool.pop(
+            timeout=60.0,
+            env_key=_env_key(spec.runtime_env),
+            runtime_env=spec.runtime_env,
+        )
         if worker is None:
             self.resources.release(allocation)
             return {"granted": False, "reason": "no worker available"}

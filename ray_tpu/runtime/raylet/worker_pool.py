@@ -53,19 +53,30 @@ class WorkerPool:
         self._registered: Dict[WorkerID, WorkerHandle] = {}
         self._starting = 0
         self._spawned_procs: Dict[int, subprocess.Popen] = {}  # pid -> proc
-        self._waiters: List[asyncio.Future] = []
+        # lease waiters keyed by runtime-env fingerprint (reference:
+        # WorkerPool pops workers matching the lease's runtime env)
+        self._waiters: Dict[str, List[asyncio.Future]] = {}
         self._stopped = False
 
     @property
     def num_total(self) -> int:
         return len(self._registered) + self._starting
 
-    def _spawn(self, env_overrides: Optional[dict] = None):
+    def _spawn(self, env_overrides: Optional[dict] = None,
+               runtime_env: Optional[dict] = None, env_key: str = ""):
         """Start one worker subprocess; it will dial back and register."""
         self._starting += 1
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self._node_id.hex()
         env.update(env_overrides or {})
+        if runtime_env:
+            import json as _json
+
+            env["RAY_TPU_RUNTIME_ENV"] = _json.dumps(runtime_env)
+            env["RAY_TPU_ENV_KEY"] = env_key
+            # env_vars also applied at process start so they are visible to
+            # module-level imports (reference: dedicated-worker env vars)
+            env.update(runtime_env.get("env_vars") or {})
         repo_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         )
@@ -99,15 +110,16 @@ class WorkerPool:
         logger.debug("spawned worker pid=%s", proc.pid)
         return proc
 
-    def on_worker_registered(self, worker_id: WorkerID, address: tuple, pid: int):
-        handle = WorkerHandle(worker_id, address, pid)
+    def on_worker_registered(self, worker_id: WorkerID, address: tuple, pid: int,
+                             env_key: str = ""):
+        handle = WorkerHandle(worker_id, address, pid, env_key=env_key)
         self._registered[worker_id] = handle
         if self._starting > 0:
             self._starting -= 1
-        # hand directly to a waiter if any, else park as idle
-        while self._waiters:
-            fut = self._waiters.pop(0)
+        # hand directly to a matching waiter if any, else park as idle
+        for fut in self._waiters.get(env_key, []):
             if not fut.done():
+                self._waiters[env_key].remove(fut)
                 fut.set_result(handle)
                 return
         self._idle.append(handle)
@@ -117,28 +129,38 @@ class WorkerPool:
         self._idle = [w for w in self._idle if w.worker_id != worker_id]
         return handle
 
-    async def pop(self, timeout: float = 60.0) -> Optional[WorkerHandle]:
-        """Pop an idle worker, spawning one if the pool is below its cap."""
-        if self._idle:
-            return self._idle.pop()
+    async def pop(self, timeout: float = 60.0, env_key: str = "",
+                  runtime_env: Optional[dict] = None) -> Optional[WorkerHandle]:
+        """Pop an idle worker whose runtime env matches, spawning a
+        dedicated one if needed (reference: WorkerPool::PopWorker matching
+        by runtime-env hash)."""
+        for i, handle in enumerate(self._idle):
+            if handle.env_key == env_key:
+                return self._idle.pop(i)
+        if self.num_total >= self._max_workers and self._idle:
+            # pool full of other-env workers: evict the longest-idle one to
+            # make room for the dedicated worker
+            victim = min(self._idle, key=lambda h: h.idle_since)
+            self._idle.remove(victim)
+            self._kill(victim)
         if self.num_total < self._max_workers:
-            self._spawn()
+            self._spawn(runtime_env=runtime_env, env_key=env_key)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._waiters.append(fut)
+        self._waiters.setdefault(env_key, []).append(fut)
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
-            if fut in self._waiters:
-                self._waiters.remove(fut)
+            if fut in self._waiters.get(env_key, []):
+                self._waiters[env_key].remove(fut)
             return None
 
     def push(self, handle: WorkerHandle):
         """Return a worker to the idle pool after its lease ends."""
         if handle.worker_id in self._registered:
             handle.idle_since = time.time()
-            while self._waiters:
-                fut = self._waiters.pop(0)
+            for fut in self._waiters.get(handle.env_key, []):
                 if not fut.done():
+                    self._waiters[handle.env_key].remove(fut)
                     fut.set_result(handle)
                     return
             self._idle.append(handle)

@@ -199,7 +199,8 @@ class CoreWorker:
     async def connect_to_raylet(self):
         raylet = self.client_pool.get(*self.raylet_address)
         reply = await raylet.call(
-            "register_worker", self.worker_id, self.address, os.getpid()
+            "register_worker", self.worker_id, self.address, os.getpid(),
+            os.environ.get("RAY_TPU_ENV_KEY", ""),
         )
         self.node_id = reply["node_id"]
         return reply

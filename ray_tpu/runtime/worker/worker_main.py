@@ -45,6 +45,20 @@ async def main(args):
         WorkerMode.WORKER, config, gcs_address, raylet_address, loop
     )
     await worker.start()
+
+    # Materialize this worker's runtime env (download packages, set cwd /
+    # sys.path / env vars) before registering, so the first leased task
+    # already sees it (reference: runtime-env agent CreateRuntimeEnv before
+    # worker handshake).
+    runtime_env_json = os.environ.get("RAY_TPU_RUNTIME_ENV")
+    if runtime_env_json:
+        import json
+
+        from ..._internal.runtime_env import materialize
+
+        gcs_client = worker.client_pool.get(*gcs_address)
+        await materialize(json.loads(runtime_env_json), gcs_client)
+
     await worker.connect_to_raylet()
 
     # expose this worker for API calls made inside executed tasks

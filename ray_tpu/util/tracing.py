@@ -1,0 +1,148 @@
+"""Tracing: spans around task submission/execution + timeline export.
+
+Role-equivalent of the reference's tracing helper
+(python/ray/util/tracing/tracing_helper.py:165-221 — OpenTelemetry spans
+patched around ``.remote()`` and task execution) and of ``ray timeline``
+(chrome-trace export of per-task profile events). Spans here are recorded
+by a dependency-free in-process recorder; the cluster-wide timeline is
+reconstructed from the GCS task-event store (per-state timestamps), and
+device-side profiling delegates to ``jax.profiler`` (the TPU-native
+equivalent of NVTX ranges).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_spans: List[dict] = []
+_enabled = os.environ.get("RAY_TPU_TRACE", "") not in ("", "0")
+
+
+def enable_tracing():
+    """Turn on span recording in this process (reference:
+    ray.init(_tracing_startup_hook=...))."""
+    global _enabled
+    _enabled = True
+
+
+def is_tracing_enabled() -> bool:
+    return _enabled
+
+
+@contextmanager
+def trace_span(name: str, category: str = "app", **attrs):
+    """Record one span (reference: tracing_helper span context managers)."""
+    if not _enabled:
+        yield
+        return
+    start = time.perf_counter()
+    wall = time.time()
+    try:
+        yield
+    finally:
+        dur = time.perf_counter() - start
+        with _lock:
+            _spans.append(
+                {
+                    "name": name,
+                    "cat": category,
+                    "ph": "X",
+                    "ts": wall * 1e6,
+                    "dur": dur * 1e6,
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident() % 100000,
+                    "args": attrs,
+                }
+            )
+
+
+def get_spans() -> List[dict]:
+    with _lock:
+        return list(_spans)
+
+
+def clear_spans():
+    with _lock:
+        _spans.clear()
+
+
+def export_spans(filename: str):
+    """Write this process's spans as a chrome trace."""
+    with open(filename, "w") as f:
+        json.dump({"traceEvents": get_spans()}, f)
+
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Cluster-wide task timeline as chrome-trace events, reconstructed
+    from the GCS task-event store (reference: `ray timeline` building a
+    chrome trace from profile events). Returns the events; also writes
+    ``filename`` if given."""
+    from .. import _worker_api
+
+    worker = _worker_api.get_core_worker()
+    events = _worker_api.run_on_worker_loop(
+        worker.client_pool.get(*worker.gcs_address).call(
+            "list_task_events", None, 100000
+        )
+    )
+    trace: List[dict] = []
+    for ev in events:
+        start = ev.get("ts_running")
+        if start is None:
+            continue
+        end = ev.get("ts_finished") or ev.get("ts_failed") or time.time()
+        trace.append(
+            {
+                "name": ev.get("name", ev.get("task_id", "?")),
+                "cat": ev.get("type", "TASK"),
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": max(end - start, 0.0) * 1e6,
+                "pid": ev.get("node_id", "node"),
+                "tid": ev.get("worker_pid", 0),
+                "args": {
+                    "task_id": ev.get("task_id"),
+                    "state": ev.get("state"),
+                    "attempt": ev.get("attempt", 0),
+                },
+            }
+        )
+    # driver-side spans join the same trace
+    trace.extend(get_spans())
+    if filename:
+        with open(filename, "w") as f:
+            json.dump({"traceEvents": trace}, f)
+    return trace
+
+
+# -- device profiling (TPU): jax.profiler passthrough -----------------------
+
+
+def start_device_trace(log_dir: str = "/tmp/ray_tpu_trace"):
+    """Start a jax.profiler trace capturing XLA/TPU activity (the
+    TPU-native role of the reference's NVTX/torch profiler flags)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    return log_dir
+
+
+def stop_device_trace():
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+@contextmanager
+def device_trace(log_dir: str = "/tmp/ray_tpu_trace"):
+    start_device_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        stop_device_trace()

@@ -1,0 +1,139 @@
+"""Runtime env + tracing tests.
+
+Models the reference's runtime_env tests (python/ray/tests/test_runtime_env*.py):
+env_vars via dedicated workers, working_dir/py_modules packaging, pip/conda
+rejection, job-level defaults; plus the tracing/timeline surface
+(util/tracing tests + `ray timeline`)."""
+
+import os
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu._internal.runtime_env import (
+    RuntimeEnvSetupError,
+    env_key,
+)
+from ray_tpu.util import tracing
+
+
+def test_env_key_stability():
+    a = {"env_vars": {"A": "1", "B": "2"}}
+    b = {"env_vars": {"B": "2", "A": "1"}}
+    assert env_key(dict(sorted(a.items()))) == env_key(dict(sorted(b.items())))
+    assert env_key(None) == ""
+    assert env_key({"env_vars": {"A": "2"}}) != env_key({"env_vars": {"A": "1"}})
+
+
+def test_env_vars_in_dedicated_worker(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RAY_TPU_TEST_VAR": "hello"}})
+    def read_env():
+        return os.environ.get("RAY_TPU_TEST_VAR")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "hello"
+
+    @ray_tpu.remote
+    def read_env_default():
+        return os.environ.get("RAY_TPU_TEST_VAR")
+
+    # default-env workers must not see the dedicated worker's vars
+    assert ray_tpu.get(read_env_default.remote(), timeout=60) is None
+
+
+def test_pip_rejected(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def f():
+        return 1
+
+    with pytest.raises(RuntimeEnvSetupError, match="pip/conda"):
+        f.remote()
+
+
+def test_working_dir(tmp_path, ray_start_regular):
+    (tmp_path / "datafile.txt").write_text("payload-42")
+    (tmp_path / "helper_mod_rt.py").write_text("VALUE = 42\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def read_file():
+        import helper_mod_rt  # resolvable: working_dir is on sys.path
+
+        with open("datafile.txt") as f:
+            return f.read(), helper_mod_rt.VALUE
+
+    content, value = ray_tpu.get(read_file.remote(), timeout=60)
+    assert content == "payload-42"
+    assert value == 42
+
+
+def test_py_modules(tmp_path, ray_start_regular):
+    pkg = tmp_path / "mypkg_rt"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("ANSWER = 7\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(tmp_path)]})
+    def use_pkg():
+        from mypkg_rt import ANSWER
+
+        return ANSWER
+
+    assert ray_tpu.get(use_pkg.remote(), timeout=60) == 7
+
+
+def test_actor_runtime_env(ray_start_regular):
+    @ray_tpu.remote
+    class EnvActor:
+        def read(self):
+            return os.environ.get("RAY_TPU_ACTOR_VAR")
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"RAY_TPU_ACTOR_VAR": "actor-env"}}
+    ).remote()
+    assert ray_tpu.get(a.read.remote(), timeout=60) == "actor-env"
+
+
+def test_job_level_runtime_env(shutdown_only):
+    ray_tpu.init(
+        num_cpus=2,
+        resources={"TPU": 0},
+        runtime_env={"env_vars": {"JOB_LEVEL_VAR": "job"}},
+    )
+
+    @ray_tpu.remote
+    def read():
+        return os.environ.get("JOB_LEVEL_VAR")
+
+    assert ray_tpu.get(read.remote(), timeout=60) == "job"
+
+
+class TestTracing:
+    def test_span_recording(self):
+        tracing.enable_tracing()
+        tracing.clear_spans()
+        with tracing.trace_span("unit-span", category="test", foo="bar"):
+            pass
+        spans = tracing.get_spans()
+        assert any(s["name"] == "unit-span" for s in spans)
+        span = next(s for s in spans if s["name"] == "unit-span")
+        assert span["args"]["foo"] == "bar"
+        assert span["dur"] >= 0
+
+    def test_timeline_export(self, tmp_path, ray_start_regular):
+        tracing.enable_tracing()
+
+        @ray_tpu.remote
+        def traced_task():
+            return 1
+
+        ray_tpu.get([traced_task.remote() for _ in range(3)], timeout=60)
+        import time
+
+        time.sleep(1.5)  # task-event flush interval
+        out = tmp_path / "timeline.json"
+        events = tracing.timeline(str(out))
+        assert out.exists()
+        task_events = [e for e in events if e["cat"] == "NORMAL_TASK"]
+        assert len(task_events) >= 3
+        assert all(e["dur"] >= 0 for e in task_events)
+        submit_spans = [e for e in events if e["cat"] == "ray_tpu.task"]
+        assert submit_spans
