@@ -1,0 +1,14 @@
+"""Dashboard: REST state API + job submission server on the head node.
+
+Role-equivalent of the reference's dashboard head process
+(python/ray/dashboard/head.py) with its module plugins — the state API
+(dashboard/state_aggregator.py + util/state), the job-submission REST
+endpoints (dashboard/modules/job/job_head.py), and the Prometheus metrics
+surface. The frontend React app is out of scope; every endpoint returns
+JSON, and `ray_tpu.scripts.cli` + JobSubmissionClient are the supported
+clients.
+"""
+
+from .http_server import DashboardServer
+
+__all__ = ["DashboardServer"]

@@ -51,21 +51,33 @@ class WorkerPool:
         self._config_json = config_json
         self._idle: List[WorkerHandle] = []
         self._registered: Dict[WorkerID, WorkerHandle] = {}
-        self._starting = 0
         self._spawned_procs: Dict[int, subprocess.Popen] = {}  # pid -> proc
+        # spawned but not yet registered: pid -> env_key (bounds spawning so
+        # a lease-retry loop cannot stampede-fork workers; reference:
+        # worker startup rate limiting in WorkerPool)
+        self._pending_spawns: Dict[int, str] = {}
         # lease waiters keyed by runtime-env fingerprint (reference:
         # WorkerPool pops workers matching the lease's runtime env)
         self._waiters: Dict[str, List[asyncio.Future]] = {}
         self._stopped = False
 
+    def _prune_dead_spawns(self):
+        for pid in list(self._pending_spawns):
+            proc = self._spawned_procs.get(pid)
+            if proc is not None and proc.poll() is not None:
+                del self._pending_spawns[pid]
+                self._spawned_procs.pop(pid, None)
+
+    def _num_starting(self, env_key: str) -> int:
+        return sum(1 for k in self._pending_spawns.values() if k == env_key)
+
     @property
     def num_total(self) -> int:
-        return len(self._registered) + self._starting
+        return len(self._registered) + len(self._pending_spawns)
 
     def _spawn(self, env_overrides: Optional[dict] = None,
                runtime_env: Optional[dict] = None, env_key: str = ""):
         """Start one worker subprocess; it will dial back and register."""
-        self._starting += 1
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self._node_id.hex()
         env.update(env_overrides or {})
@@ -107,6 +119,7 @@ class WorkerPool:
             stderr=None,
         )
         self._spawned_procs[proc.pid] = proc
+        self._pending_spawns[proc.pid] = env_key
         logger.debug("spawned worker pid=%s", proc.pid)
         return proc
 
@@ -114,8 +127,7 @@ class WorkerPool:
                              env_key: str = ""):
         handle = WorkerHandle(worker_id, address, pid, env_key=env_key)
         self._registered[worker_id] = handle
-        if self._starting > 0:
-            self._starting -= 1
+        self._pending_spawns.pop(pid, None)
         # hand directly to a matching waiter if any, else park as idle
         for fut in self._waiters.get(env_key, []):
             if not fut.done():
@@ -137,13 +149,20 @@ class WorkerPool:
         for i, handle in enumerate(self._idle):
             if handle.env_key == env_key:
                 return self._idle.pop(i)
+        self._prune_dead_spawns()
         if self.num_total >= self._max_workers and self._idle:
             # pool full of other-env workers: evict the longest-idle one to
             # make room for the dedicated worker
             victim = min(self._idle, key=lambda h: h.idle_since)
             self._idle.remove(victim)
             self._kill(victim)
-        if self.num_total < self._max_workers:
+        # Spawn only when in-flight startups cannot cover queued demand —
+        # a retrying lease must not fork a fresh worker per retry.
+        pending_demand = len(self._waiters.get(env_key, [])) + 1
+        if (
+            self.num_total < self._max_workers
+            and self._num_starting(env_key) < pending_demand
+        ):
             self._spawn(runtime_env=runtime_env, env_key=env_key)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._waiters.setdefault(env_key, []).append(fut)
