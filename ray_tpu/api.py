@@ -34,6 +34,8 @@ def init(
     object_store_memory: Optional[int] = None,
     namespace: str = "",
     runtime_env: Optional[Dict[str, Any]] = None,
+    include_dashboard: bool = False,
+    dashboard_port: int = 0,
     ignore_reinit_error: bool = False,
     log_to_driver: bool = True,
     _system_config: Optional[Dict[str, Any]] = None,
@@ -94,6 +96,11 @@ def init(
     # job-level default runtime env, merged under per-task envs (reference:
     # ray.init(runtime_env=...) becoming the JobConfig default)
     worker.job_runtime_env = dict(runtime_env) if runtime_env else None
+    if include_dashboard and node is not None:
+        from .dashboard import DashboardServer
+
+        node.dashboard = DashboardServer(gcs_address, port=dashboard_port)
+        node.dashboard.start()
     _worker_api.set_core_worker(worker, config, loop_thread=loop_thread, node=node)
     atexit.register(_atexit_shutdown)
     return node

@@ -1,0 +1,196 @@
+"""Dashboard REST server.
+
+Role-equivalent of the reference's dashboard head HTTP surface
+(python/ray/dashboard/head.py + modules: state endpoints backed by
+StateAggregator, job endpoints from dashboard/modules/job/job_head.py, and
+the /metrics Prometheus scrape target from the metrics agent). Implemented
+on the stdlib ThreadingHTTPServer so the head node has zero web-framework
+dependencies; all state queries go over the GCS RPC via a dedicated loop
+thread.
+
+Routes:
+  GET  /api/version
+  GET  /api/nodes | /api/actors | /api/tasks | /api/placement_groups
+  GET  /api/cluster_resources | /api/cluster_status
+  GET  /api/jobs/              (list submitted jobs)
+  POST /api/jobs/              (submit: {"entrypoint": ..., "runtime_env": ...})
+  GET  /api/jobs/{id}
+  POST /api/jobs/{id}/stop
+  GET  /api/jobs/{id}/logs
+  GET  /metrics                (Prometheus text format)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional, Tuple
+
+from .._internal.event_loop import LoopThread
+from .._internal.rpc import RpcClient
+from .job_manager import JobManager
+
+_VERSION = {"ray_tpu_version": "0.1.0", "api_version": "1"}
+
+
+def _ser(obj: Any):
+    """JSON-ify runtime objects (IDs, dataclasses, enums)."""
+    if hasattr(obj, "hex") and callable(obj.hex):
+        return obj.hex()
+    if hasattr(obj, "name") and obj.__class__.__module__ != "builtins":
+        return getattr(obj, "name")
+    if hasattr(obj, "__dict__"):
+        return {k: v for k, v in vars(obj).items() if not k.startswith("_")}
+    return str(obj)
+
+
+class DashboardServer:
+    def __init__(self, gcs_address: Tuple[str, int], host: str = "127.0.0.1",
+                 port: int = 0):
+        self._gcs_address = tuple(gcs_address)
+        self._loop = LoopThread("dashboard")
+        self._gcs_client: Optional[RpcClient] = None
+        self.job_manager = JobManager(self._gcs_address)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                outer._route(self, "GET")
+
+            def do_POST(self):
+                outer._route(self, "POST")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.address = (host, self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dashboard-http", daemon=True
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        self._thread.start()
+        return self.address
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._gcs_client is not None:
+            try:
+                self._loop.run(self._gcs_client.close(), timeout=5.0)
+            except Exception:
+                pass
+            self._gcs_client = None
+        self._loop.stop()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.address[0]}:{self.address[1]}"
+
+    # -- GCS bridge ---------------------------------------------------------
+
+    def _gcs(self, method: str, *args):
+        async def _call():
+            if self._gcs_client is None:
+                self._gcs_client = RpcClient(
+                    *self._gcs_address, name="dashboard-gcs"
+                )
+            return await self._gcs_client.call(method, *args, timeout=10.0)
+
+        return self._loop.run(_call(), timeout=15.0)
+
+    # -- routing ------------------------------------------------------------
+
+    def _route(self, req, verb: str):
+        path = req.path.split("?", 1)[0].rstrip("/")
+        try:
+            body = None
+            if verb == "POST":
+                length = int(req.headers.get("Content-Length") or 0)
+                raw = req.rfile.read(length) if length else b""
+                body = json.loads(raw) if raw else {}
+            handler = self._find_handler(verb, path)
+            if handler is None:
+                return self._send(req, 404, {"error": f"no route {verb} {path}"})
+            status, payload, content_type = handler(body)
+            if content_type == "text/plain":
+                data = payload.encode()
+                req.send_response(status)
+                req.send_header("Content-Type", "text/plain; version=0.0.4")
+                req.send_header("Content-Length", str(len(data)))
+                req.end_headers()
+                req.wfile.write(data)
+            else:
+                self._send(req, status, payload)
+        except KeyError as e:
+            self._send(req, 404, {"error": f"not found: {e}"})
+        except Exception as e:  # noqa: BLE001
+            self._send(req, 500, {"error": str(e)})
+
+    def _send(self, req, status: int, payload):
+        data = json.dumps(payload, default=_ser).encode()
+        req.send_response(status)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        req.wfile.write(data)
+
+    def _find_handler(self, verb: str, path: str):
+        jm = self.job_manager
+        m = re.fullmatch(r"/api/jobs/([^/]+)(/stop|/logs)?", path)
+        if m:
+            job_id, action = m.group(1), m.group(2)
+            if verb == "GET" and action is None:
+                return lambda b: (200, jm.get(job_id).to_dict(), None)
+            if verb == "GET" and action == "/logs":
+                return lambda b: (200, {"logs": jm.logs(job_id)}, None)
+            if verb == "POST" and action == "/stop":
+                return lambda b: (200, {"stopped": jm.stop(job_id)}, None)
+            return None
+        table = {
+            ("GET", "/api/version"): lambda b: (200, _VERSION, None),
+            ("GET", "/api/nodes"): lambda b: (
+                200, self._gcs("get_all_nodes"), None),
+            ("GET", "/api/actors"): lambda b: (
+                200, self._gcs("list_actors"), None),
+            ("GET", "/api/tasks"): lambda b: (
+                200, self._gcs("list_task_events", None, 1000), None),
+            ("GET", "/api/placement_groups"): lambda b: (
+                200, self._gcs("list_placement_groups"), None),
+            ("GET", "/api/cluster_resources"): lambda b: (
+                200, self._gcs("cluster_resources"), None),
+            ("GET", "/api/cluster_status"): lambda b: (
+                200,
+                {
+                    "resource_state": self._gcs("get_cluster_resource_state"),
+                    "autoscaling_state": self._gcs("get_autoscaling_state"),
+                },
+                None,
+            ),
+            ("GET", "/api/jobs"): lambda b: (200, jm.list(), None),
+            ("POST", "/api/jobs"): self._submit_job,
+            ("GET", "/metrics"): self._metrics,
+        }
+        return table.get((verb, path))
+
+    def _submit_job(self, body):
+        if not body or "entrypoint" not in body:
+            return 400, {"error": "body must include 'entrypoint'"}, None
+        submission_id = self.job_manager.submit(
+            entrypoint=body["entrypoint"],
+            submission_id=body.get("submission_id"),
+            runtime_env=body.get("runtime_env"),
+            metadata=body.get("metadata"),
+        )
+        return 200, {"submission_id": submission_id}, None
+
+    def _metrics(self, body):
+        from ..util.metrics import prometheus_text
+
+        return 200, prometheus_text(), "text/plain"

@@ -1,0 +1,169 @@
+"""Job submission: drive driver processes inside the cluster.
+
+Role-equivalent of the reference's job submission stack
+(python/ray/dashboard/modules/job/: job_manager.py driving a supervisor
+that runs the entrypoint as a subprocess, job_head.py REST endpoints,
+common.py JobStatus/JobInfo): a submitted job is a shell entrypoint run as
+a subprocess on the head with RAY_TPU_ADDRESS pointing at the cluster;
+status transitions PENDING -> RUNNING -> SUCCEEDED/FAILED/STOPPED are
+tracked in-process and logs stream to a per-job file.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+JOB_LOG_DIR = "/tmp/ray_tpu_jobs"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+class JobInfo:
+    def __init__(self, submission_id: str, entrypoint: str, metadata: dict):
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.metadata = metadata
+        self.status = JobStatus.PENDING
+        self.message = ""
+        self.start_time = time.time()
+        self.end_time: Optional[float] = None
+        self.log_path = os.path.join(JOB_LOG_DIR, f"{submission_id}.log")
+
+    def to_dict(self) -> dict:
+        return {
+            "submission_id": self.submission_id,
+            "entrypoint": self.entrypoint,
+            "status": self.status,
+            "message": self.message,
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+            "metadata": self.metadata,
+        }
+
+
+class JobManager:
+    """Runs on the head (inside the dashboard server process)."""
+
+    def __init__(self, gcs_address):
+        self._gcs_address = gcs_address
+        self._jobs: Dict[str, JobInfo] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        os.makedirs(JOB_LOG_DIR, exist_ok=True)
+
+    def submit(
+        self,
+        entrypoint: str,
+        submission_id: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
+        metadata: Optional[dict] = None,
+    ) -> str:
+        submission_id = submission_id or f"raysubmit_{secrets.token_hex(8)}"
+        with self._lock:
+            if submission_id in self._jobs:
+                raise ValueError(f"job {submission_id!r} already exists")
+            info = JobInfo(submission_id, entrypoint, metadata or {})
+            self._jobs[submission_id] = info
+
+        env = dict(os.environ)
+        host, port = self._gcs_address
+        env["RAY_TPU_ADDRESS"] = f"{host}:{port}"
+        env["RAY_TPU_JOB_SUBMISSION_ID"] = submission_id
+        cwd = None
+        if runtime_env:
+            for k, v in (runtime_env.get("env_vars") or {}).items():
+                env[k] = v
+            wd = runtime_env.get("working_dir")
+            if wd and os.path.isdir(wd):
+                cwd = wd
+                env["PYTHONPATH"] = (
+                    wd + os.pathsep + env.get("PYTHONPATH", "")
+                ).rstrip(os.pathsep)
+
+        log_file = open(info.log_path, "wb")
+        try:
+            proc = subprocess.Popen(
+                entrypoint,
+                shell=True,
+                env=env,
+                cwd=cwd,
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,  # own process group for stop_job
+            )
+        except OSError as e:
+            info.status = JobStatus.FAILED
+            info.message = str(e)
+            info.end_time = time.time()
+            log_file.close()
+            return submission_id
+        info.status = JobStatus.RUNNING
+        self._procs[submission_id] = proc
+        threading.Thread(
+            target=self._wait_job, args=(submission_id, proc, log_file),
+            daemon=True,
+        ).start()
+        return submission_id
+
+    def _wait_job(self, submission_id: str, proc: subprocess.Popen, log_file):
+        rc = proc.wait()
+        log_file.close()
+        with self._lock:
+            info = self._jobs[submission_id]
+            if info.status == JobStatus.STOPPED:
+                pass
+            elif rc == 0:
+                info.status = JobStatus.SUCCEEDED
+            else:
+                info.status = JobStatus.FAILED
+                info.message = f"entrypoint exited with code {rc}"
+            info.end_time = time.time()
+            self._procs.pop(submission_id, None)
+
+    def stop(self, submission_id: str) -> bool:
+        with self._lock:
+            info = self._jobs.get(submission_id)
+            proc = self._procs.get(submission_id)
+            if info is None:
+                raise KeyError(submission_id)
+            if proc is None or info.status in JobStatus.TERMINAL:
+                return False
+            info.status = JobStatus.STOPPED
+            info.message = "stopped by user"
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+        return True
+
+    def get(self, submission_id: str) -> JobInfo:
+        info = self._jobs.get(submission_id)
+        if info is None:
+            raise KeyError(submission_id)
+        return info
+
+    def list(self) -> List[dict]:
+        return [j.to_dict() for j in self._jobs.values()]
+
+    def logs(self, submission_id: str) -> str:
+        info = self.get(submission_id)
+        try:
+            with open(info.log_path, "rb") as f:
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""

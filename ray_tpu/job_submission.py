@@ -1,0 +1,86 @@
+"""Job submission SDK.
+
+Role-equivalent of the reference's JobSubmissionClient
+(python/ray/dashboard/modules/job/sdk.py): a thin HTTP client against the
+dashboard's job REST endpoints. The entrypoint runs as a driver subprocess
+on the head with RAY_TPU_ADDRESS set, exactly like `ray job submit`.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from .dashboard.job_manager import JobStatus
+
+__all__ = ["JobSubmissionClient", "JobStatus"]
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        """``address`` is the dashboard URL, e.g. http://127.0.0.1:8265."""
+        self._base = address.rstrip("/")
+
+    def _request(self, verb: str, path: str, body: Optional[dict] = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self._base + path,
+            data=data,
+            method=verb,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise RuntimeError(f"{verb} {path} -> {e.code}: {detail}") from None
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
+        metadata: Optional[dict] = None,
+    ) -> str:
+        reply = self._request(
+            "POST",
+            "/api/jobs",
+            {
+                "entrypoint": entrypoint,
+                "submission_id": submission_id,
+                "runtime_env": runtime_env,
+                "metadata": metadata,
+            },
+        )
+        return reply["submission_id"]
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self._request("GET", f"/api/jobs/{submission_id}")["status"]
+
+    def get_job_info(self, submission_id: str) -> Dict[str, Any]:
+        return self._request("GET", f"/api/jobs/{submission_id}")
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return self._request("GET", f"/api/jobs/{submission_id}/logs")["logs"]
+
+    def stop_job(self, submission_id: str) -> bool:
+        return self._request("POST", f"/api/jobs/{submission_id}/stop")["stopped"]
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return self._request("GET", "/api/jobs")
+
+    def wait_until_finished(
+        self, submission_id: str, timeout: float = 300.0, poll_s: float = 0.5
+    ) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(poll_s)
+        raise TimeoutError(f"job {submission_id} still running after {timeout}s")

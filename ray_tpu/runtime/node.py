@@ -65,6 +65,12 @@ class Node:
         return self.raylet.node_id
 
     def stop(self):
+        dashboard = getattr(self, "dashboard", None)
+        if dashboard is not None:
+            try:
+                dashboard.stop()
+            except Exception:
+                pass
         try:
             self.loop_thread.run(self.raylet.stop(), timeout=10)
         except Exception:

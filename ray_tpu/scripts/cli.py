@@ -41,6 +41,14 @@ def cmd_start(args):
         host, port = node.gcs_address
         print(f"ray_tpu head started; connect with:")
         print(f'  ray_tpu.init(address="{host}:{port}")')
+        if not args.no_dashboard:
+            from ..dashboard import DashboardServer
+
+            dash = DashboardServer(
+                node.gcs_address, port=args.dashboard_port
+            )
+            dash.start()
+            print(f"dashboard + job API at {dash.url}")
     else:
         if not args.address:
             print("worker nodes need --address host:port", file=sys.stderr)
@@ -117,6 +125,44 @@ def cmd_metrics(args):
     return 0
 
 
+def cmd_job(args):
+    """`ray_tpu job submit|status|logs|stop|list` (reference: `ray job`
+    subcommands, dashboard/modules/job/cli.py)."""
+    from ..job_submission import JobSubmissionClient
+
+    address = args.address
+    if not address.startswith("http"):
+        address = f"http://{address}"
+    client = JobSubmissionClient(address)
+    if args.action == "submit":
+        entrypoint = " ".join(a for a in args.entrypoint if a != "--")
+        if not entrypoint:
+            print("job submit needs an entrypoint", file=sys.stderr)
+            return 1
+        runtime_env = (
+            {"working_dir": args.working_dir} if args.working_dir else None
+        )
+        sid = client.submit_job(
+            entrypoint=entrypoint,
+            submission_id=args.submission_id,
+            runtime_env=runtime_env,
+        )
+        print(sid)
+    elif args.action == "list":
+        print(json.dumps(client.list_jobs(), indent=2))
+    else:
+        if not args.submission_id:
+            print(f"job {args.action} needs --submission-id", file=sys.stderr)
+            return 1
+        if args.action == "status":
+            print(client.get_job_status(args.submission_id))
+        elif args.action == "logs":
+            print(client.get_job_logs(args.submission_id), end="")
+        elif args.action == "stop":
+            print(client.stop_job(args.submission_id))
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -130,7 +176,19 @@ def main(argv=None):
     p.add_argument("--labels", default=None, help="JSON label map")
     p.add_argument("--object-store-memory", type=int, default=None)
     p.add_argument("--block", action="store_true")
+    p.add_argument("--no-dashboard", action="store_true")
+    p.add_argument("--dashboard-port", type=int, default=8265)
     p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("job", help="submit and manage jobs")
+    p.add_argument(
+        "action", choices=["submit", "status", "logs", "stop", "list"]
+    )
+    p.add_argument("--address", required=True, help="dashboard URL")
+    p.add_argument("--submission-id", default=None)
+    p.add_argument("--working-dir", default=None)
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_job)
 
     for name, fn in (
         ("status", cmd_status),

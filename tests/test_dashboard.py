@@ -1,0 +1,110 @@
+"""Dashboard REST API + job submission tests.
+
+Models the reference's dashboard/job tests
+(python/ray/dashboard/modules/job/tests/test_job_manager.py and the state
+head endpoint tests): REST state endpoints against a live cluster, job
+submit/status/logs/stop through the SDK, and the Prometheus scrape target.
+"""
+
+import json
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture
+def dashboard_cluster():
+    ray_tpu.init(num_cpus=4, resources={"TPU": 4}, include_dashboard=True)
+    from ray_tpu import _worker_api
+
+    node = _worker_api.get_node()
+    yield node.dashboard
+    ray_tpu.shutdown()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_version_and_nodes(dashboard_cluster):
+    dash = dashboard_cluster
+    assert _get_json(dash.url + "/api/version")["api_version"] == "1"
+    nodes = _get_json(dash.url + "/api/nodes")
+    assert len(nodes) == 1
+    assert nodes[0]["alive"] is True
+
+
+def test_state_endpoints(dashboard_cluster):
+    dash = dashboard_cluster
+
+    @ray_tpu.remote
+    class Sleeper:
+        def ping(self):
+            return 1
+
+    a = Sleeper.remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+    time.sleep(1.5)  # task-event flush
+    actors = _get_json(dash.url + "/api/actors")
+    assert len(actors) >= 1
+    tasks = _get_json(dash.url + "/api/tasks")
+    assert isinstance(tasks, list)
+    status = _get_json(dash.url + "/api/cluster_status")
+    assert "resource_state" in status
+    assert any(n["alive"] for n in status["resource_state"]["nodes"])
+
+
+def test_metrics_endpoint(dashboard_cluster):
+    dash = dashboard_cluster
+    with urllib.request.urlopen(dash.url + "/metrics", timeout=10) as resp:
+        body = resp.read().decode()
+    assert resp.status == 200 or body is not None
+
+
+def test_job_submit_and_wait(dashboard_cluster):
+    dash = dashboard_cluster
+    client = JobSubmissionClient(dash.url)
+    script = (
+        "import os, ray_tpu; "
+        "ray_tpu.init(address=os.environ['RAY_TPU_ADDRESS']); "
+        "print('job-output:', ray_tpu.get(ray_tpu.remote(lambda: 40 + 2).remote()))"
+    )
+    sid = client.submit_job(entrypoint=f'{sys.executable} -c "{script}"')
+    status = client.wait_until_finished(sid, timeout=120)
+    logs = client.get_job_logs(sid)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "job-output: 42" in logs
+
+
+def test_job_failure_status(dashboard_cluster):
+    client = JobSubmissionClient(dashboard_cluster.url)
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(sid, timeout=60) == JobStatus.FAILED
+    info = client.get_job_info(sid)
+    assert "code 3" in info["message"]
+
+
+def test_job_stop(dashboard_cluster):
+    client = JobSubmissionClient(dashboard_cluster.url)
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'"
+    )
+    time.sleep(0.5)
+    assert client.stop_job(sid) is True
+    assert client.wait_until_finished(sid, timeout=60) == JobStatus.STOPPED
+
+
+def test_job_list_and_unknown(dashboard_cluster):
+    client = JobSubmissionClient(dashboard_cluster.url)
+    sid = client.submit_job(entrypoint="true")
+    client.wait_until_finished(sid, timeout=60)
+    jobs = client.list_jobs()
+    assert any(j["submission_id"] == sid for j in jobs)
+    with pytest.raises(RuntimeError, match="404"):
+        client.get_job_status("raysubmit_doesnotexist")
