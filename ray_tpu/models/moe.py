@@ -1,0 +1,237 @@
+"""Mixture-of-Experts transformer (Mixtral-style), TPU-first.
+
+No reference analogue (the reference serves MoE through vLLM engine kwargs
+— SURVEY §2c "EP delegated"); here the framework owns the model layer.
+Mixtral-shape: LLaMA attention blocks with the dense FFN replaced by a
+top-k routed expert FFN. Expert weights carry the ``expert`` logical axis
+(sharded over the ``ep`` mesh axis by parallel/sharding.py rules); the
+dispatch/combine einsums (parallel/expert.py) lower to all_to_alls under
+GSPMD — no manual collectives in model code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..ops.rmsnorm import rmsnorm
+from ..ops.rope import rope_table
+from ..parallel.expert import expert_capacity, moe_apply_gspmd, top_k_gating
+from .llama import Attention, LlamaConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    intermediate: int = 14336
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.02
+    max_seq_len: int = 4096
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def attention_config(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size,
+            dim=self.dim,
+            n_layers=self.n_layers,
+            n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads,
+            intermediate=self.intermediate,
+            max_seq_len=self.max_seq_len,
+            rope_theta=self.rope_theta,
+            norm_eps=self.norm_eps,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            remat=self.remat,
+        )
+
+    @staticmethod
+    def mixtral_8x7b(**kw) -> "MoEConfig":
+        return MoEConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "MoEConfig":
+        defaults = dict(
+            vocab_size=256, dim=128, n_layers=2, n_heads=4, n_kv_heads=4,
+            intermediate=256, n_experts=4, experts_per_token=2,
+            max_seq_len=512, remat=False,
+        )
+        defaults.update(kw)
+        return MoEConfig(**defaults)
+
+
+class MoEFFN(nn.Module):
+    """Top-k routed SwiGLU expert FFN. Router aux loss is emitted through
+    the ``losses`` collection (sown) for the trainer to add."""
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):  # (b, s, d)
+        cfg = self.config
+        b, s, d = x.shape
+        tokens = x.reshape(b * s, d)
+
+        router_w = self.param(
+            "router",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "expert")
+            ),
+            (cfg.dim, cfg.n_experts),
+            cfg.param_dtype,
+        )
+        logits = tokens.astype(jnp.float32) @ router_w.astype(jnp.float32)
+        capacity = expert_capacity(
+            b * s, cfg.n_experts, cfg.capacity_factor, cfg.experts_per_token
+        )
+        dispatch, combine, aux = top_k_gating(
+            logits, capacity, k=cfg.experts_per_token
+        )
+        self.sow("losses", "router_aux", cfg.router_aux_weight * aux)
+
+        w_gate = self.param(
+            "w_gate",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "embed", "mlp")
+            ),
+            (cfg.n_experts, cfg.dim, cfg.intermediate),
+            cfg.param_dtype,
+        )
+        w_up = self.param(
+            "w_up",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "embed", "mlp")
+            ),
+            (cfg.n_experts, cfg.dim, cfg.intermediate),
+            cfg.param_dtype,
+        )
+        w_down = self.param(
+            "w_down",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "mlp", "embed")
+            ),
+            (cfg.n_experts, cfg.intermediate, cfg.dim),
+            cfg.param_dtype,
+        )
+
+        def experts(inp):  # (E, C, d) -> (E, C, d)
+            gate = jnp.einsum("ecd,edf->ecf", inp, w_gate.astype(inp.dtype))
+            up = jnp.einsum("ecd,edf->ecf", inp, w_up.astype(inp.dtype))
+            return jnp.einsum(
+                "ecf,efd->ecd", nn.silu(gate) * up, w_down.astype(inp.dtype)
+            )
+
+        out = moe_apply_gspmd(tokens, dispatch, combine, experts)
+        return out.reshape(b, s, d)
+
+
+class MoEBlock(nn.Module):
+    config: MoEConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        cfg = self.config
+        attn_cfg = cfg.attention_config()
+        attn_norm_w = self.param(
+            "attn_norm",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (cfg.dim,),
+            cfg.param_dtype,
+        )
+        h = x + Attention(attn_cfg, self.mesh, name="attn")(
+            rmsnorm(x, attn_norm_w.astype(x.dtype), cfg.norm_eps), cos, sin
+        )
+        ffn_norm_w = self.param(
+            "ffn_norm",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (cfg.dim,),
+            cfg.param_dtype,
+        )
+        return h + MoEFFN(cfg, name="moe")(
+            rmsnorm(h, ffn_norm_w.astype(h.dtype), cfg.norm_eps)
+        )
+
+
+class MoETransformer(nn.Module):
+    config: MoEConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, tokens):  # (batch, seq) int32
+        cfg = self.config
+        embed = self.param(
+            "embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.dim),
+            cfg.param_dtype,
+        )
+        x = embed.astype(cfg.dtype)[tokens]
+        cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+        block = MoEBlock
+        if cfg.remat:
+            block = nn.remat(
+                MoEBlock,
+                policy=jax.checkpoint_policies.save_only_these_names(),
+                prevent_cse=False,
+            )
+        for i in range(cfg.n_layers):
+            x = block(cfg, self.mesh, name=f"layer_{i}")(x, cos, sin)
+        final_norm_w = self.param(
+            "final_norm",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (cfg.dim,),
+            cfg.param_dtype,
+        )
+        x = rmsnorm(x, final_norm_w.astype(x.dtype), cfg.norm_eps)
+        head = self.param(
+            "lm_head",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("embed", "vocab")
+            ),
+            (cfg.dim, cfg.vocab_size),
+            cfg.param_dtype,
+        )
+        return x @ head.astype(x.dtype)
+
+
+def init_params(config: MoEConfig, rng, mesh: Optional[Mesh] = None, seq: int = 8):
+    model = MoETransformer(config, mesh)
+    tokens = jnp.zeros((1, seq), jnp.int32)
+    return model.init(rng, tokens)["params"]
+
+
+def next_token_loss(config: MoEConfig, mesh, params, tokens):
+    """Causal LM loss + router load-balance aux losses."""
+    model = MoETransformer(config, mesh)
+    logits, aux = model.apply(
+        {"params": params}, tokens, mutable=["losses"]
+    )
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    targets = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = nll.mean()
+    for leaf in jax.tree.leaves(aux.get("losses", {})):
+        loss = loss + jnp.sum(leaf)
+    return loss

@@ -1,0 +1,145 @@
+"""Expert parallelism: MoE gating, dispatch, and combine.
+
+No reference analogue (SURVEY §2c: EP is "delegated" to engines in the
+reference; here the framework owns it). GShard/Switch-style top-k routing
+with static capacity so every shape is compile-time constant (XLA/TPU needs
+static shapes — no gather/scatter of ragged expert batches):
+
+- ``top_k_gating`` builds dispatch/combine tensors (tokens, experts,
+  capacity) plus the load-balancing auxiliary loss
+- ``moe_apply_gspmd`` runs the experts with einsums and lets GSPMD insert
+  the all-to-alls from the ``expert`` logical-axis sharding (the pjit path
+  used by models/moe.py)
+- ``moe_dispatch`` / ``moe_combine`` are the explicit shard_map path: a
+  ``lax.all_to_all`` over the ``ep`` axis moves (expert, capacity, dim)
+  slabs so each rank runs only its local experts — for hand-scheduled
+  kernels and tests of the comm pattern itself
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def expert_capacity(tokens: int, n_experts: int, capacity_factor: float,
+                    k: int = 2) -> int:
+    """Static per-expert token capacity (reference pattern: GShard cap)."""
+    return max(1, int(math.ceil(tokens * k * capacity_factor / n_experts)))
+
+
+def top_k_gating(
+    router_logits: jax.Array,  # (tokens, experts) f32
+    capacity: int,
+    k: int = 2,
+):
+    """Build dispatch/combine tensors with static capacity.
+
+    Returns:
+      dispatch: (tokens, experts, capacity) bool-ish f32 — token t goes to
+        expert e at slot c
+      combine:  (tokens, experts, capacity) f32 — gate weight for the same
+      aux_loss: load-balance loss (Switch-style: E * sum(frac_tokens * frac_prob))
+    """
+    t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    # running per-expert fill count, updated between the k passes
+    position_in_expert = jnp.zeros((e,), jnp.int32)
+    masked = probs
+    for _ in range(k):
+        gate = jnp.max(masked, axis=-1)  # (t,)
+        idx = jnp.argmax(masked, axis=-1)  # (t,)
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (t, e)
+        # slot index for each token within its chosen expert: running count
+        # of earlier tokens choosing the same expert, offset by prior passes
+        pos = jnp.cumsum(onehot, axis=0) - 1.0 + position_in_expert[None, :]
+        pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # (t,)
+        keep = pos_tok < capacity
+        slot = jax.nn.one_hot(pos_tok, capacity, dtype=jnp.float32)  # (t, c)
+        sel = onehot * keep[:, None].astype(jnp.float32)
+        dispatch = dispatch + sel[:, :, None] * slot[:, None, :]
+        combine = combine + (gate * keep)[:, None, None] * (
+            sel[:, :, None] * slot[:, None, :]
+        )
+        position_in_expert = position_in_expert + jnp.sum(
+            onehot * keep[:, None], axis=0
+        ).astype(jnp.int32)
+        masked = masked * (1.0 - onehot)  # exclude chosen expert next pass
+
+    # renormalize combine weights over the k selected experts
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+
+    frac_tokens = jnp.mean(
+        (jnp.sum(dispatch, axis=-1) > 0).astype(jnp.float32), axis=0
+    )
+    frac_probs = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux_loss
+
+
+def moe_apply_gspmd(
+    x: jax.Array,  # (tokens, dim)
+    dispatch: jax.Array,  # (tokens, E, C)
+    combine: jax.Array,  # (tokens, E, C)
+    expert_fn: Callable[[jax.Array], jax.Array],  # (E, C, dim) -> (E, C, dim_out)
+) -> jax.Array:
+    """pjit path: einsum dispatch -> per-expert compute -> einsum combine.
+    With expert weights annotated on the ``expert`` logical axis, GSPMD
+    lowers the einsums to all_to_alls over the ep mesh axis."""
+    expert_inputs = jnp.einsum(
+        "td,tec->ecd", x.astype(jnp.float32), dispatch
+    ).astype(x.dtype)
+    expert_outputs = expert_fn(expert_inputs)  # (E, C, d_out)
+    return jnp.einsum(
+        "ecd,tec->td", expert_outputs.astype(jnp.float32), combine
+    ).astype(x.dtype)
+
+
+# -- explicit shard_map path -------------------------------------------------
+
+
+def moe_dispatch(x, dispatch, axis_name: str = "ep"):
+    """Inside shard_map: local tokens -> this rank's local experts' slabs.
+
+    x: (tokens_local, d); dispatch: (tokens_local, E_global, C).
+    Returns (E_local, n * C, d): every rank's contribution to our experts.
+    """
+    n = lax.psum(1, axis_name)
+    slabs = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch).astype(
+        x.dtype
+    )  # (E_global, C, d)
+    e_global, c, d = slabs.shape
+    if e_global % n != 0:
+        raise ValueError(f"experts ({e_global}) not divisible by ep axis ({n})")
+    # split expert dim across ranks, gather source-rank dim in its place
+    slabs = slabs.reshape(n, e_global // n, c, d)
+    recv = lax.all_to_all(slabs, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)  # (n, E_local, C, d), dim0 = source rank
+    n_, e_local, c_, d_ = recv.shape
+    return recv.transpose(1, 0, 2, 3).reshape(e_local, n_ * c_, d_)
+
+
+def moe_combine(y_local, combine, axis_name: str = "ep"):
+    """Inverse of moe_dispatch: local expert outputs -> local tokens.
+
+    y_local: (E_local, n * C, d_out); combine: (tokens_local, E_global, C).
+    """
+    n = lax.psum(1, axis_name)
+    e_local, nc, d = y_local.shape
+    c = nc // n
+    slabs = y_local.reshape(e_local, n, c, d).transpose(1, 0, 2, 3)
+    # send each source-rank slab home: (n, E_local, C, d) -> full expert dim
+    back = lax.all_to_all(slabs, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)  # (n, E_local, C, d), dim0 = expert group
+    slabs_home = back.reshape(n * e_local, c, d)  # (E_global, C, d)
+    return jnp.einsum(
+        "ecd,tec->td", slabs_home.astype(jnp.float32), combine
+    ).astype(y_local.dtype)

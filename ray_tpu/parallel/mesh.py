@@ -26,8 +26,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# canonical axis order: slowest (DCN) to fastest (ICI-minor)
-AXIS_ORDER = ("dcn", "dp", "fsdp", "ep", "sp", "tp")
+# canonical axis order: slowest (DCN) to fastest (ICI-minor). pp sits just
+# under dcn: stage-boundary transfers are point-to-point and latency-tolerant
+# (one activation per microbatch tick), so they take the slowest links.
+AXIS_ORDER = ("dcn", "pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
@@ -40,11 +42,13 @@ class MeshSpec:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
     dcn: int = 1
 
     def resolved_sizes(self, num_devices: int) -> Dict[str, int]:
         sizes = {
             "dcn": self.dcn,
+            "pp": self.pp,
             "dp": self.dp,
             "fsdp": self.fsdp,
             "ep": self.ep,
@@ -89,10 +93,11 @@ def make_mesh(
     tp: int = 1,
     sp: int = 1,
     ep: int = 1,
+    pp: int = 1,
     dcn: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    spec = MeshSpec(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep, dcn=dcn)
+    spec = MeshSpec(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep, pp=pp, dcn=dcn)
     devs = list(devices if devices is not None else jax.devices())
     if num_devices is not None:
         devs = devs[:num_devices]

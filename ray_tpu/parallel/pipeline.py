@@ -1,0 +1,92 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` mesh axis.
+
+The reference gets PP from vLLM engine kwargs or compiled-graph GPU-GPU
+channels (SURVEY §2c); here it is a mesh-native construct: every pp rank
+holds one stage's parameters, microbatch activations hop to the next stage
+with one ``lax.ppermute`` per tick, and a ``lax.scan`` over
+``n_micro + n_stages - 1`` ticks runs the classic GPipe fill/steady/drain
+schedule — all inside one jit program, so XLA overlaps the stage compute of
+tick t with the activation transfer of tick t+1.
+
+Run inside shard_map with the stage's params already sharded over ``pp``
+(stack per-stage pytrees on a leading axis; shard that axis over pp and
+index with rank inside — or pass params_local directly).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,  # (n_micro, mb_size, ...) replicated over pp
+    *,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Run ``y = stage_{n-1}(...stage_0(x))`` for each microbatch.
+
+    stage_fn(stage_params, x) -> y must keep the activation shape (equal
+    widths between stages; pad stages otherwise). Returns (n_micro, mb_size,
+    ...) valid on the LAST pp rank (other ranks hold zeros); psum or
+    ppermute it home if every rank needs the output.
+    """
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    ticks = n_micro + n - 1
+    act_shape = microbatches.shape[1:]
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # stage 0 injects microbatch t while filling; later ranks use the
+        # activation that arrived from the previous rank last tick
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        injected = lax.dynamic_index_in_dim(
+            microbatches, mb_idx, axis=0, keepdims=False
+        )
+        x = jnp.where(me == 0, injected, buf)
+        y = stage_fn(stage_params, x)
+        # the microbatch leaving the last stage at tick t is mb (t - (n-1))
+        out_idx = t - (n - 1)
+        is_out = jnp.logical_and(me == n - 1, out_idx >= 0)
+        outputs = lax.cond(
+            is_out,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.clip(out_idx, 0, n_micro - 1), axis=0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        # hop to the next stage (last rank's y drops out of the ring)
+        nxt = lax.ppermute(
+            y, axis_name, [(i, i + 1) for i in range(n - 1)]
+        )
+        return (nxt, outputs), None
+
+    buf0 = jnp.zeros(act_shape, microbatches.dtype)
+    outputs0 = jnp.zeros((n_micro,) + act_shape, microbatches.dtype)
+    (_, outputs), _ = lax.scan(
+        tick, (buf0, outputs0), jnp.arange(ticks)
+    )
+    return outputs
+
+
+def stage_index(axis_name: str = "pp"):
+    """This rank's pipeline stage id (for indexing stacked stage params)."""
+    return lax.axis_index(axis_name)
+
+
+def select_stage_params(stacked_params: Any, axis_name: str = "pp"):
+    """Index a (n_stages, ...)-stacked param pytree by this rank's stage —
+    use inside shard_map when stage weights arrive replicated."""
+    idx = lax.axis_index(axis_name)
+    return jax.tree.map(
+        lambda p: lax.dynamic_index_in_dim(p, idx, axis=0, keepdims=False),
+        stacked_params,
+    )

@@ -1,0 +1,66 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all head/seq swap.
+
+No reference analogue (SURVEY §2c: SP "must be built natively"). The
+alternative to ring attention (parallel/ring_attention.py) when the head
+count is divisible by the sp axis: instead of rotating K/V around a ring,
+one ``jax.lax.all_to_all`` re-shards q/k/v from sequence-sharded to
+head-sharded, every rank runs ordinary full-sequence flash attention on its
+head subset, and a second all_to_all restores sequence sharding. Two
+all-to-alls of the activation per attention call vs. (n-1) K/V neighbor
+hops for the ring: Ulysses wins when heads >= sp and sequence length per
+step is moderate; the ring wins for very long sequences (K/V smaller than
+activations) — both are provided.
+
+Call inside shard_map with (batch, heads, seq_local, head_dim) shards.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.flash_attention import flash_attention
+
+
+def _seq_to_heads(x, axis_name: str):
+    # (b, h, s_local, d) -> (b, h/n, s_global, d)
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def _heads_to_seq(x, axis_name: str):
+    # (b, h/n, s_global, d) -> (b, h, s_local, d)
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    sm_scale: Optional[float] = None,
+    causal: bool = True,
+) -> jax.Array:
+    """Causal attention with the sequence sharded over ``axis_name`` via the
+    all-to-all head/sequence swap. Requires n_heads % axis_size == 0. GQA kv
+    heads are repeated to q heads first (so the swap is uniform)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = lax.psum(1, axis_name)
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[1]}) divisible by sp axis ({n})"
+        )
+    qg = _seq_to_heads(q, axis_name)
+    kg = _seq_to_heads(k, axis_name)
+    vg = _seq_to_heads(v, axis_name)
+    out = flash_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale)
+    return _heads_to_seq(out, axis_name)

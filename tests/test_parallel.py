@@ -21,7 +21,9 @@ pytestmark = pytest.mark.skipif(
 def test_mesh_spec_resolution():
     spec = MeshSpec(dp=2, fsdp=-1, tp=2)
     sizes = spec.resolved_sizes(8)
-    assert sizes == {"dcn": 1, "dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
+    assert sizes == {
+        "dcn": 1, "pp": 1, "dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2,
+    }
     with pytest.raises(ValueError):
         MeshSpec(dp=3).resolved_sizes(8)
 
