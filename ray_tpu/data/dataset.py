@@ -79,6 +79,8 @@ class Dataset:
         compute: Optional[ActorPoolStrategy] = None,
         fn_args: tuple = (),
         fn_kwargs: Optional[dict] = None,
+        fn_constructor_args: tuple = (),
+        fn_constructor_kwargs: Optional[dict] = None,
         num_cpus: Optional[float] = None,
         num_tpus: Optional[float] = None,
         **ray_remote_args,
@@ -96,7 +98,9 @@ class Dataset:
                 input_op=self._op,
                 transforms=[
                     planlib.BatchTransform(
-                        fn, batch_size, fn_args, fn_kwargs or {}
+                        fn, batch_size, fn_args, fn_kwargs or {},
+                        fn_constructor_args=fn_constructor_args,
+                        fn_constructor_kwargs=fn_constructor_kwargs or {},
                     )
                 ],
                 compute=compute,

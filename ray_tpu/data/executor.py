@@ -263,7 +263,7 @@ class _PoolWorker:
         self._transforms = []
         for t in transforms:
             if isinstance(t, planlib.BatchTransform) and isinstance(t.fn, type):
-                inst = t.fn()
+                inst = t.fn(*t.fn_constructor_args, **t.fn_constructor_kwargs)
                 t = planlib.BatchTransform(
                     inst, t.batch_size, t.fn_args, t.fn_kwargs
                 )

@@ -40,6 +40,10 @@ class BatchTransform:
     fn_args: tuple = ()
     fn_kwargs: dict = field(default_factory=dict)
     zero_copy: bool = False
+    # constructor args for callable-class fns, applied once per pool worker
+    # (reference: map_batches fn_constructor_args)
+    fn_constructor_args: tuple = ()
+    fn_constructor_kwargs: dict = field(default_factory=dict)
 
 
 Transform = Any  # RowTransform | BatchTransform

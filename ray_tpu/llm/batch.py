@@ -1,0 +1,57 @@
+"""Offline batch inference: an LLM stage for ray_tpu.data pipelines.
+
+Role-equivalent of the reference's vLLM batch stage
+(llm/_internal/batch/stages/vllm_engine_stage.py — a map_batches UDF class
+holding an engine): use with ``Dataset.map_batches(LLMPredictor, ...,
+compute=ActorPoolStrategy(size=N))`` so each actor pins one engine (and its
+TPU chips) and streams batches through it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .config import LLMConfig
+from .engine import GenerationRequest, LLMEngine
+
+
+class LLMPredictor:
+    """map_batches UDF: {"token_ids": list-of-lists} -> adds "generated"."""
+
+    def __init__(self, llm_config: Optional[LLMConfig] = None,
+                 params_blob: Optional[bytes] = None):
+        import jax
+
+        from ..parallel.sharding import unbox_params
+
+        self._config = llm_config or LLMConfig()
+        model_config = self._config.build_model_config()
+        if params_blob is not None:
+            from .._internal import serialization
+
+            params = serialization.loads(params_blob)
+        else:
+            from ..models.llama import init_params
+
+            params = unbox_params(
+                init_params(model_config, jax.random.PRNGKey(0))
+            )
+        self._engine = LLMEngine(
+            model_config, params,
+            max_batch_size=self._config.max_batch_size,
+        )
+
+    def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        prompts = batch["token_ids"]
+        requests = [
+            GenerationRequest(
+                token_ids=list(p),
+                max_new_tokens=self._config.max_new_tokens,
+                temperature=self._config.temperature,
+            )
+            for p in prompts
+        ]
+        results = self._engine.generate(requests)
+        out = dict(batch)
+        out["generated"] = [r.token_ids for r in results]
+        return out

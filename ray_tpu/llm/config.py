@@ -1,0 +1,53 @@
+"""LLM deployment configuration.
+
+Role-equivalent of the reference's LLMConfig (llm/_internal/serve/configs/
+server_models.py): model family + engine kwargs + per-replica resources.
+``tensor_parallel_size`` maps to the mesh ``tp`` axis instead of vLLM's
+NCCL groups (reference: vllm_models.py:215,219).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class LLMConfig:
+    model_id: str = "llama-tiny"
+    # model construction: either a models.llama config name or kwargs
+    model_family: str = "llama"  # "llama" | "moe"
+    model_kwargs: Dict[str, Any] = field(default_factory=dict)
+    max_seq_len: int = 512
+    max_batch_size: int = 8
+    # parallelism (reference: engine_kwargs tensor_parallel_size / pp)
+    tensor_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    # serving
+    num_replicas: int = 1
+    resources_per_replica: Dict[str, float] = field(
+        default_factory=lambda: {"TPU": 0.0, "CPU": 1.0}
+    )
+    # generation defaults
+    max_new_tokens: int = 64
+    temperature: float = 0.0  # 0 = greedy
+    seed: int = 0
+
+    def build_model_config(self):
+        if self.model_family == "llama":
+            from ..models.llama import LlamaConfig
+
+            kwargs = dict(self.model_kwargs)
+            kwargs.setdefault("max_seq_len", self.max_seq_len)
+            return LlamaConfig.tiny(**kwargs) if self.model_id.endswith(
+                "tiny"
+            ) else LlamaConfig(**kwargs)
+        if self.model_family == "moe":
+            from ..models.moe import MoEConfig
+
+            kwargs = dict(self.model_kwargs)
+            kwargs.setdefault("max_seq_len", self.max_seq_len)
+            return MoEConfig.tiny(**kwargs) if self.model_id.endswith(
+                "tiny"
+            ) else MoEConfig(**kwargs)
+        raise ValueError(f"unknown model family {self.model_family!r}")

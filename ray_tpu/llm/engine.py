@@ -1,0 +1,158 @@
+"""The TPU LLM engine: jitted prefill + decode with a KV cache.
+
+Role-equivalent of the vLLM engine the reference wraps
+(llm/_internal/batch/stages/vllm_engine_stage.py submits prompts to
+AsyncLLMEngine); TPU-native design:
+
+- **prefill** runs the model over the whole prompt batch in decode mode,
+  writing every layer's K/V into the cache collection in one MXU-heavy pass
+- **decode** is one token per step for the whole batch — a single jit
+  program re-run with the carried cache, so XLA compiles exactly two
+  programs per (batch, prompt_len) bucket and the HBM-resident cache never
+  leaves the device
+- **static shapes**: requests are grouped by prompt length (no padding — a
+  left pad would sit inside the causal window and pollute attention; a
+  right pad would desync the shared cache index). Each group is one
+  prefill + decode loop; distinct shapes compile once and hit the jit
+  cache afterwards. EOS'd rows keep decoding with outputs masked — wasted
+  FLOPs on finished rows are the standard TPU trade for static shapes.
+
+Greedy and temperature sampling; per-request max_new_tokens.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class GenerationRequest:
+    token_ids: List[int]
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    eos_token_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    token_ids: List[int]  # generated tokens only
+    num_prompt_tokens: int
+    finished_reason: str  # "eos" | "length"
+
+
+class LLMEngine:
+    def __init__(self, model_config, params, mesh=None, max_batch_size: int = 8):
+        from ..models.llama import Llama
+
+        self._cfg = model_config
+        self._params = params
+        self._mesh = mesh
+        self._max_batch = max_batch_size
+        self._model = Llama(model_config, mesh, decode=True)
+        self._prefill = jax.jit(self._prefill_impl)
+        self._decode = jax.jit(self._decode_impl)
+
+    # -- jitted programs -----------------------------------------------------
+
+    def _prefill_impl(self, params, tokens):
+        logits, vars_out = self._model.apply(
+            {"params": params}, tokens, mutable=["cache"]
+        )
+        return logits[:, -1, :], vars_out["cache"]
+
+    def _decode_impl(self, params, cache, last_tokens):
+        logits, vars_out = self._model.apply(
+            {"params": params, "cache": cache}, last_tokens, mutable=["cache"]
+        )
+        return logits[:, -1, :], vars_out["cache"]
+
+    # -- generation ----------------------------------------------------------
+
+    def generate(self, requests: List[GenerationRequest]) -> List[GenerationResult]:
+        """Generate for a list of requests, grouping same-length prompts
+        into batched prefill/decode programs."""
+        groups: Dict[int, List[int]] = {}
+        for i, r in enumerate(requests):
+            groups.setdefault(len(r.token_ids), []).append(i)
+        results: List[Optional[GenerationResult]] = [None] * len(requests)
+        for _plen, indices in sorted(groups.items()):
+            for start in range(0, len(indices), self._max_batch):
+                chunk = indices[start:start + self._max_batch]
+                out = self._generate_group([requests[i] for i in chunk])
+                for i, res in zip(chunk, out):
+                    results[i] = res
+        return results  # type: ignore[return-value]
+
+    def _generate_group(
+        self, requests: List[GenerationRequest]
+    ) -> List[GenerationResult]:
+        cfg = self._cfg
+        b = len(requests)
+        plen = len(requests[0].token_ids)
+        max_new = max(r.max_new_tokens for r in requests)
+        if plen + max_new > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({plen}) + max_new_tokens ({max_new}) exceeds "
+                f"max_seq_len ({cfg.max_seq_len})"
+            )
+        tokens = np.asarray(
+            [r.token_ids for r in requests], np.int32
+        )  # (b, plen), no padding by construction
+
+        logits, cache = self._prefill(self._params, jnp.asarray(tokens))
+        rng = jax.random.PRNGKey(0)
+        generated: List[List[int]] = [[] for _ in range(b)]
+        finished = [False] * b
+        reasons = ["length"] * b
+
+        def record(last):
+            for i, r in enumerate(requests):
+                if finished[i] or len(generated[i]) >= r.max_new_tokens:
+                    continue
+                tok = int(last[i])
+                generated[i].append(tok)
+                if r.eos_token_id is not None and tok == r.eos_token_id:
+                    finished[i] = True
+                    reasons[i] = "eos"
+
+        last = self._sample(logits, requests, rng, 0)
+        record(last)
+        for step in range(1, max_new):
+            if all(
+                finished[i] or len(generated[i]) >= requests[i].max_new_tokens
+                for i in range(b)
+            ):
+                break
+            logits, cache = self._decode(
+                self._params, cache, jnp.asarray(last).reshape(b, 1)
+            )
+            last = self._sample(logits, requests, rng, step)
+            record(last)
+
+        return [
+            GenerationResult(
+                token_ids=generated[i][: r.max_new_tokens],
+                num_prompt_tokens=plen,
+                finished_reason=reasons[i],
+            )
+            for i, r in enumerate(requests)
+        ]
+
+    def _sample(self, logits, requests, rng, step):
+        temps = np.array(
+            [max(r.temperature, 0.0) for r in requests], np.float32
+        )
+        greedy = jnp.argmax(logits, axis=-1)
+        if np.all(temps == 0.0):
+            return np.asarray(greedy)
+        key = jax.random.fold_in(rng, step)
+        scaled = logits / jnp.maximum(jnp.asarray(temps)[:, None], 1e-6)
+        sampled = jax.random.categorical(key, scaled, axis=-1)
+        return np.asarray(
+            jnp.where(jnp.asarray(temps) == 0.0, greedy, sampled)
+        )

@@ -1,0 +1,113 @@
+"""LLM serving: build a serve deployment around the TPU engine.
+
+Role-equivalent of the reference's build_openai_app / LLM deployments
+(llm/_internal/serve/builders/application_builders.py + vllm_models.py):
+each replica holds one jitted engine (params resident in HBM), replicas
+scale through serve's deployment config, and `tensor_parallel_size` maps
+to the mesh ``tp`` axis of the replica's devices instead of vLLM's NCCL
+workers.
+
+Request/response shape (token-level; bring-your-own tokenizer, or pass
+``tokenizer_name`` to use a HF tokenizer):
+  {"token_ids": [...], "max_new_tokens": 32, "temperature": 0.0}
+  {"prompt": "text", ...}   (with a tokenizer configured)
+-> {"token_ids": [...], "num_prompt_tokens": N, "finished_reason": ...}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .. import serve
+from .config import LLMConfig
+from .engine import GenerationRequest, LLMEngine
+
+
+class _LLMReplica:
+    """The replica callable (reference role: VLLMDeployment)."""
+
+    def __init__(self, llm_config: LLMConfig, params_blob: Optional[bytes] = None,
+                 tokenizer_name: Optional[str] = None):
+        import jax
+
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharding import unbox_params
+
+        self._config = llm_config
+        model_config = llm_config.build_model_config()
+        mesh = None
+        if llm_config.tensor_parallel_size > 1:
+            mesh = make_mesh(
+                tp=llm_config.tensor_parallel_size,
+                sp=llm_config.sequence_parallel_size,
+                fsdp=1,
+                dp=-1,
+            )
+        if params_blob is not None:
+            from .._internal import serialization
+
+            params = serialization.loads(params_blob)
+        else:
+            from ..models.llama import init_params
+
+            params = unbox_params(
+                init_params(model_config, jax.random.PRNGKey(0))
+            )
+        self._engine = LLMEngine(
+            model_config, params, mesh,
+            max_batch_size=llm_config.max_batch_size,
+        )
+        self._tokenizer = None
+        if tokenizer_name:
+            from transformers import AutoTokenizer
+
+            self._tokenizer = AutoTokenizer.from_pretrained(tokenizer_name)
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        token_ids = request.get("token_ids")
+        if token_ids is None:
+            prompt = request.get("prompt")
+            if prompt is None:
+                raise ValueError("request needs 'token_ids' or 'prompt'")
+            if self._tokenizer is None:
+                raise ValueError(
+                    "'prompt' requires a tokenizer; deploy with tokenizer_name"
+                )
+            token_ids = self._tokenizer.encode(prompt)
+        gen_req = GenerationRequest(
+            token_ids=list(token_ids),
+            max_new_tokens=int(
+                request.get("max_new_tokens", self._config.max_new_tokens)
+            ),
+            temperature=float(
+                request.get("temperature", self._config.temperature)
+            ),
+            eos_token_id=request.get("eos_token_id"),
+        )
+        result = self._engine.generate([gen_req])[0]
+        out: Dict[str, Any] = {
+            "token_ids": result.token_ids,
+            "num_prompt_tokens": result.num_prompt_tokens,
+            "finished_reason": result.finished_reason,
+        }
+        if self._tokenizer is not None:
+            out["text"] = self._tokenizer.decode(result.token_ids)
+        return out
+
+
+def build_llm_deployment(
+    llm_config: LLMConfig,
+    *,
+    params_blob: Optional[bytes] = None,
+    tokenizer_name: Optional[str] = None,
+    name: Optional[str] = None,
+):
+    """Return a bound serve Application for this LLM (reference:
+    build_llm_deployment, llm/_internal/serve/builders)."""
+    dep = serve.deployment(
+        _LLMReplica,
+        name=name or llm_config.model_id,
+        num_replicas=llm_config.num_replicas,
+        ray_actor_options=dict(llm_config.resources_per_replica),
+    )
+    return dep.bind(llm_config, params_blob, tokenizer_name)

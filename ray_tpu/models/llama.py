@@ -18,6 +18,7 @@ No reference analogue: the reference serves models through vLLM/torch
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 from typing import Any, Optional, Tuple
 
@@ -141,6 +142,7 @@ class LoRADense(nn.Module):
 class Attention(nn.Module):
     config: LlamaConfig
     mesh: Optional[Mesh] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, cos, sin):
@@ -162,10 +164,52 @@ class Attention(nn.Module):
         q = proj(h * d, "wq")(x).reshape(b, s, h, d).transpose(0, 2, 1, 3)
         k = proj(hk * d, "wk")(x).reshape(b, s, hk, d).transpose(0, 2, 1, 3)
         v = proj(hk * d, "wv")(x).reshape(b, s, hk, d).transpose(0, 2, 1, 3)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
 
-        if self.mesh is not None:
+        if self.decode:
+            # KV-cache incremental path (serving; reference role: vLLM's
+            # paged KV cache behind ray.llm — here a dense ring buffer per
+            # layer in a flax "cache" collection, as in flax nn.SelfAttention
+            # decode mode)
+            cached_k = self.variable(
+                "cache", "cached_key",
+                jnp.zeros, (b, hk, cfg.max_seq_len, d), cfg.dtype,
+            )
+            cached_v = self.variable(
+                "cache", "cached_value",
+                jnp.zeros, (b, hk, cfg.max_seq_len, d), cfg.dtype,
+            )
+            idx_var = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            idx = idx_var.value
+            q = apply_rope(q, cos, sin, offset=idx)
+            k = apply_rope(k, cos, sin, offset=idx)
+            cached_k.value = jax.lax.dynamic_update_slice_in_dim(
+                cached_k.value, k.astype(cfg.dtype), idx, axis=2
+            )
+            cached_v.value = jax.lax.dynamic_update_slice_in_dim(
+                cached_v.value, v.astype(cfg.dtype), idx, axis=2
+            )
+            idx_var.value = idx + s
+            k_all = jnp.repeat(cached_k.value, h // hk, axis=1)
+            v_all = jnp.repeat(cached_v.value, h // hk, axis=1)
+            # query i sits at absolute position idx+i; key j is visible iff
+            # j <= idx+i and j has been written
+            scores = jnp.einsum(
+                "bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                k_all.astype(jnp.float32),
+            ) / math.sqrt(d)
+            q_pos = idx + jnp.arange(s)[:, None]
+            k_pos = jnp.arange(cfg.max_seq_len)[None, :]
+            mask = k_pos <= q_pos  # (s, max_seq)
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum(
+                "bhqk,bhkd->bhqd", probs, v_all.astype(jnp.float32)
+            ).astype(cfg.dtype)
+        elif self.mesh is not None:
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
             # ring attention under shard_map: batch over data axes, heads
             # over tp, sequence over sp (ICI neighbor exchanges)
             qkv_spec = P(("dcn", "dp", "fsdp"), "tp", "sp", None)
@@ -178,6 +222,8 @@ class Attention(nn.Module):
             )
             out = attn(q, k, v)
         else:
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
             out = flash_attention(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
         return LoRADense(
@@ -212,6 +258,7 @@ class MLP(nn.Module):
 class Block(nn.Module):
     config: LlamaConfig
     mesh: Optional[Mesh] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, cos, sin):
@@ -222,7 +269,7 @@ class Block(nn.Module):
             (cfg.dim,),
             cfg.param_dtype,
         )
-        h = x + Attention(cfg, self.mesh, name="attn")(
+        h = x + Attention(cfg, self.mesh, self.decode, name="attn")(
             rmsnorm(x, attn_norm_w.astype(x.dtype), cfg.norm_eps), cos, sin
         )
         mlp_norm_w = self.param(
@@ -239,6 +286,7 @@ class Block(nn.Module):
 class Llama(nn.Module):
     config: LlamaConfig
     mesh: Optional[Mesh] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens):  # (batch, seq) int32
@@ -261,7 +309,7 @@ class Llama(nn.Module):
                 prevent_cse=False,
             )
         for i in range(cfg.n_layers):
-            x = block(cfg, self.mesh, name=f"layer_{i}")(x, cos, sin)
+            x = block(cfg, self.mesh, self.decode, name=f"layer_{i}")(x, cos, sin)
         final_norm_w = self.param(
             "final_norm",
             nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),

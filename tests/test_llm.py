@@ -1,0 +1,149 @@
+"""ray_tpu.llm tests.
+
+Models the reference's llm test surface (python/ray/llm/tests/): engine
+generation correctness (the KV-cache decode path must match the full
+forward pass token-for-token under greedy decoding), serve deployment
+round trip, and the batch-inference stage.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.llm import (
+    GenerationRequest,
+    LLMConfig,
+    LLMEngine,
+    LLMPredictor,
+    build_llm_deployment,
+)
+from ray_tpu.models.llama import Llama, LlamaConfig, init_params
+from ray_tpu.parallel.sharding import unbox_params
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    cfg = LlamaConfig.tiny(max_seq_len=64)
+    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
+    return cfg, params, LLMEngine(cfg, params, max_batch_size=4)
+
+
+def _greedy_reference(cfg, params, prompt, n_new):
+    """Greedy decoding via repeated FULL forward passes (no cache)."""
+    model = Llama(cfg, None)
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = model.apply(
+            {"params": params}, jnp.asarray([toks], jnp.int32)
+        )
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def test_cache_decode_matches_full_forward(tiny_engine):
+    cfg, params, engine = tiny_engine
+    prompt = [3, 14, 15, 92, 65, 35]
+    n_new = 8
+    ref = _greedy_reference(cfg, params, prompt, n_new)
+    out = engine.generate(
+        [GenerationRequest(token_ids=prompt, max_new_tokens=n_new)]
+    )[0]
+    assert out.token_ids == ref
+    assert out.num_prompt_tokens == len(prompt)
+    assert out.finished_reason == "length"
+
+
+def test_batched_same_length_prompts(tiny_engine):
+    cfg, params, engine = tiny_engine
+    prompts = [[1, 2, 3, 4], [9, 8, 7, 6], [5, 5, 5, 5]]
+    outs = engine.generate(
+        [GenerationRequest(token_ids=p, max_new_tokens=5) for p in prompts]
+    )
+    for p, o in zip(prompts, outs):
+        assert o.token_ids == _greedy_reference(cfg, params, p, 5)
+
+
+def test_mixed_length_prompts_grouped(tiny_engine):
+    cfg, params, engine = tiny_engine
+    prompts = [[1, 2], [3, 4, 5, 6], [7, 8], [9, 10, 11, 12]]
+    outs = engine.generate(
+        [GenerationRequest(token_ids=p, max_new_tokens=4) for p in prompts]
+    )
+    for p, o in zip(prompts, outs):
+        assert o.token_ids == _greedy_reference(cfg, params, p, 4)
+
+
+def test_eos_stops_generation(tiny_engine):
+    cfg, params, engine = tiny_engine
+    prompt = [3, 14, 15, 92]
+    ref = _greedy_reference(cfg, params, prompt, 8)
+    eos = ref[0]  # the first greedy token acts as EOS
+    out = engine.generate(
+        [GenerationRequest(token_ids=prompt, max_new_tokens=8,
+                           eos_token_id=eos)]
+    )[0]
+    assert out.finished_reason == "eos"
+    assert out.token_ids == [eos]
+
+
+def test_temperature_sampling_changes_output(tiny_engine):
+    _cfg, _params, engine = tiny_engine
+    req = GenerationRequest(
+        token_ids=[1, 2, 3, 4], max_new_tokens=16, temperature=5.0
+    )
+    a = engine.generate([req])[0].token_ids
+    greedy = engine.generate(
+        [GenerationRequest(token_ids=[1, 2, 3, 4], max_new_tokens=16)]
+    )[0].token_ids
+    # with very high temperature the trajectory should diverge from greedy
+    assert a != greedy
+
+
+def test_seq_len_guard(tiny_engine):
+    _cfg, _params, engine = tiny_engine
+    with pytest.raises(ValueError, match="max_seq_len"):
+        engine.generate(
+            [GenerationRequest(token_ids=[1] * 60, max_new_tokens=10)]
+        )
+
+
+def test_llm_serve_deployment(ray_start_regular):
+    from ray_tpu import serve
+
+    llm_config = LLMConfig(
+        model_id="llama-tiny",
+        max_seq_len=64,
+        max_new_tokens=4,
+        resources_per_replica={"CPU": 1.0},
+    )
+    app = build_llm_deployment(llm_config)
+    serve.start(proxy=False)
+    handle = serve.run(app, name="llm-app", route_prefix=None, _proxy=False)
+    try:
+        resp = handle.remote({"token_ids": [1, 2, 3, 4], "max_new_tokens": 3})
+        out = resp.result(timeout_s=120)
+        assert len(out["token_ids"]) == 3
+        assert out["finished_reason"] in ("length", "eos")
+    finally:
+        serve.shutdown()
+
+
+def test_llm_batch_stage(ray_start_regular):
+    from ray_tpu import data as rd
+
+    llm_config = LLMConfig(
+        model_id="llama-tiny", max_seq_len=64, max_new_tokens=3
+    )
+    ds = rd.from_items(
+        [{"token_ids": [i + 1, i + 2, i + 3]} for i in range(8)]
+    )
+    out = ds.map_batches(
+        LLMPredictor,
+        fn_constructor_args=(llm_config,),
+        compute=rd.ActorPoolStrategy(size=1),
+        batch_size=4,
+    ).take_all()
+    assert len(out) == 8
+    assert all(len(r["generated"]) == 3 for r in out)
