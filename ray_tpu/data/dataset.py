@@ -184,6 +184,31 @@ class Dataset:
             planlib.Sort(input_op=self._op, key=key, descending=descending)
         )
 
+    def join(
+        self,
+        other: "Dataset",
+        on: str,
+        *,
+        join_type: str = "inner",
+        num_partitions: Optional[int] = None,
+    ) -> "Dataset":
+        """Hash join on a key column (reference: Dataset.join backed by the
+        hash-shuffle operator, _internal/execution/operators/join.py):
+        both sides are hash-partitioned on the key, then joined
+        partition-wise. join_type: inner | left | right | full. Duplicate
+        non-key columns from the right side get an ``_r`` suffix."""
+        if join_type not in ("inner", "left", "right", "full"):
+            raise ValueError(f"unknown join_type {join_type!r}")
+        return self._with(
+            planlib.Join(
+                input_op=self._op,
+                other=other._op,
+                on=on,
+                join_type=join_type,
+                num_partitions=num_partitions or 8,
+            )
+        )
+
     def zip(self, other: "Dataset") -> "Dataset":
         return self._with(planlib.Zip(input_op=self._op, other=other._op))
 

@@ -185,6 +185,63 @@ def _remotes():
         out = rows_to_columns(out_rows)
         return out, BlockAccessor(out).metadata()
 
+    def _join(on, join_type, n_left, *parts) -> tuple:
+        """Partition-wise hash join (runs once per hash partition)."""
+        left = concat_blocks(list(parts[:n_left]))
+        right = concat_blocks(list(parts[n_left:]))
+        la, ra = BlockAccessor(left), BlockAccessor(right)
+        lrows = list(la.iter_rows())
+        rrows = list(ra.iter_rows())
+
+        def keyval(row):
+            k = row.get(on)
+            return k.item() if hasattr(k, "item") else k
+
+        index: Dict[Any, list] = {}
+        for r in rrows:
+            index.setdefault(keyval(r), []).append(r)
+        rcols = set()
+        for r in rrows:
+            rcols.update(r.keys())
+        lcols = set()
+        for r in lrows:
+            lcols.update(r.keys())
+
+        def combine(lr, rr):
+            row = dict(lr) if lr is not None else {
+                c: None for c in lcols if c != on
+            }
+            if lr is None:
+                row[on] = rr.get(on)
+            for k, v in (rr or {}).items():
+                if k == on:
+                    continue
+                row[k if k not in lcols or k == on else f"{k}_r"] = v
+            if rr is None:
+                for k in rcols:
+                    if k != on:
+                        row.setdefault(
+                            k if k not in lcols else f"{k}_r", None
+                        )
+            return row
+
+        out_rows = []
+        matched_right = set()
+        for lr in lrows:
+            matches = index.get(keyval(lr))
+            if matches:
+                for rr in matches:
+                    matched_right.add(id(rr))
+                    out_rows.append(combine(lr, rr))
+            elif join_type in ("left", "full"):
+                out_rows.append(combine(lr, None))
+        if join_type in ("right", "full"):
+            for rr in rrows:
+                if id(rr) not in matched_right:
+                    out_rows.append(combine(None, rr))
+        out = rows_to_columns(out_rows) if out_rows else []
+        return out, BlockMetadata(len(out_rows), 0)
+
     def _zip_all(n_left, n_out, *blocks):
         left = concat_blocks(list(blocks[:n_left]))
         right = concat_blocks(list(blocks[n_left:]))
@@ -215,6 +272,7 @@ def _remotes():
         concat_shuffled=api.remote(_concat_shuffled),
         sort_all=api.remote(_sort_all),
         aggregate=api.remote(_aggregate),
+        join=api.remote(_join),
         zip_all=api.remote(_zip_all),
     )
     return _REMOTES
@@ -308,6 +366,8 @@ def _exec(op: Op) -> Iterator[RefBundle]:
         return _exec_sort(op)
     if isinstance(op, GroupByAggregate):
         return _exec_groupby(op)
+    if isinstance(op, planlib.Join):
+        return _exec_join(op)
     if isinstance(op, Zip):
         return _exec_zip(op)
     raise NotImplementedError(f"no physical operator for {op}")
@@ -493,6 +553,39 @@ def _exec_groupby(op: GroupByAggregate) -> Iterator[RefBundle]:
     for i in range(n_parts):
         parts = [p[i] for p in parts_per_input]
         block_ref, meta_ref = agg.remote(op.key, op.aggs, *parts)
+        bundle = RefBundle(block_ref, api.get(meta_ref))
+        if bundle.meta.num_rows > 0:
+            yield bundle
+
+
+def _exec_join(op) -> Iterator[RefBundle]:
+    """Hash-partition both sides on the key, then join partition-wise
+    (reference: hash_shuffle join operator)."""
+    left = _collect(op.input_op)
+    right = _collect(op.other)
+    n_parts = max(min(op.num_partitions, max(len(left), len(right), 1)), 1)
+    split = _remotes()["split"]
+    join = _remotes()["join"].options(num_returns=2)
+
+    def partition(bundles):
+        parts_per_input = []
+        for b in bundles:
+            refs = split.options(num_returns=n_parts).remote(
+                b.block_ref, n_parts, "hash", op.on, None
+            )
+            if n_parts == 1:
+                refs = [refs]
+            parts_per_input.append(refs)
+        return parts_per_input
+
+    lparts = partition(left)
+    rparts = partition(right)
+    for i in range(n_parts):
+        lp = [p[i] for p in lparts]
+        rp = [p[i] for p in rparts]
+        block_ref, meta_ref = join.remote(
+            op.on, op.join_type, len(lp), *lp, *rp
+        )
         bundle = RefBundle(block_ref, api.get(meta_ref))
         if bundle.meta.num_rows > 0:
             yield bundle

@@ -159,6 +159,17 @@ class GroupByAggregate(Op):
 
 
 @dataclass
+class Join(Op):
+    other: Op = None
+    on: Any = None  # column name (both sides)
+    join_type: str = "inner"  # inner | left | right | full
+    num_partitions: int = 8
+
+    def name(self):
+        return f"Join({self.join_type} on {self.on!r})"
+
+
+@dataclass
 class Zip(Op):
     other: Op = None
 

@@ -223,3 +223,38 @@ def test_device_put_batches(cluster):
     ds = rd.range_tensor(8, shape=(4,), parallelism=2)
     batches = list(ds.iter_batches(batch_size=4, device_put=True))
     assert all(isinstance(b["data"], jax.Array) for b in batches)
+
+
+def test_join_inner(cluster):
+    left = rd.from_items([{"id": i, "a": i * 10} for i in range(8)])
+    right = rd.from_items([{"id": i, "b": i * 100} for i in range(4, 12)])
+    rows = left.join(right, on="id").take_all()
+    rows.sort(key=lambda r: r["id"])
+    assert [r["id"] for r in rows] == [4, 5, 6, 7]
+    assert all(r["b"] == r["id"] * 100 and r["a"] == r["id"] * 10 for r in rows)
+
+
+def test_join_left_right_full(cluster):
+    left = rd.from_items([{"id": i, "a": i} for i in range(4)])
+    right = rd.from_items([{"id": i, "b": i} for i in range(2, 6)])
+    lrows = left.join(right, on="id", join_type="left").take_all()
+    assert sorted(r["id"] for r in lrows) == [0, 1, 2, 3]
+    assert {r["id"]: r["b"] for r in lrows}[0] is None
+    rrows = left.join(right, on="id", join_type="right").take_all()
+    assert sorted(r["id"] for r in rrows) == [2, 3, 4, 5]
+    frows = left.join(right, on="id", join_type="full").take_all()
+    assert sorted(r["id"] for r in frows) == [0, 1, 2, 3, 4, 5]
+
+
+def test_join_duplicate_columns_suffixed(cluster):
+    left = rd.from_items([{"id": 1, "v": "L"}])
+    right = rd.from_items([{"id": 1, "v": "R"}])
+    rows = left.join(right, on="id").take_all()
+    assert rows[0]["v"] == "L" and rows[0]["v_r"] == "R"
+
+
+def test_join_many_to_many(cluster):
+    left = rd.from_items([{"id": 1, "a": i} for i in range(3)])
+    right = rd.from_items([{"id": 1, "b": j} for j in range(2)])
+    rows = left.join(right, on="id").take_all()
+    assert len(rows) == 6
