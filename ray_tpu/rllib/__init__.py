@@ -7,6 +7,7 @@ with the learner compiling to the TPU instead of torch DDP.
 """
 
 from .algorithm import PPO, PPOConfig, as_trainable
+from .dqn import DQN, DQNConfig, ReplayBuffer
 from .env import VectorEnv, make_env
 from .env_runner import EnvRunner
 from .learner import PPOLearner
@@ -14,6 +15,9 @@ from .learner import PPOLearner
 __all__ = [
     "PPO",
     "PPOConfig",
+    "DQN",
+    "DQNConfig",
+    "ReplayBuffer",
     "as_trainable",
     "PPOLearner",
     "EnvRunner",

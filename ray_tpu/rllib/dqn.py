@@ -1,0 +1,382 @@
+"""DQN: off-policy Q-learning with a replay-buffer actor.
+
+Role-equivalent of the reference's DQN family (rllib/algorithms/dqn/ —
+DQNConfig, EpisodeReplayBuffer, target network): epsilon-greedy rollout
+actors feed a replay-buffer actor; the driver-side learner runs jitted
+double-DQN updates (one ``lax.scan`` over the whole train batch of
+minibatches per iteration — a single compiled program on the MXU) and
+periodically syncs the target network.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .. import api
+from .env import VectorEnv, encode_obs, make_env, space_dims
+from .models import MLP_HIDDEN, QNetwork
+
+
+class ReplayBuffer:
+    """Uniform ring-buffer replay (reference:
+    rllib/utils/replay_buffers/replay_buffer.py). Runs as an actor so many
+    runners share one buffer."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self._capacity = capacity
+        self._obs = np.zeros((capacity, obs_dim), np.float32)
+        self._next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self._actions = np.zeros((capacity,), np.int64)
+        self._rewards = np.zeros((capacity,), np.float32)
+        self._dones = np.zeros((capacity,), np.float32)
+        self._idx = 0
+        self._size = 0
+
+    def add(self, obs, actions, rewards, next_obs, dones):
+        n = len(rewards)
+        for i in range(n):
+            j = self._idx
+            self._obs[j] = obs[i]
+            self._next_obs[j] = next_obs[i]
+            self._actions[j] = actions[i]
+            self._rewards[j] = rewards[i]
+            self._dones[j] = dones[i]
+            self._idx = (self._idx + 1) % self._capacity
+            self._size = min(self._size + 1, self._capacity)
+        return self._size
+
+    def sample(self, batch_size: int, seed: int = 0):
+        idx = np.random.default_rng(seed).integers(0, self._size, batch_size)
+        return {
+            "obs": self._obs[idx],
+            "actions": self._actions[idx],
+            "rewards": self._rewards[idx],
+            "next_obs": self._next_obs[idx],
+            "dones": self._dones[idx],
+        }
+
+    def size(self) -> int:
+        return self._size
+
+
+class DQNRunner:
+    """Epsilon-greedy rollout actor (reference: DQN EnvRunner with
+    EpsilonGreedy exploration)."""
+
+    def __init__(self, env_spec, env_config, num_envs, rollout_len, seed):
+        env_fn = make_env(env_spec, env_config)
+        self._env = VectorEnv([env_fn for _ in range(num_envs)])
+        self._obs_space = self._env.envs[0].observation_space
+        self._rollout_len = rollout_len
+        self._rng = np.random.default_rng(seed)
+        self._obs = self._env.reset(seed=seed)
+        self._model: Optional[QNetwork] = None
+        self._ep_ret = np.zeros(num_envs)
+        self._ep_len = np.zeros(num_envs, np.int64)
+
+    def sample(self, params, epsilon: float) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        if self._model is None:
+            obs_dim, act_dim, _ = space_dims(
+                self._obs_space, self._env.envs[0].action_space
+            )
+            self._model = QNetwork(act_dim)
+        out: Dict[str, List] = {
+            "obs": [], "actions": [], "rewards": [], "next_obs": [],
+            "dones": [],
+        }
+        ep_returns, ep_lengths = [], []
+        for _ in range(self._rollout_len):
+            enc = encode_obs(self._obs_space, self._obs)
+            q = np.asarray(
+                self._model.apply({"params": params}, jnp.asarray(enc))
+            )
+            greedy = q.argmax(axis=-1)
+            random_a = self._rng.integers(0, q.shape[-1], len(greedy))
+            explore = self._rng.random(len(greedy)) < epsilon
+            actions = np.where(explore, random_a, greedy)
+            next_obs, rewards, dones, _infos = self._env.step(actions)
+            next_enc = encode_obs(self._obs_space, next_obs)
+            out["obs"].append(enc)
+            out["actions"].append(actions)
+            out["rewards"].append(rewards)
+            out["next_obs"].append(next_enc)
+            out["dones"].append(dones.astype(np.float32))
+            self._ep_ret += rewards
+            self._ep_len += 1
+            for i, d in enumerate(dones):
+                if d:
+                    ep_returns.append(float(self._ep_ret[i]))
+                    ep_lengths.append(int(self._ep_len[i]))
+                    self._ep_ret[i] = 0.0
+                    self._ep_len[i] = 0
+            self._obs = next_obs
+        return {
+            "obs": np.concatenate(out["obs"]),
+            "actions": np.concatenate(out["actions"]),
+            "rewards": np.concatenate(out["rewards"]),
+            "next_obs": np.concatenate(out["next_obs"]),
+            "dones": np.concatenate(out["dones"]),
+            "episode_returns": ep_returns,
+            "episode_lengths": ep_lengths,
+        }
+
+    def ping(self):
+        return True
+
+
+class DQNConfig:
+    """Builder config (reference: dqn/dqn.py DQNConfig)."""
+
+    def __init__(self):
+        self.env_spec: Union[str, Callable, None] = None
+        self.env_config: Dict[str, Any] = {}
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 2
+        self.rollout_len = 32
+        self.gamma = 0.99
+        self.lr = 1e-3
+        self.buffer_capacity = 100_000
+        self.learning_starts = 500
+        self.train_batch_size = 64
+        self.num_updates_per_iter = 16
+        self.target_update_freq = 4  # iterations between target syncs
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_iters = 50
+        self.double_q = True
+        self.seed = 0
+        self.num_cpus_per_runner = 1.0
+
+    def environment(self, env, env_config: Optional[dict] = None):
+        self.env_spec = env
+        self.env_config = dict(env_config or {})
+        return self
+
+    def env_runners(self, num_env_runners=None, num_envs_per_env_runner=None,
+                    rollout_fragment_length=None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_len = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(copy.deepcopy(self))
+
+    build_algo = build
+
+
+class DQN:
+    def __init__(self, config: DQNConfig):
+        if config.env_spec is None:
+            raise ValueError("config.environment(...) is required")
+        self.config = config
+        self.iteration = 0
+        probe = make_env(config.env_spec, config.env_config)()
+        obs_dim, act_dim, discrete = space_dims(
+            probe.observation_space, probe.action_space
+        )
+        if not discrete:
+            raise ValueError("DQN requires a discrete action space")
+        try:
+            probe.close()
+        except Exception:
+            pass
+        self._obs_dim, self._act_dim = obs_dim, act_dim
+
+        self.model = QNetwork(act_dim)
+        key = jax.random.PRNGKey(config.seed)
+        self.params = self.model.init(key, jnp.zeros((1, obs_dim)))["params"]
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._update = jax.jit(self._update_impl)
+
+        Buffer = api.remote(num_cpus=0)(ReplayBuffer)
+        self.buffer = Buffer.remote(config.buffer_capacity, obs_dim)
+        Runner = api.remote(num_cpus=config.num_cpus_per_runner)(DQNRunner)
+        self.runners = [
+            Runner.remote(
+                config.env_spec, config.env_config,
+                config.num_envs_per_runner, config.rollout_len,
+                config.seed + 1000 * (i + 1),
+            )
+            for i in range(config.num_env_runners)
+        ]
+        api.get([r.ping.remote() for r in self.runners])
+        self._ep_return_window: List[float] = []
+
+    # -- jitted learner ------------------------------------------------------
+
+    def _update_impl(self, params, target_params, opt_state, batch):
+        cfg = self.config
+
+        def loss_fn(p):
+            q = self.model.apply({"params": p}, batch["obs"])
+            q_sel = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=-1
+            )[:, 0]
+            q_next_target = self.model.apply(
+                {"params": target_params}, batch["next_obs"]
+            )
+            if cfg.double_q:
+                q_next_online = self.model.apply(
+                    {"params": p}, batch["next_obs"]
+                )
+                best = jnp.argmax(q_next_online, axis=-1)
+                q_next = jnp.take_along_axis(
+                    q_next_target, best[:, None], axis=-1
+                )[:, 0]
+            else:
+                q_next = jnp.max(q_next_target, axis=-1)
+            target = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) * (
+                jax.lax.stop_gradient(q_next)
+            )
+            td = q_sel - target
+            return jnp.mean(td * td)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(self.iteration / max(cfg.epsilon_decay_iters, 1), 1.0)
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial
+        )
+
+    # -- training loop -------------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        cfg = self.config
+        eps = self._epsilon()
+        rollouts = api.get(
+            [r.sample.remote(self.params, eps) for r in self.runners]
+        )
+        adds = []
+        ep_returns, ep_lengths = [], []
+        for ro in rollouts:
+            adds.append(
+                self.buffer.add.remote(
+                    ro["obs"], ro["actions"], ro["rewards"],
+                    ro["next_obs"], ro["dones"],
+                )
+            )
+            ep_returns.extend(ro["episode_returns"])
+            ep_lengths.extend(ro["episode_lengths"])
+        buffer_size = api.get(adds)[-1]
+
+        losses = []
+        if buffer_size >= cfg.learning_starts:
+            batches = api.get(
+                [
+                    self.buffer.sample.remote(
+                        cfg.train_batch_size,
+                        seed=cfg.seed + self.iteration * 997 + u,
+                    )
+                    for u in range(cfg.num_updates_per_iter)
+                ]
+            )
+            for b in batches:
+                jb = {k: jnp.asarray(v) for k, v in b.items()}
+                self.params, self.opt_state, loss = self._update(
+                    self.params, self.target_params, self.opt_state, jb
+                )
+                losses.append(float(loss))
+        if self.iteration % max(cfg.target_update_freq, 1) == 0:
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+
+        self.iteration += 1
+        self._ep_return_window.extend(ep_returns)
+        self._ep_return_window = self._ep_return_window[-100:]
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (
+                float(np.mean(self._ep_return_window))
+                if self._ep_return_window else float("nan")
+            ),
+            "num_episodes": len(ep_returns),
+            "buffer_size": buffer_size,
+            "epsilon": eps,
+            "loss_mean": float(np.mean(losses)) if losses else float("nan"),
+            "num_env_steps_sampled": sum(
+                len(ro["rewards"]) for ro in rollouts
+            ),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    # -- checkpointing -------------------------------------------------------
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        with open(os.path.join(checkpoint_dir, "dqn_state.pkl"), "wb") as f:
+            pickle.dump(
+                {
+                    "params": jax.tree.map(np.asarray, self.params),
+                    "target_params": jax.tree.map(
+                        np.asarray, self.target_params
+                    ),
+                    "iteration": self.iteration,
+                },
+                f,
+            )
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str):
+        with open(os.path.join(checkpoint_dir, "dqn_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.target_params = jax.tree.map(
+            jnp.asarray, state["target_params"]
+        )
+        self.opt_state = self.tx.init(self.params)
+        self.iteration = state["iteration"]
+
+    def compute_single_action(self, obs):
+        from .env import encode_obs as enc
+
+        probe_space = None
+        q = self.model.apply(
+            {"params": self.params},
+            jnp.asarray(np.asarray(obs, np.float32)[None]),
+        )
+        return int(np.asarray(jnp.argmax(q, axis=-1))[0])
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                api.kill(r)
+            except Exception:
+                pass
+        try:
+            api.kill(self.buffer)
+        except Exception:
+            pass
+        self.runners = []

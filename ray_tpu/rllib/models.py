@@ -37,6 +37,23 @@ class ActorCritic(nn.Module):
         return logits, jnp.squeeze(v, -1)
 
 
+MLP_HIDDEN: Tuple[int, ...] = (64, 64)
+
+
+class QNetwork(nn.Module):
+    """State-action value network for DQN (reference: dqn_rl_module)."""
+
+    action_dim: int
+    hidden: Tuple[int, ...] = MLP_HIDDEN
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.action_dim)(x)
+
+
 def init_actor_critic(obs_dim: int, action_dim: int, discrete: bool, seed: int = 0):
     model = ActorCritic(action_dim=action_dim, discrete=discrete)
     params = model.init(
