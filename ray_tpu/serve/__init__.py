@@ -18,10 +18,15 @@ from .api import (
     start,
     status,
 )
+from .batching import batch
 from .config import AutoscalingConfig, DeploymentConfig
 from .handle import DeploymentHandle, DeploymentResponse
+from .multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
+    "batch",
+    "multiplexed",
+    "get_multiplexed_model_id",
     "deployment",
     "Deployment",
     "Application",

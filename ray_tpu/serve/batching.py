@@ -1,0 +1,103 @@
+"""Dynamic request batching: @serve.batch.
+
+Role-equivalent of the reference's serve.batch (python/ray/serve/batching.py):
+individual async calls accumulate into a list; the wrapped callable runs once
+per batch (``async def fn(self, items: List)`` -> list of results, one per
+caller) when the batch fills or the wait timeout fires. On TPU replicas this
+is the lever that turns single requests into MXU-sized batches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int, wait_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._wait_s = wait_s
+        self._pending: List[tuple] = []  # (item, future)
+        self._timer: Optional[asyncio.TimerHandle] = None
+
+    async def submit(self, item: Any):
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((item, fut))
+        if len(self._pending) >= self._max:
+            self._flush()
+        elif self._timer is None:
+            self._timer = loop.call_later(self._wait_s, self._flush)
+        return await fut
+
+    def _flush(self):
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        asyncio.ensure_future(self._run(batch))
+
+    async def _run(self, batch: List[tuple]):
+        items = [item for item, _f in batch]
+        try:
+            results = await self._fn(items)
+            if results is None or len(results) != len(items):
+                raise ValueError(
+                    "@serve.batch function must return one result per input "
+                    f"(got {None if results is None else len(results)} for "
+                    f"{len(items)} inputs)"
+                )
+            for (_item, fut), res in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(res)
+        except Exception as e:  # noqa: BLE001 — error fans out to all callers
+            for _item, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(_fn=None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: ``@serve.batch`` / ``@serve.batch(max_batch_size=32,
+    batch_wait_timeout_s=0.05)`` on an async method taking a list."""
+
+    def deco(fn):
+        if not inspect.iscoroutinefunction(fn):
+            raise TypeError("@serve.batch requires an async def function")
+        params = list(inspect.signature(fn).parameters)
+        is_method = bool(params) and params[0] == "self"
+        attr = f"__serve_batch_queue_{fn.__name__}"
+
+        if is_method:
+            async def wrapper(self, item):
+                q = getattr(self, attr, None)
+                if q is None:
+                    async def bound(items):
+                        return await fn(self, items)
+
+                    q = _BatchQueue(bound, max_batch_size, batch_wait_timeout_s)
+                    setattr(self, attr, q)
+                return await q.submit(item)
+        else:
+            state = {}
+
+            async def wrapper(item):
+                q = state.get("q")
+                if q is None:
+                    q = state["q"] = _BatchQueue(
+                        fn, max_batch_size, batch_wait_timeout_s
+                    )
+                return await q.submit(item)
+
+        wrapper.__name__ = fn.__name__
+        wrapper.__doc__ = fn.__doc__
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

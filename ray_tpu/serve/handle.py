@@ -88,16 +88,25 @@ class Router:
 
 
 class DeploymentHandle:
-    def __init__(self, controller, app_name: str, deployment: str, method: str = "__call__"):
+    def __init__(self, controller, app_name: str, deployment: str,
+                 method: str = "__call__", multiplexed_model_id: str = ""):
         self._controller = controller
         self._app_name = app_name
         self._deployment = deployment
         self._method = method
+        self._multiplexed_model_id = multiplexed_model_id
         self._router: Optional[Router] = None
 
-    def options(self, *, method_name: str) -> "DeploymentHandle":
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
         return DeploymentHandle(
-            self._controller, self._app_name, self._deployment, method_name
+            self._controller,
+            self._app_name,
+            self._deployment,
+            method_name if method_name is not None else self._method,
+            multiplexed_model_id
+            if multiplexed_model_id is not None
+            else self._multiplexed_model_id,
         )
 
     def __getattr__(self, name: str):
@@ -105,18 +114,23 @@ class DeploymentHandle:
             raise AttributeError(name)
         # handle.other_method.remote(...) sugar
         return DeploymentHandle(
-            self._controller, self._app_name, self._deployment, name
+            self._controller, self._app_name, self._deployment, name,
+            self._multiplexed_model_id,
         )
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         if self._router is None:
             self._router = Router(self._controller, self._app_name)
         replica = self._router.pick(self._deployment)
-        ref = replica.handle_request.remote(self._method, args, kwargs)
+        metadata = None
+        if self._multiplexed_model_id:
+            metadata = {"multiplexed_model_id": self._multiplexed_model_id}
+        ref = replica.handle_request.remote(self._method, args, kwargs, metadata)
         return DeploymentResponse(ref)
 
     def __reduce__(self):
         return (
             DeploymentHandle,
-            (self._controller, self._app_name, self._deployment, self._method),
+            (self._controller, self._app_name, self._deployment, self._method,
+             self._multiplexed_model_id),
         )

@@ -1,0 +1,125 @@
+"""Model multiplexing: many models time-share one replica.
+
+Role-equivalent of the reference's serve.multiplexed /
+get_multiplexed_model_id (python/ray/serve/multiplex.py + api.py): the
+caller tags a request with a model id
+(``handle.options(multiplexed_model_id="m1").remote(...)``); the replica's
+``@serve.multiplexed`` loader keeps an LRU cache of loaded models (on TPU:
+param pytrees resident in HBM), loading on miss and evicting the least
+recently used model beyond the cap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+from collections import OrderedDict
+from typing import Any, Callable
+
+_model_id_ctx: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "ray_tpu_serve_multiplexed_model_id", default=""
+)
+
+
+def get_multiplexed_model_id() -> str:
+    """Model id of the current request (reference:
+    serve.get_multiplexed_model_id)."""
+    return _model_id_ctx.get()
+
+
+def _set_multiplexed_model_id(model_id: str):
+    _model_id_ctx.set(model_id)
+
+
+class _ModelCache:
+    def __init__(self, loader: Callable, max_models: int):
+        self._loader = loader
+        self._max = max_models
+        self._cache: "OrderedDict[str, Any]" = OrderedDict()
+        self._loading: dict = {}  # model_id -> future (dedup concurrent loads)
+
+    async def get(self, self_obj, model_id: str):
+        if model_id in self._cache:
+            self._cache.move_to_end(model_id)
+            return self._cache[model_id]
+        fut = self._loading.get(model_id)
+        if fut is not None:
+            return await fut
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._loading[model_id] = fut
+        try:
+            if self_obj is not None:
+                model = await self._loader(self_obj, model_id)
+            else:
+                model = await self._loader(model_id)
+            while len(self._cache) >= self._max:
+                evicted_id, evicted = self._cache.popitem(last=False)
+                del_fn = getattr(evicted, "__del__", None)
+                if del_fn is not None:
+                    try:
+                        res = del_fn()
+                        if inspect.iscoroutine(res):
+                            await res
+                    except Exception:
+                        pass
+            self._cache[model_id] = model
+            fut.set_result(model)
+            return model
+        except Exception as e:  # noqa: BLE001
+            fut.set_exception(e)
+            raise
+        finally:
+            self._loading.pop(model_id, None)
+            # consume the exception if nobody else awaited the future
+            if fut.done() and fut.exception() is not None:
+                fut.exception()
+
+
+def multiplexed(max_num_models_per_replica: int = 3):
+    """Decorator for an async model loader: ``@serve.multiplexed()
+    async def get_model(self, model_id): ...`` (reference: serve.multiplexed)."""
+
+    def deco(fn):
+        if not inspect.iscoroutinefunction(fn):
+            raise TypeError("@serve.multiplexed requires an async def loader")
+        params = list(inspect.signature(fn).parameters)
+        is_method = bool(params) and params[0] == "self"
+        attr = f"__serve_multiplex_cache_{fn.__name__}"
+
+        if is_method:
+            async def wrapper(self, model_id: str = ""):
+                model_id = model_id or get_multiplexed_model_id()
+                if not model_id:
+                    raise ValueError(
+                        "no model id: pass one or set multiplexed_model_id "
+                        "on the handle"
+                    )
+                cache = getattr(self, attr, None)
+                if cache is None:
+                    cache = _ModelCache(fn, max_num_models_per_replica)
+                    setattr(self, attr, cache)
+                return await cache.get(self, model_id)
+        else:
+            state: dict = {}
+
+            async def wrapper(model_id: str = ""):
+                model_id = model_id or get_multiplexed_model_id()
+                if not model_id:
+                    raise ValueError(
+                        "no model id: pass one or set multiplexed_model_id "
+                        "on the handle"
+                    )
+                cache = state.get("c")
+                if cache is None:
+                    cache = state["c"] = _ModelCache(
+                        fn, max_num_models_per_replica
+                    )
+                return await cache.get(None, model_id)
+
+        wrapper.__name__ = fn.__name__
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    return deco

@@ -48,8 +48,13 @@ class Replica:
 
     # -- request path --------------------------------------------------------
 
-    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+    async def handle_request(self, method: str, args: tuple, kwargs: dict,
+                             metadata: Optional[dict] = None):
         self._ongoing += 1
+        if metadata and metadata.get("multiplexed_model_id"):
+            from .multiplex import _set_multiplexed_model_id
+
+            _set_multiplexed_model_id(metadata["multiplexed_model_id"])
         try:
             if self._is_function:
                 fn = self._callable
@@ -58,10 +63,14 @@ class Replica:
             if inspect.iscoroutinefunction(fn):
                 return await fn(*args, **kwargs)
             # sync user code must not block the worker's event loop (it
-            # services RPC + heartbeats); run it on the request pool
+            # services RPC + heartbeats); run it on the request pool. The
+            # context carries the multiplexed model id across the thread hop.
+            import contextvars
+
             loop = asyncio.get_running_loop()
+            ctx = contextvars.copy_context()
             return await loop.run_in_executor(
-                self._pool, lambda: fn(*args, **kwargs)
+                self._pool, lambda: ctx.run(fn, *args, **kwargs)
             )
         finally:
             self._ongoing -= 1

@@ -259,3 +259,78 @@ def test_delete_application(cluster):
     assert "temp" in serve.status()
     serve.delete("temp")
     assert "temp" not in serve.status()
+
+
+def test_serve_batch_accumulates(cluster):
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Batcher:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        async def handle(self, items):
+            # one result per item, tagged with the batch size it rode in
+            return [{"v": i * 2, "batch": len(items)} for i in items]
+
+        async def __call__(self, x):
+            return await self.handle(x)
+
+    handle = serve.run(Batcher.bind(), name="batch-app", _proxy=False)
+    responses = [handle.remote(i) for i in range(8)]
+    results = [r.result(timeout_s=60) for r in responses]
+    assert [r["v"] for r in results] == [2 * i for i in range(8)]
+    # at least one call actually rode in a multi-item batch
+    assert max(r["batch"] for r in results) >= 2
+    serve.delete("batch-app")
+
+
+def test_serve_batch_error_propagates(cluster):
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Bad:
+        @serve.batch(max_batch_size=2, batch_wait_timeout_s=0.05)
+        async def handle(self, items):
+            raise RuntimeError("batch exploded")
+
+        async def __call__(self, x):
+            return await self.handle(x)
+
+    handle = serve.run(Bad.bind(), name="badbatch-app", _proxy=False)
+    with pytest.raises(Exception, match="batch exploded"):
+        handle.remote(1).result(timeout_s=60)
+    serve.delete("badbatch-app")
+
+
+def test_serve_multiplexed_lru(cluster):
+    from ray_tpu import serve
+
+    @serve.deployment
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id):
+            self.loads.append(model_id)
+            return {"id": model_id}
+
+        async def __call__(self, _x):
+            model = await self.get_model()
+            return {
+                "served_by": model["id"],
+                "ctx": serve.get_multiplexed_model_id(),
+                "loads": list(self.loads),
+            }
+
+    handle = serve.run(MultiModel.bind(), name="mux-app", _proxy=False)
+    r1 = handle.options(multiplexed_model_id="m1").remote(0).result(timeout_s=60)
+    assert r1["served_by"] == "m1" and r1["ctx"] == "m1"
+    r2 = handle.options(multiplexed_model_id="m2").remote(0).result(timeout_s=60)
+    # m1 cached: no reload
+    r3 = handle.options(multiplexed_model_id="m1").remote(0).result(timeout_s=60)
+    assert r3["loads"].count("m1") == 1
+    # third model evicts LRU (m2); asking for m2 again reloads it
+    handle.options(multiplexed_model_id="m3").remote(0).result(timeout_s=60)
+    r5 = handle.options(multiplexed_model_id="m2").remote(0).result(timeout_s=60)
+    assert r5["loads"].count("m2") == 2
+    serve.delete("mux-app")
