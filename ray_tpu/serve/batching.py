@@ -10,6 +10,7 @@ is the lever that turns single requests into MXU-sized batches.
 from __future__ import annotations
 
 import asyncio
+import functools
 import inspect
 from typing import Any, Callable, List, Optional
 
@@ -21,6 +22,9 @@ class _BatchQueue:
         self._wait_s = wait_s
         self._pending: List[tuple] = []  # (item, future)
         self._timer: Optional[asyncio.TimerHandle] = None
+        # strong refs: the loop only weakly references tasks, and a collected
+        # batch task would strand every caller future in it
+        self._tasks: set = set()
 
     async def submit(self, item: Any):
         loop = asyncio.get_running_loop()
@@ -39,7 +43,9 @@ class _BatchQueue:
         if not self._pending:
             return
         batch, self._pending = self._pending, []
-        asyncio.ensure_future(self._run(batch))
+        task = asyncio.ensure_future(self._run(batch))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
 
     async def _run(self, batch: List[tuple]):
         items = [item for item, _f in batch]
@@ -93,10 +99,7 @@ def batch(_fn=None, *, max_batch_size: int = 10,
                     )
                 return await q.submit(item)
 
-        wrapper.__name__ = fn.__name__
-        wrapper.__doc__ = fn.__doc__
-        wrapper.__wrapped__ = fn
-        return wrapper
+        return functools.wraps(fn)(wrapper)
 
     if _fn is not None:
         return deco(_fn)

@@ -89,13 +89,18 @@ class Router:
 
 class DeploymentHandle:
     def __init__(self, controller, app_name: str, deployment: str,
-                 method: str = "__call__", multiplexed_model_id: str = ""):
+                 method: str = "__call__", multiplexed_model_id: str = "",
+                 _router: Optional[list] = None):
         self._controller = controller
         self._app_name = app_name
         self._deployment = deployment
         self._method = method
         self._multiplexed_model_id = multiplexed_model_id
-        self._router: Optional[Router] = None
+        # the router depends only on (controller, app_name), both immutable
+        # across options()/method handles — a shared mutable holder means
+        # whichever handle first routes a request creates the Router and all
+        # derived handles reuse its cached routing table
+        self._router_holder: list = _router if _router is not None else [None]
 
     def options(self, *, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
@@ -107,6 +112,7 @@ class DeploymentHandle:
             multiplexed_model_id
             if multiplexed_model_id is not None
             else self._multiplexed_model_id,
+            _router=self._router_holder,
         )
 
     def __getattr__(self, name: str):
@@ -115,13 +121,13 @@ class DeploymentHandle:
         # handle.other_method.remote(...) sugar
         return DeploymentHandle(
             self._controller, self._app_name, self._deployment, name,
-            self._multiplexed_model_id,
+            self._multiplexed_model_id, _router=self._router_holder,
         )
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        if self._router is None:
-            self._router = Router(self._controller, self._app_name)
-        replica = self._router.pick(self._deployment)
+        if self._router_holder[0] is None:
+            self._router_holder[0] = Router(self._controller, self._app_name)
+        replica = self._router_holder[0].pick(self._deployment)
         metadata = None
         if self._multiplexed_model_id:
             metadata = {"multiplexed_model_id": self._multiplexed_model_id}

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import functools
 import inspect
 from collections import OrderedDict
 from typing import Any, Callable
@@ -55,15 +56,12 @@ class _ModelCache:
             else:
                 model = await self._loader(model_id)
             while len(self._cache) >= self._max:
-                evicted_id, evicted = self._cache.popitem(last=False)
-                del_fn = getattr(evicted, "__del__", None)
-                if del_fn is not None:
-                    try:
-                        res = del_fn()
-                        if inspect.iscoroutine(res):
-                            await res
-                    except Exception:
-                        pass
+                # Evict = drop our reference. In-flight requests still hold
+                # theirs, so device buffers (jax arrays free on GC) are
+                # released only when the last user finishes — calling a
+                # finalizer here would free HBM mid-use and CPython would
+                # run __del__ a second time at GC.
+                self._cache.popitem(last=False)
             self._cache[model_id] = model
             fut.set_result(model)
             return model
@@ -77,9 +75,10 @@ class _ModelCache:
                 fut.exception()
 
 
-def multiplexed(max_num_models_per_replica: int = 3):
-    """Decorator for an async model loader: ``@serve.multiplexed()
-    async def get_model(self, model_id): ...`` (reference: serve.multiplexed)."""
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorator for an async model loader: ``@serve.multiplexed`` /
+    ``@serve.multiplexed(max_num_models_per_replica=8)``
+    ``async def get_model(self, model_id): ...`` (reference: serve.multiplexed)."""
 
     def deco(fn):
         if not inspect.iscoroutinefunction(fn):
@@ -118,8 +117,8 @@ def multiplexed(max_num_models_per_replica: int = 3):
                     )
                 return await cache.get(None, model_id)
 
-        wrapper.__name__ = fn.__name__
-        wrapper.__wrapped__ = fn
-        return wrapper
+        return functools.wraps(fn)(wrapper)
 
+    if _fn is not None:
+        return deco(_fn)
     return deco

@@ -1,6 +1,7 @@
 """ray_tpu.util: placement groups, scheduling strategies, TPU slices, helpers."""
 
 from .actor_pool import ActorPool
+from .check_serialize import inspect_serializability
 from .placement_group import (
     PlacementGroup,
     get_placement_group,
@@ -16,6 +17,7 @@ from .scheduling_strategies import (
 
 __all__ = [
     "ActorPool",
+    "inspect_serializability",
     "PlacementGroup",
     "placement_group",
     "remove_placement_group",

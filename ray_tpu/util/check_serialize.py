@@ -1,0 +1,91 @@
+"""Serializability inspection.
+
+Role-equivalent of the reference's ``ray.util.inspect_serializability``
+(util/check_serialize.py): recursively locates the members of an object that
+fail to pickle, so users can find the offending closure capture / attribute
+instead of staring at a raw pickle error.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Optional, Set, Tuple
+
+from .._internal import serialization
+
+
+class FailTuple:
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailTuple({self.name} [obj={self.obj!r}, parent={self.parent!r}])"
+
+    def __eq__(self, other):
+        return isinstance(other, FailTuple) and self.name == other.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+def _is_serializable(obj: Any) -> bool:
+    try:
+        serialization.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
+def inspect_serializability(
+    obj: Any,
+    name: Optional[str] = None,
+    depth: int = 3,
+    _failures: Optional[Set[FailTuple]] = None,
+    _seen: Optional[Set[int]] = None,
+) -> Tuple[bool, Set[FailTuple]]:
+    """Returns (serializable, failures). Walks closures, globals-used, and
+    attributes up to ``depth`` levels looking for the leaf objects that fail."""
+    name = name or getattr(obj, "__name__", str(obj))
+    failures: Set[FailTuple] = set() if _failures is None else _failures
+    seen: Set[int] = set() if _seen is None else _seen
+
+    if _is_serializable(obj):
+        return True, failures
+    if id(obj) in seen or depth <= 0:
+        failures.add(FailTuple(obj, name, None))
+        return False, failures
+    seen.add(id(obj))
+
+    found_deeper = False
+    members: list = []
+    if inspect.isfunction(obj):
+        # closure cells
+        closure = getattr(obj, "__closure__", None) or ()
+        freevars = getattr(obj.__code__, "co_freevars", ())
+        for var, cell in zip(freevars, closure):
+            try:
+                members.append((var, cell.cell_contents))
+            except ValueError:
+                pass
+        # referenced globals
+        gl = getattr(obj, "__globals__", {})
+        for gname in getattr(obj.__code__, "co_names", ()):
+            if gname in gl:
+                members.append((gname, gl[gname]))
+    else:
+        for attr, val in list(getattr(obj, "__dict__", {}).items()):
+            members.append((attr, val))
+
+    for mname, member in members:
+        if not _is_serializable(member):
+            ok, _ = inspect_serializability(
+                member, f"{name}.{mname}", depth - 1, failures, seen
+            )
+            if not ok:
+                found_deeper = True
+
+    if not found_deeper:
+        failures.add(FailTuple(obj, name, None))
+    return False, failures

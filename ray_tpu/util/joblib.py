@@ -1,0 +1,106 @@
+"""joblib backend running joblib tasks as remote tasks.
+
+Role-equivalent of the reference's ``ray.util.joblib`` (register_ray in
+util/joblib/__init__.py + the backend in ray_backend.py): after
+``register_ray()``, ``joblib.parallel_backend("ray")`` runs scikit-learn
+style joblib workloads on the cluster.
+"""
+
+from __future__ import annotations
+
+from .. import api
+
+
+def register_ray() -> None:
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray", RayBackend)
+
+
+class _AsyncRef:
+    """Future-like over one ObjectRef; callback fires from a waiter thread so
+    joblib's dispatch loop keeps feeding batches while earlier ones run."""
+
+    def __init__(self, ref, callback=None):
+        import threading
+
+        self._ref = ref
+        self._value = None
+        self._error = None
+        self._done = threading.Event()
+
+        def _wait():
+            try:
+                self._value = api.get(ref)
+            except Exception as e:
+                self._error = e
+            finally:
+                self._done.set()
+                if callback is not None:
+                    callback(self)
+
+        threading.Thread(target=_wait, daemon=True).start()
+
+    def get(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("joblib task not ready")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+def _run_batch(batch):
+    return batch()
+
+
+from joblib._parallel_backends import ParallelBackendBase
+
+
+class RayBackend(ParallelBackendBase):
+    """joblib backend: each joblib batch (a ``BatchedCalls`` callable)
+    becomes one remote task. Inherits the rest of the joblib protocol
+    (retrieval_context, nesting bookkeeping) from ParallelBackendBase."""
+
+    supports_timeout = True
+    uses_threads = False
+    supports_sharedmem = False
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.parallel = None
+        self._n_jobs = 1
+        self._remote = None
+
+    # -- ParallelBackendBase protocol ---------------------------------------
+
+    def configure(self, n_jobs=1, parallel=None, prefer=None, require=None,
+                  **kwargs):
+        if not api.is_initialized():
+            api.init()
+        self.parallel = parallel
+        self._n_jobs = self.effective_n_jobs(n_jobs)
+        self._remote = api.remote(num_cpus=1)(_run_batch)
+        return self._n_jobs
+
+    def effective_n_jobs(self, n_jobs):
+        if n_jobs == 0:
+            raise ValueError("n_jobs == 0 has no meaning")
+        total = max(int(api.cluster_resources().get("CPU", 1)), 1)
+        if n_jobs is None or n_jobs < 0:
+            return total
+        return n_jobs
+
+    def apply_async(self, func, callback=None):
+        return _AsyncRef(self._remote.remote(func), callback)
+
+    def get_nested_backend(self):
+        from joblib._parallel_backends import SequentialBackend
+
+        return SequentialBackend(nesting_level=self.nesting_level + 1), None
+
+    def abort_everything(self, ensure_ready=True):
+        if ensure_ready:
+            self.configure(n_jobs=self._n_jobs, parallel=self.parallel)
+
+    def terminate(self):
+        pass

@@ -1,0 +1,191 @@
+"""multiprocessing.Pool drop-in backed by actors.
+
+Role-equivalent of the reference's ``ray.util.multiprocessing`` (the Pool
+shim in util/multiprocessing/pool.py): a ``Pool`` whose worker processes are
+actors, so user code written against the stdlib Pool API fans out over the
+cluster unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Iterable, List, Optional
+
+from .. import api
+
+
+class TimeoutError(Exception):  # noqa: A001 - mirrors multiprocessing.TimeoutError
+    pass
+
+
+class _PoolWorker:
+    """Actor holding an optional initializer's state; runs submitted calls."""
+
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_batch(self, fn, chunk):
+        return [fn(*args, **kwargs) for args, kwargs in chunk]
+
+    def ping(self):
+        return True
+
+
+class AsyncResult:
+    """multiprocessing.pool.AsyncResult equivalent over ObjectRefs."""
+
+    def __init__(self, refs: List[Any], unpack_single: bool, callback=None,
+                 error_callback=None):
+        self._refs = refs
+        self._unpack_single = unpack_single
+        self._callback = callback
+        self._error_callback = error_callback
+        self._result = None
+        self._error = None
+        self._done = threading.Event()
+        t = threading.Thread(target=self._collect, daemon=True)
+        t.start()
+
+    def _collect(self):
+        try:
+            chunks = api.get(self._refs)
+            flat = [v for chunk in chunks for v in chunk]
+            self._result = flat[0] if self._unpack_single else flat
+            if self._callback is not None:
+                self._callback(self._result)
+        except Exception as e:  # surfaced again from get()
+            self._error = e
+            if self._error_callback is not None:
+                self._error_callback(e)
+        finally:
+            self._done.set()
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def wait(self, timeout: Optional[float] = None):
+        self._done.wait(timeout)
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def successful(self) -> bool:
+        if not self._done.is_set():
+            raise ValueError("result not ready")
+        return self._error is None
+
+
+class Pool:
+    """Actor-backed process pool (stdlib ``multiprocessing.Pool`` API)."""
+
+    def __init__(
+        self,
+        processes: Optional[int] = None,
+        initializer: Optional[Callable] = None,
+        initargs: tuple = (),
+        ray_remote_args: Optional[dict] = None,
+    ):
+        if not api.is_initialized():
+            api.init()
+        if processes is None:
+            processes = max(int(api.cluster_resources().get("CPU", 2)), 1)
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._processes = processes
+        remote_args = dict(ray_remote_args or {})
+        remote_args.setdefault("num_cpus", 1)
+        worker_cls = api.remote(**remote_args)(_PoolWorker)
+        self._actors = [
+            worker_cls.remote(initializer, initargs) for _ in range(processes)
+        ]
+        self._rr = itertools.cycle(range(processes))
+        self._closed = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+        for a in self._actors:
+            api.kill(a)
+        self._actors = []
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("join() before close()")
+        # all submissions are synchronous on the actor queue; ping flushes
+        if self._actors:
+            api.get([a.ping.remote() for a in self._actors])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+
+    # -- submission ---------------------------------------------------------
+
+    def _check_open(self):
+        if self._closed or not self._actors:
+            raise ValueError("Pool is closed")
+
+    def _submit_chunks(self, fn, calls, chunksize):
+        """calls: list of (args, kwargs); returns refs of list-chunks."""
+        self._check_open()
+        if chunksize is None:
+            chunksize = max(len(calls) // (self._processes * 4), 1)
+        refs = []
+        for i in range(0, len(calls), chunksize):
+            chunk = calls[i : i + chunksize]
+            actor = self._actors[next(self._rr)]
+            refs.append(actor.run_batch.remote(fn, chunk))
+        return refs
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args=(), kwds=None, callback=None,
+                    error_callback=None) -> AsyncResult:
+        refs = self._submit_chunks(fn, [(tuple(args), kwds or {})], 1)
+        return AsyncResult(refs, True, callback, error_callback)
+
+    def map(self, fn: Callable, iterable: Iterable, chunksize=None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable, chunksize=None, callback=None,
+                  error_callback=None) -> AsyncResult:
+        calls = [((x,), {}) for x in iterable]
+        refs = self._submit_chunks(fn, calls, chunksize)
+        return AsyncResult(refs, False, callback, error_callback)
+
+    def starmap(self, fn: Callable, iterable: Iterable, chunksize=None):
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        calls = [(tuple(args), {}) for args in iterable]
+        refs = self._submit_chunks(fn, calls, chunksize)
+        return AsyncResult(refs, False)
+
+    def imap(self, fn: Callable, iterable: Iterable, chunksize=1):
+        """Lazy ordered iterator over results."""
+        calls = [((x,), {}) for x in iterable]
+        refs = self._submit_chunks(fn, calls, chunksize)
+        for ref in refs:
+            yield from api.get(ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable, chunksize=1):
+        calls = [((x,), {}) for x in iterable]
+        refs = self._submit_chunks(fn, calls, chunksize)
+        pending = list(refs)
+        while pending:
+            ready, pending = api.wait(pending, num_returns=1)
+            yield from api.get(ready[0])

@@ -1,0 +1,116 @@
+"""multiprocessing Pool shim, joblib backend, serializability inspector
+(reference: ray.util.multiprocessing, ray.util.joblib, util/check_serialize)."""
+
+import threading
+
+import pytest
+
+
+def _square(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+_init_flag = {"v": 0}
+
+
+def _initializer(v):
+    _init_flag["v"] = v
+
+
+class TestPool:
+    def test_map_and_apply(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            assert p.map(_square, range(10)) == [x * x for x in range(10)]
+            assert p.apply(_add, (2, 3)) == 5
+
+    def test_async_and_starmap(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            r = p.apply_async(_add, (1, 2))
+            assert r.get(timeout=30) == 3
+            assert r.successful()
+            assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+            mr = p.map_async(_square, [1, 2, 3])
+            assert mr.get(timeout=30) == [1, 4, 9]
+
+    def test_imap(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            assert list(p.imap(_square, range(6), chunksize=2)) == [
+                x * x for x in range(6)
+            ]
+            assert sorted(p.imap_unordered(_square, range(6))) == sorted(
+                x * x for x in range(6)
+            )
+
+    def test_initializer_and_close(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        p = Pool(processes=1, initializer=_initializer, initargs=(7,))
+        p.close()
+        p.join()
+        with pytest.raises(ValueError):
+            p.map(_square, [1])
+
+    def test_error_propagates(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        def boom(x):
+            raise RuntimeError("nope")
+
+        with Pool(processes=1) as p:
+            with pytest.raises(Exception):
+                p.map(boom, [1])
+
+
+class TestJoblib:
+    def test_parallel_backend(self, ray_start_regular):
+        import joblib
+
+        from ray_tpu.util.joblib import register_ray
+
+        register_ray()
+        with joblib.parallel_backend("ray", n_jobs=2):
+            out = joblib.Parallel()(joblib.delayed(_square)(i) for i in range(8))
+        assert out == [i * i for i in range(8)]
+
+
+class TestCheckSerialize:
+    def test_ok(self):
+        from ray_tpu.util import inspect_serializability
+
+        ok, failures = inspect_serializability(_square)
+        assert ok and not failures
+
+    def test_finds_bad_closure(self):
+        from ray_tpu.util import inspect_serializability
+
+        lock = threading.Lock()
+
+        def captures_lock():
+            return lock
+
+        ok, failures = inspect_serializability(captures_lock)
+        assert not ok
+        assert any("lock" in f.name for f in failures)
+
+    def test_finds_bad_attribute(self):
+        from ray_tpu.util import inspect_serializability
+
+        class Holder:
+            pass
+
+        h = Holder()
+        h.fine = 3
+        h.bad = threading.Lock()
+        ok, failures = inspect_serializability(h, name="holder")
+        assert not ok
+        assert any("bad" in f.name for f in failures)
