@@ -5,8 +5,15 @@ reported metrics, ASHA prunes underperformers, and with_resources gang-
 places TPU trials.
 """
 
-from ._session import report
-from .schedulers import ASHAScheduler, FIFOScheduler, MedianStoppingRule
+from ._session import get_checkpoint, report
+from .schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+from .searchers import BasicVariantGenerator, Searcher, TPESearcher
 from .search import (
     choice,
     grid_search,
@@ -43,4 +50,10 @@ __all__ = [
     "FIFOScheduler",
     "MedianStoppingRule",
     "ASHAScheduler",
+    "HyperBandScheduler",
+    "PopulationBasedTraining",
+    "Searcher",
+    "BasicVariantGenerator",
+    "TPESearcher",
+    "get_checkpoint",
 ]

@@ -1,8 +1,11 @@
-"""Per-trial session: tune.report from inside the trainable.
+"""Per-trial session: tune.report / tune.get_checkpoint from inside the
+trainable.
 
 Role-equivalent of the reference's tune session (ray.tune.report /
-train.report inside a trainable): thread-local binding between the user
-function and its _TrialRunner actor.
+ray.tune.get_checkpoint inside a trainable): thread-local binding between
+the user function and its _TrialRunner actor. Checkpoints are plain dicts
+(param pytrees / opt state) shipped through the object store — the PBT
+scheduler uses them to clone top trials into bottom ones.
 """
 
 from __future__ import annotations
@@ -30,10 +33,21 @@ def _get():
     return runner
 
 
-def report(metrics: Dict[str, Any], **kw_metrics: Any):
+def report(
+    metrics: Dict[str, Any],
+    *,
+    checkpoint: Optional[Dict[str, Any]] = None,
+    **kw_metrics: Any,
+):
     runner = _get()
     merged = dict(metrics or {})
     merged.update(kw_metrics)
-    runner._report(merged)
+    runner._report(merged, checkpoint)
     if runner._should_stop():
         raise StopTrial()
+
+
+def get_checkpoint() -> Optional[Dict[str, Any]]:
+    """Checkpoint this trial was (re)started from, or None on a fresh start
+    (reference: ray.tune.get_checkpoint)."""
+    return _get()._start_checkpoint

@@ -1,19 +1,26 @@
-"""Trial schedulers: FIFO and ASHA.
+"""Trial schedulers: FIFO, median stopping, ASHA, HyperBand, PBT.
 
 Role-equivalent of the reference's TrialScheduler family
 (python/ray/tune/schedulers/ — FIFOScheduler, AsyncHyperBandScheduler/ASHA
-in async_hyperband.py): on every reported result the scheduler decides
-CONTINUE or STOP; ASHA keeps successive-halving rungs and stops trials that
-fall below the top ``1/reduction_factor`` quantile at each rung.
+in async_hyperband.py, HyperBandScheduler in hyperband.py,
+PopulationBasedTraining in pbt.py): on every reported result the scheduler
+decides CONTINUE / STOP / PERTURB; ASHA keeps successive-halving rungs and
+stops trials below the top ``1/reduction_factor`` quantile at each rung;
+PBT clones top-quantile trials (config + checkpoint) into bottom-quantile
+ones with mutated hyperparameters.
 """
 
 from __future__ import annotations
 
+import random
 from collections import defaultdict
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional, Tuple
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+# trial should restart from a donor checkpoint with a mutated config; the
+# controller calls scheduler.exploit(trial_id) for the payload
+PERTURB = "PERTURB"
 
 
 class FIFOScheduler:
@@ -123,3 +130,168 @@ class ASHAScheduler:
 
     def on_trial_complete(self, trial_id: str):
         pass
+
+
+class HyperBandScheduler:
+    """Bracketed successive halving (reference: schedulers/hyperband.py),
+    run asynchronously: trials are dealt round-robin into ``s_max + 1``
+    brackets, each an ASHA ladder with a different grace period, so some
+    brackets explore aggressively (early stops from iteration ~1) while one
+    bracket never stops early. Async rung evaluation (decide as results
+    arrive) replaces the reference's synchronized bracket rounds, which
+    would idle chips while waiting for stragglers."""
+
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        max_t: int = 81,
+        reduction_factor: int = 3,
+    ):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.rf = reduction_factor
+        # largest s with rf**s <= max_t, via integer powers (float log floors
+        # e.g. log(1000)/log(10) = 2.9999... and would drop a bracket)
+        s_max, power = 0, reduction_factor
+        while power <= max_t:
+            s_max += 1
+            power *= reduction_factor
+        self._brackets: List[ASHAScheduler] = []
+        for s in range(s_max + 1):
+            grace = max(1, int(max_t / reduction_factor**s))
+            self._brackets.append(
+                ASHAScheduler(
+                    metric=metric, mode=mode, time_attr=time_attr,
+                    max_t=max_t, grace_period=grace,
+                    reduction_factor=reduction_factor,
+                )
+            )
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def _bracket(self, trial_id: str) -> ASHAScheduler:
+        if trial_id not in self._assignment:
+            self._assignment[trial_id] = self._next % len(self._brackets)
+            self._next += 1
+        b = self._brackets[self._assignment[trial_id]]
+        # metric may have been filled in by the Tuner after construction
+        b.metric, b.mode = self.metric, self.mode
+        return b
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        return self._bracket(trial_id).on_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str):
+        self._bracket(trial_id).on_trial_complete(trial_id)
+
+
+class PopulationBasedTraining:
+    """PBT (reference: schedulers/pbt.py PopulationBasedTraining): every
+    ``perturbation_interval`` iterations a trial is ranked against the
+    population's latest scores; bottom-quantile trials exploit (copy config
+    + checkpoint from a random top-quantile trial) and explore (mutate the
+    copied hyperparameters). The controller performs the actual clone —
+    ``on_result`` returns PERTURB and the controller calls ``exploit``.
+    """
+
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 5,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        perturbation_factors: Tuple[float, float] = (0.8, 1.2),
+        seed: Optional[int] = None,
+    ):
+        assert mode in ("max", "min")
+        if not 0.0 < quantile_fraction <= 0.5:
+            raise ValueError("quantile_fraction must be in (0, 0.5]")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self.factors = perturbation_factors
+        self._rng = random.Random(seed)
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._scores: Dict[str, float] = {}
+        self._last_perturb: Dict[str, int] = defaultdict(int)
+
+    # controller hook: record each trial's live config
+    def on_trial_add(self, trial_id: str, config: Dict[str, Any]):
+        self._configs[trial_id] = dict(config)
+
+    def _score(self, value: float) -> float:
+        return value if self.mode == "max" else -value
+
+    def _quantiles(self) -> Tuple[List[str], List[str]]:
+        ranked = sorted(self._scores, key=lambda t: self._scores[t])
+        n = max(1, int(len(ranked) * self.quantile))
+        if len(ranked) <= 1:
+            return [], []
+        return ranked[:n], ranked[-n:]  # (bottom, top)
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        self._scores[trial_id] = self._score(float(value))
+        if t - self._last_perturb[trial_id] < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        bottom, top = self._quantiles()
+        if trial_id in bottom and top and trial_id not in top:
+            return PERTURB
+        return CONTINUE
+
+    def exploit(self, trial_id: str) -> Tuple[Dict[str, Any], str]:
+        """(new_config, donor_trial_id) for a PERTURB-ed trial."""
+        _bottom, top = self._quantiles()
+        donor = self._rng.choice([t for t in top if t != trial_id] or top)
+        new_config = self._explore(dict(self._configs.get(donor, {})))
+        self._configs[trial_id] = dict(new_config)
+        return new_config, donor
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from .search import Domain
+
+        for key, spec in self.mutations.items():
+            if key not in config:
+                continue
+            resample = self._rng.random() < self.resample_prob
+            if isinstance(spec, Domain):
+                if resample:
+                    config[key] = spec.sample(self._rng)
+                elif isinstance(config[key], (int, float)):
+                    config[key] = self._perturb_numeric(config[key])
+            elif isinstance(spec, list):
+                if resample or config[key] not in spec:
+                    config[key] = self._rng.choice(spec)
+                else:  # step to a neighboring value in the list
+                    i = spec.index(config[key])
+                    j = min(max(i + self._rng.choice((-1, 1)), 0), len(spec) - 1)
+                    config[key] = spec[j]
+            elif callable(spec):
+                config[key] = spec()
+        return config
+
+    def _perturb_numeric(self, value):
+        factor = self._rng.choice(self.factors)
+        out = value * factor
+        return int(round(out)) if isinstance(value, int) else out
+
+    def on_trial_complete(self, trial_id: str):
+        self._scores.pop(trial_id, None)
+        self._configs.pop(trial_id, None)
+        self._last_perturb.pop(trial_id, None)

@@ -1,0 +1,218 @@
+"""Search algorithms: suggest configs from past results.
+
+Role-equivalent of the reference's Searcher layer (python/ray/tune/search/:
+searcher.py Searcher ABC, basic_variant.py BasicVariantGenerator, and the
+hyperopt/optuna integrations). The reference wraps external libraries for
+model-based search; here TPE (tree-structured Parzen estimator, the
+algorithm behind hyperopt) is implemented natively on numpy so model-based
+search works with zero extra dependencies.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from .search import Choice, Domain, GridSearch, LogUniform, QUniform, RandInt, SampleFrom, Uniform
+
+
+class Searcher:
+    """ABC (reference: tune/search/searcher.py): ``suggest`` returns the next
+    config; ``on_trial_complete`` feeds the final result back."""
+
+    def set_search_properties(
+        self, metric: Optional[str], mode: str, param_space: Dict[str, Any]
+    ) -> None:
+        self.metric = metric
+        self.mode = mode
+        self.param_space = param_space
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(
+        self, trial_id: str, result: Optional[Dict[str, Any]] = None
+    ) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Random/grid sampling straight from the param space (reference:
+    tune/search/basic_variant.py)."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        return _sample_config(self.param_space, self._rng)
+
+
+def _sample_config(space: Dict[str, Any], rng: random.Random) -> Dict[str, Any]:
+    out = {}
+    for k, v in space.items():
+        if isinstance(v, dict):
+            out[k] = _sample_config(v, rng)
+        elif isinstance(v, Domain):
+            out[k] = v.sample(rng)
+        elif isinstance(v, GridSearch):
+            out[k] = rng.choice(v.values)
+        elif isinstance(v, SampleFrom):
+            out[k] = v.fn({})
+        else:
+            out[k] = v
+    return out
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen estimator (the hyperopt algorithm,
+    reference-equivalent of tune/search/hyperopt/hyperopt_search.py).
+
+    After ``n_startup`` random trials, completed trials are split into the
+    top ``gamma`` fraction ("good") and the rest ("bad"). For each numeric
+    dimension a Parzen (Gaussian-kernel) density is fit to each side in the
+    domain's transformed space (log for LogUniform); candidates sampled from
+    the good density are ranked by the likelihood ratio l(x)/g(x) and the
+    best candidate wins. Categorical dimensions use smoothed count ratios.
+    """
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: str = "max",
+        n_startup_trials: int = 10,
+        gamma: float = 0.25,
+        n_candidates: int = 24,
+        seed: Optional[int] = None,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self._n_startup = n_startup_trials
+        self._gamma = gamma
+        self._n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._live: Dict[str, Dict[str, Any]] = {}
+        self._history: List[Tuple[Dict[str, Any], float]] = []
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        if len(self._history) < self._n_startup:
+            config = _sample_config(self.param_space, self._rng)
+        else:
+            config = self._tpe_sample()
+        self._live[trial_id] = config
+        return config
+
+    def on_trial_complete(self, trial_id, result=None):
+        config = self._live.pop(trial_id, None)
+        if config is None or not result:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        score = float(value) if self.mode == "max" else -float(value)
+        self._history.append((config, score))
+
+    # -- TPE core -----------------------------------------------------------
+
+    def _split(self):
+        ordered = sorted(self._history, key=lambda cs: -cs[1])
+        n_good = max(1, int(math.ceil(self._gamma * len(ordered))))
+        good = [c for c, _s in ordered[:n_good]]
+        bad = [c for c, _s in ordered[n_good:]] or good
+        return good, bad
+
+    def _tpe_sample(self) -> Dict[str, Any]:
+        good, bad = self._split()
+        return self._sample_space(self.param_space, good, bad)
+
+    def _sample_space(self, space: Dict[str, Any], good, bad) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for key, domain in space.items():
+            gvals = [g[key] for g in good if key in g]
+            bvals = [b[key] for b in bad if key in b]
+            if isinstance(domain, dict):
+                # nested space: recurse with the matching sub-configs
+                out[key] = self._sample_space(domain, gvals, bvals)
+            else:
+                out[key] = self._sample_dim(domain, gvals, bvals)
+        return out
+
+    def _sample_dim(self, domain, gvals, bvals):
+        if isinstance(domain, (Choice, GridSearch)):
+            cats = domain.categories if isinstance(domain, Choice) else domain.values
+            return self._sample_categorical(cats, gvals, bvals)
+        if isinstance(domain, (Uniform, LogUniform, QUniform, RandInt)):
+            return self._sample_numeric(domain, gvals, bvals)
+        if isinstance(domain, Domain):
+            return domain.sample(self._rng)
+        if isinstance(domain, SampleFrom):
+            return domain.fn({})
+        return domain  # constant
+
+    def _sample_categorical(self, cats, gvals, bvals):
+        def weights(vals):
+            counts = {c: 1.0 for c in cats}  # +1 smoothing
+            for v in vals:
+                if v in counts:
+                    counts[v] += 1.0
+            total = sum(counts.values())
+            return {c: w / total for c, w in counts.items()}
+
+        gw, bw = weights(gvals), weights(bvals)
+        # sample candidates from good distribution, rank by ratio
+        best, best_ratio = None, -1.0
+        for _ in range(self._n_candidates):
+            c = self._rng.choices(cats, weights=[gw[c] for c in cats])[0]
+            ratio = gw[c] / max(bw[c], 1e-12)
+            if ratio > best_ratio:
+                best, best_ratio = c, ratio
+        return best
+
+    def _sample_numeric(self, domain, gvals, bvals):
+        lo, hi = domain.low, domain.high
+        log = isinstance(domain, LogUniform)
+
+        def fwd(x):
+            return math.log(x) if log else float(x)
+
+        def inv(x):
+            return math.exp(x) if log else x
+
+        tlo, thi = fwd(lo), fwd(hi)
+        span = max(thi - tlo, 1e-12)
+
+        def parzen(vals):
+            pts = [fwd(v) for v in vals] if vals else [0.5 * (tlo + thi)]
+            # Scott-style bandwidth, floored so early rounds keep exploring
+            if len(pts) > 1:
+                mean = sum(pts) / len(pts)
+                var = sum((p - mean) ** 2 for p in pts) / (len(pts) - 1)
+                bw = max(math.sqrt(var) * len(pts) ** -0.2, span / 20.0)
+            else:
+                bw = span / 4.0
+            return pts, bw
+
+        def density(x, pts, bw):
+            s = 0.0
+            for p in pts:
+                z = (x - p) / bw
+                s += math.exp(-0.5 * z * z) / bw
+            return s / len(pts)
+
+        gpts, gbw = parzen(gvals)
+        bpts, bbw = parzen(bvals)
+        best, best_ratio = None, -1.0
+        for _ in range(self._n_candidates):
+            x = min(max(self._rng.choice(gpts) + self._rng.gauss(0.0, gbw), tlo), thi)
+            ratio = density(x, gpts, gbw) / max(density(x, bpts, bbw), 1e-12)
+            if ratio > best_ratio:
+                best, best_ratio = x, ratio
+        value = inv(best)
+        if isinstance(domain, QUniform):
+            value = round(value / domain.q) * domain.q
+        if isinstance(domain, RandInt):
+            value = int(min(max(round(value), lo), hi - 1))
+        return value

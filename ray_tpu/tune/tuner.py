@@ -21,7 +21,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import api
-from .schedulers import CONTINUE, STOP, FIFOScheduler
+from .schedulers import PERTURB, STOP, FIFOScheduler
 from .search import generate_variants
 
 logger = logging.getLogger(__name__)
@@ -34,6 +34,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 0  # 0 => derive from cluster CPUs
     scheduler: Any = None
+    search_alg: Any = None  # a Searcher (searchers.py); None => pre-expanded
     seed: Optional[int] = None
     max_failures: int = 1
 
@@ -129,13 +130,26 @@ class _TrialRunner:
         self._thread: Optional[threading.Thread] = None
 
     def start(
-        self, fn_bytes: bytes, config: dict, stop_criteria: dict = None
+        self,
+        fn_bytes: bytes,
+        config: dict,
+        stop_criteria: dict = None,
+        checkpoint_bytes: bytes = None,
+        start_iteration: int = 0,
     ) -> bool:
         from .._internal import serialization
         from . import _session
 
         self._stop_criteria = dict(stop_criteria or {})
-        self._iteration = 0
+        self._iteration = start_iteration
+        self._start_checkpoint = (
+            serialization.loads(checkpoint_bytes) if checkpoint_bytes else None
+        )
+        self._latest_checkpoint_bytes: Optional[bytes] = checkpoint_bytes
+        # ship checkpoint bytes to the controller only when they change —
+        # polls run ~10x/s and a param-pytree checkpoint can be large
+        self._ckpt_version = 0
+        self._shipped_ckpt_version = 0
         fn = serialization.loads(fn_bytes)
 
         def _run():
@@ -158,7 +172,7 @@ class _TrialRunner:
         self._thread.start()
         return True
 
-    def _report(self, metrics: dict):
+    def _report(self, metrics: dict, checkpoint: dict = None):
         """Queue a report; evaluate user stop criteria trial-side so fast
         loops stop at the right iteration instead of overrunning while the
         controller polls (reference: Trainable stop conditions checked
@@ -168,6 +182,11 @@ class _TrialRunner:
             self._iteration += 1
             report.setdefault("training_iteration", self._iteration)
             self._reports.append(report)
+            if checkpoint is not None:
+                from .._internal import serialization
+
+                self._latest_checkpoint_bytes = serialization.dumps(checkpoint)
+                self._ckpt_version += 1
         if any(
             k in report and report[k] >= v
             for k, v in self._stop_criteria.items()
@@ -184,10 +203,15 @@ class _TrialRunner:
     def poll(self) -> dict:
         with self._lock:
             reports, self._reports = self._reports, []
+            ckpt = None
+            if self._ckpt_version != self._shipped_ckpt_version:
+                ckpt = self._latest_checkpoint_bytes
+                self._shipped_ckpt_version = self._ckpt_version
             return {
                 "reports": reports,
                 "done": self._done,
                 "error": self._error,
+                "checkpoint": ckpt,
             }
 
 
@@ -203,6 +227,10 @@ class _Trial:
     failures: int = 0
     start_timeouts: int = 0
     error: Optional[str] = None
+    # PBT support: latest checkpoint bytes + restart payload
+    checkpoint_bytes: Optional[bytes] = None
+    restart_checkpoint: Optional[bytes] = None
+    restart_iteration: int = 0
 
 
 class Tuner:
@@ -233,17 +261,34 @@ class Tuner:
             scheduler.metric = cfg.metric
             if hasattr(scheduler, "mode"):
                 scheduler.mode = cfg.mode
-        variants = generate_variants(
-            self._param_space, cfg.num_samples, cfg.seed
-        )
-        trials = [
-            _Trial(
-                trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:6]}",
-                config=v,
-                resources=dict(self._resources),
+        searcher = cfg.search_alg
+        if searcher is not None:
+            searcher.set_search_properties(
+                cfg.metric, cfg.mode, self._param_space
             )
-            for i, v in enumerate(variants)
-        ]
+            # configs are suggested lazily at launch time (config=None until
+            # then) so model-based searchers see completed results before
+            # proposing the next trial
+            trials = [
+                _Trial(
+                    trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:6]}",
+                    config=None,
+                    resources=dict(self._resources),
+                )
+                for i in range(cfg.num_samples)
+            ]
+        else:
+            variants = generate_variants(
+                self._param_space, cfg.num_samples, cfg.seed
+            )
+            trials = [
+                _Trial(
+                    trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:6]}",
+                    config=v,
+                    resources=dict(self._resources),
+                )
+                for i, v in enumerate(variants)
+            ]
         fn_bytes = serialization.dumps(self._trainable)
         max_concurrent = cfg.max_concurrent_trials
         if max_concurrent <= 0:
@@ -271,11 +316,19 @@ class Tuner:
         while pending or running:
             while pending and len(running) < max_concurrent:
                 trial = pending.pop(0)
+                if trial.config is None and searcher is not None:
+                    trial.config = searcher.suggest(trial.trial_id)
+                if hasattr(scheduler, "on_trial_add"):
+                    scheduler.on_trial_add(trial.trial_id, trial.config)
                 trial.runner = Runner.remote()
                 try:
                     api.get(
                         trial.runner.start.remote(
-                            fn_bytes, trial.config, stop_criteria
+                            fn_bytes,
+                            trial.config,
+                            stop_criteria,
+                            trial.restart_checkpoint,
+                            trial.restart_iteration,
                         ),
                         timeout=60,
                     )
@@ -305,21 +358,49 @@ class Tuner:
                 try:
                     update = api.get(trial.runner.poll.remote(), timeout=30)
                 except Exception as e:  # runner actor died
-                    self._on_trial_crash(trial, repr(e), pending)
+                    self._on_trial_crash(trial, repr(e), pending, scheduler, searcher)
                     if trial.state == "ERROR":
                         finished.append(trial)
                     continue
+                if update.get("checkpoint") is not None:
+                    trial.checkpoint_bytes = update["checkpoint"]
                 stop_now = False
+                perturb_now = False
                 for report in update["reports"]:
                     trial.iterations = report["training_iteration"]
                     trial.last_metrics = report
                     decision = scheduler.on_result(trial.trial_id, report)
+                    if decision == PERTURB:
+                        perturb_now = True
+                        break
                     if decision == STOP or self._hits_stop_criteria(
                         report, stop_criteria
                     ):
                         stop_now = True
                         break  # later reports are past the stop point
-                if stop_now and not update["done"]:
+                if perturb_now and not update["done"]:
+                    # PBT exploit/explore: restart from the donor's checkpoint
+                    # with the mutated config, keeping the iteration counter
+                    self._kill_runner(trial)
+                    new_config, donor_id = scheduler.exploit(trial.trial_id)
+                    donor = next(
+                        (
+                            t
+                            for t in (running + pending + finished)
+                            if t.trial_id == donor_id
+                        ),
+                        None,
+                    )
+                    trial.config = new_config
+                    trial.restart_checkpoint = (
+                        donor.checkpoint_bytes
+                        if donor is not None and donor.checkpoint_bytes
+                        else trial.checkpoint_bytes
+                    )
+                    trial.restart_iteration = trial.iterations
+                    trial.state = "PENDING"
+                    pending.append(trial)
+                elif stop_now and not update["done"]:
                     try:
                         trial.runner.request_stop.remote()
                     except Exception:
@@ -327,6 +408,10 @@ class Tuner:
                     trial.state = "STOPPED"
                     self._kill_runner(trial)
                     scheduler.on_trial_complete(trial.trial_id)
+                    if searcher is not None:
+                        searcher.on_trial_complete(
+                            trial.trial_id, trial.last_metrics
+                        )
                     finished.append(trial)
                 elif update["done"]:
                     if update["error"] is not None:
@@ -338,17 +423,24 @@ class Tuner:
                                 trial.failures,
                             )
                             self._kill_runner(trial)
+                            self._retire_trial_id(scheduler, searcher, trial)
                             self._reset_for_retry(trial)
                             pending.append(trial)
                         else:
                             trial.state = "ERROR"
                             trial.error = update["error"]
                             self._kill_runner(trial)
+                            if searcher is not None:
+                                searcher.on_trial_complete(trial.trial_id, None)
                             finished.append(trial)
                     else:
                         trial.state = "TERMINATED"
                         self._kill_runner(trial)
                         scheduler.on_trial_complete(trial.trial_id)
+                        if searcher is not None:
+                            searcher.on_trial_complete(
+                                trial.trial_id, trial.last_metrics
+                            )
                         finished.append(trial)
                 else:
                     still_running.append(trial)
@@ -364,22 +456,43 @@ class Tuner:
         ]
         return ResultGrid(results, cfg.metric, cfg.mode)
 
-    def _on_trial_crash(self, trial: _Trial, err: str, pending: list):
+    def _on_trial_crash(
+        self, trial: _Trial, err: str, pending: list, scheduler=None,
+        searcher=None,
+    ):
         trial.failures += 1
         self._kill_runner(trial)
         if trial.failures <= self._tune_config.max_failures:
+            self._retire_trial_id(scheduler, searcher, trial)
             self._reset_for_retry(trial)
             pending.append(trial)
         else:
             trial.state = "ERROR"
             trial.error = err
+            if searcher is not None:
+                searcher.on_trial_complete(trial.trial_id, None)
+
+    @staticmethod
+    def _retire_trial_id(scheduler, searcher, trial: _Trial):
+        """A retry gets a fresh trial id; drop scheduler/searcher state keyed
+        by the old one so stale scores can't occupy PBT quantile slots and
+        searcher live-trial maps don't leak."""
+        if scheduler is not None:
+            scheduler.on_trial_complete(trial.trial_id)
+        if searcher is not None:
+            searcher.on_trial_complete(trial.trial_id, None)
 
     @staticmethod
     def _reset_for_retry(trial: _Trial):
         """Fresh trial id per attempt: scheduler rung/average state from the
-        aborted attempt must not leak into the retry."""
+        aborted attempt must not leak into the retry. The retry resumes from
+        the last reported checkpoint, if any (reference: trial restore on
+        failure, tune/execution/tune_controller.py)."""
         trial.state = "PENDING"
-        trial.iterations = 0
+        if trial.checkpoint_bytes is not None:
+            trial.restart_checkpoint = trial.checkpoint_bytes
+            trial.restart_iteration = trial.iterations
+        trial.iterations = trial.restart_iteration
         base = trial.trial_id.split("@attempt")[0]
         trial.trial_id = f"{base}@attempt{trial.failures}"
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import itertools
 import threading
-import time
+from collections import deque
 from typing import Any, Callable, Iterable, List, Optional
 
 from .. import api
@@ -175,17 +175,38 @@ class Pool:
         refs = self._submit_chunks(fn, calls, chunksize)
         return AsyncResult(refs, False)
 
+    def _lazy_chunks(self, fn, iterable, chunksize):
+        """Submit one chunk at a time from the (possibly infinite) iterable —
+        the stdlib imap contract is lazy, bounded-memory submission."""
+        self._check_open()
+        it = iter(iterable)
+        while True:
+            chunk = [((x,), {}) for x in itertools.islice(it, chunksize)]
+            if not chunk:
+                return
+            actor = self._actors[next(self._rr)]
+            yield actor.run_batch.remote(fn, chunk)
+
     def imap(self, fn: Callable, iterable: Iterable, chunksize=1):
-        """Lazy ordered iterator over results."""
-        calls = [((x,), {}) for x in iterable]
-        refs = self._submit_chunks(fn, calls, chunksize)
-        for ref in refs:
-            yield from api.get(ref)
+        """Lazy ordered iterator; keeps ~2x pool-size chunks in flight."""
+        window = max(2 * self._processes, 2)
+        refs: deque = deque()
+        submitter = self._lazy_chunks(fn, iterable, chunksize)
+        for ref in itertools.islice(submitter, window):
+            refs.append(ref)
+        while refs:
+            yield from api.get(refs.popleft())
+            nxt = next(submitter, None)
+            if nxt is not None:
+                refs.append(nxt)
 
     def imap_unordered(self, fn: Callable, iterable: Iterable, chunksize=1):
-        calls = [((x,), {}) for x in iterable]
-        refs = self._submit_chunks(fn, calls, chunksize)
-        pending = list(refs)
+        window = max(2 * self._processes, 2)
+        submitter = self._lazy_chunks(fn, iterable, chunksize)
+        pending = list(itertools.islice(submitter, window))
         while pending:
             ready, pending = api.wait(pending, num_returns=1)
+            nxt = next(submitter, None)
+            if nxt is not None:
+                pending.append(nxt)
             yield from api.get(ready[0])

@@ -156,3 +156,130 @@ def test_result_dataframe(cluster):
     df = results.get_dataframe()
     assert len(df) == 3
     assert set(df["config/x"]) == {1, 2, 3}
+
+
+def test_pbt_perturbs_and_checkpoints(cluster):
+    """Bottom-quantile trials clone a top trial's checkpoint + mutated
+    config; cloned trials see the donor's progress via tune.get_checkpoint."""
+
+    def objective(config):
+        import time as _time
+
+        ckpt = tune.get_checkpoint()
+        step = ckpt["step"] if ckpt else 0
+        best = ckpt["best"] if ckpt else 0.0
+        for _ in range(40):
+            step += 1
+            # lr=0.5 is good, lr near 0 makes no progress
+            best += config["lr"]
+            # slow iterations: both runners must overlap (actor spawn takes
+            # ~seconds) so the population has two live members to rank
+            _time.sleep(0.15)
+            tune.report(
+                {"score": best}, checkpoint={"step": step, "best": best}
+            )
+
+    scheduler = tune.PopulationBasedTraining(
+        metric="score",
+        mode="max",
+        perturbation_interval=5,
+        hyperparam_mutations={"lr": tune.uniform(0.0, 0.5)},
+        quantile_fraction=0.5,
+        seed=0,
+    )
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.001, 0.5])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=scheduler,
+            max_concurrent_trials=2,
+        ),
+        run_config=tune.RunConfig(stop={"training_iteration": 40}),
+    )
+    results = tuner.fit()
+    assert len(results) == 2
+    best = results.get_best_result()
+    assert best.metrics["score"] > 10  # the good lr dominates
+    # the originally-bad trial must have been perturbed toward the good one
+    worst = min(
+        (r for r in results if r.error is None),
+        key=lambda r: r.metrics.get("score", 0),
+    )
+    assert worst.config["lr"] > 0.001 or worst.metrics["score"] > 1.0
+
+
+def test_hyperband_brackets_stop_bad_trials(cluster):
+    scheduler = tune.HyperBandScheduler(
+        metric="acc", mode="max", max_t=27, reduction_factor=3
+    )
+
+    def objective(config):
+        for i in range(27):
+            tune.report({"acc": config["quality"] + i * 0.01})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"quality": tune.grid_search([0.1, 0.2, 0.8, 0.9])},
+        tune_config=tune.TuneConfig(
+            metric="acc", mode="max", scheduler=scheduler,
+            max_concurrent_trials=4,
+        ),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    assert results.get_best_result().config["quality"] == pytest.approx(0.9)
+
+
+def test_tpe_searcher_converges():
+    """Pure searcher logic (no cluster): TPE should concentrate samples near
+    the optimum after startup trials."""
+    searcher = tune.TPESearcher(
+        metric="loss", mode="min", n_startup_trials=8, seed=0
+    )
+    searcher.set_search_properties(
+        "loss", "min", {"x": tune.uniform(-10, 10), "c": tune.choice(["a", "b"])}
+    )
+    for i in range(40):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        loss = (cfg["x"] - 2.0) ** 2 + (0.0 if cfg["c"] == "a" else 5.0)
+        searcher.on_trial_complete(tid, {"loss": loss})
+    late = [searcher.suggest(f"probe{i}") for i in range(10)]
+    xs = [c["x"] for c in late]
+    assert sum(abs(x - 2.0) < 4.0 for x in xs) >= 6
+    assert sum(c["c"] == "a" for c in late) >= 6
+
+
+def test_tpe_searcher_with_tuner(cluster):
+    def objective(config):
+        tune.report({"loss": (config["x"] - 1.0) ** 2})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(-5, 5)},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=12,
+            search_alg=tune.TPESearcher(n_startup_trials=4, seed=1),
+            max_concurrent_trials=2,
+        ),
+    )
+    results = tuner.fit()
+    assert len(results) == 12
+    assert results.get_best_result().metrics["loss"] < 4.0
+
+
+def test_tpe_nested_param_space():
+    """Nested dict spaces must keep working past the startup phase."""
+    searcher = tune.TPESearcher(
+        metric="loss", mode="min", n_startup_trials=3, seed=0
+    )
+    searcher.set_search_properties(
+        "loss", "min", {"opt": {"lr": tune.uniform(0.0, 1.0)}, "k": 5}
+    )
+    for i in range(10):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        assert isinstance(cfg["opt"], dict)
+        assert isinstance(cfg["opt"]["lr"], float), cfg
+        assert cfg["k"] == 5
+        searcher.on_trial_complete(tid, {"loss": (cfg["opt"]["lr"] - 0.3) ** 2})

@@ -114,3 +114,13 @@ class TestCheckSerialize:
         ok, failures = inspect_serializability(h, name="holder")
         assert not ok
         assert any("bad" in f.name for f in failures)
+
+    def test_imap_lazy_over_infinite_generator(self, ray_start_regular):
+        import itertools
+
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            gen = (i for i in itertools.count())  # infinite
+            out = list(itertools.islice(p.imap(_square, gen, chunksize=1), 5))
+            assert out == [0, 1, 4, 9, 16]
