@@ -7,16 +7,26 @@ with the learner compiling to the TPU instead of torch DDP.
 """
 
 from .algorithm import PPO, PPOConfig, as_trainable
+from .bc import BC, BCConfig
 from .dqn import DQN, DQNConfig, ReplayBuffer
 from .env import VectorEnv, make_env
 from .env_runner import EnvRunner
+from .impala import APPOConfig, IMPALA, IMPALAConfig
 from .learner import PPOLearner
+from .sac import SAC, SACConfig
 
 __all__ = [
     "PPO",
     "PPOConfig",
     "DQN",
     "DQNConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "APPOConfig",
+    "SAC",
+    "SACConfig",
+    "BC",
+    "BCConfig",
     "ReplayBuffer",
     "as_trainable",
     "PPOLearner",

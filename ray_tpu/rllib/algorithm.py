@@ -19,17 +19,16 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import numpy as np
 
 from .. import api
+from .config_base import AlgorithmConfig
 from .env import make_env, space_dims
 from .env_runner import EnvRunner
 from .learner import PPOLearner
 from .models import compute_gae
 
 
-class PPOConfig:
+class PPOConfig(AlgorithmConfig):
     def __init__(self):
-        self.env_spec: Union[str, Callable, None] = None
-        self.env_config: Dict[str, Any] = {}
-        self.num_env_runners = 2
+        super().__init__()
         self.num_envs_per_runner = 4
         self.rollout_len = 64
         self.gamma = 0.99
@@ -41,55 +40,6 @@ class PPOConfig:
         self.num_epochs = 4
         self.minibatch_size = 128
         self.max_grad_norm = 0.5
-        self.seed = 0
-        self.num_cpus_per_runner = 1.0
-        self.num_tpus_for_learner = 0.0
-
-    # -- builder API (reference: AlgorithmConfig fluent methods) -----------
-
-    def environment(self, env, env_config: Optional[dict] = None) -> "PPOConfig":
-        self.env_spec = env
-        self.env_config = dict(env_config or {})
-        return self
-
-    def env_runners(
-        self,
-        num_env_runners: Optional[int] = None,
-        num_envs_per_env_runner: Optional[int] = None,
-        rollout_fragment_length: Optional[int] = None,
-        num_cpus_per_env_runner: Optional[float] = None,
-    ) -> "PPOConfig":
-        if num_env_runners is not None:
-            self.num_env_runners = num_env_runners
-        if num_envs_per_env_runner is not None:
-            self.num_envs_per_runner = num_envs_per_env_runner
-        if rollout_fragment_length is not None:
-            self.rollout_len = rollout_fragment_length
-        if num_cpus_per_env_runner is not None:
-            self.num_cpus_per_runner = num_cpus_per_env_runner
-        return self
-
-    def training(self, **kwargs) -> "PPOConfig":
-        for k, v in kwargs.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown training option {k!r}")
-            setattr(self, k, v)
-        return self
-
-    def resources(self, num_tpus_for_learner: float = 0) -> "PPOConfig":
-        self.num_tpus_for_learner = num_tpus_for_learner
-        return self
-
-    def debugging(self, seed: Optional[int] = None) -> "PPOConfig":
-        if seed is not None:
-            self.seed = seed
-        return self
-
-    def build(self) -> "PPO":
-        return PPO(copy.deepcopy(self))
-
-    # legacy alias used by reference examples
-    build_algo = build
 
 
 class PPO:
@@ -250,6 +200,9 @@ class PPO:
             except Exception:
                 pass
         self.runners = []
+
+
+PPOConfig.algo_class = PPO
 
 
 def as_trainable(config: PPOConfig):

@@ -1,0 +1,196 @@
+"""BC: offline behavior cloning.
+
+Role-equivalent of the reference's BC algorithm (rllib/algorithms/bc/ —
+offline RL base: learn the logged policy by supervised learning on
+(obs, action) pairs, no environment interaction). TPU-first: the whole
+epoch (shuffle + minibatch SGD) is one jitted ``lax.scan``; the offline
+dataset arrives either as numpy arrays or as a ``ray_tpu.data.Dataset``
+streamed through the object store.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .config_base import AlgorithmConfig
+from .env import encode_obs, make_env, space_dims
+from .models import ActorCritic, log_prob_entropy
+
+
+class BCConfig(AlgorithmConfig):
+    """Builder config (reference: bc/bc.py BCConfig + offline_data)."""
+
+    def __init__(self):
+        super().__init__()
+        # offline input: {"obs": [N, D], "actions": [N] or [N, A]} arrays,
+        # or a ray_tpu.data.Dataset of such rows
+        self.input_data: Any = None
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.num_epochs_per_iter = 1
+
+    def offline_data(self, input_data) -> "BCConfig":
+        self.input_data = input_data
+        return self
+
+
+class BC:
+    def __init__(self, config: BCConfig):
+        if config.env_spec is None:
+            raise ValueError("config.environment(...) is required")
+        if config.input_data is None:
+            raise ValueError("config.offline_data(...) is required")
+        self.config = config
+        self.iteration = 0
+        probe = make_env(config.env_spec, config.env_config)()
+        self._obs_space = probe.observation_space
+        obs_dim, act_dim, discrete = space_dims(
+            probe.observation_space, probe.action_space
+        )
+        try:
+            probe.close()
+        except Exception:
+            pass
+        self._discrete = discrete
+        self.model = ActorCritic(action_dim=act_dim, discrete=discrete)
+        self.params = self.model.init(
+            jax.random.PRNGKey(config.seed), jnp.zeros((1, obs_dim))
+        )["params"]
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._epoch_fn = jax.jit(self._epoch_impl)
+        self._key = jax.random.PRNGKey(config.seed + 1)
+        # one-time host->device transfer: the offline dataset is immutable
+        self._data = jax.tree.map(
+            jnp.asarray, self._materialize(config.input_data, obs_dim)
+        )
+
+    def _materialize(self, data, obs_dim) -> Dict[str, np.ndarray]:
+        from ..data.dataset import Dataset
+
+        if isinstance(data, Dataset):
+            rows = data.take_all()
+            obs = np.stack([np.asarray(r["obs"], np.float32) for r in rows])
+            actions = np.stack([np.asarray(r["actions"]) for r in rows])
+        else:
+            obs = np.asarray(data["obs"], np.float32)
+            actions = np.asarray(data["actions"])
+        obs = encode_obs(self._obs_space, obs)
+        assert obs.shape[1] == obs_dim, (obs.shape, obs_dim)
+        if self._discrete:
+            actions = actions.astype(np.int64).reshape(len(actions))
+        else:
+            actions = actions.astype(np.float32).reshape(len(actions), -1)
+        return {"obs": obs, "actions": actions}
+
+    # -- jitted supervised epoch ---------------------------------------------
+
+    def _loss(self, params, batch):
+        out, _values = self.model.apply({"params": params}, batch["obs"])
+        logp, _ = log_prob_entropy(self._discrete, out, batch["actions"])
+        return -jnp.mean(logp)
+
+    def _epoch_impl(self, params, opt_state, key, data):
+        B = data["obs"].shape[0]
+        mb = min(self.config.train_batch_size, B)
+        n_mb = max(B // mb, 1)
+
+        def step(carry, idx):
+            params, opt_state = carry
+            batch = jax.tree.map(lambda x: x[idx], data)
+            loss, grads = jax.value_and_grad(self._loss)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        perm = jax.random.permutation(key, B)[: n_mb * mb].reshape(n_mb, mb)
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), perm
+        )
+        return params, opt_state, jnp.mean(losses)
+
+    # -- training -----------------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        losses = []
+        for _ in range(self.config.num_epochs_per_iter):
+            self._key, sub = jax.random.split(self._key)
+            self.params, self.opt_state, loss = self._epoch_fn(
+                self.params, self.opt_state, sub, self._data
+            )
+            losses.append(float(loss))
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "bc_loss": float(np.mean(losses)),
+            "num_samples": int(self._data["obs"].shape[0]),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        """Greedy rollouts in the real env (reference: Algorithm.evaluate)."""
+        env = make_env(self.config.env_spec, self.config.env_config)()
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=self.config.seed + ep)
+            done, total = False, 0.0
+            steps = 0
+            while not done and steps < 1000:
+                a = self.compute_single_action(obs)
+                obs, r, term, trunc, _ = env.step(a)
+                total += float(r)
+                done = term or trunc
+                steps += 1
+            returns.append(total)
+        try:
+            env.close()
+        except Exception:
+            pass
+        return {
+            "episode_return_mean": float(np.mean(returns)),
+            "num_episodes": num_episodes,
+        }
+
+    def compute_single_action(self, obs):
+        enc = encode_obs(self._obs_space, np.asarray(obs)[None])
+        out, _ = self.model.apply({"params": self.params}, jnp.asarray(enc))
+        if self._discrete:
+            return int(np.asarray(jnp.argmax(out, axis=-1))[0])
+        mean, _log_std = out
+        return np.asarray(mean)[0]
+
+    # -- checkpointing -------------------------------------------------------
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        with open(os.path.join(checkpoint_dir, "bc_state.pkl"), "wb") as f:
+            pickle.dump(
+                {
+                    "params": jax.tree.map(np.asarray, self.params),
+                    "iteration": self.iteration,
+                },
+                f,
+            )
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str):
+        with open(os.path.join(checkpoint_dir, "bc_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.opt_state = self.tx.init(self.params)
+        self.iteration = state["iteration"]
+
+    def stop(self):
+        pass
+
+
+BCConfig.algo_class = BC

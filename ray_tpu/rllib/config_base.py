@@ -1,0 +1,74 @@
+"""Shared AlgorithmConfig builder base.
+
+Role-equivalent of the reference's AlgorithmConfig
+(rllib/algorithms/algorithm_config.py): the fluent builder surface
+(environment / env_runners / training / resources / debugging / build)
+shared by every algorithm config, with per-algorithm defaults and algo
+classes supplied by subclasses.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional, Union
+
+
+class AlgorithmConfig:
+    #: subclass hook: the Algorithm class ``build()`` instantiates
+    algo_class: Any = None
+
+    def __init__(self):
+        self.env_spec: Union[str, Callable, None] = None
+        self.env_config: Dict[str, Any] = {}
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 2
+        self.rollout_len = 32
+        self.seed = 0
+        self.num_cpus_per_runner = 1.0
+        self.num_tpus_for_learner = 0.0
+
+    def environment(self, env, env_config: Optional[dict] = None):
+        self.env_spec = env
+        self.env_config = dict(env_config or {})
+        return self
+
+    def env_runners(
+        self,
+        num_env_runners: Optional[int] = None,
+        num_envs_per_env_runner: Optional[int] = None,
+        rollout_fragment_length: Optional[int] = None,
+        num_cpus_per_env_runner: Optional[float] = None,
+    ):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_len = rollout_fragment_length
+        if num_cpus_per_env_runner is not None:
+            self.num_cpus_per_runner = num_cpus_per_env_runner
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def resources(self, num_tpus_for_learner: float = 0):
+        self.num_tpus_for_learner = num_tpus_for_learner
+        return self
+
+    def debugging(self, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self):
+        if self.algo_class is None:
+            raise NotImplementedError(f"{type(self).__name__}.algo_class unset")
+        return self.algo_class(copy.deepcopy(self))
+
+    # legacy alias used by reference examples
+    build_algo = build

@@ -22,6 +22,7 @@ import numpy as np
 import optax
 
 from .. import api
+from .config_base import AlgorithmConfig
 from .env import VectorEnv, encode_obs, make_env, space_dims
 from .models import MLP_HIDDEN, QNetwork
 
@@ -29,13 +30,15 @@ from .models import MLP_HIDDEN, QNetwork
 class ReplayBuffer:
     """Uniform ring-buffer replay (reference:
     rllib/utils/replay_buffers/replay_buffer.py). Runs as an actor so many
-    runners share one buffer."""
+    runners share one buffer. Actions default to discrete scalars; pass
+    ``act_shape``/``act_dtype`` for continuous vectors (SAC)."""
 
-    def __init__(self, capacity: int, obs_dim: int):
+    def __init__(self, capacity: int, obs_dim: int, act_shape: tuple = (),
+                 act_dtype=np.int64):
         self._capacity = capacity
         self._obs = np.zeros((capacity, obs_dim), np.float32)
         self._next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self._actions = np.zeros((capacity,), np.int64)
+        self._actions = np.zeros((capacity,) + tuple(act_shape), act_dtype)
         self._rewards = np.zeros((capacity,), np.float32)
         self._dones = np.zeros((capacity,), np.float32)
         self._idx = 0
@@ -43,19 +46,18 @@ class ReplayBuffer:
 
     def add(self, obs, actions, rewards, next_obs, dones):
         n = len(rewards)
-        for i in range(n):
-            j = self._idx
-            self._obs[j] = obs[i]
-            self._next_obs[j] = next_obs[i]
-            self._actions[j] = actions[i]
-            self._rewards[j] = rewards[i]
-            self._dones[j] = dones[i]
-            self._idx = (self._idx + 1) % self._capacity
-            self._size = min(self._size + 1, self._capacity)
+        # vectorized ring write: at most two contiguous slices
+        pos = (self._idx + np.arange(n)) % self._capacity
+        self._obs[pos] = obs[:n]
+        self._next_obs[pos] = next_obs[:n]
+        self._actions[pos] = actions[:n]
+        self._rewards[pos] = rewards[:n]
+        self._dones[pos] = dones[:n]
+        self._idx = int((self._idx + n) % self._capacity)
+        self._size = int(min(self._size + n, self._capacity))
         return self._size
 
-    def sample(self, batch_size: int, seed: int = 0):
-        idx = np.random.default_rng(seed).integers(0, self._size, batch_size)
+    def _gather(self, idx):
         return {
             "obs": self._obs[idx],
             "actions": self._actions[idx],
@@ -63,6 +65,17 @@ class ReplayBuffer:
             "next_obs": self._next_obs[idx],
             "dones": self._dones[idx],
         }
+
+    def sample(self, batch_size: int, seed: int = 0):
+        idx = np.random.default_rng(seed).integers(0, self._size, batch_size)
+        return self._gather(idx)
+
+    def sample_many(self, batch_size: int, n_batches: int, seed: int = 0):
+        """n_batches stacked minibatches in one RPC — feeds a jitted
+        lax.scan over updates without per-batch object-store round trips."""
+        rng = np.random.default_rng(seed)
+        idx = rng.integers(0, self._size, (n_batches, batch_size))
+        return self._gather(idx)
 
     def size(self) -> int:
         return self._size
@@ -135,15 +148,11 @@ class DQNRunner:
         return True
 
 
-class DQNConfig:
+class DQNConfig(AlgorithmConfig):
     """Builder config (reference: dqn/dqn.py DQNConfig)."""
 
     def __init__(self):
-        self.env_spec: Union[str, Callable, None] = None
-        self.env_config: Dict[str, Any] = {}
-        self.num_env_runners = 2
-        self.num_envs_per_runner = 2
-        self.rollout_len = 32
+        super().__init__()
         self.gamma = 0.99
         self.lr = 1e-3
         self.buffer_capacity = 100_000
@@ -155,40 +164,6 @@ class DQNConfig:
         self.epsilon_final = 0.05
         self.epsilon_decay_iters = 50
         self.double_q = True
-        self.seed = 0
-        self.num_cpus_per_runner = 1.0
-
-    def environment(self, env, env_config: Optional[dict] = None):
-        self.env_spec = env
-        self.env_config = dict(env_config or {})
-        return self
-
-    def env_runners(self, num_env_runners=None, num_envs_per_env_runner=None,
-                    rollout_fragment_length=None):
-        if num_env_runners is not None:
-            self.num_env_runners = num_env_runners
-        if num_envs_per_env_runner is not None:
-            self.num_envs_per_runner = num_envs_per_env_runner
-        if rollout_fragment_length is not None:
-            self.rollout_len = rollout_fragment_length
-        return self
-
-    def training(self, **kwargs):
-        for k, v in kwargs.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown training option {k!r}")
-            setattr(self, k, v)
-        return self
-
-    def debugging(self, seed: Optional[int] = None):
-        if seed is not None:
-            self.seed = seed
-        return self
-
-    def build(self) -> "DQN":
-        return DQN(copy.deepcopy(self))
-
-    build_algo = build
 
 
 class DQN:
@@ -380,3 +355,6 @@ class DQN:
         except Exception:
             pass
         self.runners = []
+
+
+DQNConfig.algo_class = DQN

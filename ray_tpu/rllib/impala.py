@@ -1,0 +1,346 @@
+"""IMPALA: asynchronous actor-learner with V-trace off-policy correction.
+
+Role-equivalent of the reference's IMPALA family (rllib/algorithms/impala/
+— IMPALAConfig, the async EnvRunner sampling + learner-group pipeline, and
+vtrace_torch.py). TPU-first: rollouts arrive asynchronously from stale-
+policy runners (api.wait on in-flight sample refs — the decoupling the
+reference gets from its aggregation/broadcast actors), and the V-trace
+target computation + policy/value update run as ONE jitted program: the
+time-axis recursion is a ``lax.scan``, so the whole importance-corrected
+update lowers to a single XLA program on the MXU instead of a Python loop.
+APPO (the PPO-clipped variant) rides the same machinery via ``use_clip``.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .. import api
+from .config_base import AlgorithmConfig
+from .env import VectorEnv, encode_obs, make_env, space_dims
+from .models import ActorCritic, log_prob_entropy
+
+
+class ImpalaRunner:
+    """Rollout actor returning [T, N] trajectories + behavior log-probs and
+    the bootstrap observation (reference: SingleAgentEnvRunner used by
+    IMPALA; values are NOT recorded — the learner recomputes them with its
+    own fresh parameters, as V-trace requires)."""
+
+    def __init__(self, env_spec, env_config, num_envs, rollout_len, seed):
+        from .models import init_actor_critic, sample_actions
+
+        factory = make_env(env_spec, env_config)
+        self._vec = VectorEnv([factory for _ in range(num_envs)])
+        self._rollout_len = rollout_len
+        obs_dim, act_dim, discrete = space_dims(
+            self._vec.observation_space, self._vec.action_space
+        )
+        self._model, _ = init_actor_critic(obs_dim, act_dim, discrete, seed)
+        self._key = jax.random.PRNGKey(seed)
+        self._encode = lambda o: encode_obs(self._vec.observation_space, o)
+        self._obs = self._encode(self._vec.reset(seed=seed))
+        self._ep_ret = np.zeros(num_envs, np.float32)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self._sample_fn = jax.jit(
+            lambda params, obs, key: sample_actions(
+                self._model, params, obs, key
+            )
+        )
+
+    def sample(self, params) -> Dict[str, Any]:
+        T, N = self._rollout_len, self._vec.num_envs
+        obs_buf = np.zeros((T, N) + self._obs.shape[1:], np.float32)
+        act_buf = None
+        logp_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        ep_returns, ep_lengths = [], []
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            actions, logp, _values = self._sample_fn(
+                params, self._obs.astype(np.float32), sub
+            )
+            actions = np.asarray(actions)
+            obs_buf[t] = self._obs
+            if act_buf is None:
+                act_buf = np.zeros((T, N) + actions.shape[1:], actions.dtype)
+            act_buf[t] = actions
+            logp_buf[t] = np.asarray(logp)
+            next_obs, rewards, terms, truncs = self._vec.step(actions)
+            dones = terms | truncs
+            rew_buf[t] = rewards
+            done_buf[t] = dones.astype(np.float32)
+            self._ep_ret += rewards
+            self._ep_len += 1
+            for i in np.nonzero(dones)[0]:
+                ep_returns.append(float(self._ep_ret[i]))
+                ep_lengths.append(int(self._ep_len[i]))
+                self._ep_ret[i] = 0.0
+                self._ep_len[i] = 0
+            self._obs = self._encode(next_obs)
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "behavior_logp": logp_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "bootstrap_obs": self._obs.astype(np.float32),
+            "episode_returns": ep_returns,
+            "episode_lengths": ep_lengths,
+        }
+
+    def ping(self):
+        return True
+
+
+class IMPALAConfig(AlgorithmConfig):
+    """Builder config (reference: impala/impala.py IMPALAConfig)."""
+
+    def __init__(self):
+        super().__init__()
+        self.num_envs_per_runner = 4
+        self.gamma = 0.99
+        self.lr = 5e-4
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.max_grad_norm = 40.0
+        # V-trace clippings (IMPALA paper: rho_bar, c_bar)
+        self.vtrace_rho_clip = 1.0
+        self.vtrace_c_clip = 1.0
+        # APPO variant: additionally clip the pg ratio PPO-style
+        self.use_clip = False
+        self.clip_param = 0.3
+        self.num_batches_per_iter = 4
+
+
+class APPOConfig(IMPALAConfig):
+    """APPO = IMPALA machinery + PPO surrogate clipping (reference:
+    rllib/algorithms/appo/)."""
+
+    def __init__(self):
+        super().__init__()
+        self.use_clip = True
+
+
+class IMPALA:
+    """Async actor-learner: runners keep one sample() in flight each with
+    whatever params they last received; the learner consumes rollouts as
+    they land and corrects the off-policy gap with V-trace."""
+
+    def __init__(self, config: IMPALAConfig):
+        if config.env_spec is None:
+            raise ValueError("config.environment(...) is required")
+        self.config = config
+        self.iteration = 0
+        probe = make_env(config.env_spec, config.env_config)()
+        obs_dim, act_dim, discrete = space_dims(
+            probe.observation_space, probe.action_space
+        )
+        try:
+            probe.close()
+        except Exception:
+            pass
+        self._discrete = discrete
+        self.model = ActorCritic(action_dim=act_dim, discrete=discrete)
+        key = jax.random.PRNGKey(config.seed)
+        self.params = self.model.init(
+            key, jnp.zeros((1, obs_dim), jnp.float32)
+        )["params"]
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(config.max_grad_norm),
+            optax.adam(config.lr),
+        )
+        self.opt_state = self.tx.init(self.params)
+        self._update = jax.jit(self._update_impl)
+
+        Runner = api.remote(num_cpus=config.num_cpus_per_runner)(ImpalaRunner)
+        self.runners = [
+            Runner.remote(
+                config.env_spec, config.env_config,
+                config.num_envs_per_runner, config.rollout_len,
+                config.seed + 1000 * (i + 1),
+            )
+            for i in range(config.num_env_runners)
+        ]
+        api.get([r.ping.remote() for r in self.runners])
+        # async pipeline: one in-flight sample per runner
+        self._inflight: Dict[Any, Any] = {
+            r.sample.remote(self.params): r for r in self.runners
+        }
+        self._ep_return_window: List[float] = []
+
+    # -- jitted V-trace update ----------------------------------------------
+
+    def _update_impl(self, params, opt_state, batch):
+        cfg = self.config
+
+        def loss_fn(p):
+            T, N = batch["rewards"].shape
+            flat_obs = batch["obs"].reshape(T * N, -1)
+            out, values_flat = self.model.apply({"params": p}, flat_obs)
+            flat_actions = batch["actions"].reshape(
+                (T * N,) + batch["actions"].shape[2:]
+            )
+            logp_flat, entropy_flat = log_prob_entropy(
+                self._discrete, out, flat_actions
+            )
+            values = values_flat.reshape(T, N)
+            target_logp = logp_flat.reshape(T, N)
+            _, bootstrap_v = self.model.apply(
+                {"params": p}, batch["bootstrap_obs"]
+            )
+
+            # V-trace (IMPALA paper eq. 1): backward lax.scan over time
+            rhos = jnp.exp(target_logp - batch["behavior_logp"])
+            clipped_rho = jnp.minimum(rhos, cfg.vtrace_rho_clip)
+            clipped_c = jnp.minimum(rhos, cfg.vtrace_c_clip)
+            discounts = cfg.gamma * (1.0 - batch["dones"])
+            values_sg = jax.lax.stop_gradient(values)
+            bootstrap_sg = jax.lax.stop_gradient(bootstrap_v)
+
+            next_values = jnp.concatenate(
+                [values_sg[1:], bootstrap_sg[None]], axis=0
+            )
+            deltas = clipped_rho * (
+                batch["rewards"] + discounts * next_values - values_sg
+            )
+
+            def vtrace_step(acc, xs):
+                delta_t, discount_t, c_t = xs
+                acc = delta_t + discount_t * c_t * acc
+                return acc, acc
+
+            _, vs_minus_v = jax.lax.scan(
+                vtrace_step,
+                jnp.zeros_like(values_sg[0]),
+                (deltas, discounts, clipped_c),
+                reverse=True,
+            )
+            vs = vs_minus_v + values_sg
+            next_vs = jnp.concatenate([vs[1:], bootstrap_sg[None]], axis=0)
+            pg_adv = clipped_rho * (
+                batch["rewards"] + discounts * next_vs - values_sg
+            )
+            pg_adv = jax.lax.stop_gradient(pg_adv)
+
+            if cfg.use_clip:
+                # APPO: PPO surrogate on the V-trace advantage
+                ratio = jnp.exp(target_logp - batch["behavior_logp"])
+                pg1 = ratio * pg_adv
+                pg2 = jnp.clip(
+                    ratio, 1 - cfg.clip_param, 1 + cfg.clip_param
+                ) * pg_adv
+                pg_loss = -jnp.mean(jnp.minimum(pg1, pg2))
+            else:
+                pg_loss = -jnp.mean(target_logp * pg_adv)
+            vf_loss = jnp.mean((values - jax.lax.stop_gradient(vs)) ** 2)
+            ent = jnp.mean(entropy_flat)
+            total = pg_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * ent
+            return total, {
+                "policy_loss": pg_loss,
+                "vf_loss": vf_loss,
+                "entropy": ent,
+                "total_loss": total,
+                "mean_rho": jnp.mean(rhos),
+            }
+
+        (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, stats
+
+    # -- async training loop -------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        cfg = self.config
+        stats_acc: List[Dict[str, float]] = []
+        ep_returns: List[float] = []
+        steps = 0
+        for _ in range(cfg.num_batches_per_iter):
+            ready, _ = api.wait(
+                list(self._inflight), num_returns=1, timeout=120
+            )
+            if not ready:
+                break
+            ref = ready[0]
+            runner = self._inflight.pop(ref)
+            rollout = api.get(ref)
+            batch = {
+                "obs": jnp.asarray(rollout["obs"]),
+                "actions": jnp.asarray(rollout["actions"]),
+                "behavior_logp": jnp.asarray(rollout["behavior_logp"]),
+                "rewards": jnp.asarray(rollout["rewards"]),
+                "dones": jnp.asarray(rollout["dones"]),
+                "bootstrap_obs": jnp.asarray(rollout["bootstrap_obs"]),
+            }
+            self.params, self.opt_state, stats = self._update(
+                self.params, self.opt_state, batch
+            )
+            stats_acc.append({k: float(v) for k, v in stats.items()})
+            ep_returns.extend(rollout["episode_returns"])
+            steps += rollout["rewards"].size
+            # resubmit with fresh params — the runner's next rollout is at
+            # most one update stale (reference: broadcast interval)
+            self._inflight[runner.sample.remote(self.params)] = runner
+
+        self.iteration += 1
+        self._ep_return_window.extend(ep_returns)
+        self._ep_return_window = self._ep_return_window[-100:]
+        mean_stats = {
+            k: float(np.mean([s[k] for s in stats_acc]))
+            for k in (stats_acc[0] if stats_acc else {})
+        }
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (
+                float(np.mean(self._ep_return_window))
+                if self._ep_return_window else float("nan")
+            ),
+            "num_episodes": len(ep_returns),
+            "num_env_steps_sampled": steps,
+            "time_this_iter_s": time.time() - t0,
+            **mean_stats,
+        }
+
+    # -- checkpointing -------------------------------------------------------
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        with open(os.path.join(checkpoint_dir, "impala_state.pkl"), "wb") as f:
+            pickle.dump(
+                {
+                    "params": jax.tree.map(np.asarray, self.params),
+                    "iteration": self.iteration,
+                },
+                f,
+            )
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str):
+        with open(os.path.join(checkpoint_dir, "impala_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.opt_state = self.tx.init(self.params)
+        self.iteration = state["iteration"]
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                api.kill(r)
+            except Exception:
+                pass
+        self.runners = []
+        self._inflight = {}
+
+
+IMPALAConfig.algo_class = IMPALA

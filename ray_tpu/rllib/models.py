@@ -54,6 +54,61 @@ class QNetwork(nn.Module):
         return nn.Dense(self.action_dim)(x)
 
 
+class TwinQ(nn.Module):
+    """Two independent Q(s, a) heads for clipped double-Q (reference: SAC's
+    twin critics, rllib/algorithms/sac/sac_rl_module)."""
+
+    hidden: Tuple[int, ...] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs, actions):
+        x0 = jnp.concatenate([obs, actions], axis=-1)
+
+        def q_head(x, name):
+            for i, h in enumerate(self.hidden):
+                x = nn.relu(nn.Dense(h, name=f"{name}_d{i}")(x))
+            return jnp.squeeze(nn.Dense(1, name=f"{name}_out")(x), -1)
+
+        return q_head(x0, "q1"), q_head(x0, "q2")
+
+
+class SquashedGaussianActor(nn.Module):
+    """tanh-squashed gaussian policy (reference: SAC action dist); outputs
+    (mean, log_std) of the pre-squash gaussian."""
+
+    action_dim: int
+    hidden: Tuple[int, ...] = (256, 256)
+    log_std_min: float = -20.0
+    log_std_max: float = 2.0
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        mean = nn.Dense(self.action_dim)(x)
+        log_std = nn.Dense(self.action_dim)(x)
+        log_std = jnp.clip(log_std, self.log_std_min, self.log_std_max)
+        return mean, log_std
+
+
+def squashed_sample_logp(mean, log_std, key):
+    """Sample a = tanh(u), u ~ N(mean, std), with the tanh-corrected
+    log-prob (SAC eq. 21)."""
+    std = jnp.exp(log_std)
+    u = mean + std * jax.random.normal(key, mean.shape)
+    a = jnp.tanh(u)
+    logp = jnp.sum(
+        -0.5 * ((u - mean) / std) ** 2 - log_std - 0.5 * jnp.log(2 * jnp.pi),
+        axis=-1,
+    )
+    # change of variables: log det of d tanh(u)/du, numerically stable form
+    logp -= jnp.sum(
+        2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u)), axis=-1
+    )
+    return a, logp
+
+
 def init_actor_critic(obs_dim: int, action_dim: int, discrete: bool, seed: int = 0):
     model = ActorCritic(action_dim=action_dim, discrete=discrete)
     params = model.init(

@@ -1,0 +1,410 @@
+"""SAC: soft actor-critic for continuous control.
+
+Role-equivalent of the reference's SAC family (rllib/algorithms/sac/ —
+SACConfig, twin Q networks, squashed gaussian policy, auto-tuned entropy
+temperature). TPU-first: actor, both critics, the temperature, and the
+polyak target update all advance inside ONE jitted function per train
+batch; the ``num_updates_per_iter`` gradient steps run under a single
+``lax.scan`` so the whole off-policy update is one XLA program on the MXU.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .. import api
+from .config_base import AlgorithmConfig
+from .dqn import ReplayBuffer
+from .env import VectorEnv, encode_obs, make_env, space_dims
+from .models import SquashedGaussianActor, TwinQ, squashed_sample_logp
+
+
+class SACRunner:
+    """Rollout actor sampling from the squashed gaussian policy, rescaling
+    tanh actions into the env's Box bounds."""
+
+    def __init__(self, env_spec, env_config, num_envs, rollout_len, seed):
+        factory = make_env(env_spec, env_config)
+        self._vec = VectorEnv([factory for _ in range(num_envs)])
+        obs_dim, act_dim, discrete = space_dims(
+            self._vec.observation_space, self._vec.action_space
+        )
+        if discrete:
+            raise ValueError("SAC requires a continuous (Box) action space")
+        self._rollout_len = rollout_len
+        self._actor = SquashedGaussianActor(action_dim=act_dim)
+        self._key = jax.random.PRNGKey(seed)
+        self._encode = lambda o: encode_obs(self._vec.observation_space, o)
+        self._obs = self._encode(self._vec.reset(seed=seed))
+        space = self._vec.action_space
+        self._act_low = np.asarray(space.low, np.float32)
+        self._act_high = np.asarray(space.high, np.float32)
+        self._ep_ret = np.zeros(num_envs, np.float32)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self._np_rng = np.random.default_rng(seed)  # warmup exploration
+
+        def _sample(params, obs, key):
+            mean, log_std = self._actor.apply({"params": params}, obs)
+            a, _ = squashed_sample_logp(mean, log_std, key)
+            return a
+
+        self._sample_fn = jax.jit(_sample)
+
+    def sample(self, params, random_actions: bool = False) -> Dict[str, Any]:
+        out: Dict[str, List] = {
+            "obs": [], "actions": [], "rewards": [], "next_obs": [],
+            "dones": [],
+        }
+        ep_returns, ep_lengths = [], []
+        for _ in range(self._rollout_len):
+            if random_actions:  # warmup exploration before learning starts
+                a = self._np_rng.uniform(
+                    -1.0, 1.0, (len(self._obs), len(self._act_low))
+                ).astype(np.float32)
+            else:
+                self._key, sub = jax.random.split(self._key)
+                a = np.asarray(
+                    self._sample_fn(
+                        params, self._obs.astype(np.float32), sub
+                    )
+                )
+            env_a = self._act_low + (a + 1.0) * 0.5 * (
+                self._act_high - self._act_low
+            )
+            next_obs, rewards, terms, truncs = self._vec.step(env_a)
+            next_enc = self._encode(next_obs)
+            dones = (terms | truncs).astype(np.float32)
+            out["obs"].append(self._obs)
+            out["actions"].append(a)  # store the tanh-space action
+            out["rewards"].append(rewards)
+            out["next_obs"].append(next_enc)
+            out["dones"].append(dones)
+            self._ep_ret += rewards
+            self._ep_len += 1
+            for i in np.nonzero(dones)[0]:
+                ep_returns.append(float(self._ep_ret[i]))
+                ep_lengths.append(int(self._ep_len[i]))
+                self._ep_ret[i] = 0.0
+                self._ep_len[i] = 0
+            self._obs = next_enc
+        return {
+            "obs": np.concatenate(out["obs"]).astype(np.float32),
+            "actions": np.concatenate(out["actions"]).astype(np.float32),
+            "rewards": np.concatenate(out["rewards"]),
+            "next_obs": np.concatenate(out["next_obs"]).astype(np.float32),
+            "dones": np.concatenate(out["dones"]),
+            "episode_returns": ep_returns,
+            "episode_lengths": ep_lengths,
+        }
+
+    def ping(self):
+        return True
+
+
+class SACConfig(AlgorithmConfig):
+    """Builder config (reference: sac/sac.py SACConfig)."""
+
+    def __init__(self):
+        super().__init__()
+        self.num_env_runners = 1
+        self.num_envs_per_runner = 1
+        self.rollout_len = 64
+        self.gamma = 0.99
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.tau = 0.005  # polyak coefficient for target critics
+        self.initial_alpha = 1.0
+        self.target_entropy: Optional[float] = None  # default: -act_dim
+        self.buffer_capacity = 100_000
+        self.learning_starts = 1000
+        self.train_batch_size = 256
+        self.num_updates_per_iter = 16
+
+
+class SAC:
+    def __init__(self, config: SACConfig):
+        if config.env_spec is None:
+            raise ValueError("config.environment(...) is required")
+        self.config = config
+        self.iteration = 0
+        probe = make_env(config.env_spec, config.env_config)()
+        obs_dim, act_dim, discrete = space_dims(
+            probe.observation_space, probe.action_space
+        )
+        if discrete:
+            raise ValueError("SAC requires a continuous (Box) action space")
+        probe_act_low = np.asarray(probe.action_space.low, np.float32)
+        probe_act_high = np.asarray(probe.action_space.high, np.float32)
+        try:
+            probe.close()
+        except Exception:
+            pass
+        self._obs_dim, self._act_dim = obs_dim, act_dim
+        self.target_entropy = (
+            config.target_entropy
+            if config.target_entropy is not None
+            else -float(act_dim)
+        )
+
+        key = jax.random.PRNGKey(config.seed)
+        k_actor, k_critic = jax.random.split(key)
+        self.actor = SquashedGaussianActor(action_dim=act_dim)
+        self.critic = TwinQ()
+        zo = jnp.zeros((1, obs_dim), jnp.float32)
+        za = jnp.zeros((1, act_dim), jnp.float32)
+        self.state = {
+            "actor": self.actor.init(k_actor, zo)["params"],
+            "critic": self.critic.init(k_critic, zo, za)["params"],
+            "log_alpha": jnp.log(jnp.asarray(config.initial_alpha)),
+        }
+        self.state["target_critic"] = jax.tree.map(
+            jnp.copy, self.state["critic"]
+        )
+        self.actor_tx = optax.adam(config.actor_lr)
+        self.critic_tx = optax.adam(config.critic_lr)
+        self.alpha_tx = optax.adam(config.alpha_lr)
+        self.opt = {
+            "actor": self.actor_tx.init(self.state["actor"]),
+            "critic": self.critic_tx.init(self.state["critic"]),
+            "alpha": self.alpha_tx.init(self.state["log_alpha"]),
+        }
+        self._update_scan = jax.jit(self._update_scan_impl)
+
+        self._act_low = np.asarray(probe_act_low, np.float32)
+        self._act_high = np.asarray(probe_act_high, np.float32)
+        Buffer = api.remote(num_cpus=0)(ReplayBuffer)
+        self.buffer = Buffer.remote(
+            config.buffer_capacity, obs_dim, (act_dim,), np.float32
+        )
+        Runner = api.remote(num_cpus=config.num_cpus_per_runner)(SACRunner)
+        self.runners = [
+            Runner.remote(
+                config.env_spec, config.env_config,
+                config.num_envs_per_runner, config.rollout_len,
+                config.seed + 1000 * (i + 1),
+            )
+            for i in range(config.num_env_runners)
+        ]
+        api.get([r.ping.remote() for r in self.runners])
+        self._ep_return_window: List[float] = []
+
+    # -- jitted update (all SAC losses + polyak, scanned over minibatches) ---
+
+    def _one_update(self, carry, batch):
+        state, opt, key = carry
+        cfg = self.config
+        key, k_next, k_cur = jax.random.split(key, 3)
+
+        # critic loss: soft Bellman target from target critics
+        mean_n, log_std_n = self.actor.apply(
+            {"params": state["actor"]}, batch["next_obs"]
+        )
+        next_a, next_logp = squashed_sample_logp(mean_n, log_std_n, k_next)
+        tq1, tq2 = self.critic.apply(
+            {"params": state["target_critic"]}, batch["next_obs"], next_a
+        )
+        alpha = jnp.exp(state["log_alpha"])
+        target_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+        target_q = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) * (
+            jax.lax.stop_gradient(target_v)
+        )
+
+        def critic_loss_fn(cp):
+            q1, q2 = self.critic.apply(
+                {"params": cp}, batch["obs"], batch["actions"]
+            )
+            return jnp.mean((q1 - target_q) ** 2) + jnp.mean(
+                (q2 - target_q) ** 2
+            )
+
+        c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(state["critic"])
+        c_updates, opt_critic = self.critic_tx.update(
+            c_grads, opt["critic"], state["critic"]
+        )
+        critic_params = optax.apply_updates(state["critic"], c_updates)
+
+        # actor loss: maximize E[min Q - alpha * logp]
+        def actor_loss_fn(ap):
+            mean, log_std = self.actor.apply({"params": ap}, batch["obs"])
+            a, logp = squashed_sample_logp(mean, log_std, k_cur)
+            q1, q2 = self.critic.apply(
+                {"params": critic_params}, batch["obs"], a
+            )
+            q = jnp.minimum(q1, q2)
+            return jnp.mean(alpha * logp - q), logp
+
+        (a_loss, logp), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(state["actor"])
+        a_updates, opt_actor = self.actor_tx.update(
+            a_grads, opt["actor"], state["actor"]
+        )
+        actor_params = optax.apply_updates(state["actor"], a_updates)
+
+        # temperature: drive policy entropy toward target_entropy
+        def alpha_loss_fn(log_alpha):
+            return -jnp.mean(
+                jnp.exp(log_alpha)
+                * jax.lax.stop_gradient(logp + self.target_entropy)
+            )
+
+        al_loss, al_grad = jax.value_and_grad(alpha_loss_fn)(
+            state["log_alpha"]
+        )
+        al_updates, opt_alpha = self.alpha_tx.update(
+            al_grad, opt["alpha"], state["log_alpha"]
+        )
+        log_alpha = optax.apply_updates(state["log_alpha"], al_updates)
+
+        # polyak target update
+        tau = cfg.tau
+        target_critic = jax.tree.map(
+            lambda t, o: (1.0 - tau) * t + tau * o,
+            state["target_critic"],
+            critic_params,
+        )
+        new_state = {
+            "actor": actor_params,
+            "critic": critic_params,
+            "target_critic": target_critic,
+            "log_alpha": log_alpha,
+        }
+        new_opt = {
+            "actor": opt_actor,
+            "critic": opt_critic,
+            "alpha": opt_alpha,
+        }
+        stats = {
+            "critic_loss": c_loss,
+            "actor_loss": a_loss,
+            "alpha_loss": al_loss,
+            "alpha": jnp.exp(log_alpha),
+            "entropy": -jnp.mean(logp),
+        }
+        return (new_state, new_opt, key), stats
+
+    def _update_scan_impl(self, state, opt, key, batches):
+        (state, opt, _), stats = jax.lax.scan(
+            self._one_update, (state, opt, key), batches
+        )
+        return state, opt, jax.tree.map(jnp.mean, stats)
+
+    # -- training loop -------------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        cfg = self.config
+        warmup = api.get(self.buffer.size.remote()) < cfg.learning_starts
+        rollouts = api.get(
+            [
+                r.sample.remote(self.state["actor"], warmup)
+                for r in self.runners
+            ]
+        )
+        adds, ep_returns = [], []
+        for ro in rollouts:
+            adds.append(
+                self.buffer.add.remote(
+                    ro["obs"], ro["actions"], ro["rewards"],
+                    ro["next_obs"], ro["dones"],
+                )
+            )
+            ep_returns.extend(ro["episode_returns"])
+        buffer_size = api.get(adds)[-1]
+
+        stats: Dict[str, float] = {}
+        if buffer_size >= cfg.learning_starts:
+            batches = api.get(
+                self.buffer.sample_many.remote(
+                    cfg.train_batch_size,
+                    cfg.num_updates_per_iter,
+                    seed=cfg.seed + self.iteration * 997,
+                )
+            )
+            jb = {k: jnp.asarray(v) for k, v in batches.items()}
+            self.state, self.opt, jstats = self._update_scan(
+                self.state, self.opt,
+                jax.random.PRNGKey(cfg.seed + self.iteration),
+                jb,
+            )
+            stats = {k: float(v) for k, v in jstats.items()}
+
+        self.iteration += 1
+        self._ep_return_window.extend(ep_returns)
+        self._ep_return_window = self._ep_return_window[-100:]
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (
+                float(np.mean(self._ep_return_window))
+                if self._ep_return_window else float("nan")
+            ),
+            "num_episodes": len(ep_returns),
+            "buffer_size": buffer_size,
+            "num_env_steps_sampled": sum(
+                len(ro["rewards"]) for ro in rollouts
+            ),
+            "time_this_iter_s": time.time() - t0,
+            **stats,
+        }
+
+    # -- checkpointing -------------------------------------------------------
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        with open(os.path.join(checkpoint_dir, "sac_state.pkl"), "wb") as f:
+            pickle.dump(
+                {
+                    "state": jax.tree.map(np.asarray, self.state),
+                    "iteration": self.iteration,
+                },
+                f,
+            )
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str):
+        with open(os.path.join(checkpoint_dir, "sac_state.pkl"), "rb") as f:
+            saved = pickle.load(f)
+        self.state = jax.tree.map(jnp.asarray, saved["state"])
+        self.opt = {
+            "actor": self.actor_tx.init(self.state["actor"]),
+            "critic": self.critic_tx.init(self.state["critic"]),
+            "alpha": self.alpha_tx.init(self.state["log_alpha"]),
+        }
+        self.iteration = saved["iteration"]
+
+    def compute_single_action(self, obs):
+        """Deterministic (mean) action, rescaled into the env's Box bounds —
+        the same mapping the rollout runners apply before env.step."""
+        mean, _ = self.actor.apply(
+            {"params": self.state["actor"]},
+            jnp.asarray(np.asarray(obs, np.float32).reshape(1, -1)),
+        )
+        a = np.asarray(jnp.tanh(mean))[0]
+        return self._act_low + (a + 1.0) * 0.5 * (
+            self._act_high - self._act_low
+        )
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                api.kill(r)
+            except Exception:
+                pass
+        try:
+            api.kill(self.buffer)
+        except Exception:
+            pass
+        self.runners = []
+
+
+SACConfig.algo_class = SAC

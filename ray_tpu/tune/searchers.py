@@ -31,6 +31,11 @@ class Searcher:
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         raise NotImplementedError
 
+    def on_trial_restore(self, trial_id: str, config: Dict[str, Any]) -> None:
+        """A trial was relaunched under a new id with an existing config
+        (retry after crash) — re-associate so its final result still feeds
+        the model."""
+
     def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
         pass
 
@@ -104,6 +109,9 @@ class TPESearcher(Searcher):
             config = self._tpe_sample()
         self._live[trial_id] = config
         return config
+
+    def on_trial_restore(self, trial_id, config):
+        self._live[trial_id] = dict(config)
 
     def on_trial_complete(self, trial_id, result=None):
         config = self._live.pop(trial_id, None)

@@ -316,8 +316,13 @@ class Tuner:
         while pending or running:
             while pending and len(running) < max_concurrent:
                 trial = pending.pop(0)
-                if trial.config is None and searcher is not None:
-                    trial.config = searcher.suggest(trial.trial_id)
+                if searcher is not None:
+                    if trial.config is None:
+                        trial.config = searcher.suggest(trial.trial_id)
+                    elif trial.failures > 0:
+                        # retry under a fresh id: re-register the config so
+                        # the final result still reaches the searcher
+                        searcher.on_trial_restore(trial.trial_id, trial.config)
                 if hasattr(scheduler, "on_trial_add"):
                     scheduler.on_trial_add(trial.trial_id, trial.config)
                 trial.runner = Runner.remote()
