@@ -47,6 +47,14 @@ class Config:
     max_workers_per_node: int = 64
     idle_worker_kill_s: float = 300.0
 
+    # --- OOM defense (reference: memory_monitor_refresh_ms,
+    # memory_usage_threshold in ray_config_def.h) ---
+    # 0 disables the monitor.
+    memory_monitor_refresh_s: float = 1.0
+    memory_usage_threshold: float = 0.95
+    # kill policy: "group_by_owner" | "retriable_lifo"
+    worker_killing_policy: str = "group_by_owner"
+
     # --- fault tolerance ---
     health_check_period_s: float = 1.0
     health_check_timeout_s: float = 10.0

@@ -1,0 +1,15 @@
+"""ray_tpu.experimental: device objects (RDT) and other previews."""
+
+from .device_objects import (
+    DeviceObjectRef,
+    device_get,
+    device_put_object,
+    free_device_object,
+)
+
+__all__ = [
+    "DeviceObjectRef",
+    "device_put_object",
+    "device_get",
+    "free_device_object",
+]

@@ -41,6 +41,12 @@ from ..._internal.rpc import ClientPool, RpcServer
 from ...exceptions import ObjectStoreFullError
 from ..gcs.pubsub import SubscriberClient
 from ..object_store.native_store import create_object_store
+from .memory_monitor import (
+    GroupByOwnerWorkerKillingPolicy,
+    KillCandidate,
+    MemoryMonitor,
+    RetriableLIFOWorkerKillingPolicy,
+)
 from .resources import Allocation, LocalResourceManager
 from .worker_pool import WorkerHandle, WorkerPool
 
@@ -48,13 +54,14 @@ logger = logging.getLogger(__name__)
 
 
 class Lease:
-    __slots__ = ("lease_id", "worker", "allocation", "spec")
+    __slots__ = ("lease_id", "worker", "allocation", "spec", "granted_at")
 
     def __init__(self, lease_id, worker: WorkerHandle, allocation: Allocation, spec):
         self.lease_id = lease_id
         self.worker = worker
         self.allocation = allocation
         self.spec = spec
+        self.granted_at = time.time()
 
 
 class Raylet:
@@ -103,6 +110,14 @@ class Raylet:
         self._runner: Optional[PeriodicRunner] = None
         self._last_reported: Optional[Dict[str, float]] = None
         self._stopped = False
+        # OOM defense (reference: MemoryMonitor + WorkerKillingPolicy)
+        self.memory_monitor = MemoryMonitor(config.memory_usage_threshold)
+        self._kill_policy = (
+            RetriableLIFOWorkerKillingPolicy()
+            if config.worker_killing_policy == "retriable_lifo"
+            else GroupByOwnerWorkerKillingPolicy()
+        )
+        self._oom_kills = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -142,6 +157,10 @@ class Raylet:
             max(self.config.health_check_period_s / 2, 0.1), self._report_resources
         )
         self._runner.run_every(5.0, self._reap_idle_workers)
+        if self.config.memory_monitor_refresh_s > 0:
+            self._runner.run_every(
+                self.config.memory_monitor_refresh_s, self._check_memory
+            )
         self._dispatch_task = asyncio.ensure_future(self._dispatch_loop())
         if self.config.prestart_workers:
             self.worker_pool.prestart(self.config.prestart_workers)
@@ -214,6 +233,68 @@ class Raylet:
         )
 
     # -- cluster view ------------------------------------------------------
+
+    async def _check_memory(self):
+        """OOM defense tick (reference: NodeManager memory-monitor callback
+        + WorkerKillingPolicy): above the usage threshold, kill the leased
+        worker the policy picks; the owner sees a worker crash and retries
+        if the task is retriable."""
+        if not self._leases or not self.memory_monitor.is_over_threshold():
+            return
+        candidates = []
+        for lease in self._leases.values():
+            spec = lease.spec
+            retriable = (
+                spec.max_restarts != 0
+                if spec.actor_id is not None
+                else spec.max_retries > 0
+            )
+            candidates.append(
+                KillCandidate(
+                    lease_id=lease.lease_id,
+                    worker_id=lease.worker.worker_id,
+                    pid=lease.worker.pid,
+                    owner_id=spec.owner_worker_id,
+                    retriable=retriable,
+                    started_at=lease.granted_at,
+                )
+            )
+        victim = self._kill_policy.select(candidates)
+        if victim is None:
+            return
+        used, total = self.memory_monitor.usage()
+        self._oom_kills += 1
+        logger.warning(
+            "memory pressure (%.0f/%.0f MB): killing worker %s (pid %s, "
+            "retriable=%s) to reclaim memory",
+            used / 1e6, total / 1e6, victim.worker_id, victim.pid,
+            victim.retriable,
+        )
+        handle = self.worker_pool.on_worker_dead(victim.worker_id)
+        try:
+            os.kill(victim.pid, 9)
+        except ProcessLookupError:
+            pass
+        # free the lease now — the kill is deliberate, no need to wait for
+        # the connection-loss callback (which becomes a no-op: the handle is
+        # already deregistered)
+        for lease_id, lease in list(self._leases.items()):
+            if lease.worker.worker_id == victim.worker_id:
+                self.resources.release(lease.allocation)
+                del self._leases[lease_id]
+        self._dispatch_wakeup.set()
+        if handle is not None:
+            try:
+                gcs = self.client_pool.get(*self.gcs_address)
+                await gcs.call(
+                    "report_worker_death",
+                    victim.worker_id,
+                    f"killed by memory monitor: node memory {used}/{total} "
+                    f"exceeded threshold "
+                    f"{self.memory_monitor.usage_threshold:.2f}",
+                )
+            except Exception:
+                pass
 
     def _on_node_event(self, channel, message):
         kind, info = message

@@ -195,6 +195,11 @@ class CoreWorker:
         s.register("actor_task", self._handle_actor_task)
         s.register("exit_worker", self._handle_exit_worker)
         s.register("ping", self._handle_ping)
+        # device objects (reference: RDT / GPU object manager, P13)
+        from ...experimental import device_objects
+
+        s.register("fetch_device_object", device_objects.handle_fetch)
+        s.register("free_device_object", device_objects.handle_free)
 
     async def connect_to_raylet(self):
         raylet = self.client_pool.get(*self.raylet_address)
@@ -1065,6 +1070,23 @@ class CoreWorker:
             args, kwargs = await self._unflatten(spec)
         except Exception as e:  # noqa: BLE001
             return self._error_reply(spec, e)
+        # tensor_transport="device": DeviceObjectRef args resolve to their
+        # on-device pytrees; results with arrays park in the device store
+        # (reference: @ray.method(tensor_transport=...), P13). Resolution
+        # runs on the executor thread: remote fetches block on RPCs that
+        # this loop must keep servicing.
+        method_opts = getattr(method, "__ray_tpu_method_options__", {})
+        device_transport = method_opts.get("tensor_transport") == "device"
+        if device_transport:
+            from ...experimental import device_objects
+
+            try:
+                args, kwargs = await self.loop.run_in_executor(
+                    self._executor_pool,
+                    lambda: device_objects.resolve_args(args, kwargs),
+                )
+            except Exception as e:  # noqa: BLE001
+                return self._error_reply(spec, e)
         max_conc = self._actor_spec.max_concurrency if self._actor_spec else 1
         try:
             if asyncio.iscoroutinefunction(method):
@@ -1080,6 +1102,10 @@ class CoreWorker:
                     )
         except Exception as e:  # noqa: BLE001
             return self._error_reply(spec, e)
+        if device_transport:
+            from ...experimental import device_objects
+
+            result = device_objects.wrap_result(result)
         return await self._build_reply(spec, result)
 
     async def _handle_exit_worker(self):
