@@ -54,6 +54,9 @@ class Config:
     memory_usage_threshold: float = 0.95
     # kill policy: "group_by_owner" | "retriable_lifo"
     worker_killing_policy: str = "group_by_owner"
+    # minimum spacing between OOM kills: reclaim after a SIGKILL lags, and
+    # killing a worker per tick would drain the node before pressure clears
+    oom_kill_cooldown_s: float = 5.0
 
     # --- fault tolerance ---
     health_check_period_s: float = 1.0
@@ -64,6 +67,11 @@ class Config:
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 120.0
+    # ray:// client server on the head node: -1 disabled, 0 auto port,
+    # >0 fixed port (reference: --ray-client-server-port). Bind 0.0.0.0 to
+    # accept clients from other machines.
+    client_server_port: int = -1
+    client_server_host: str = "127.0.0.1"
 
     # --- misc ---
     session_dir: str = "/tmp/ray_tpu"

@@ -45,12 +45,32 @@ def init(
     With no ``address`` a local single-node cluster is started in-process:
     GCS + raylet on a background loop thread, workers as subprocesses
     (reference: ray.init starting head processes via Node, _private/node.py).
-    ``address`` may be "host:port" of an existing GCS to connect as a driver.
+    ``address`` may be "host:port" of an existing GCS to connect as a driver,
+    or "ray://host:port" of a client server to attach WITHOUT joining the
+    cluster network (reference: Ray Client, util/client/).
     """
     if _worker_api.is_initialized():
         if ignore_reinit_error:
             return _worker_api.get_node()
         raise RuntimeError("ray_tpu.init() called twice; shutdown() first")
+
+    if address is not None and address.startswith("ray://"):
+        from .client import connect as _client_connect
+
+        client_config = Config()
+        client_config.apply_overrides(_system_config)
+        client_worker = _client_connect(
+            address, client_config, namespace=namespace,
+            runtime_env=runtime_env,
+        )
+        _worker_api.set_core_worker(
+            client_worker,
+            client_worker.config,
+            loop_thread=client_worker.loop_thread,
+            node=None,
+        )
+        atexit.register(_atexit_shutdown)
+        return None
 
     config = Config()
     config.apply_overrides(_system_config)
@@ -116,18 +136,14 @@ def _detect_tpu_chips() -> int:
 
 def _find_raylet(loop_thread, gcs_address):
     async def _lookup():
+        from ._internal.node_lookup import find_raylet_address
         from ._internal.rpc import RpcClient
 
         client = RpcClient(*gcs_address, name="init-lookup")
-        nodes = await client.call("get_all_nodes")
-        await client.close()
-        for n in nodes:
-            if n.alive and n.address[0] in ("127.0.0.1", "localhost"):
-                return n.address
-        for n in nodes:
-            if n.alive:
-                return n.address
-        raise RuntimeError("no alive nodes in cluster")
+        try:
+            return await find_raylet_address(client)
+        finally:
+            await client.close()
 
     return loop_thread.run(_lookup(), timeout=30)
 

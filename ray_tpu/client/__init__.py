@@ -1,0 +1,14 @@
+"""ray_tpu.client: connect to a remote cluster without joining it.
+
+Role-equivalent of the reference's Ray Client (python/ray/util/client/ +
+src/ray/protobuf/ray_client.proto): a thin client process speaks to a
+client server running next to the head node; the server hosts a real
+driver CoreWorker that owns all objects/tasks submitted on the client's
+behalf, so the client machine needs no inbound connectivity from the
+cluster. ``ray_tpu.init("ray://host:port")`` selects this mode.
+"""
+
+from .server import ClientServer, start_client_server
+from .worker import ClientWorker, connect
+
+__all__ = ["ClientServer", "ClientWorker", "connect", "start_client_server"]

@@ -1,0 +1,102 @@
+"""Client server: the cluster-side half of ray:// connections.
+
+Role-equivalent of the reference's client server
+(python/ray/util/client/server/server.py, proxier.py): hosts one driver
+CoreWorker per server inside the cluster network and exposes three RPCs —
+``client_connect`` (handshake metadata), ``worker_op`` (invoke a CoreWorker
+method by name: submit_task/put/get_objects/...), and ``proxy_rpc`` (relay
+an arbitrary control-plane call, e.g. to the GCS, through the server's
+client pool). Ownership of every client-created object rests with the
+server's worker, exactly as the reference parks ownership in the proxied
+driver.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional, Tuple
+
+from .._internal.config import Config
+from .._internal.event_loop import LoopThread
+from .._internal.rpc import RpcClient, RpcServer
+from ..runtime.worker.core_worker import CoreWorker, WorkerMode
+
+logger = logging.getLogger(__name__)
+
+
+class ClientServer:
+    def __init__(self, gcs_address: Tuple[str, int], config: Optional[Config] = None):
+        self.gcs_address = gcs_address
+        self.config = config or Config()
+        self.server = RpcServer("client-server")
+        self.worker: Optional[CoreWorker] = None
+        self.address: Optional[Tuple[str, int]] = None
+
+    async def _find_raylet(self):
+        from .._internal.node_lookup import find_raylet_address
+
+        client = RpcClient(*self.gcs_address, name="client-server-lookup")
+        try:
+            return await find_raylet_address(client)
+        finally:
+            await client.close()
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        raylet_address = await self._find_raylet()
+        self.worker = CoreWorker(
+            WorkerMode.DRIVER, self.config, self.gcs_address, raylet_address,
+            asyncio.get_event_loop(),
+        )
+        await self.worker.start()
+        await self.worker.register_driver_job({"namespace": "_client_server"})
+        self.server.register("client_connect", self._handle_connect)
+        self.server.register("worker_op", self._handle_worker_op)
+        self.server.register("proxy_rpc", self._handle_proxy_rpc)
+        bound = await self.server.start(host, port)
+        self.address = (host, bound)
+        logger.info("client server on %s", self.address)
+        return self.address
+
+    async def stop(self):
+        await self.server.stop()
+        if self.worker is not None:
+            await self.worker.shutdown()
+
+    # -- handlers -----------------------------------------------------------
+
+    async def _handle_connect(self):
+        return {
+            "worker_address": self.worker.address,
+            "worker_id": self.worker.worker_id,
+            "gcs_address": self.gcs_address,
+        }
+
+    async def _handle_worker_op(self, op: str, *args):
+        if op.startswith("_"):
+            raise ValueError(f"worker_op {op!r} not allowed")
+        fn = getattr(self.worker, op, None)
+        if fn is None:
+            raise AttributeError(f"CoreWorker has no op {op!r}")
+        result = fn(*args)
+        if asyncio.iscoroutine(result):
+            result = await result
+        return result
+
+    async def _handle_proxy_rpc(self, address, method: str, *args):
+        return await self.worker.client_pool.get(*tuple(address)).call(
+            method, *args
+        )
+
+
+def start_client_server(
+    gcs_address: Tuple[str, int],
+    loop_thread: LoopThread,
+    host: str = "127.0.0.1",
+    port: int = 0,
+) -> ClientServer:
+    """Start a ClientServer on an existing loop thread (used by Node when
+    ``client_server_port`` is configured, and by tests)."""
+    server = ClientServer(gcs_address)
+    loop_thread.run(server.start(host, port), timeout=30)
+    return server

@@ -149,14 +149,20 @@ async def handle_free(obj_id: str) -> bool:
 
 
 def resolve_args(args, kwargs):
-    """Replace DeviceObjectRef arguments with their pytrees (reference: the
-    implicit resolution GPUObjectManager does for tensor_transport
-    methods)."""
+    """Replace DeviceObjectRef arguments — including refs nested inside
+    lists/dicts/tuples — with their pytrees (reference: the implicit
+    resolution GPUObjectManager does for tensor_transport methods)."""
+    import jax
 
     def r(x):
         return device_get(x) if isinstance(x, DeviceObjectRef) else x
 
-    return [r(a) for a in args], {k: r(v) for k, v in kwargs.items()}
+    resolve = lambda tree: jax.tree.map(  # noqa: E731
+        r, tree, is_leaf=lambda x: isinstance(x, DeviceObjectRef)
+    )
+    return [resolve(a) for a in args], {
+        k: resolve(v) for k, v in kwargs.items()
+    }
 
 
 def wrap_result(result: Any) -> Any:

@@ -49,6 +49,7 @@ class Node:
             self.gcs = GcsServer(config)
             self.gcs_address = self.loop_thread.run(self.gcs.start(), timeout=30)
         assert self.gcs_address is not None, "non-head node needs gcs_address"
+        self.client_server = None
         self.raylet = Raylet(
             config,
             self.gcs_address,
@@ -59,6 +60,17 @@ class Node:
             object_store_memory=object_store_memory,
         )
         self.raylet_address = self.loop_thread.run(self.raylet.start(), timeout=30)
+        if head and config.client_server_port >= 0:
+            # ray:// attach point (reference: the client server proxier
+            # started next to the head, util/client/server). After raylet
+            # start — the server's driver worker needs a node to lease from.
+            from ..client.server import start_client_server
+
+            self.client_server = start_client_server(
+                self.gcs_address, self.loop_thread,
+                host=config.client_server_host,
+                port=config.client_server_port,
+            )
 
     @property
     def node_id(self):
@@ -69,6 +81,11 @@ class Node:
         if dashboard is not None:
             try:
                 dashboard.stop()
+            except Exception:
+                pass
+        if self.client_server is not None:
+            try:
+                self.loop_thread.run(self.client_server.stop(), timeout=10)
             except Exception:
                 pass
         try:

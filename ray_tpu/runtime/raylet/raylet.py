@@ -118,6 +118,7 @@ class Raylet:
             else GroupByOwnerWorkerKillingPolicy()
         )
         self._oom_kills = 0
+        self._last_oom_kill_ts = 0.0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -241,6 +242,12 @@ class Raylet:
         if the task is retriable."""
         if not self._leases or not self.memory_monitor.is_over_threshold():
             return
+        # cooldown: reclaim after SIGKILL lags behind the next tick, and
+        # back-to-back kills would drain the node before pressure clears
+        # (reference: kill-in-progress gating in the memory-monitor callback)
+        now = time.time()
+        if now - self._last_oom_kill_ts < self.config.oom_kill_cooldown_s:
+            return
         candidates = []
         for lease in self._leases.values():
             spec = lease.spec
@@ -264,6 +271,7 @@ class Raylet:
             return
         used, total = self.memory_monitor.usage()
         self._oom_kills += 1
+        self._last_oom_kill_ts = now
         logger.warning(
             "memory pressure (%.0f/%.0f MB): killing worker %s (pid %s, "
             "retriable=%s) to reclaim memory",

@@ -31,6 +31,9 @@ def cmd_start(args):
 
     config = Config()
     if args.head:
+        if args.ray_client_server_port is not None:
+            config.client_server_port = args.ray_client_server_port
+        config.client_server_host = args.ray_client_server_host
         node = Node(
             config,
             head=True,
@@ -41,6 +44,9 @@ def cmd_start(args):
         host, port = node.gcs_address
         print(f"ray_tpu head started; connect with:")
         print(f'  ray_tpu.init(address="{host}:{port}")')
+        if node.client_server is not None:
+            chost, cport = node.client_server.address
+            print(f'  ray_tpu.init(address="ray://{chost}:{cport}")  # client mode')
         if not args.no_dashboard:
             from ..dashboard import DashboardServer
 
@@ -178,6 +184,14 @@ def main(argv=None):
     p.add_argument("--block", action="store_true")
     p.add_argument("--no-dashboard", action="store_true")
     p.add_argument("--dashboard-port", type=int, default=8265)
+    p.add_argument(
+        "--ray-client-server-port", type=int, default=10001,
+        help="port for ray:// clients (head only); -1 disables",
+    )
+    p.add_argument(
+        "--ray-client-server-host", default="127.0.0.1",
+        help="bind host for ray:// clients; 0.0.0.0 accepts remote machines",
+    )
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("job", help="submit and manage jobs")

@@ -1,0 +1,106 @@
+"""ray:// client mode (reference: Ray Client, python/ray/util/client/ and
+ray_client.proto): the client process attaches through the client server
+without joining the cluster."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CLIENT_SCRIPT = textwrap.dedent(
+    """
+    import sys
+    sys.path.insert(0, %(repo)r)
+    import ray_tpu
+
+    ray_tpu.init(address="ray://127.0.0.1:%(port)d")
+
+    # objects
+    ref = ray_tpu.put({"k": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"k": [1, 2, 3]}
+
+    # tasks (with a by-reference arg)
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    big = ray_tpu.put(40)
+    out = ray_tpu.get(add.remote(big, 2), timeout=60)
+    assert out == 42, out
+
+    # ready/not-ready split
+    ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=10)
+    assert len(ready) == 1 and not not_ready
+
+    # actors
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    assert ray_tpu.get(c.incr.remote(5), timeout=60) == 6
+    ray_tpu.kill(c)
+
+    # cluster introspection goes through the proxy
+    nodes = ray_tpu.nodes()
+    assert len(nodes) == 1 and nodes[0]["Alive"]
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU", 0) >= 1
+
+    ray_tpu.shutdown()
+    print("CLIENT_OK")
+    """
+)
+
+
+def test_client_mode_end_to_end(shutdown_only):
+    node = ray_tpu.init(
+        num_cpus=4, _system_config={"client_server_port": 0}
+    )
+    assert node.client_server is not None
+    port = node.client_server.address[1]
+    script = CLIENT_SCRIPT % {"repo": REPO, "port": port}
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "RAY_TPU_JAX_PLATFORM": "cpu"},
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "CLIENT_OK" in proc.stdout
+
+
+def test_client_server_survives_client_exit(shutdown_only):
+    """A second client can attach after the first disconnects."""
+    node = ray_tpu.init(
+        num_cpus=4, _system_config={"client_server_port": 0}
+    )
+    port = node.client_server.address[1]
+    quick = textwrap.dedent(
+        """
+        import sys
+        sys.path.insert(0, %(repo)r)
+        import ray_tpu
+        ray_tpu.init(address="ray://127.0.0.1:%(port)d")
+        assert ray_tpu.get(ray_tpu.put(11)) == 11
+        ray_tpu.shutdown()
+        print("OK")
+        """
+    ) % {"repo": REPO, "port": port}
+    for _ in range(2):
+        proc = subprocess.run(
+            [sys.executable, "-c", quick],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "RAY_TPU_JAX_PLATFORM": "cpu"},
+        )
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        assert "OK" in proc.stdout

@@ -97,3 +97,23 @@ def test_device_put_from_driver(ray_start_regular):
     ref2 = ray_tpu.get(p.double_local.remote(ref))
     tree2 = device_get(ref2)
     assert float(tree2["x"].sum()) == 18.0
+
+
+def test_nested_refs_resolve(ray_start_regular):
+    """Refs inside containers resolve too (the implicit-resolution promise)."""
+    p = Producer.remote()
+    c = Consumer.remote()
+    r1 = ray_tpu.get(p.make.remote(3))
+
+    @ray_tpu.remote
+    class NestedConsumer:
+        @ray_tpu.method(tensor_transport="device")
+        def sum_nested(self, payload):
+            import jax.numpy as jnp
+
+            tree = payload["inner"][0]
+            return float(jnp.sum(tree["w"]))
+
+    n = NestedConsumer.remote()
+    out = ray_tpu.get(n.sum_nested.remote({"inner": [r1]}))
+    assert out == float(np.arange(3).sum())
