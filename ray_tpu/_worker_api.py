@@ -37,6 +37,10 @@ def clear():
         _node = None
 
 
+def get_loop_thread():
+    return _loop_thread
+
+
 def maybe_get_core_worker():
     return _core_worker
 

@@ -161,12 +161,20 @@ def shutdown():
         return
     worker = _worker_api.get_core_worker()
     node = _worker_api.get_node()
+    loop_thread = _worker_api.get_loop_thread()
     try:
         _worker_api.run_on_worker_loop(worker.shutdown(), timeout=10)
     except Exception:
         pass
     if node is not None:
-        node.stop()
+        node.stop()  # owns (and stops) the loop thread
+    elif loop_thread is not None:
+        # client / address-connect modes own their loop thread; stop it or
+        # repeated init/shutdown cycles leak a daemon thread each
+        try:
+            loop_thread.stop()
+        except Exception:
+            pass
     _worker_api.clear()
 
 

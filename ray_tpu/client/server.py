@@ -26,12 +26,22 @@ logger = logging.getLogger(__name__)
 
 
 class ClientServer:
+    # CoreWorker ops clients may invoke; everything else (shutdown, start,
+    # handler registration...) would let one client break the shared worker
+    ALLOWED_OPS = frozenset({
+        "put", "get_objects", "wait", "submit_task", "create_actor",
+        "submit_actor_task", "kill_actor", "attach_actor",
+    })
+
     def __init__(self, gcs_address: Tuple[str, int], config: Optional[Config] = None):
         self.gcs_address = gcs_address
         self.config = config or Config()
         self.server = RpcServer("client-server")
         self.worker: Optional[CoreWorker] = None
         self.address: Optional[Tuple[str, int]] = None
+        # ids pinned on behalf of clients for the session (reference: Ray
+        # Client server-side object pinning per session); released at stop
+        self._pinned_ids: set = set()
 
     async def _find_raylet(self):
         from .._internal.node_lookup import find_raylet_address
@@ -61,6 +71,12 @@ class ClientServer:
     async def stop(self):
         await self.server.stop()
         if self.worker is not None:
+            with self.worker._ref_lock:
+                pinned, self._pinned_ids = self._pinned_ids, set()
+                for oid in pinned:
+                    self.worker._local_refs[oid] -= 1
+            for oid in pinned:
+                self.worker._maybe_free(oid)
             await self.worker.shutdown()
 
     # -- handlers -----------------------------------------------------------
@@ -72,15 +88,27 @@ class ClientServer:
             "gcs_address": self.gcs_address,
         }
 
+    def _pin(self, object_ids):
+        """Hold a local ref on behalf of clients so the owner worker doesn't
+        free objects the client still references (clients have no in-cluster
+        refcount presence)."""
+        with self.worker._ref_lock:
+            for oid in object_ids:
+                if oid not in self._pinned_ids:
+                    self._pinned_ids.add(oid)
+                    self.worker._local_refs[oid] += 1
+
     async def _handle_worker_op(self, op: str, *args):
-        if op.startswith("_"):
+        if op not in self.ALLOWED_OPS:
             raise ValueError(f"worker_op {op!r} not allowed")
-        fn = getattr(self.worker, op, None)
-        if fn is None:
-            raise AttributeError(f"CoreWorker has no op {op!r}")
+        fn = getattr(self.worker, op)
         result = fn(*args)
         if asyncio.iscoroutine(result):
             result = await result
+        if op == "put":
+            self._pin([result])
+        elif op in ("submit_task", "submit_actor_task"):
+            self._pin(result)
         return result
 
     async def _handle_proxy_rpc(self, address, method: str, *args):

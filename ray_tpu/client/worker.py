@@ -160,9 +160,10 @@ class ClientWorker:
     def as_future(self, ref):
         import asyncio
 
-        return asyncio.run_coroutine_threadsafe(
-            self.get_objects([ref], None), self.loop
-        )
+        async def _one():
+            return (await self.get_objects([ref], None))[0]
+
+        return asyncio.run_coroutine_threadsafe(_one(), self.loop)
 
     # -- lifecycle ----------------------------------------------------------
 

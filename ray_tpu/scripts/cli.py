@@ -31,8 +31,7 @@ def cmd_start(args):
 
     config = Config()
     if args.head:
-        if args.ray_client_server_port is not None:
-            config.client_server_port = args.ray_client_server_port
+        config.client_server_port = args.ray_client_server_port
         config.client_server_host = args.ray_client_server_host
         node = Node(
             config,

@@ -135,9 +135,16 @@ def run(
     route_prefix: Optional[str] = None,
     _blocking: bool = True,
     _proxy: bool = True,
+    _local_testing_mode: bool = False,
 ) -> DeploymentHandle:
     """Deploy an application and wait until it is RUNNING (reference:
-    serve.run serve/api.py:681)."""
+    serve.run serve/api.py:681). ``_local_testing_mode=True`` runs every
+    deployment in-process with no cluster (reference:
+    serve/_private/local_testing_mode.py)."""
+    if _local_testing_mode:
+        from .local_mode import run_local
+
+        return run_local(app, name)
     controller = start(proxy=_proxy)
     nodes = app._collect()
     ingress_name = app.root.deployment.name

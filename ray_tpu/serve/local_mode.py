@@ -1,0 +1,151 @@
+"""Local testing mode: run a Serve app in-process, no cluster.
+
+Role-equivalent of the reference's local testing mode
+(serve/_private/local_testing_mode.py, ``serve.run(..,
+_local_testing_mode=True)``): deployments are instantiated in the caller's
+process, handles call them directly, and async def methods (including
+@serve.batch / @serve.multiplexed machinery) run on a private event-loop
+thread — so unit tests exercise the exact user code without paying for
+controller/proxy/replica actors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Dict, Optional
+
+
+class _LocalLoop:
+    """One shared event-loop thread for all local replicas' async methods."""
+
+    _instance: Optional["_LocalLoop"] = None
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        t = threading.Thread(
+            target=self.loop.run_forever, name="serve-local", daemon=True
+        )
+        t.start()
+
+    @classmethod
+    def get(cls) -> "_LocalLoop":
+        if cls._instance is None:
+            cls._instance = _LocalLoop()
+        return cls._instance
+
+    def run(self, coro, timeout=None):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+
+
+class LocalDeploymentResponse:
+    """Mirror of DeploymentResponse for local mode: the request is already
+    in flight (dispatched eagerly, like the real handle) and ``result``
+    just waits."""
+
+    def __init__(self, future):
+        self._future = future
+
+    def result(self, timeout_s: Optional[float] = None):
+        return self._future.result(timeout_s)
+
+
+class LocalDeploymentHandle:
+    """Calls the in-process instance directly (reference: the local-mode
+    handle in local_testing_mode.py)."""
+
+    def __init__(self, instances: Dict[str, Any], deployment: str,
+                 method: str = "__call__", multiplexed_model_id: str = ""):
+        self._instances = instances
+        self._deployment = deployment
+        self._method = method
+        self._multiplexed_model_id = multiplexed_model_id
+
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None):
+        return LocalDeploymentHandle(
+            self._instances,
+            self._deployment,
+            method_name if method_name is not None else self._method,
+            multiplexed_model_id
+            if multiplexed_model_id is not None
+            else self._multiplexed_model_id,
+        )
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return LocalDeploymentHandle(
+            self._instances, self._deployment, name,
+            self._multiplexed_model_id,
+        )
+
+    def remote(self, *args, **kwargs) -> LocalDeploymentResponse:
+        import contextvars
+
+        instance = self._instances[self._deployment]
+        method = (
+            instance
+            if self._method == "__call__" and not hasattr(instance, "__call__")
+            else getattr(instance, self._method)
+        )
+        model_id = self._multiplexed_model_id
+        loop = _LocalLoop.get().loop
+
+        async def invoke():
+            if asyncio.iscoroutinefunction(method):
+                if model_id:
+                    from .multiplex import _set_multiplexed_model_id
+
+                    # this task's context only — no leak across requests
+                    _set_multiplexed_model_id(model_id)
+                return await method(*args, **kwargs)
+            # sync method: run on a thread (the loop must keep serving
+            # concurrent requests, e.g. @serve.batch coalescing), inside a
+            # context copy so the model-id var never leaks to later calls
+            def call():
+                if model_id:
+                    from .multiplex import _set_multiplexed_model_id
+
+                    _set_multiplexed_model_id(model_id)
+                return method(*args, **kwargs)
+
+            ctx = contextvars.copy_context()
+            return await loop.run_in_executor(None, lambda: ctx.run(call))
+
+        # eager dispatch, matching the real handle: fire-and-forget calls
+        # still execute and concurrent requests actually overlap
+        future = asyncio.run_coroutine_threadsafe(invoke(), loop)
+        return LocalDeploymentResponse(future)
+
+
+def run_local(app, name: str = "default") -> LocalDeploymentHandle:
+    """Instantiate every deployment in-process and return the root handle."""
+    from .api import Application, _BoundDeployment
+
+    nodes = app._collect()
+    instances: Dict[str, Any] = {}
+
+    def resolve(obj):
+        if isinstance(obj, Application):
+            obj = obj.root
+        if isinstance(obj, _BoundDeployment):
+            return LocalDeploymentHandle(instances, obj.deployment.name)
+        if isinstance(obj, tuple):
+            return tuple(resolve(x) for x in obj)
+        if isinstance(obj, list):
+            return [resolve(x) for x in obj]
+        if isinstance(obj, dict):
+            return {k: resolve(v) for k, v in obj.items()}
+        return obj
+
+    for node in nodes:
+        target = node.deployment._target
+        args = resolve(node.init_args)
+        kwargs = resolve(node.init_kwargs)
+        if isinstance(target, type):
+            instances[node.deployment.name] = target(*args, **kwargs)
+        else:
+            # function deployment: the "instance" is the function itself
+            instances[node.deployment.name] = target
+    return LocalDeploymentHandle(instances, app.root.deployment.name)

@@ -42,6 +42,10 @@ class ScalingConfig:
             self.num_workers = (
                 pod_type_num_hosts(self.topology) if self.topology else 1
             )
+        # (min, max) selects elastic scaling (scaling_policy.py); size checks
+        # below apply to the fixed case only
+        if isinstance(self.num_workers, tuple):
+            return
         if self.use_tpu and self.topology and self.num_workers > 1:
             # one ranked worker per slice host, spread across hosts
             self.placement_strategy = "STRICT_SPREAD"

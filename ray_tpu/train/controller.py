@@ -72,6 +72,12 @@ class TrainController:
         self._checkpoints = CheckpointManager(
             run_config.run_dir, run_config.checkpoint_config
         )
+        from .scaling_policy import make_scaling_policy
+
+        self._scaling_policy = make_scaling_policy(scaling_config)
+        # elastic configs carry (min, max); the policy's config is the
+        # concrete max-sized one used for per-worker resource shapes
+        self._scaling = self._scaling_policy.scaling_config
         self._failures = 0
         self._metrics_history: List[Dict[str, Any]] = []
 
@@ -115,13 +121,23 @@ class TrainController:
 
     def _run_attempt(self) -> Result:
         self.state = RunState.SCHEDULING
+        # the scaling policy sizes this attempt's gang (elastic: shrink to
+        # what fits now, grow back on later restarts)
+        decision = self._scaling_policy.decide(self._failures)
+        attempt_scaling = self._scaling
+        if decision.num_workers != attempt_scaling.num_workers:
+            from dataclasses import replace
+
+            attempt_scaling = replace(
+                attempt_scaling, num_workers=decision.num_workers
+            )
         overrides: Dict[str, Any] = {}
         for cb in self._callbacks:
-            out = cb.before_worker_group_start(self._scaling)
+            out = cb.before_worker_group_start(attempt_scaling)
             if out:
                 overrides.update(out)
         wg = WorkerGroup(
-            self._scaling,
+            attempt_scaling,
             placement_group_override=overrides.get("placement_group_override"),
             bundle_label_selector=overrides.get("bundle_label_selector"),
         )

@@ -1,0 +1,112 @@
+"""Scaling policies: decide the worker-group size per attempt.
+
+Role-equivalent of the reference's ScalingPolicy
+(train/v2/_internal/execution/scaling_policy/scaling_policy.py:29 —
+FixedScalingPolicy and the elastic ScalingDecision path): the controller
+asks the policy for a ScalingDecision before every worker-group (re)start.
+Elastic training resizes at restart boundaries — JAX SPMD gangs are
+all-or-nothing, so mid-run resizes require a gang restart anyway, and every
+restart resumes from the latest checkpoint with a freshly compiled program
+for the new mesh size (the reference's elastic semantics, adapted to XLA's
+static-world compilation model).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+from .config import ScalingConfig
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ScalingDecision:
+    num_workers: int
+
+
+class ScalingPolicy:
+    """ABC: ``decide`` is called before each worker-group start attempt."""
+
+    def __init__(self, scaling_config: ScalingConfig):
+        self.scaling_config = scaling_config
+
+    def decide(self, attempt: int) -> ScalingDecision:
+        raise NotImplementedError
+
+
+class FixedScalingPolicy(ScalingPolicy):
+    """Always the configured world size (reference: FixedScalingPolicy)."""
+
+    def decide(self, attempt: int) -> ScalingDecision:
+        return ScalingDecision(num_workers=self.scaling_config.num_workers)
+
+
+class ElasticScalingPolicy(ScalingPolicy):
+    """Size the gang to what the cluster can actually schedule, clamped to
+    [min_workers, max_workers]. On the first attempt it waits up to
+    ``grace_s`` for the full max size before settling for less; restarts
+    re-measure, so a recovered node grows the gang back."""
+
+    def __init__(
+        self,
+        scaling_config: ScalingConfig,
+        min_workers: int,
+        max_workers: int,
+        grace_s: float = 10.0,
+    ):
+        super().__init__(scaling_config)
+        if not 1 <= min_workers <= max_workers:
+            raise ValueError("need 1 <= min_workers <= max_workers")
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.grace_s = grace_s
+
+    def _fit_to_cluster(self) -> int:
+        from .. import api
+
+        per_worker = self.scaling_config._resources_per_worker_not_none
+        try:
+            avail = api.available_resources()
+        except Exception:
+            return self.min_workers
+        fits = math.inf
+        for k, v in per_worker.items():
+            if v > 0:
+                fits = min(fits, avail.get(k, 0.0) // v)
+        if not math.isfinite(fits):
+            fits = self.max_workers
+        return int(fits)
+
+    def decide(self, attempt: int) -> ScalingDecision:
+        import time
+
+        deadline = time.time() + self.grace_s
+        n = self._fit_to_cluster()
+        while n < self.max_workers and time.time() < deadline:
+            time.sleep(0.5)
+            n = max(n, self._fit_to_cluster())
+        n = max(min(n, self.max_workers), self.min_workers)
+        if n < self.max_workers:
+            logger.warning(
+                "elastic scaling: running with %d/%d workers (attempt %d)",
+                n, self.max_workers, attempt,
+            )
+        return ScalingDecision(num_workers=n)
+
+
+def make_scaling_policy(scaling_config: ScalingConfig) -> ScalingPolicy:
+    """num_workers given as (min, max) selects elastic; an int stays fixed
+    (reference: elastic num_workers tuple in Train's elastic API)."""
+    nw = scaling_config.num_workers
+    if isinstance(nw, tuple):
+        from dataclasses import replace
+
+        lo, hi = nw
+        return ElasticScalingPolicy(
+            replace(scaling_config, num_workers=hi), lo, hi
+        )
+    return FixedScalingPolicy(scaling_config)

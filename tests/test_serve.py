@@ -334,3 +334,59 @@ def test_serve_multiplexed_lru(cluster):
     r5 = handle.options(multiplexed_model_id="m2").remote(0).result(timeout_s=60)
     assert r5["loads"].count("m2") == 2
     serve.delete("mux-app")
+
+
+def test_local_testing_mode_no_cluster():
+    """serve.run(_local_testing_mode=True) needs no cluster at all
+    (reference: serve/_private/local_testing_mode.py)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Gateway:
+        def __init__(self, doubler):
+            self.doubler = doubler
+
+        def __call__(self, x):
+            return self.doubler.remote(x).result() + 1
+
+        async def aecho(self, x):
+            return x
+
+    app = Gateway.bind(Doubler.bind())
+    handle = serve.run(app, _local_testing_mode=True)
+    assert handle.remote(10).result() == 21
+    # method routing + async methods work locally
+    assert handle.options(method_name="aecho").remote("hi").result() == "hi"
+
+
+def test_local_testing_mode_batching_and_multiplex():
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Batched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.02)
+        async def __call__(self, items):
+            return [i + 100 for i in items]
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            return {"id": model_id}
+
+        async def which_model(self):
+            model = await self.get_model()
+            return model["id"]
+
+    handle = serve.run(Batched.bind(), _local_testing_mode=True)
+    rs = [handle.remote(i) for i in range(4)]
+    assert [r.result(5) for r in rs] == [100, 101, 102, 103]
+    out = (
+        handle.options(multiplexed_model_id="m7", method_name="which_model")
+        .remote()
+        .result(5)
+    )
+    assert out == "m7"

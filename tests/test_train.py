@@ -219,3 +219,43 @@ def test_dataset_shard_list(train_cluster):
     assert result.error is None
     total = sum(m["n"] for m in result.metrics_history)
     assert total == 10
+
+
+def _world_size_probe(config):
+    ctx = rt_train.get_context()
+    rt_train.report({"world": ctx.get_world_size(), "rank": ctx.get_world_rank()})
+
+
+def test_elastic_scaling_shrinks_to_cluster(train_cluster):
+    """num_workers=(min,max): the gang sizes itself to what the cluster can
+    schedule (cluster has 8 CPUs; max 32 can never fit)."""
+    trainer = rt_train.JaxTrainer(
+        _world_size_probe,
+        scaling_config=rt_train.ScalingConfig(num_workers=(1, 32)),
+        run_config=rt_train.RunConfig(name="elastic-test"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    world = result.metrics["world"]
+    assert 1 <= world < 32
+    # every rank of the shrunk gang actually ran
+    ranks = {m["rank"] for m in result.metrics_history}
+    assert ranks == set(range(world))
+
+
+def test_elastic_scaling_policy_units():
+    from ray_tpu.train.scaling_policy import (
+        ElasticScalingPolicy,
+        FixedScalingPolicy,
+        make_scaling_policy,
+    )
+
+    fixed = make_scaling_policy(rt_train.ScalingConfig(num_workers=3))
+    assert isinstance(fixed, FixedScalingPolicy)
+    assert fixed.decide(0).num_workers == 3
+
+    elastic = make_scaling_policy(rt_train.ScalingConfig(num_workers=(2, 6)))
+    assert isinstance(elastic, ElasticScalingPolicy)
+    assert elastic.min_workers == 2 and elastic.max_workers == 6
+    with pytest.raises(ValueError):
+        ElasticScalingPolicy(rt_train.ScalingConfig(num_workers=1), 3, 2)
