@@ -67,6 +67,9 @@ class Config:
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 120.0
+    # Token auth on every RPC channel (reference: enable_cluster_auth,
+    # ray_config_def.h:36). Empty = auth disabled.
+    cluster_auth_token: str = ""
     # ray:// client server on the head node: -1 disabled, 0 auto port,
     # >0 fixed port (reference: --ray-client-server-port). Bind 0.0.0.0 to
     # accept clients from other machines.

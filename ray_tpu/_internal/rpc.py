@@ -52,6 +52,28 @@ def _write_frame(writer: asyncio.StreamWriter, payload: Any):
 
 
 # ---------------------------------------------------------------------------
+# Authentication (reference: rpc/authentication/, enable_cluster_auth in
+# ray_config_def.h:36 — cluster-ID/token auth on every RPC channel)
+# ---------------------------------------------------------------------------
+
+_auth_token: Optional[str] = None
+
+
+def set_auth_token(token: Optional[str]):
+    """Process-wide shared secret. When set, every RpcServer in this process
+    requires clients to present it before any other method, and every
+    RpcClient sends it on connect. Distributed to workers through the config
+    JSON on their command line (the reference ships its cluster ID the same
+    way)."""
+    global _auth_token
+    _auth_token = token or None
+
+
+def get_auth_token() -> Optional[str]:
+    return _auth_token
+
+
+# ---------------------------------------------------------------------------
 # Chaos injection (reference: rpc/rpc_chaos.h, RAY_testing_rpc_failure)
 # ---------------------------------------------------------------------------
 
@@ -143,10 +165,48 @@ class RpcServer:
                     logger.warning("%s: malformed frame, dropping connection", self.name)
                     break
                 if method == "__register__":
+                    if (
+                        _auth_token is not None
+                        and kwargs.get("auth_token") != _auth_token
+                    ):
+                        # reject BEFORE absorbing the meta: a spoofed
+                        # worker_id in an unauthenticated register must not
+                        # reach the connection-lost callback (worker-death
+                        # spoofing)
+                        logger.warning(
+                            "%s: unauthenticated register, dropping connection",
+                            self.name,
+                        )
+                        if req_id != -1:
+                            try:
+                                _write_frame(
+                                    writer,
+                                    (req_id, False,
+                                     RpcError("authentication failed")),
+                                )
+                                await writer.drain()
+                            except Exception:
+                                pass
+                        break
                     peer_meta.update(kwargs)
                     if req_id != -1:
                         _write_frame(writer, (req_id, True, None))
                     continue
+                if _auth_token is not None and peer_meta.get("auth_token") != _auth_token:
+                    logger.warning(
+                        "%s: unauthenticated request %r, dropping connection",
+                        self.name, method,
+                    )
+                    if req_id != -1:
+                        try:
+                            _write_frame(
+                                writer,
+                                (req_id, False, RpcError("authentication failed")),
+                            )
+                            await writer.drain()
+                        except Exception:
+                            pass
+                    break
                 t = asyncio.ensure_future(
                     self._dispatch(writer, req_id, method, args, kwargs)
                 )
@@ -248,8 +308,11 @@ class RpcClient:
                         )
                     await asyncio.sleep(delay)
                     delay = min(delay * 2, 0.5)
-            if self._register_meta:
-                _write_frame(self._writer, (-1, "__register__", (), self._register_meta))
+            meta = dict(self._register_meta or {})
+            if _auth_token is not None:
+                meta["auth_token"] = _auth_token
+            if meta:
+                _write_frame(self._writer, (-1, "__register__", (), meta))
             self._recv_task = asyncio.ensure_future(self._recv_loop())
 
     async def _recv_loop(self):

@@ -16,30 +16,47 @@ SRC = os.path.join(_DIR, "store.cc")
 LIB = os.path.join(_DIR, "libray_tpu_store.so")
 
 
+XLANG_SRC = os.path.join(_DIR, "xlang_client.cc")
+XLANG_BIN = os.path.join(_DIR, "ray_tpu_xlang")
+XLANG_LIB = os.path.join(_DIR, "libray_tpu_xlang.so")
+
+
+def _compile(cmd, out):
+    subprocess.run(cmd + ["-o", out + ".tmp"], check=True, capture_output=True)
+    os.replace(out + ".tmp", out)  # atomic: concurrent builders race safely
+    return out
+
+
+def _stale(out, src):
+    return not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src)
+
+
 def build(force: bool = False) -> str:
-    """Compile if missing/stale; returns the library path."""
-    if (
-        not force
-        and os.path.exists(LIB)
-        and os.path.getmtime(LIB) >= os.path.getmtime(SRC)
-    ):
+    """Compile the store library if missing/stale; returns the path."""
+    if not force and not _stale(LIB, SRC):
         return LIB
-    cmd = [
-        "g++",
-        "-std=c++17",
-        "-O2",
-        "-shared",
-        "-fPIC",
-        "-pthread",
-        "-o",
-        LIB + ".tmp",
-        SRC,
-    ]
-    subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(LIB + ".tmp", LIB)  # atomic: concurrent builders race safely
-    return LIB
+    return _compile(
+        ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-pthread", SRC], LIB
+    )
+
+
+def build_xlang(force: bool = False) -> tuple:
+    """Compile the C++ frontend (CLI binary + ctypes lib); returns paths."""
+    if force or _stale(XLANG_BIN, XLANG_SRC):
+        _compile(
+            ["g++", "-std=c++17", "-O2", "-DRAY_TPU_XLANG_MAIN", XLANG_SRC],
+            XLANG_BIN,
+        )
+    if force or _stale(XLANG_LIB, XLANG_SRC):
+        _compile(
+            ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", XLANG_SRC],
+            XLANG_LIB,
+        )
+    return XLANG_BIN, XLANG_LIB
 
 
 if __name__ == "__main__":
-    path = build(force="--force" in sys.argv)
-    print(path)
+    force = "--force" in sys.argv
+    print(build(force=force))
+    for p in build_xlang(force=force):
+        print(p)

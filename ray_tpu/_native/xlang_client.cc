@@ -1,0 +1,377 @@
+// C++ frontend for the ray_tpu cluster.
+//
+// Role-equivalent of the reference's C++ API frontend (cpp/include/ray/api —
+// ray::Init / ray::Task(F).Remote()) combined with its cross-language call
+// path (python/ray/cross_language.py, msgpack-serialized calls): a C++
+// program connects to the ray:// client server, submits a *named Python
+// function* with JSON arguments over the cluster's length-prefixed frame
+// protocol, and receives a JSON reply. The wire payload is a hand-written
+// minimal pickle (protocol 2 writer / subset reader) — the response side is
+// parseable because the server's xlang handler always replies with a plain
+// (int, bool, str) tuple.
+//
+// Build (see build.py):
+//   g++ -std=c++17 -O2 -o ray_tpu_xlang xlang_client.cc -DRAY_TPU_XLANG_MAIN
+//   g++ -std=c++17 -O2 -shared -fPIC -o libray_tpu_xlang.so xlang_client.cc
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+
+// ---------------------------------------------------------------------------
+// Minimal pickle protocol-2 writer (requests are fully under our control).
+// ---------------------------------------------------------------------------
+
+class Pickler {
+ public:
+  Pickler() { buf_ += "\x80\x02"; }  // PROTO 2
+
+  void Mark() { buf_ += '('; }
+  void TupleFromMark() { buf_ += 't'; }
+  void None() { buf_ += 'N'; }
+  void EmptyDict() { buf_ += '}'; }
+  void SetItemsFromMark() { buf_ += 'u'; }
+
+  void Int(int64_t v) {
+    // BININT (i32) covers request ids and sizes we use
+    buf_ += 'J';
+    AppendLE32(static_cast<uint32_t>(static_cast<int32_t>(v)));
+  }
+
+  void Str(const std::string& s) {
+    buf_ += 'X';  // BINUNICODE, u32 length
+    AppendLE32(static_cast<uint32_t>(s.size()));
+    buf_ += s;
+  }
+
+  void Double(double v) {
+    buf_ += 'G';  // BINFLOAT, big-endian IEEE 754
+    uint64_t bits;
+    std::memcpy(&bits, &v, 8);
+    for (int i = 7; i >= 0; --i)
+      buf_ += static_cast<char>((bits >> (i * 8)) & 0xff);
+  }
+
+  std::string Finish() {
+    std::string out = buf_;
+    out += '.';  // STOP
+    return out;
+  }
+
+ private:
+  void AppendLE32(uint32_t v) {
+    for (int i = 0; i < 4; ++i) buf_ += static_cast<char>((v >> (i * 8)) & 0xff);
+  }
+  std::string buf_;
+};
+
+// ---------------------------------------------------------------------------
+// Minimal pickle reader for responses shaped (int, bool, str|None).
+// Handles the opcode subset CPython's pickler emits for that tuple at any
+// protocol <= 5 (PROTO/FRAME/MEMOIZE wrappers included).
+// ---------------------------------------------------------------------------
+
+struct Value {
+  enum class Kind { kNone, kBool, kInt, kStr, kTuple } kind = Kind::kNone;
+  bool b = false;
+  int64_t i = 0;
+  std::string s;
+  std::vector<Value> items;
+};
+
+class Unpickler {
+ public:
+  explicit Unpickler(const std::string& data) : data_(data) {}
+
+  Value Parse() {
+    size_t pos = 0;
+    std::vector<Value> stack;
+    std::vector<size_t> marks;
+    while (pos < data_.size()) {
+      uint8_t op = static_cast<uint8_t>(data_[pos++]);
+      switch (op) {
+        case 0x80:  // PROTO
+          pos += 1;
+          break;
+        case 0x95:  // FRAME (8-byte length)
+          pos += 8;
+          break;
+        case 0x94:  // MEMOIZE — ignore the memo
+          break;
+        case 'q':  // BINPUT (1-byte memo index)
+          pos += 1;
+          break;
+        case 'r':  // LONG_BINPUT
+          pos += 4;
+          break;
+        case 'N':
+          stack.push_back(Value{});
+          break;
+        case 0x88: {  // NEWTRUE
+          Value v; v.kind = Value::Kind::kBool; v.b = true;
+          stack.push_back(v);
+          break;
+        }
+        case 0x89: {  // NEWFALSE
+          Value v; v.kind = Value::Kind::kBool; v.b = false;
+          stack.push_back(v);
+          break;
+        }
+        case 'K': {  // BININT1
+          Value v; v.kind = Value::Kind::kInt;
+          v.i = static_cast<uint8_t>(data_[pos++]);
+          stack.push_back(v);
+          break;
+        }
+        case 'M': {  // BININT2
+          Value v; v.kind = Value::Kind::kInt;
+          v.i = ReadLE(pos, 2); pos += 2;
+          stack.push_back(v);
+          break;
+        }
+        case 'J': {  // BININT (signed i32)
+          Value v; v.kind = Value::Kind::kInt;
+          v.i = static_cast<int32_t>(ReadLE(pos, 4)); pos += 4;
+          stack.push_back(v);
+          break;
+        }
+        case 0x8c: {  // SHORT_BINUNICODE
+          size_t n = static_cast<uint8_t>(data_[pos++]);
+          PushStr(stack, pos, n);
+          break;
+        }
+        case 'X': {  // BINUNICODE (u32)
+          size_t n = ReadLE(pos, 4); pos += 4;
+          PushStr(stack, pos, n);
+          break;
+        }
+        case 0x8d: {  // BINUNICODE8
+          size_t n = static_cast<size_t>(ReadLE(pos, 8)); pos += 8;
+          PushStr(stack, pos, n);
+          break;
+        }
+        case '(':  // MARK
+          marks.push_back(stack.size());
+          break;
+        case 't': {  // TUPLE (from mark)
+          size_t m = marks.back(); marks.pop_back();
+          Value v; v.kind = Value::Kind::kTuple;
+          v.items.assign(stack.begin() + m, stack.end());
+          stack.resize(m);
+          stack.push_back(v);
+          break;
+        }
+        case 0x85: case 0x86: case 0x87: {  // TUPLE1..TUPLE3
+          size_t n = op - 0x84;
+          Value v; v.kind = Value::Kind::kTuple;
+          v.items.assign(stack.end() - n, stack.end());
+          stack.resize(stack.size() - n);
+          stack.push_back(v);
+          break;
+        }
+        case '.':  // STOP
+          if (stack.empty()) throw std::runtime_error("pickle: empty stack");
+          return stack.back();
+        default:
+          throw std::runtime_error(
+              "pickle: unsupported opcode 0x" + ToHex(op) +
+              " (server reply was not a plain (int, bool, str) tuple)");
+      }
+    }
+    throw std::runtime_error("pickle: no STOP");
+  }
+
+ private:
+  uint64_t ReadLE(size_t pos, int n) {
+    uint64_t v = 0;
+    for (int i = 0; i < n; ++i)
+      v |= static_cast<uint64_t>(static_cast<uint8_t>(data_[pos + i])) << (i * 8);
+    return v;
+  }
+  void PushStr(std::vector<Value>& stack, size_t& pos, size_t n) {
+    Value v; v.kind = Value::Kind::kStr;
+    v.s = data_.substr(pos, n); pos += n;
+    stack.push_back(v);
+  }
+  static std::string ToHex(uint8_t b) {
+    const char* d = "0123456789abcdef";
+    return std::string() + d[b >> 4] + d[b & 0xf];
+  }
+  const std::string& data_;
+};
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+
+class XlangClient {
+ public:
+  XlangClient(const std::string& host, int port, const std::string& auth_token = "")
+      : fd_(-1) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw std::runtime_error("bad host " + host);
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      throw std::runtime_error("connect to " + host + " failed");
+    if (!auth_token.empty()) Register(auth_token);
+  }
+
+  ~XlangClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  // Submit module.qualname(*json.loads(args_json)) as a cluster task; the
+  // reply is the server's JSON envelope {"ok": ..., "value"/"error": ...}.
+  std::string Call(const std::string& module, const std::string& qualname,
+                   const std::string& args_json, double timeout_s = 120.0) {
+    int req_id = next_req_id_++;
+    Pickler p;
+    p.Mark();
+    p.Int(req_id);
+    p.Str("xlang_task");
+    p.Mark();
+    p.Str(module);
+    p.Str(qualname);
+    p.Str(args_json);
+    p.TupleFromMark();
+    p.EmptyDict();
+    p.TupleFromMark();
+    WriteFrame(p.Finish());
+
+    while (true) {
+      Value reply = Unpickler(ReadFrame()).Parse();
+      if (reply.kind != Value::Kind::kTuple || reply.items.size() != 3)
+        throw std::runtime_error("malformed reply frame");
+      if (reply.items[0].i != req_id) continue;  // not ours (multiplexing)
+      if (reply.items[1].kind == Value::Kind::kBool && !reply.items[1].b)
+        throw std::runtime_error("server error (see server logs)");
+      return reply.items[2].s;
+    }
+  }
+
+ private:
+  void Register(const std::string& token) {
+    Pickler p;
+    p.Mark();
+    p.Int(-1);
+    p.Str("__register__");
+    p.Mark();
+    p.TupleFromMark();
+    p.EmptyDict();
+    p.Mark();
+    p.Str("auth_token");
+    p.Str(token);
+    p.SetItemsFromMark();
+    p.TupleFromMark();
+    WriteFrame(p.Finish());
+  }
+
+  void WriteFrame(const std::string& payload) {
+    uint32_t n = static_cast<uint32_t>(payload.size());
+    char hdr[4];
+    for (int i = 0; i < 4; ++i) hdr[i] = static_cast<char>((n >> (i * 8)) & 0xff);
+    SendAll(hdr, 4);
+    SendAll(payload.data(), payload.size());
+  }
+
+  std::string ReadFrame() {
+    char hdr[4];
+    RecvAll(hdr, 4);
+    uint32_t n = 0;
+    for (int i = 0; i < 4; ++i)
+      n |= static_cast<uint32_t>(static_cast<uint8_t>(hdr[i])) << (i * 8);
+    std::string body(n, '\0');
+    RecvAll(&body[0], n);
+    return body;
+  }
+
+  void SendAll(const char* p, size_t n) {
+    while (n > 0) {
+      ssize_t w = ::send(fd_, p, n, 0);
+      if (w <= 0) throw std::runtime_error("send failed");
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+
+  void RecvAll(char* p, size_t n) {
+    while (n > 0) {
+      ssize_t r = ::recv(fd_, p, n, 0);
+      if (r <= 0) throw std::runtime_error("connection closed");
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+
+  int fd_;
+  int next_req_id_ = 1;
+};
+
+}  // namespace ray_tpu
+
+// -- C ABI for ctypes bindings ----------------------------------------------
+
+extern "C" {
+
+void* ray_tpu_xlang_connect(const char* host, int port, const char* token) {
+  try {
+    return new ray_tpu::XlangClient(host, port, token ? token : "");
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+// Returns a malloc'd C string the caller must free(); nullptr on error.
+char* ray_tpu_xlang_call(void* client, const char* module, const char* fn,
+                         const char* args_json) {
+  try {
+    auto* c = static_cast<ray_tpu::XlangClient*>(client);
+    std::string out = c->Call(module, fn, args_json);
+    char* buf = static_cast<char*>(::malloc(out.size() + 1));
+    std::memcpy(buf, out.c_str(), out.size() + 1);
+    return buf;
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void ray_tpu_xlang_disconnect(void* client) {
+  delete static_cast<ray_tpu::XlangClient*>(client);
+}
+
+}  // extern "C"
+
+#ifdef RAY_TPU_XLANG_MAIN
+#include <cstdio>
+
+int main(int argc, char** argv) {
+  if (argc < 6) {
+    std::fprintf(stderr,
+                 "usage: %s <host> <port> <module> <function> <args_json>\n",
+                 argv[0]);
+    return 2;
+  }
+  try {
+    ray_tpu::XlangClient client(argv[1], std::atoi(argv[2]));
+    std::string out = client.Call(argv[3], argv[4], argv[5]);
+    std::printf("%s\n", out.c_str());
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}
+#endif

@@ -59,6 +59,10 @@ def init(
 
         client_config = Config()
         client_config.apply_overrides(_system_config)
+        if client_config.cluster_auth_token:
+            from ._internal.rpc import set_auth_token
+
+            set_auth_token(client_config.cluster_auth_token)
         client_worker = _client_connect(
             address, client_config, namespace=namespace,
             runtime_env=runtime_env,
@@ -74,6 +78,10 @@ def init(
 
     config = Config()
     config.apply_overrides(_system_config)
+    if config.cluster_auth_token:
+        from ._internal.rpc import set_auth_token
+
+        set_auth_token(config.cluster_auth_token)
     if config.testing_rpc_failure:
         import json
 

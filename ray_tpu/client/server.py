@@ -39,9 +39,11 @@ class ClientServer:
         self.server = RpcServer("client-server")
         self.worker: Optional[CoreWorker] = None
         self.address: Optional[Tuple[str, int]] = None
-        # ids pinned on behalf of clients for the session (reference: Ray
-        # Client server-side object pinning per session); released at stop
-        self._pinned_ids: set = set()
+        # ids pinned on behalf of each client session (reference: Ray Client
+        # server-side per-session pinning); a session's pins release when its
+        # connection drops (or at stop for sessions that never disconnect)
+        self._pins_by_client: dict = {}  # client_id -> set[ObjectID]
+        self._exported_fns: set = set()
 
     async def _find_raylet(self):
         from .._internal.node_lookup import find_raylet_address
@@ -63,6 +65,8 @@ class ClientServer:
         self.server.register("client_connect", self._handle_connect)
         self.server.register("worker_op", self._handle_worker_op)
         self.server.register("proxy_rpc", self._handle_proxy_rpc)
+        self.server.register("xlang_task", self._handle_xlang_task)
+        self.server.on_connection_lost(self._on_client_disconnect)
         bound = await self.server.start(host, port)
         self.address = (host, bound)
         logger.info("client server on %s", self.address)
@@ -71,13 +75,25 @@ class ClientServer:
     async def stop(self):
         await self.server.stop()
         if self.worker is not None:
-            with self.worker._ref_lock:
-                pinned, self._pinned_ids = self._pinned_ids, set()
-                for oid in pinned:
-                    self.worker._local_refs[oid] -= 1
-            for oid in pinned:
-                self.worker._maybe_free(oid)
+            for client_id in list(self._pins_by_client):
+                self._release_client(client_id)
             await self.worker.shutdown()
+
+    def _release_client(self, client_id: str):
+        pinned = self._pins_by_client.pop(client_id, None)
+        if not pinned or self.worker is None:
+            return
+        with self.worker._ref_lock:
+            for oid in pinned:
+                self.worker._local_refs[oid] -= 1
+        for oid in pinned:
+            self.worker._maybe_free(oid)
+
+    def _on_client_disconnect(self, peer_meta: dict):
+        client_id = peer_meta.get("client_id")
+        if client_id:
+            logger.info("client %s disconnected; releasing its pins", client_id)
+            self._release_client(client_id)
 
     # -- handlers -----------------------------------------------------------
 
@@ -88,17 +104,18 @@ class ClientServer:
             "gcs_address": self.gcs_address,
         }
 
-    def _pin(self, object_ids):
-        """Hold a local ref on behalf of clients so the owner worker doesn't
-        free objects the client still references (clients have no in-cluster
-        refcount presence)."""
+    def _pin(self, object_ids, client_id: str):
+        """Hold a local ref on behalf of a client session so the owner worker
+        doesn't free objects the client still references (clients have no
+        in-cluster refcount presence). Released on that client's disconnect."""
+        pins = self._pins_by_client.setdefault(client_id, set())
         with self.worker._ref_lock:
             for oid in object_ids:
-                if oid not in self._pinned_ids:
-                    self._pinned_ids.add(oid)
+                if oid not in pins:
+                    pins.add(oid)
                     self.worker._local_refs[oid] += 1
 
-    async def _handle_worker_op(self, op: str, *args):
+    async def _handle_worker_op(self, client_id: str, op: str, *args):
         if op not in self.ALLOWED_OPS:
             raise ValueError(f"worker_op {op!r} not allowed")
         fn = getattr(self.worker, op)
@@ -106,15 +123,90 @@ class ClientServer:
         if asyncio.iscoroutine(result):
             result = await result
         if op == "put":
-            self._pin([result])
+            self._pin([result], client_id)
         elif op in ("submit_task", "submit_actor_task"):
-            self._pin(result)
+            self._pin(result, client_id)
         return result
 
     async def _handle_proxy_rpc(self, address, method: str, *args):
         return await self.worker.client_pool.get(*tuple(address)).call(
             method, *args
         )
+
+    # -- cross-language entry (reference: ray.cross_language P28 + the C++
+    # frontend N25): non-Python clients submit named Python functions with
+    # JSON args; the reply is ALWAYS a JSON string so a minimal non-Python
+    # pickle reader can parse the response frame -----------------------------
+
+    async def _handle_xlang_task(
+        self, module: str, qualname: str, args_json: str,
+        num_cpus: float = 1.0, timeout: float = 120.0,
+    ) -> str:
+        import hashlib
+        import json
+
+        from .._internal import args as arglib
+        from .._internal import serialization
+        from .._internal.protocol import (
+            FunctionDescriptor,
+            TaskArg,
+            TaskSpec,
+            TaskType,
+        )
+        from ..object_ref import ObjectRef
+
+        try:
+            worker = self.worker
+            pickled = serialization.dumps(_xlang_exec)
+            fn_hash = hashlib.sha1(pickled).hexdigest()
+            if fn_hash not in self._exported_fns:
+                await worker.client_pool.get(*self.gcs_address).call(
+                    "kv_put", f"fn:{fn_hash}", pickled, True
+                )
+                self._exported_fns.add(fn_hash)
+            structure, _refs = arglib.flatten((module, qualname, args_json), {})
+            spec = TaskSpec(
+                task_id=worker.next_task_id(),
+                job_id=worker.job_id,
+                task_type=TaskType.NORMAL_TASK,
+                function=FunctionDescriptor(
+                    module=_xlang_exec.__module__,
+                    qualname="_xlang_exec",
+                    function_hash=fn_hash,
+                ),
+                args=[TaskArg(value=serialization.pack(structure))],
+                num_returns=1,
+                resources={"CPU": float(num_cpus)},
+                owner_worker_id=worker.worker_id,
+                owner_address=worker.address,
+            )
+            return_ids = await worker.submit_task(spec)
+            ref = ObjectRef(return_ids[0], worker.address, _register=False)
+            try:
+                values = await worker.get_objects([ref], timeout)
+            finally:
+                # the result was handed to the caller; drop the owner-side
+                # entry or every xlang call leaks one memory-store object
+                worker._maybe_free(ref.id)
+            return values[0]  # _xlang_exec already returns a JSON envelope
+        except Exception as e:  # noqa: BLE001 — JSON-encodable error reply
+            return json.dumps({"ok": False, "error": repr(e)})
+
+
+def _xlang_exec(module: str, qualname: str, args_json: str) -> str:
+    """Runs in a worker: import + call the named function with JSON args."""
+    import importlib
+    import json
+
+    try:
+        obj = importlib.import_module(module)
+        for part in qualname.split("."):
+            obj = getattr(obj, part)
+        args = json.loads(args_json) if args_json else []
+        out = obj(**args) if isinstance(args, dict) else obj(*args)
+        return json.dumps({"ok": True, "value": out})
+    except Exception as e:  # noqa: BLE001
+        return json.dumps({"ok": False, "error": repr(e)})
 
 
 def start_client_server(

@@ -68,10 +68,18 @@ class ClientWorker:
         namespace: str = "",
         runtime_env: Optional[dict] = None,
     ):
+        import uuid
+
         self.config = config or Config()
         self.loop_thread = LoopThread("ray_tpu-client")
         self.loop = self.loop_thread.loop
-        self._server = RpcClient(host, port, name="ray-client")
+        # session identity: the server releases this session's object pins
+        # when the connection carrying this id drops
+        self._client_id = uuid.uuid4().hex
+        self._server = RpcClient(
+            host, port, name="ray-client",
+            register_meta={"client_id": self._client_id},
+        )
         meta = self.loop_thread.run(
             self._server.call("client_connect"), timeout=30
         )
@@ -104,8 +112,9 @@ class ClientWorker:
 
     def register_ref(self, ref) -> None:
         """Client-held refs pin their objects on the server driver for the
-        lifetime of the session (reference: Ray Client server-side
-        per-session pinning); per-ref release happens at disconnect."""
+        lifetime of this session (reference: Ray Client server-side
+        per-session pinning); the whole session's pins release when this
+        client's connection drops."""
 
     def unregister_ref(self, ref) -> None:
         pass
@@ -113,28 +122,29 @@ class ClientWorker:
     # -- delegated operations ----------------------------------------------
 
     async def put(self, value: Any, object_id: Optional[ObjectID] = None):
-        return await self._server.call("worker_op", "put", value, object_id)
+        return await self._server.call("worker_op", self._client_id, "put", value, object_id)
 
     async def get_objects(self, refs: List[Any], timeout: Optional[float] = None):
-        return await self._server.call("worker_op", "get_objects", refs, timeout)
+        return await self._server.call("worker_op", self._client_id, "get_objects", refs, timeout)
 
     async def wait(self, refs, num_returns: int, timeout, fetch_local: bool = True):
         return await self._server.call(
-            "worker_op", "wait", refs, num_returns, timeout, fetch_local
+            "worker_op", self._client_id, "wait", refs, num_returns, timeout,
+            fetch_local,
         )
 
     async def submit_task(self, spec) -> List[ObjectID]:
-        return await self._server.call("worker_op", "submit_task", spec)
+        return await self._server.call("worker_op", self._client_id, "submit_task", spec)
 
     async def create_actor(self, spec, detached: bool) -> ActorID:
-        return await self._server.call("worker_op", "create_actor", spec, detached)
+        return await self._server.call("worker_op", self._client_id, "create_actor", spec, detached)
 
     async def submit_actor_task(self, spec) -> List[ObjectID]:
-        return await self._server.call("worker_op", "submit_actor_task", spec)
+        return await self._server.call("worker_op", self._client_id, "submit_actor_task", spec)
 
     async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         return await self._server.call(
-            "worker_op", "kill_actor", actor_id, no_restart
+            "worker_op", self._client_id, "kill_actor", actor_id, no_restart
         )
 
     def attach_actor(self, actor_id, info=None):
@@ -145,7 +155,7 @@ class ClientWorker:
         relay and let it complete in the background."""
         import asyncio
 
-        coro = self._server.call("worker_op", "attach_actor", actor_id, info)
+        coro = self._server.call("worker_op", self._client_id, "attach_actor", actor_id, info)
         try:
             running = asyncio.get_running_loop()
         except RuntimeError:

@@ -127,13 +127,22 @@ class Raylet:
         self.server.on_connection_lost(self._on_connection_lost)
         bound = await self.server.start(host, port)
         self.address = (host, bound)
+        # the auth token ships to workers via env, NOT the --config argv JSON
+        # (argv is world-readable through /proc/<pid>/cmdline). The key is
+        # OMITTED — an empty value would overwrite the env-provided token in
+        # the worker's Config.from_json.
+        import json as _json
+
+        cfg_dict = _json.loads(self.config.to_json())
+        cfg_dict.pop("cluster_auth_token", None)
         self.worker_pool = WorkerPool(
             self.node_id,
             lambda: self.address[1],
             self.gcs_address,
             self.session_id,
             self.config.max_workers_per_node,
-            self.config.to_json(),
+            _json.dumps(cfg_dict),
+            auth_token=self.config.cluster_auth_token,
         )
         gcs = self.client_pool.get(*self.gcs_address)
         info = NodeInfo(

@@ -42,6 +42,7 @@ class WorkerPool:
         session_id: str,
         max_workers: int,
         config_json: str,
+        auth_token: str = "",
     ):
         self._node_id = node_id
         self._raylet_port_getter = raylet_port_getter
@@ -49,6 +50,7 @@ class WorkerPool:
         self._session_id = session_id
         self._max_workers = max_workers
         self._config_json = config_json
+        self._auth_token = auth_token
         self._idle: List[WorkerHandle] = []
         self._registered: Dict[WorkerID, WorkerHandle] = {}
         self._spawned_procs: Dict[int, subprocess.Popen] = {}  # pid -> proc
@@ -80,6 +82,9 @@ class WorkerPool:
         """Start one worker subprocess; it will dial back and register."""
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self._node_id.hex()
+        if self._auth_token:
+            # Config.__post_init__ picks this up (cluster_auth_token field)
+            env["RAY_TPU_CLUSTER_AUTH_TOKEN"] = self._auth_token
         env.update(env_overrides or {})
         if runtime_env:
             import json as _json
@@ -111,6 +116,7 @@ class WorkerPool:
             "--gcs-port", str(self._gcs_address[1]),
             "--node-id", self._node_id.hex(),
             "--session", self._session_id,
+            "--config", self._config_json,
         ]
         proc = subprocess.Popen(
             cmd,

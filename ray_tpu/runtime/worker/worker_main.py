@@ -32,6 +32,10 @@ async def main(args):
     config = Config()
     if args.config:
         config = Config.from_json(args.config)
+    if config.cluster_auth_token:
+        from ..._internal.rpc import set_auth_token
+
+        set_auth_token(config.cluster_auth_token)
     if config.testing_rpc_failure:
         import json
 

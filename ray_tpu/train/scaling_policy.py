@@ -84,7 +84,10 @@ class ElasticScalingPolicy(ScalingPolicy):
     def decide(self, attempt: int) -> ScalingDecision:
         import time
 
-        deadline = time.time() + self.grace_s
+        # full grace only on the initial start; a failure restart should
+        # recover promptly with whatever capacity is present now
+        grace = self.grace_s if attempt == 0 else 0.0
+        deadline = time.time() + grace
         n = self._fit_to_cluster()
         while n < self.max_workers and time.time() < deadline:
             time.sleep(0.5)

@@ -1,0 +1,79 @@
+"""Token RPC auth (reference: rpc/authentication/, enable_cluster_auth)."""
+
+import pytest
+
+
+@pytest.fixture
+def reset_token():
+    yield
+    from ray_tpu._internal.rpc import set_auth_token
+
+    set_auth_token(None)
+
+
+def test_cluster_with_auth_token_works(shutdown_only, reset_token):
+    import ray_tpu
+
+    ray_tpu.init(
+        num_cpus=2, _system_config={"cluster_auth_token": "s3cret"}
+    )
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(41), timeout=60) == 42
+
+    @ray_tpu.remote
+    class A:
+        def g(self):
+            return "ok"
+
+    a = A.remote()
+    assert ray_tpu.get(a.g.remote(), timeout=60) == "ok"
+
+
+def test_wrong_token_rejected(shutdown_only, reset_token):
+    """The probe runs in a subprocess: the auth token is process-global, so
+    an in-process probe would share the server's own token."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    import ray_tpu
+
+    node = ray_tpu.init(
+        num_cpus=2, _system_config={"cluster_auth_token": "s3cret"}
+    )
+    gcs_host, gcs_port = node.gcs_address
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def probe(token):
+        script = textwrap.dedent(
+            f"""
+            import asyncio, sys
+            sys.path.insert(0, {repo!r})
+            from ray_tpu._internal.rpc import RpcClient, set_auth_token
+
+            async def main():
+                set_auth_token({token!r} or None)
+                client = RpcClient({gcs_host!r}, {gcs_port}, name="probe")
+                nodes = await client.call("get_all_nodes", timeout=5)
+                await client.close()
+                print("GOT", len(nodes))
+
+            asyncio.run(main())
+            """
+        )
+        return subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    ok = probe("s3cret")
+    assert ok.returncode == 0 and "GOT 1" in ok.stdout, (ok.stdout, ok.stderr)
+    for bad in ("wrong", ""):
+        denied = probe(bad)
+        assert denied.returncode != 0, (bad, denied.stdout)
+        assert "GOT" not in denied.stdout

@@ -1,0 +1,81 @@
+"""C++ frontend / cross-language calls (reference: cpp/ API frontend +
+ray.cross_language): a C++ client submits named Python functions with JSON
+args through the client server and the cluster runs them as tasks."""
+
+import ctypes
+import json
+import os
+import subprocess
+
+import pytest
+
+import ray_tpu
+from ray_tpu._native.build import build_xlang
+
+
+@pytest.fixture(scope="module")
+def xlang_binaries():
+    return build_xlang()
+
+
+@pytest.fixture
+def cluster_with_client_server(shutdown_only):
+    node = ray_tpu.init(
+        num_cpus=4, _system_config={"client_server_port": 0}
+    )
+    yield node.client_server.address
+
+
+def test_cpp_cli_calls_python_function(cluster_with_client_server, xlang_binaries):
+    host, port = cluster_with_client_server
+    binary, _lib = xlang_binaries
+    out = subprocess.run(
+        [binary, host, str(port), "math", "hypot", "[3, 4]"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    reply = json.loads(out.stdout)
+    assert reply == {"ok": True, "value": 5.0}
+
+
+def test_cpp_cli_error_envelope(cluster_with_client_server, xlang_binaries):
+    host, port = cluster_with_client_server
+    binary, _lib = xlang_binaries
+    out = subprocess.run(
+        [binary, host, str(port), "math", "no_such_fn", "[]"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0
+    reply = json.loads(out.stdout)
+    assert reply["ok"] is False
+    assert "no_such_fn" in reply["error"]
+
+
+def test_ctypes_lib_roundtrip(cluster_with_client_server, xlang_binaries):
+    host, port = cluster_with_client_server
+    _binary, libpath = xlang_binaries
+    lib = ctypes.CDLL(libpath)
+    lib.ray_tpu_xlang_connect.restype = ctypes.c_void_p
+    lib.ray_tpu_xlang_connect.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+    ]
+    lib.ray_tpu_xlang_call.restype = ctypes.c_void_p  # manual free
+    lib.ray_tpu_xlang_call.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.ray_tpu_xlang_disconnect.argtypes = [ctypes.c_void_p]
+
+    client = lib.ray_tpu_xlang_connect(host.encode(), port, b"")
+    assert client
+    try:
+        raw = lib.ray_tpu_xlang_call(
+            client, b"json", b"dumps", json.dumps([[1, 2, 3]]).encode()
+        )
+        assert raw
+        reply = json.loads(ctypes.string_at(raw).decode())
+        libc = ctypes.CDLL(None)
+        libc.free(ctypes.c_void_p(raw))
+        assert reply["ok"] is True
+        assert json.loads(reply["value"]) == [1, 2, 3]
+    finally:
+        lib.ray_tpu_xlang_disconnect(client)
