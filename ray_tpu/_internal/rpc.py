@@ -62,15 +62,11 @@ _auth_token: Optional[str] = None
 def set_auth_token(token: Optional[str]):
     """Process-wide shared secret. When set, every RpcServer in this process
     requires clients to present it before any other method, and every
-    RpcClient sends it on connect. Distributed to workers through the config
-    JSON on their command line (the reference ships its cluster ID the same
-    way)."""
+    RpcClient sends it on connect. Workers receive it via the
+    RAY_TPU_CLUSTER_AUTH_TOKEN env var — deliberately NOT via the --config
+    argv JSON, which is world-readable through /proc/<pid>/cmdline."""
     global _auth_token
     _auth_token = token or None
-
-
-def get_auth_token() -> Optional[str]:
-    return _auth_token
 
 
 # ---------------------------------------------------------------------------

@@ -19,7 +19,9 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cerrno>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <stdexcept>
 #include <string>
@@ -237,6 +239,7 @@ class XlangClient {
   // reply is the server's JSON envelope {"ok": ..., "value"/"error": ...}.
   std::string Call(const std::string& module, const std::string& qualname,
                    const std::string& args_json, double timeout_s = 120.0) {
+    SetRecvTimeout(timeout_s);
     int req_id = next_req_id_++;
     Pickler p;
     p.Mark();
@@ -307,9 +310,18 @@ class XlangClient {
     }
   }
 
+  void SetRecvTimeout(double timeout_s) {
+    timeval tv{};
+    tv.tv_sec = static_cast<long>(timeout_s);
+    tv.tv_usec = static_cast<long>((timeout_s - tv.tv_sec) * 1e6);
+    ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  }
+
   void RecvAll(char* p, size_t n) {
     while (n > 0) {
       ssize_t r = ::recv(fd_, p, n, 0);
+      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+        throw std::runtime_error("recv timed out");
       if (r <= 0) throw std::runtime_error("connection closed");
       p += r;
       n -= static_cast<size_t>(r);
@@ -359,13 +371,17 @@ void ray_tpu_xlang_disconnect(void* client) {
 
 int main(int argc, char** argv) {
   if (argc < 6) {
-    std::fprintf(stderr,
-                 "usage: %s <host> <port> <module> <function> <args_json>\n",
-                 argv[0]);
+    std::fprintf(
+        stderr,
+        "usage: %s <host> <port> <module> <function> <args_json> [auth_token]\n"
+        "       (auth_token also read from RAY_TPU_CLUSTER_AUTH_TOKEN)\n",
+        argv[0]);
     return 2;
   }
   try {
-    ray_tpu::XlangClient client(argv[1], std::atoi(argv[2]));
+    const char* env_token = std::getenv("RAY_TPU_CLUSTER_AUTH_TOKEN");
+    std::string token = argc > 6 ? argv[6] : (env_token ? env_token : "");
+    ray_tpu::XlangClient client(argv[1], std::atoi(argv[2]), token);
     std::string out = client.Call(argv[3], argv[4], argv[5]);
     std::printf("%s\n", out.c_str());
     return 0;

@@ -43,6 +43,7 @@ class ClientServer:
         # server-side per-session pinning); a session's pins release when its
         # connection drops (or at stop for sessions that never disconnect)
         self._pins_by_client: dict = {}  # client_id -> set[ObjectID]
+        self._activity: dict = {}  # client_id -> op counter (reconnect detection)
         self._exported_fns: set = set()
 
     async def _find_raylet(self):
@@ -89,11 +90,26 @@ class ClientServer:
         for oid in pinned:
             self.worker._maybe_free(oid)
 
+    #: seconds a disconnected session's pins survive — RpcClient reconnects
+    #: transparently with the same client_id after a TCP blip, and freeing
+    #: immediately would invalidate refs the continuing session still holds
+    RELEASE_GRACE_S = 60.0
+
     def _on_client_disconnect(self, peer_meta: dict):
         client_id = peer_meta.get("client_id")
-        if client_id:
-            logger.info("client %s disconnected; releasing its pins", client_id)
-            self._release_client(client_id)
+        if not client_id:
+            return
+        seen = self._activity.get(client_id, 0)
+        asyncio.get_event_loop().call_later(
+            self.RELEASE_GRACE_S, self._release_if_inactive, client_id, seen
+        )
+
+    def _release_if_inactive(self, client_id: str, activity_at_disconnect: int):
+        if self._activity.get(client_id, 0) != activity_at_disconnect:
+            return  # the session reconnected and kept working
+        logger.info("client %s gone; releasing its pins", client_id)
+        self._activity.pop(client_id, None)
+        self._release_client(client_id)
 
     # -- handlers -----------------------------------------------------------
 
@@ -118,6 +134,7 @@ class ClientServer:
     async def _handle_worker_op(self, client_id: str, op: str, *args):
         if op not in self.ALLOWED_OPS:
             raise ValueError(f"worker_op {op!r} not allowed")
+        self._activity[client_id] = self._activity.get(client_id, 0) + 1
         fn = getattr(self.worker, op)
         result = fn(*args)
         if asyncio.iscoroutine(result):
@@ -184,10 +201,21 @@ class ClientServer:
             ref = ObjectRef(return_ids[0], worker.address, _register=False)
             try:
                 values = await worker.get_objects([ref], timeout)
-            finally:
-                # the result was handed to the caller; drop the owner-side
-                # entry or every xlang call leaks one memory-store object
-                worker._maybe_free(ref.id)
+            except Exception:
+                # task still running: freeing now would strip ownership and
+                # orphan the eventual result — reap it in the background
+                # once it materializes
+                async def _reap():
+                    try:
+                        await worker.get_objects([ref], 3600.0)
+                    except Exception:
+                        pass
+                    worker._maybe_free(ref.id)
+
+                asyncio.ensure_future(_reap())
+                raise
+            # result handed to the caller; drop the owner-side entry
+            worker._maybe_free(ref.id)
             return values[0]  # _xlang_exec already returns a JSON envelope
         except Exception as e:  # noqa: BLE001 — JSON-encodable error reply
             return json.dumps({"ok": False, "error": repr(e)})

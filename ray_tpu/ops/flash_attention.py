@@ -109,6 +109,20 @@ def _fwd_kernel(
         lse_ref[0] = m_ref[...] + jnp.log(l_safe)
 
 
+def _causal_kv_index(block_q: int, block_k: int):
+    """Index map clamping the kv block to the q block's diagonal: iterations
+    whose compute is predicated off (whole block above the diagonal) would
+    otherwise still copy their K/V blocks HBM->VMEM; mapping them to the
+    diagonal block makes the index repeat and Pallas elides the copy —
+    ~1/3 less attention HBM traffic at seq=4*block."""
+
+    def index_map(b, i, j):
+        diag = (i * block_q + block_q - 1) // block_k
+        return (b, jnp.minimum(j, diag), 0)
+
+    return index_map
+
+
 def _flash_forward(
     q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int
 ) -> Tuple[jax.Array, jax.Array]:
@@ -130,13 +144,18 @@ def _flash_forward(
         seq_q=sq,
         seq_k=sk,
     )
+    kv_index = (
+        _causal_kv_index(block_q, block_k)
+        if causal and sq == sk
+        else (lambda b, i, j: (b, j, 0))
+    )
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -291,6 +310,11 @@ def flash_bwd_dq(q, k, v, do, lse, delta, *, sm_scale, causal, block_q=256, bloc
     _, sk, _ = k.shape
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    kv_index = (
+        _causal_kv_index(block_q, block_k)
+        if causal and sq == sk
+        else (lambda b, i, j: (b, j, 0))
+    )
     return pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel,
@@ -300,8 +324,8 @@ def flash_bwd_dq(q, k, v, do, lse, delta, *, sm_scale, causal, block_q=256, bloc
         grid=(bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -320,6 +344,17 @@ def flash_bwd_dkv(q, k, v, do, lse, delta, *, sm_scale, causal, block_q=256, blo
     _, sk, _ = k.shape
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    if causal and sq == sk:
+        # mirror of _causal_kv_index: early q blocks entirely above the
+        # diagonal are compute-skipped; clamp their loads to the first
+        # contributing q block so the repeated index elides the copy
+        def q_index(b, j, i):
+            first = (j * block_k) // block_q
+            return (b, jnp.maximum(i, first), 0)
+    else:
+        def q_index(b, j, i):
+            return (b, i, 0)
+
     return pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel,
@@ -328,12 +363,12 @@ def flash_bwd_dkv(q, k, v, do, lse, delta, *, sm_scale, causal, block_q=256, blo
         ),
         grid=(bh, pl.cdiv(sk, block_k), pl.cdiv(sq, block_q)),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_index),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, 1), q_index),
+            pl.BlockSpec((1, block_q, 1), q_index),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),

@@ -84,9 +84,10 @@ class ElasticScalingPolicy(ScalingPolicy):
     def decide(self, attempt: int) -> ScalingDecision:
         import time
 
-        # full grace only on the initial start; a failure restart should
-        # recover promptly with whatever capacity is present now
-        grace = self.grace_s if attempt == 0 else 0.0
+        # full grace only on the initial start; restarts keep a short window
+        # so resources of the just-failed workers can be reclaimed (zero
+        # would snapshot availability mid-teardown and shrink a healthy gang)
+        grace = self.grace_s if attempt == 0 else min(self.grace_s, 3.0)
         deadline = time.time() + grace
         n = self._fit_to_cluster()
         while n < self.max_workers and time.time() < deadline:
