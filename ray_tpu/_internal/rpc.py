@@ -108,6 +108,7 @@ class RpcServer:
         self._handlers: Dict[str, Callable] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conn_lost_cb: Optional[Callable] = None
+        self._conn_registered_cb: Optional[Callable] = None
         self._conns: set[asyncio.StreamWriter] = set()
         self.port: Optional[int] = None
 
@@ -124,6 +125,12 @@ class RpcServer:
         """cb(peer_meta) fires when a client connection drops; used for
         worker-death detection (reference: NodeManager::HandleClientConnectionError)."""
         self._conn_lost_cb = cb
+
+    def on_connection_registered(self, cb: Callable):
+        """cb(peer_meta) fires on every (authenticated) __register__ — i.e.
+        also on transparent reconnects; pairs with on_connection_lost for
+        session liveness tracking."""
+        self._conn_registered_cb = cb
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._server = await asyncio.start_server(self._on_client, host, port)
@@ -185,6 +192,11 @@ class RpcServer:
                                 pass
                         break
                     peer_meta.update(kwargs)
+                    if self._conn_registered_cb is not None:
+                        try:
+                            self._conn_registered_cb(peer_meta)
+                        except Exception:
+                            logger.exception("connection-registered callback failed")
                     if req_id != -1:
                         _write_frame(writer, (req_id, True, None))
                     continue

@@ -373,14 +373,15 @@ int main(int argc, char** argv) {
   if (argc < 6) {
     std::fprintf(
         stderr,
-        "usage: %s <host> <port> <module> <function> <args_json> [auth_token]\n"
-        "       (auth_token also read from RAY_TPU_CLUSTER_AUTH_TOKEN)\n",
+        "usage: %s <host> <port> <module> <function> <args_json>\n"
+        "       (auth token read from RAY_TPU_CLUSTER_AUTH_TOKEN — env only:\n"
+        "        argv is world-readable via /proc/<pid>/cmdline)\n",
         argv[0]);
     return 2;
   }
   try {
     const char* env_token = std::getenv("RAY_TPU_CLUSTER_AUTH_TOKEN");
-    std::string token = argc > 6 ? argv[6] : (env_token ? env_token : "");
+    std::string token = env_token ? env_token : "";
     ray_tpu::XlangClient client(argv[1], std::atoi(argv[2]), token);
     std::string out = client.Call(argv[3], argv[4], argv[5]);
     std::printf("%s\n", out.c_str());

@@ -68,6 +68,9 @@ class ClientServer:
         self.server.register("proxy_rpc", self._handle_proxy_rpc)
         self.server.register("xlang_task", self._handle_xlang_task)
         self.server.on_connection_lost(self._on_client_disconnect)
+        # a transparent reconnect (same client_id) counts as activity so the
+        # disconnect-grace timer never frees a live session's pins
+        self.server.on_connection_registered(self._on_client_register)
         bound = await self.server.start(host, port)
         self.address = (host, bound)
         logger.info("client server on %s", self.address)
@@ -94,6 +97,11 @@ class ClientServer:
     #: transparently with the same client_id after a TCP blip, and freeing
     #: immediately would invalidate refs the continuing session still holds
     RELEASE_GRACE_S = 60.0
+
+    def _on_client_register(self, peer_meta: dict):
+        client_id = peer_meta.get("client_id")
+        if client_id:
+            self._activity[client_id] = self._activity.get(client_id, 0) + 1
 
     def _on_client_disconnect(self, peer_meta: dict):
         client_id = peer_meta.get("client_id")

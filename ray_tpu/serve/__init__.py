@@ -13,18 +13,22 @@ from .api import (
     deployment,
     get_app_handle,
     get_deployment_handle,
+    grpc_proxy_address,
     run,
     shutdown,
     start,
     status,
 )
 from .batching import batch
+from .grpc_proxy import grpc_call
 from .config import AutoscalingConfig, DeploymentConfig
 from .handle import DeploymentHandle, DeploymentResponse
 from .multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "batch",
+    "grpc_call",
+    "grpc_proxy_address",
     "multiplexed",
     "get_multiplexed_model_id",
     "deployment",

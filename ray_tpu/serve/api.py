@@ -103,11 +103,16 @@ def deployment(_target=None, **options):
 
 
 def start(
-    *, http_host: str = "127.0.0.1", http_port: int = 8000, proxy: bool = True
+    *,
+    http_host: str = "127.0.0.1",
+    http_port: int = 8000,
+    proxy: bool = True,
+    grpc_port: Optional[int] = None,
 ):
     """Start (or connect to) the Serve control plane (reference:
     serve.start): a detached-ish named controller actor plus one HTTP proxy
-    actor."""
+    actor, and — with ``grpc_port`` — a gRPC ingress (reference: the gRPC
+    proxy, proxy.py:533; 0 picks a free port, see serve.grpc_proxy_address)."""
     if _state["controller"] is None:
         try:
             controller = ray_api.get_actor(CONTROLLER_NAME)
@@ -125,7 +130,22 @@ def start(
         p = Proxy.remote(_state["controller"], http_host, http_port)
         ray_api.get(p.ping.remote())
         _state["proxy"] = p
+    if grpc_port is not None and _state.get("grpc_proxy") is None:
+        from .grpc_proxy import GRPCProxy
+
+        GProxy = ray_api.remote(num_cpus=0)(GRPCProxy)
+        gp = GProxy.remote(_state["controller"], http_host, grpc_port)
+        ray_api.get(gp.ping.remote())
+        _state["grpc_proxy"] = gp
     return _state["controller"]
+
+
+def grpc_proxy_address():
+    """(host, port) of the running gRPC ingress, or None."""
+    gp = _state.get("grpc_proxy")
+    if gp is None:
+        return None
+    return ray_api.get(gp.address.remote())
 
 
 def run(
@@ -239,13 +259,14 @@ def shutdown():
             ray_api.kill(controller)
         except Exception:
             pass
-    proxy = _state["proxy"]
-    if proxy is not None:
-        try:
-            ray_api.kill(proxy)
-        except Exception:
-            pass
-    _state.update(controller=None, proxy=None, ingress={})
+    for key in ("proxy", "grpc_proxy"):
+        p = _state.get(key)
+        if p is not None:
+            try:
+                ray_api.kill(p)
+            except Exception:
+                pass
+    _state.update(controller=None, proxy=None, grpc_proxy=None, ingress={})
 
 
 def _require_controller():

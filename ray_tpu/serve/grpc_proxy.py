@@ -1,0 +1,136 @@
+"""gRPC proxy actor: the cluster's second ingress.
+
+Role-equivalent of the reference's gRPC proxy path (serve/_private/proxy.py
+gRPC handling :533 + serve.proto's user-defined services): a grpc.aio
+server routes RPCs to deployments through DeploymentHandles. The reference
+compiles user .proto services; this environment has no protoc plugin for
+Python, so the service is a generic bytes-in/bytes-out surface
+(``/ray_tpu.serve.ServeAPI/Call``) carrying a JSON envelope
+{"application", "method", "payload"} — any gRPC client in any language can
+speak it without generated stubs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+SERVICE_NAME = "ray_tpu.serve.ServeAPI"
+
+
+class GRPCProxy:
+    """Actor: runs a grpc.aio server in a dedicated thread+loop."""
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 9000):
+        self._controller = controller
+        self._host = host
+        self._port = port
+        self._bound_port: Optional[int] = None
+        self._handles: Dict[str, object] = {}
+        self._ready = threading.Event()
+        self._error: Optional[str] = None
+        self._thread = threading.Thread(
+            target=self._serve_forever, daemon=True, name="grpc-proxy"
+        )
+        self._thread.start()
+        if not self._ready.wait(timeout=15):
+            raise RuntimeError(f"gRPC proxy failed to start: {self._error}")
+        if self._error is not None:
+            raise RuntimeError(f"gRPC proxy failed to start: {self._error}")
+
+    def _serve_forever(self):
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(self._start_server())
+            loop.run_forever()
+        except Exception as e:  # noqa: BLE001
+            self._error = repr(e)
+            self._ready.set()
+
+    async def _start_server(self):
+        import grpc
+
+        server = grpc.aio.server()
+        rpc_handlers = {
+            "Call": grpc.unary_unary_rpc_method_handler(
+                self._handle_call,
+                request_deserializer=None,  # raw bytes through
+                response_serializer=None,
+            ),
+            "Healthz": grpc.unary_unary_rpc_method_handler(
+                self._handle_health,
+            ),
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, rpc_handlers),)
+        )
+        self._bound_port = server.add_insecure_port(
+            f"{self._host}:{self._port}"
+        )
+        await server.start()
+        self._server = server
+        self._ready.set()
+
+    async def _handle_health(self, request: bytes, context) -> bytes:
+        return b'{"status": "ok"}'
+
+    async def _handle_call(self, request: bytes, context) -> bytes:
+        try:
+            envelope = json.loads(request or b"{}")
+            app_name = envelope.get("application", "default")
+            method = envelope.get("method", "__call__")
+            payload = envelope.get("payload")
+            result = await asyncio.get_event_loop().run_in_executor(
+                None, self._call_ingress, app_name, method, payload
+            )
+            if isinstance(result, Exception):
+                return json.dumps({"ok": False, "error": repr(result)}).encode()
+            return json.dumps({"ok": True, "result": result}).encode()
+        except Exception as e:  # noqa: BLE001
+            return json.dumps({"ok": False, "error": repr(e)}).encode()
+
+    def _call_ingress(self, app_name: str, method: str, payload):
+        from .api import get_app_handle
+
+        try:
+            handle = self._handles.get(app_name)
+            if handle is None:
+                handle = get_app_handle(app_name, _controller=self._controller)
+                self._handles[app_name] = handle
+            if method != "__call__":
+                handle = handle.options(method_name=method)
+            return handle.remote(payload).result(timeout_s=60)
+        except Exception as e:  # noqa: BLE001
+            return e
+
+    # -- control -------------------------------------------------------------
+
+    def address(self):
+        return (self._host, self._bound_port or self._port)
+
+    def ping(self):
+        return True
+
+
+def grpc_call(address, payload, *, application="default", method="__call__",
+              timeout_s: float = 60.0):
+    """Client helper: one RPC against a GRPCProxy from any process
+    (reference: generated stubs; here a generic bytes channel)."""
+    import grpc
+
+    host, port = address
+    envelope = json.dumps(
+        {"application": application, "method": method, "payload": payload}
+    ).encode()
+    with grpc.insecure_channel(f"{host}:{port}") as channel:
+        fn = channel.unary_unary(f"/{SERVICE_NAME}/Call")
+        reply = json.loads(fn(envelope, timeout=timeout_s))
+    if not reply.get("ok"):
+        raise RuntimeError(f"serve gRPC error: {reply.get('error')}")
+    return reply.get("result")

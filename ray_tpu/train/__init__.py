@@ -10,6 +10,11 @@ from . import collective
 from .backend import BackendConfig, JaxConfig, TorchConfig
 from .callbacks import TPUReservationCallback, TrainCallback
 from .checkpoint import Checkpoint, CheckpointManager, load_latest_checkpoint
+from .sharded_checkpoint import (
+    ShardedCheckpointWriter,
+    restore_sharded,
+    save_sharded,
+)
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
 from .controller import Result, RunState, TrainController
 from .session import (
@@ -23,6 +28,9 @@ from .trainer import DataParallelTrainer, JaxTrainer, TorchTrainer
 from .worker_group import WorkerGroup
 
 __all__ = [
+    "save_sharded",
+    "restore_sharded",
+    "ShardedCheckpointWriter",
     "BackendConfig",
     "JaxConfig",
     "TorchConfig",

@@ -390,3 +390,29 @@ def test_local_testing_mode_batching_and_multiplex():
         .result(5)
     )
     assert out == "m7"
+
+
+def test_grpc_ingress(ray_start_regular):
+    """gRPC proxy routes to deployments (reference: serve gRPC proxy path,
+    proxy.py:533) via the generic bytes service."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+        def shout(self, payload):
+            return str(payload).upper()
+
+    serve.start(proxy=False, grpc_port=0)
+    serve.run(Echo.bind(), _proxy=False)
+    try:
+        addr = serve.grpc_proxy_address()
+        assert addr is not None
+        out = serve.grpc_call(addr, {"x": 1})
+        assert out == {"echo": {"x": 1}}
+        out2 = serve.grpc_call(addr, "hi", method="shout")
+        assert out2 == "HI"
+    finally:
+        serve.shutdown()
