@@ -392,7 +392,7 @@ def test_local_testing_mode_batching_and_multiplex():
     assert out == "m7"
 
 
-def test_grpc_ingress(ray_start_regular):
+def test_grpc_ingress(cluster):
     """gRPC proxy routes to deployments (reference: serve gRPC proxy path,
     proxy.py:533) via the generic bytes service."""
     from ray_tpu import serve
