@@ -1,0 +1,6 @@
+"""ray_tpu.models: TPU-first model families (GSPMD logical-axis sharding).
+
+Llama (causal LM + LoRA + KV-cache decode), MoE transformer (expert
+parallel), ViT (vision encoder). The reference delegates model execution to
+torch/vLLM; this framework owns it.
+"""

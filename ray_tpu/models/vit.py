@@ -1,0 +1,188 @@
+"""Vision Transformer, TPU-first.
+
+No reference analogue: the reference trains vision models through torch
+(e.g. the ResNet-50 DataParallelTrainer config in BASELINE.json); this
+framework owns the model-execution layer, so the vision family is a ViT
+built the same way as the Llama family — flax modules with logical-axis
+annotations (parallel/sharding.py rule table → GSPMD collectives), the
+Pallas flash kernel for (non-causal) encoder attention, bf16 activations
+over f32 params, and optional per-layer remat.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..ops.flash_attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def base(**kw) -> "ViTConfig":  # ViT-B/16
+        return ViTConfig(**kw)
+
+    @staticmethod
+    def large(**kw) -> "ViTConfig":  # ViT-L/16
+        return ViTConfig(
+            dim=1024, n_layers=24, n_heads=16, mlp_dim=4096, **kw
+        )
+
+    @staticmethod
+    def tiny(**kw) -> "ViTConfig":
+        defaults = dict(
+            image_size=32, patch_size=8, num_classes=10, dim=64,
+            n_layers=2, n_heads=4, mlp_dim=128,
+        )
+        defaults.update(kw)
+        return ViTConfig(**defaults)
+
+
+def _dense(features, logical_axes, name, cfg, use_bias=True):
+    return nn.DenseGeneral(
+        features=features,
+        use_bias=use_bias,
+        name=name,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.xavier_uniform(), logical_axes
+        ),
+    )
+
+
+class EncoderBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        b, s, _ = x.shape
+        h, d = cfg.n_heads, cfg.head_dim
+
+        y = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        q = _dense(h * d, ("embed", "heads"), "wq", cfg)(y)
+        k = _dense(h * d, ("embed", "heads"), "wk", cfg)(y)
+        v = _dense(h * d, ("embed", "heads"), "wv", cfg)(y)
+        q = q.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        k = k.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        v = v.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        # bidirectional attention: every patch sees every patch
+        attn = flash_attention(q, k, v, causal=False)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        x = x + _dense(cfg.dim, ("heads", "embed"), "wo", cfg)(attn)
+
+        y = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+        y = _dense(cfg.mlp_dim, ("embed", "mlp"), "fc1", cfg)(y)
+        y = nn.gelu(y)
+        y = _dense(cfg.dim, ("mlp", "embed"), "fc2", cfg)(y)
+        if cfg.dropout > 0:
+            y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return x + y
+
+
+class ViT(nn.Module):
+    config: ViTConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, images, deterministic: bool = True):
+        """images: (batch, H, W, C) float -> (batch, num_classes) logits."""
+        cfg = self.config
+        b = images.shape[0]
+        p = cfg.patch_size
+        # patchify as one strided conv = one big MXU matmul per patch grid
+        x = nn.Conv(
+            features=cfg.dim,
+            kernel_size=(p, p),
+            strides=(p, p),
+            padding="VALID",
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(),
+                (None, None, None, "embed"),
+            ),
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        x = x.reshape(b, -1, cfg.dim)  # (b, patches, dim)
+        cls = self.param(
+            "cls_token",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (None, None, "embed")
+            ),
+            (1, 1, cfg.dim),
+            cfg.param_dtype,
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(cfg.dtype), (b, 1, cfg.dim)), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "seq", "embed")
+            ),
+            (1, cfg.num_patches + 1, cfg.dim),
+            cfg.param_dtype,
+        )
+        x = x + pos.astype(cfg.dtype)
+
+        block = EncoderBlock
+        if cfg.remat:
+            block = nn.remat(EncoderBlock, prevent_cse=False)
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"layer_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_final")(x)
+        cls_out = x[:, 0]  # classification token
+        head = self.param(
+            "head",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("embed", "vocab")
+            ),
+            (cfg.dim, cfg.num_classes),
+            cfg.param_dtype,
+        )
+        return (cls_out @ head.astype(cls_out.dtype)).astype(jnp.float32)
+
+
+def init_params(config: ViTConfig, rng):
+    model = ViT(config)
+    images = jnp.zeros(
+        (1, config.image_size, config.image_size, 3), jnp.float32
+    )
+    return model.init(rng, images)["params"]
+
+
+def classification_loss(config: ViTConfig, mesh, params, images, labels):
+    """Softmax cross-entropy via the fused logsumexp form."""
+    logits = ViT(config, mesh).apply({"params": params}, images)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return (lse - tgt).mean()
