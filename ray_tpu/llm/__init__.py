@@ -10,13 +10,19 @@ mesh (GSPMD), replicas scheduled on TPU resources through serve.
 """
 
 from .config import LLMConfig
-from .engine import LLMEngine, GenerationRequest, GenerationResult
+from .engine import (
+    ContinuousBatchingEngine,
+    GenerationRequest,
+    GenerationResult,
+    LLMEngine,
+)
 from .serving import build_llm_deployment
 from .batch import LLMPredictor
 
 __all__ = [
     "LLMConfig",
     "LLMEngine",
+    "ContinuousBatchingEngine",
     "GenerationRequest",
     "GenerationResult",
     "build_llm_deployment",

@@ -45,19 +45,19 @@ class GenerationResult:
     finished_reason: str  # "eos" | "length"
 
 
-class LLMEngine:
-    def __init__(self, model_config, params, mesh=None, max_batch_size: int = 8):
+class _DecodeModelBase:
+    """Shared jitted prefill/decode programs over the cached Llama
+    (both engines compile the identical two programs)."""
+
+    def __init__(self, model_config, params, mesh=None):
         from ..models.llama import Llama
 
         self._cfg = model_config
         self._params = params
         self._mesh = mesh
-        self._max_batch = max_batch_size
         self._model = Llama(model_config, mesh, decode=True)
         self._prefill = jax.jit(self._prefill_impl)
         self._decode = jax.jit(self._decode_impl)
-
-    # -- jitted programs -----------------------------------------------------
 
     def _prefill_impl(self, params, tokens):
         logits, vars_out = self._model.apply(
@@ -70,6 +70,12 @@ class LLMEngine:
             {"params": params, "cache": cache}, last_tokens, mutable=["cache"]
         )
         return logits[:, -1, :], vars_out["cache"]
+
+
+class LLMEngine(_DecodeModelBase):
+    def __init__(self, model_config, params, mesh=None, max_batch_size: int = 8):
+        super().__init__(model_config, params, mesh)
+        self._max_batch = max_batch_size
 
     # -- generation ----------------------------------------------------------
 
@@ -156,3 +162,162 @@ class LLMEngine:
         return np.asarray(
             jnp.where(jnp.asarray(temps) == 0.0, greedy, sampled)
         )
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: int
+    request: GenerationRequest
+    generated: List[int]
+    last_token: int
+
+
+class ContinuousBatchingEngine(_DecodeModelBase):
+    """Continuous (in-flight) batching: a fixed pool of decode slots; new
+    requests prefill into free slots while other slots keep decoding, so
+    short requests don't wait for long ones and the decode batch stays full.
+
+    Role-equivalent of vLLM's continuous batching scheduler behind
+    ``ray.llm`` (llm/_internal/serve — AsyncLLMEngine admission), TPU-style:
+    static shapes throughout. The decode program is ONE jitted step over the
+    full (num_slots, 1) batch with a PER-ROW cache index (models/llama.py
+    decode path); prefill runs per request at its prompt length and the
+    resulting K/V rows are inserted into the pooled cache. XLA compiles one
+    decode program + one prefill program per prompt-length bucket.
+    """
+
+    def __init__(self, model_config, params, mesh=None, num_slots: int = 8):
+        super().__init__(model_config, params, mesh)
+        self._num_slots = num_slots
+        self._slots: Dict[int, _Slot] = {}  # slot index -> active request
+        self._pending: List[tuple] = []  # (request_id, GenerationRequest)
+        self._results: Dict[int, GenerationResult] = {}
+        self._next_id = 0
+        self._rng = jax.random.PRNGKey(0)
+        self._step_count = 0
+        self._cache = None  # pooled cache, allocated on first prefill
+
+    # -- public API ----------------------------------------------------------
+
+    def add_request(self, request: GenerationRequest) -> int:
+        if len(request.token_ids) + request.max_new_tokens > self._cfg.max_seq_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        rid = self._next_id
+        self._next_id += 1
+        self._pending.append((rid, request))
+        return rid
+
+    @property
+    def num_active(self) -> int:
+        return len(self._slots) + len(self._pending)
+
+    def step(self) -> List[tuple]:
+        """One engine iteration: admit pending requests into free slots
+        (prefill), decode one token for every occupied slot, retire finished
+        requests. Returns [(request_id, GenerationResult)] finished now."""
+        finished: List[tuple] = self._admit()
+        if not self._slots:
+            return finished
+        # one decode step for the whole pool; free rows compute garbage at
+        # their stale positions (static-shape trade) and are ignored
+        last = np.zeros((self._num_slots, 1), np.int32)
+        for si, slot in self._slots.items():
+            last[si, 0] = slot.last_token
+        logits, self._cache = self._decode(
+            self._params, self._cache, jnp.asarray(last)
+        )
+        self._step_count += 1
+        tokens = self._sample_rows(logits)
+        for si in list(self._slots):
+            slot = self._slots[si]
+            tok = int(tokens[si])
+            slot.generated.append(tok)
+            slot.last_token = tok
+            req = slot.request
+            done_eos = req.eos_token_id is not None and tok == req.eos_token_id
+            done_len = len(slot.generated) >= req.max_new_tokens
+            if done_eos or done_len:
+                result = GenerationResult(
+                    token_ids=slot.generated[: req.max_new_tokens],
+                    num_prompt_tokens=len(req.token_ids),
+                    finished_reason="eos" if done_eos else "length",
+                )
+                self._results[slot.request_id] = result
+                finished.append((slot.request_id, result))
+                del self._slots[si]  # slot is free for the next admit
+        return finished
+
+    def run_until_complete(self) -> Dict[int, GenerationResult]:
+        """Drain every queued request; returns request_id -> result."""
+        while self.num_active:
+            self.step()
+        out, self._results = self._results, {}
+        return out
+
+    # -- internals -----------------------------------------------------------
+
+    def _admit(self) -> List[tuple]:
+        """Prefill pending requests into free slots; returns the (rare)
+        requests that finish AT admission (eos on the first token, or
+        max_new_tokens == 1) so step() reports every finish."""
+        finished: List[tuple] = []
+        free = [i for i in range(self._num_slots) if i not in self._slots]
+        while free and self._pending:
+            si = free.pop(0)
+            rid, req = self._pending.pop(0)
+            tokens = jnp.asarray([req.token_ids], jnp.int32)
+            logits, solo_cache = self._prefill(self._params, tokens)
+            first = int(np.asarray(jnp.argmax(logits[0])))
+            if req.temperature > 0:
+                key = jax.random.fold_in(self._rng, rid)
+                first = int(
+                    jax.random.categorical(
+                        key, logits[0] / max(req.temperature, 1e-6)
+                    )
+                )
+            if self._cache is None:
+                self._cache = self._empty_cache(solo_cache)
+            # insert the prefilled K/V row + its write position into slot si
+            self._cache = jax.tree.map(
+                lambda pool, solo, si=si: pool.at[si].set(solo[0]),
+                self._cache,
+                solo_cache,
+            )
+            slot = _Slot(
+                request_id=rid, request=req, generated=[first],
+                last_token=first,
+            )
+            req_eos = req.eos_token_id is not None and first == req.eos_token_id
+            if req_eos or req.max_new_tokens <= 1:
+                result = GenerationResult(
+                    token_ids=[first],
+                    num_prompt_tokens=len(req.token_ids),
+                    finished_reason="eos" if req_eos else "length",
+                )
+                self._results[rid] = result
+                finished.append((rid, result))
+                free.insert(0, si)
+                continue
+            self._slots[si] = slot
+        return finished
+
+    def _empty_cache(self, solo_cache):
+        """Pooled cache with num_slots rows, shaped from a solo prefill."""
+        def widen(x):
+            return jnp.zeros(
+                (self._num_slots,) + tuple(x.shape[1:]), x.dtype
+            )
+
+        return jax.tree.map(widen, solo_cache)
+
+    def _sample_rows(self, logits) -> np.ndarray:
+        greedy = np.asarray(jnp.argmax(logits, axis=-1))
+        temps = np.zeros(self._num_slots, np.float32)
+        for si, slot in self._slots.items():
+            temps[si] = max(slot.request.temperature, 0.0)
+        if np.all(temps == 0.0):
+            return greedy
+        key = jax.random.fold_in(self._rng, 10_000 + self._step_count)
+        scaled = logits / jnp.maximum(jnp.asarray(temps)[:, None], 1e-6)
+        sampled = np.asarray(jax.random.categorical(key, scaled, axis=-1))
+        return np.where(temps == 0.0, greedy, sampled)

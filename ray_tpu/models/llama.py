@@ -168,8 +168,9 @@ class Attention(nn.Module):
         if self.decode:
             # KV-cache incremental path (serving; reference role: vLLM's
             # paged KV cache behind ray.llm — here a dense ring buffer per
-            # layer in a flax "cache" collection, as in flax nn.SelfAttention
-            # decode mode)
+            # layer in a flax "cache" collection). The cache index is
+            # PER-ROW (b,): continuous batching interleaves requests at
+            # different positions in one decode batch.
             cached_k = self.variable(
                 "cache", "cached_key",
                 jnp.zeros, (b, hk, cfg.max_seq_len, d), cfg.dtype,
@@ -179,30 +180,37 @@ class Attention(nn.Module):
                 jnp.zeros, (b, hk, cfg.max_seq_len, d), cfg.dtype,
             )
             idx_var = self.variable(
-                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+                "cache", "cache_index", lambda: jnp.zeros((b,), jnp.int32)
             )
-            idx = idx_var.value
+            idx = idx_var.value  # (b,)
             q = apply_rope(q, cos, sin, offset=idx)
             k = apply_rope(k, cos, sin, offset=idx)
-            cached_k.value = jax.lax.dynamic_update_slice_in_dim(
-                cached_k.value, k.astype(cfg.dtype), idx, axis=2
+
+            # per-row insertion offset: vmap'd dynamic_update_slice
+            def _insert(cache_row, new_row, pos):
+                return jax.lax.dynamic_update_slice_in_dim(
+                    cache_row, new_row, pos, axis=1
+                )
+
+            cached_k.value = jax.vmap(_insert)(
+                cached_k.value, k.astype(cfg.dtype), idx
             )
-            cached_v.value = jax.lax.dynamic_update_slice_in_dim(
-                cached_v.value, v.astype(cfg.dtype), idx, axis=2
+            cached_v.value = jax.vmap(_insert)(
+                cached_v.value, v.astype(cfg.dtype), idx
             )
             idx_var.value = idx + s
             k_all = jnp.repeat(cached_k.value, h // hk, axis=1)
             v_all = jnp.repeat(cached_v.value, h // hk, axis=1)
-            # query i sits at absolute position idx+i; key j is visible iff
-            # j <= idx+i and j has been written
+            # row r's query i sits at absolute position idx[r]+i; key j is
+            # visible iff j <= idx[r]+i (and thus has been written)
             scores = jnp.einsum(
                 "bhqd,bhkd->bhqk", q.astype(jnp.float32),
                 k_all.astype(jnp.float32),
             ) / math.sqrt(d)
-            q_pos = idx + jnp.arange(s)[:, None]
-            k_pos = jnp.arange(cfg.max_seq_len)[None, :]
-            mask = k_pos <= q_pos  # (s, max_seq)
-            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            q_pos = idx[:, None, None] + jnp.arange(s)[None, :, None]
+            k_pos = jnp.arange(cfg.max_seq_len)[None, None, :]
+            mask = k_pos <= q_pos  # (b, s, max_seq)
+            scores = jnp.where(mask[:, None], scores, -jnp.inf)
             probs = jax.nn.softmax(scores, axis=-1)
             out = jnp.einsum(
                 "bhqk,bhkd->bhqd", probs, v_all.astype(jnp.float32)

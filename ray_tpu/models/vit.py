@@ -154,9 +154,18 @@ class ViT(nn.Module):
         )
         x = x + pos.astype(cfg.dtype)
 
+        if self.mesh is not None:
+            # activation sharding hint: batch over data axes, patches over
+            # sp; features replicated (the "embed" rule is for WEIGHTS —
+            # fsdp — and would collide with batch's fsdp use here)
+            from ..parallel.sharding import constrain
+
+            x = constrain(x, self.mesh, "batch", "seq", None)
         block = EncoderBlock
         if cfg.remat:
-            block = nn.remat(EncoderBlock, prevent_cse=False)
+            # deterministic is a python bool: static under remat or Dropout's
+            # `if deterministic` would see a tracer
+            block = nn.remat(EncoderBlock, prevent_cse=False, static_argnums=(2,))
         for i in range(cfg.n_layers):
             x = block(cfg, name=f"layer_{i}")(x, deterministic)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_final")(x)

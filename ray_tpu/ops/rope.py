@@ -28,9 +28,22 @@ def apply_rope(
     offset: int | jax.Array = 0,
 ) -> jax.Array:
     """Rotate pairs (x[..., ::2], x[..., 1::2]); ``offset`` is the absolute
-    position of x's first token (nonzero on sp shards and in decode)."""
+    position of x's first token (nonzero on sp shards and in decode). A
+    vector offset of shape (batch,) applies a different position per row —
+    the continuous-batching decode case."""
     seq = x.shape[-2]
     half = x.shape[-1] // 2
+    if hasattr(offset, "ndim") and offset.ndim == 1:
+        def per_row(x_row, off):  # (heads, seq, head_dim)
+            c = jax.lax.dynamic_slice_in_dim(cos, off, seq, axis=0)[None]
+            s = jax.lax.dynamic_slice_in_dim(sin, off, seq, axis=0)[None]
+            x1 = x_row[..., :half]
+            x2 = x_row[..., half:]
+            return jnp.concatenate(
+                [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+            )
+
+        return jax.vmap(per_row)(x, offset).astype(x.dtype)
     c = jax.lax.dynamic_slice_in_dim(cos, offset, seq, axis=0)[None, None]
     s = jax.lax.dynamic_slice_in_dim(sin, offset, seq, axis=0)[None, None]
     x1 = x[..., :half]

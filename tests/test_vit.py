@@ -56,3 +56,22 @@ def test_remat_matches_no_remat():
         params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
         outs.append(np.asarray(ViT(cfg).apply({"params": params}, imgs)))
     np.testing.assert_allclose(outs[0], outs[1], atol=1e-5, rtol=1e-5)
+
+
+def test_remat_with_dropout_trains():
+    """remat + dropout: deterministic must be static under nn.remat."""
+    cfg = ViTConfig.tiny(remat=True, dropout=0.1)
+    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
+    imgs = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+
+    @jax.jit
+    def loss_fn(p, key):
+        logits = ViT(cfg).apply(
+            {"params": p}, imgs, deterministic=False,
+            rngs={"dropout": key},
+        )
+        return jnp.mean(logits**2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params, jax.random.PRNGKey(2))
+    assert jnp.isfinite(loss)
+    assert all(jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads))
