@@ -71,6 +71,17 @@ class _DecodeModelBase:
         )
         return logits[:, -1, :], vars_out["cache"]
 
+    @staticmethod
+    def _sample_tokens(logits, temps: np.ndarray, key) -> np.ndarray:
+        """Greedy where temps==0, temperature-categorical elsewhere — the
+        one sampling rule both engines use everywhere."""
+        greedy = np.asarray(jnp.argmax(logits, axis=-1))
+        if np.all(temps == 0.0):
+            return greedy
+        scaled = logits / jnp.maximum(jnp.asarray(temps)[:, None], 1e-6)
+        sampled = np.asarray(jax.random.categorical(key, scaled, axis=-1))
+        return np.where(temps == 0.0, greedy, sampled)
+
 
 class LLMEngine(_DecodeModelBase):
     def __init__(self, model_config, params, mesh=None, max_batch_size: int = 8):
@@ -153,15 +164,7 @@ class LLMEngine(_DecodeModelBase):
         temps = np.array(
             [max(r.temperature, 0.0) for r in requests], np.float32
         )
-        greedy = jnp.argmax(logits, axis=-1)
-        if np.all(temps == 0.0):
-            return np.asarray(greedy)
-        key = jax.random.fold_in(rng, step)
-        scaled = logits / jnp.maximum(jnp.asarray(temps)[:, None], 1e-6)
-        sampled = jax.random.categorical(key, scaled, axis=-1)
-        return np.asarray(
-            jnp.where(jnp.asarray(temps) == 0.0, greedy, sampled)
-        )
+        return self._sample_tokens(logits, temps, jax.random.fold_in(rng, step))
 
 
 @dataclasses.dataclass
@@ -191,11 +194,22 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         self._num_slots = num_slots
         self._slots: Dict[int, _Slot] = {}  # slot index -> active request
         self._pending: List[tuple] = []  # (request_id, GenerationRequest)
-        self._results: Dict[int, GenerationResult] = {}
         self._next_id = 0
         self._rng = jax.random.PRNGKey(0)
         self._step_count = 0
         self._cache = None  # pooled cache, allocated on first prefill
+        # donated in-place row insert: one compiled program for every slot
+        # (si is a traced scalar), no full-pool copy per admission
+        self._insert_row = jax.jit(
+            lambda pool, solo, si: jax.tree.map(
+                lambda p, s: jax.lax.dynamic_update_index_in_dim(
+                    p, s[0], si, axis=0
+                ),
+                pool,
+                solo,
+            ),
+            donate_argnums=(0,),
+        )
 
     # -- public API ----------------------------------------------------------
 
@@ -242,16 +256,19 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                     num_prompt_tokens=len(req.token_ids),
                     finished_reason="eos" if done_eos else "length",
                 )
-                self._results[slot.request_id] = result
                 finished.append((slot.request_id, result))
                 del self._slots[si]  # slot is free for the next admit
         return finished
 
     def run_until_complete(self) -> Dict[int, GenerationResult]:
-        """Drain every queued request; returns request_id -> result."""
+        """Drain every queued request; returns request_id -> result.
+        Long-running callers should consume step()'s return value instead —
+        the engine keeps NO finished-result state (a serving loop would leak
+        otherwise)."""
+        out: Dict[int, GenerationResult] = {}
         while self.num_active:
-            self.step()
-        out, self._results = self._results, {}
+            for rid, result in self.step():
+                out[rid] = result
         return out
 
     # -- internals -----------------------------------------------------------
@@ -267,21 +284,18 @@ class ContinuousBatchingEngine(_DecodeModelBase):
             rid, req = self._pending.pop(0)
             tokens = jnp.asarray([req.token_ids], jnp.int32)
             logits, solo_cache = self._prefill(self._params, tokens)
-            first = int(np.asarray(jnp.argmax(logits[0])))
-            if req.temperature > 0:
-                key = jax.random.fold_in(self._rng, rid)
-                first = int(
-                    jax.random.categorical(
-                        key, logits[0] / max(req.temperature, 1e-6)
-                    )
-                )
+            first = int(
+                self._sample_tokens(
+                    logits,
+                    np.array([max(req.temperature, 0.0)], np.float32),
+                    jax.random.fold_in(self._rng, rid),
+                )[0]
+            )
             if self._cache is None:
                 self._cache = self._empty_cache(solo_cache)
             # insert the prefilled K/V row + its write position into slot si
-            self._cache = jax.tree.map(
-                lambda pool, solo, si=si: pool.at[si].set(solo[0]),
-                self._cache,
-                solo_cache,
+            self._cache = self._insert_row(
+                self._cache, solo_cache, jnp.asarray(si, jnp.int32)
             )
             slot = _Slot(
                 request_id=rid, request=req, generated=[first],
@@ -290,11 +304,10 @@ class ContinuousBatchingEngine(_DecodeModelBase):
             req_eos = req.eos_token_id is not None and first == req.eos_token_id
             if req_eos or req.max_new_tokens <= 1:
                 result = GenerationResult(
-                    token_ids=[first],
+                    token_ids=[first][: req.max_new_tokens],
                     num_prompt_tokens=len(req.token_ids),
                     finished_reason="eos" if req_eos else "length",
                 )
-                self._results[rid] = result
                 finished.append((rid, result))
                 free.insert(0, si)
                 continue
@@ -311,13 +324,8 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         return jax.tree.map(widen, solo_cache)
 
     def _sample_rows(self, logits) -> np.ndarray:
-        greedy = np.asarray(jnp.argmax(logits, axis=-1))
         temps = np.zeros(self._num_slots, np.float32)
         for si, slot in self._slots.items():
             temps[si] = max(slot.request.temperature, 0.0)
-        if np.all(temps == 0.0):
-            return greedy
         key = jax.random.fold_in(self._rng, 10_000 + self._step_count)
-        scaled = logits / jnp.maximum(jnp.asarray(temps)[:, None], 1e-6)
-        sampled = np.asarray(jax.random.categorical(key, scaled, axis=-1))
-        return np.where(temps == 0.0, greedy, sampled)
+        return self._sample_tokens(logits, temps, key)

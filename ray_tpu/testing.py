@@ -1,0 +1,142 @@
+"""Chaos/fault-injection test utilities.
+
+Role-equivalent of the reference's test harness killers
+(_private/test_utils.py:1372,1458,1606 — ResourceKillerActor,
+NodeKillerBase, WorkerKillerActor) adapted to the in-process cluster: a
+background thread SIGKILLs random busy workers (or removes whole nodes from
+a cluster_utils.Cluster) at an interval, while the workload runs — retries,
+actor restarts, and lineage reconstruction must absorb the damage. RPC-level
+chaos is separate (``_system_config={"testing_rpc_failure": ...}``,
+_internal/rpc.py set_rpc_chaos).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import signal
+import threading
+import time
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerKiller:
+    """Kills random registered (busy-or-idle) worker processes of the given
+    nodes' raylets until stopped or ``max_kills`` is reached."""
+
+    def __init__(
+        self,
+        nodes,
+        interval_s: float = 0.5,
+        max_kills: int = 5,
+        seed: int = 0,
+        busy_only: bool = True,
+    ):
+        self._nodes = list(nodes)
+        self._interval = interval_s
+        self._max_kills = max_kills
+        self._rng = random.Random(seed)
+        self._busy_only = busy_only
+        self.kills: List[int] = []  # pids killed
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _candidates(self) -> List[int]:
+        pids = []
+        for node in self._nodes:
+            raylet = node.raylet
+            if self._busy_only:
+                pids.extend(
+                    lease.worker.pid for lease in raylet._leases.values()
+                )
+            elif raylet.worker_pool is not None:
+                pids.extend(
+                    h.pid for h in raylet.worker_pool._registered.values()
+                )
+        return pids
+
+    def _run(self):
+        while not self._stop.is_set() and len(self.kills) < self._max_kills:
+            time.sleep(self._interval)
+            pids = self._candidates()
+            if not pids:
+                continue
+            pid = self._rng.choice(pids)
+            try:
+                os.kill(pid, signal.SIGKILL)
+                self.kills.append(pid)
+                logger.info("WorkerKiller: killed worker pid %s", pid)
+            except ProcessLookupError:
+                pass
+
+    def start(self) -> "WorkerKiller":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="worker-killer"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class NodeKiller:
+    """Removes random non-head nodes from a cluster_utils.Cluster at an
+    interval (reference: NodeKillerBase killing raylets during chaos
+    tests)."""
+
+    def __init__(self, cluster, interval_s: float = 1.0, max_kills: int = 1,
+                 seed: int = 0):
+        self._cluster = cluster
+        self._interval = interval_s
+        self._max_kills = max_kills
+        self._rng = random.Random(seed)
+        self.killed: List[str] = []  # node id hexes
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _run(self):
+        while not self._stop.is_set() and len(self.killed) < self._max_kills:
+            time.sleep(self._interval)
+            victims = [
+                n for n in self._cluster.list_nodes() if not n.head
+            ]
+            if not victims:
+                continue
+            node = self._rng.choice(victims)
+            node_id = node.node_id.hex()
+            try:
+                self._cluster.remove_node(node, graceful=False)
+                self.killed.append(node_id)
+                logger.info("NodeKiller: removed node %s", node_id)
+            except Exception:
+                logger.exception("NodeKiller: removal failed")
+
+    def start(self) -> "NodeKiller":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="node-killer"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -196,10 +196,11 @@ class TestContinuousBatching:
             for p in prompts
         }
         # step manually: at most 2 slots busy at once
+        results = {}
         while engine.num_active:
-            engine.step()
+            for rid, res in engine.step():
+                results[rid] = res
             assert len(engine._slots) <= 2
-        results = engine._results
         for rid, ref in refs.items():
             assert results[rid].token_ids == ref, rid
 
