@@ -183,6 +183,11 @@ def shutdown():
             loop_thread.stop()
         except Exception:
             pass
+    # injected RPC chaos is process-global; it must not outlive the cluster
+    # that configured it (later init()s in the same process would inherit it)
+    from ._internal.rpc import set_rpc_chaos
+
+    set_rpc_chaos({})
     _worker_api.clear()
 
 

@@ -37,10 +37,12 @@ _VERSION = {"ray_tpu_version": "0.1.0", "api_version": "1"}
 
 def _ser(obj: Any):
     """JSON-ify runtime objects (IDs, dataclasses, enums)."""
+    import enum
+
     if hasattr(obj, "hex") and callable(obj.hex):
         return obj.hex()
-    if hasattr(obj, "name") and obj.__class__.__module__ != "builtins":
-        return getattr(obj, "name")
+    if isinstance(obj, enum.Enum):
+        return obj.name
     if hasattr(obj, "__dict__"):
         return {k: v for k, v in vars(obj).items() if not k.startswith("_")}
     return str(obj)
@@ -119,10 +121,14 @@ class DashboardServer:
             if handler is None:
                 return self._send(req, 404, {"error": f"no route {verb} {path}"})
             status, payload, content_type = handler(body)
-            if content_type == "text/plain":
+            if content_type is not None:
+                header = {
+                    "text/plain": "text/plain; version=0.0.4",
+                    "text/html": "text/html; charset=utf-8",
+                }[content_type]
                 data = payload.encode()
                 req.send_response(status)
-                req.send_header("Content-Type", "text/plain; version=0.0.4")
+                req.send_header("Content-Type", header)
                 req.send_header("Content-Length", str(len(data)))
                 req.end_headers()
                 req.wfile.write(data)
@@ -176,6 +182,10 @@ class DashboardServer:
             ("GET", "/api/jobs"): lambda b: (200, jm.list(), None),
             ("POST", "/api/jobs"): self._submit_job,
             ("GET", "/metrics"): self._metrics,
+            # browser UI (role of the React frontend, dashboard/client/ —
+            # here a dependency-free single page over the same REST API)
+            ("GET", ""): lambda b: (200, _INDEX_HTML, "text/html"),
+            ("GET", "/index.html"): lambda b: (200, _INDEX_HTML, "text/html"),
         }
         return table.get((verb, path))
 
@@ -194,3 +204,76 @@ class DashboardServer:
         from ..util.metrics import prometheus_text
 
         return 200, prometheus_text(), "text/plain"
+
+
+_INDEX_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  body { font-family: system-ui, sans-serif; margin: 2rem; color: #1a1a1a; }
+  h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.6rem; }
+  table { border-collapse: collapse; width: 100%; font-size: .85rem; }
+  th, td { text-align: left; padding: .3rem .6rem; border-bottom: 1px solid #ddd; }
+  th { background: #f5f5f5; }
+  .pill { padding: .1rem .5rem; border-radius: 1rem; font-size: .75rem; }
+  .ok { background: #d7f5dd; } .bad { background: #fde0e0; }
+  #err { color: #b00; }
+  code { background: #f5f5f5; padding: .1rem .3rem; }
+</style>
+</head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="err"></div>
+<h2>Cluster resources</h2><div id="resources">loading…</div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Recent tasks</h2><table id="tasks"></table>
+<script>
+async function j(p) { const r = await fetch(p); return r.json(); }
+function esc(v) {  // user-controlled strings (entrypoints, names) must not reach innerHTML raw
+  const d = document.createElement("div"); d.textContent = String(v ?? ""); return d.innerHTML;
+}
+function fill(id, rows, cols) {
+  const t = document.getElementById(id);
+  t.innerHTML = "<tr>" + cols.map(c => "<th>" + esc(c) + "</th>").join("") + "</tr>" +
+    rows.map(r => "<tr>" + cols.map(c => "<td>" + esc(r[c]) + "</td>").join("") + "</tr>").join("");
+}
+async function refresh() {
+  try {
+    const res = await j("/api/cluster_resources");
+    document.getElementById("resources").innerHTML =
+      "<code>" + esc(JSON.stringify(res)) + "</code>";
+    const nodes = await j("/api/nodes");
+    fill("nodes", nodes.map(n => ({
+      id: (n.node_id || "").slice(0, 12),
+      address: Array.isArray(n.address) ? n.address.join(":") : n.address,
+      alive: n.alive ? "alive" : "dead",
+      head: n.is_head ? "head" : "",
+      resources: JSON.stringify(n.resources_total || {}),
+    })), ["id", "address", "alive", "head", "resources"]);
+    const actors = await j("/api/actors");
+    fill("actors", actors.map(a => ({
+      id: (a.actor_id || "").slice(0, 12),
+      name: a.name || "", state: a.state || "",
+      restarts: a.num_restarts ?? 0,
+    })), ["id", "name", "state", "restarts"]);
+    const jobs = await j("/api/jobs");
+    fill("jobs", jobs.map(x => ({
+      id: x.submission_id || x.job_id, status: x.status,
+      entrypoint: x.entrypoint,
+    })), ["id", "status", "entrypoint"]);
+    const tasks = await j("/api/tasks");
+    fill("tasks", tasks.slice(-50).reverse().map(t => ({
+      task: (t.task_id || "").slice(0, 12), name: t.name || "",
+      state: t.state || "", type: t.type || "",
+    })), ["task", "name", "state", "type"]);
+    document.getElementById("err").textContent = "";
+  } catch (e) { document.getElementById("err").textContent = "refresh failed: " + e; }
+}
+refresh(); setInterval(refresh, 3000);
+</script>
+</body>
+</html>"""

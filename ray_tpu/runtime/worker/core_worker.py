@@ -78,7 +78,10 @@ class WorkerMode(enum.Enum):
 class _ActorClientState:
     """Client-side view of one actor (reference: ActorTaskSubmitter state)."""
 
-    __slots__ = ("actor_id", "state", "address", "seq", "queue", "death_cause")
+    __slots__ = (
+        "actor_id", "state", "address", "seq", "queue", "death_cause",
+        "incarnation",
+    )
 
     def __init__(self, actor_id: ActorID):
         self.actor_id = actor_id
@@ -88,6 +91,10 @@ class _ActorClientState:
         # tasks parked while the actor is pending/restarting
         self.queue: deque = deque()
         self.death_cause = ""
+        # which restart generation our sequence numbering belongs to: the
+        # executor's per-caller counters die with its process, so the queue
+        # renumbers from 0 exactly once per new incarnation
+        self.incarnation = -1
 
 
 class CoreWorker:
@@ -145,6 +152,9 @@ class CoreWorker:
         # per-caller ordered queues for actor tasks
         self._caller_expected_seq: Dict[WorkerID, int] = defaultdict(int)
         self._caller_parked: Dict[WorkerID, Dict[int, tuple]] = defaultdict(dict)
+        # completed replies by (caller, seq) for duplicate-delivery dedup
+        # (bounded; insertion-ordered dict doubles as an LRU-ish window)
+        self._caller_replies: Dict[WorkerID, Dict[int, TaskReply]] = defaultdict(dict)
         self._execution_lock = asyncio.Lock()
         self._exit_requested = False
 
@@ -716,6 +726,7 @@ class CoreWorker:
         gcs = self.client_pool.get(*self.gcs_address)
         info: ActorInfo = await gcs.call("register_actor", spec, detached)
         state.state = info.state
+        state.incarnation = getattr(info, "num_restarts", 0)
         if info.address:
             state.address = info.address
         return spec.actor_id
@@ -730,6 +741,7 @@ class CoreWorker:
             state.state = info.state
             state.address = info.address
             state.death_cause = info.death_cause
+            state.incarnation = getattr(info, "num_restarts", 0)
         self._actors[actor_id] = state
 
         async def _sub():
@@ -755,12 +767,17 @@ class CoreWorker:
         state.death_cause = info.death_cause
         if info.state == ActorState.ALIVE and info.address is not None:
             state.address = info.address
-            # New incarnation: the executor's per-caller sequence counters
-            # start at 0, so renumber the parked queue from 0 in FIFO order
-            # (ordering is preserved; only the epoch resets).
-            for i, (spec, _fut) in enumerate(state.queue):
-                spec.sequence_number = i
-            state.seq = len(state.queue)
+            # New incarnation ONLY: the executor's per-caller sequence
+            # counters died with its process, so renumber the parked queue
+            # from 0 in FIFO order. A repeated ALIVE for the same
+            # incarnation (pubsub + get_actor race) must NOT renumber —
+            # calls already delivered under this numbering would collide.
+            incarnation = getattr(info, "num_restarts", 0)
+            if incarnation != state.incarnation:
+                state.incarnation = incarnation
+                for i, (spec, _fut) in enumerate(state.queue):
+                    spec.sequence_number = i
+                state.seq = len(state.queue)
             asyncio.ensure_future(self._flush_actor_queue(state))
         elif info.state == ActorState.DEAD:
             state.address = None
@@ -818,12 +835,28 @@ class CoreWorker:
                 ActorState.PENDING_CREATION,
                 ActorState.ALIVE,
             ):
-                self._apply_actor_info(info)
                 if self._actor_retries_allowed(spec):
-                    state.queue.append((spec, fut))
-                    if info.state == ActorState.ALIVE:
-                        await self._flush_actor_queue(state)
+                    if (
+                        info.state == ActorState.ALIVE
+                        and getattr(info, "num_restarts", 0)
+                        == state.incarnation
+                    ):
+                        # same incarnation (transient RPC failure, executor
+                        # still alive): resend with the ORIGINAL seq — the
+                        # client can't know whether the lost call executed.
+                        # Never executed -> runs in order; executed with the
+                        # reply lost -> the executor's reply cache answers
+                        # the duplicate (see _handle_actor_task).
+                        asyncio.ensure_future(
+                            self._push_actor_task(state, spec, fut)
+                        )
+                    else:
+                        # park BEFORE applying: a new-incarnation ALIVE
+                        # renumbers the whole queue including this spec
+                        state.queue.append((spec, fut))
+                        self._apply_actor_info(info)
                     return
+                self._apply_actor_info(info)
             if not fut.done():
                 fut.set_exception(
                     ActorDiedError(spec.actor_id, "connection lost")
@@ -1000,9 +1033,25 @@ class CoreWorker:
 
     async def _handle_actor_task(self, spec: TaskSpec) -> TaskReply:
         """Per-caller in-order execution (reference: ActorSchedulingQueue
-        sequencing by client seq-no)."""
+        sequencing by client seq-no). A retried call arrives with its
+        ORIGINAL seq (the client cannot know whether the lost RPC executed);
+        stale seqs answer from the reply cache instead of re-executing."""
         caller = spec.owner_worker_id
         expected = self._caller_expected_seq[caller]
+        if spec.sequence_number < expected:
+            # duplicate delivery: the call already executed but its reply
+            # was lost in flight (reference: the dedup the executor does by
+            # seq-no). Serve the cached reply.
+            cached = self._caller_replies[caller].get(spec.sequence_number)
+            if cached is not None:
+                return cached
+            return self._error_reply(
+                spec,
+                RuntimeError(
+                    f"duplicate actor task seq {spec.sequence_number} "
+                    f"(expected {expected}) with evicted reply"
+                ),
+            )
         if spec.sequence_number != expected:
             # park until predecessors arrive
             parked = self._caller_parked[caller]
@@ -1016,6 +1065,12 @@ class CoreWorker:
             if nxt is not None:
                 nxt.set()
 
+        def _cache_reply(reply: TaskReply):
+            replies = self._caller_replies[caller]
+            replies[spec.sequence_number] = reply
+            while len(replies) > 64:
+                replies.pop(next(iter(replies)))
+
         max_conc = self._actor_spec.max_concurrency if self._actor_spec else 1
         if max_conc > 1:
             # concurrent actor (reference: async/threaded actors via
@@ -1026,9 +1081,13 @@ class CoreWorker:
                 self._actor_semaphore = asyncio.Semaphore(max_conc)
             _advance()
             async with self._actor_semaphore:
-                return await self._execute_actor_task(spec)
+                reply = await self._execute_actor_task(spec)
+                _cache_reply(reply)
+                return reply
         try:
-            return await self._execute_actor_task(spec)
+            reply = await self._execute_actor_task(spec)
+            _cache_reply(reply)
+            return reply
         finally:
             _advance()
 

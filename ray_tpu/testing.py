@@ -45,32 +45,42 @@ class WorkerKiller:
         self._thread: Optional[threading.Thread] = None
 
     def _candidates(self) -> List[int]:
+        # snapshot with list(): the raylet loop thread mutates these dicts
+        # concurrently (that churn is exactly what this killer causes)
         pids = []
         for node in self._nodes:
             raylet = node.raylet
             if self._busy_only:
                 pids.extend(
-                    lease.worker.pid for lease in raylet._leases.values()
+                    lease.worker.pid
+                    for lease in list(raylet._leases.values())
                 )
             elif raylet.worker_pool is not None:
                 pids.extend(
-                    h.pid for h in raylet.worker_pool._registered.values()
+                    h.pid
+                    for h in list(raylet.worker_pool._registered.values())
                 )
         return pids
 
     def _run(self):
         while not self._stop.is_set() and len(self.kills) < self._max_kills:
-            time.sleep(self._interval)
-            pids = self._candidates()
-            if not pids:
-                continue
-            pid = self._rng.choice(pids)
+            # event-based wait: stop() during the interval must prevent the
+            # kill that would otherwise land after the chaos window closed
+            if self._stop.wait(self._interval):
+                return
             try:
+                pids = self._candidates()
+                if not pids:
+                    continue
+                pid = self._rng.choice(pids)
                 os.kill(pid, signal.SIGKILL)
                 self.kills.append(pid)
                 logger.info("WorkerKiller: killed worker pid %s", pid)
             except ProcessLookupError:
                 pass
+            except Exception:
+                # a racing snapshot must not silently end the chaos thread
+                logger.exception("WorkerKiller tick failed; continuing")
 
     def start(self) -> "WorkerKiller":
         self._thread = threading.Thread(
@@ -108,7 +118,8 @@ class NodeKiller:
 
     def _run(self):
         while not self._stop.is_set() and len(self.killed) < self._max_kills:
-            time.sleep(self._interval)
+            if self._stop.wait(self._interval):
+                return
             victims = [
                 n for n in self._cluster.list_nodes() if not n.head
             ]

@@ -108,3 +108,13 @@ def test_job_list_and_unknown(dashboard_cluster):
     assert any(j["submission_id"] == sid for j in jobs)
     with pytest.raises(RuntimeError, match="404"):
         client.get_job_status("raysubmit_doesnotexist")
+
+
+def test_index_page_served(dashboard_cluster):
+    """The browser UI page (role of dashboard/client) serves at /."""
+    dash = dashboard_cluster
+    with urllib.request.urlopen(dash.url + "/") as resp:
+        body = resp.read().decode()
+        assert resp.headers["Content-Type"].startswith("text/html")
+    assert "ray_tpu dashboard" in body
+    assert "/api/cluster_resources" in body
