@@ -132,8 +132,11 @@ class TaskSpec:
     max_concurrency: int = 1
     namespace: str = ""
     actor_name: str = ""
-    # actor call
+    # actor call: position in the per-caller ordered stream, and which
+    # restart generation that numbering belongs to (a retry must not carry
+    # an old generation's seq to a fresh executor)
     sequence_number: int = 0
+    sequence_incarnation: int = 0
     # placement group this task is bound to
     placement_group_id: Optional[PlacementGroupID] = None
     placement_group_bundle_index: int = -1
@@ -275,3 +278,6 @@ class TaskReply:
     error: Optional[bytes] = None  # packed TaskError
     # worker asks owner to retry (system failure, not user exception)
     retriable_failure: bool = False
+    # streaming generator tasks: total items yielded (reference: the
+    # end-of-stream accounting behind ObjectRefStream, task_manager.h:67)
+    num_streamed: Optional[int] = None

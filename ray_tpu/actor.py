@@ -225,6 +225,12 @@ class ActorHandle:
         worker = _worker_api.get_core_worker()
         task_args = prepare_args(worker, args, kwargs)
         num_returns = options.get("num_returns", 1)
+        if num_returns == "streaming":
+            raise NotImplementedError(
+                'num_returns="streaming" is supported for task functions '
+                "only, not actor methods (reference parity gap: actor "
+                "streaming generators)"
+            )
         spec = TaskSpec(
             task_id=worker.next_task_id(),
             job_id=worker.job_id,

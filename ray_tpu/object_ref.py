@@ -85,3 +85,52 @@ class ObjectRef:
 
 def _deserialize_ref(object_id, owner_address):
     return ObjectRef(object_id, owner_address)
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming-generator task's yielded objects.
+
+    Role-equivalent of the reference's ObjectRefGenerator
+    (_private/object_ref_generator.py:32 backed by TryReadObjectRefStream,
+    core_worker.h:306): ``next()`` blocks until the executor reports the
+    next yielded item (items stream while the task still runs) and returns
+    its ObjectRef; StopIteration at end-of-stream; a mid-stream task error
+    raises after the already-yielded items are consumed.
+    """
+
+    def __init__(self, task_id):
+        self._task_id = task_id
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        from . import _worker_api
+
+        worker = _worker_api.get_core_worker()
+        ref = _worker_api.run_on_worker_loop(
+            worker.next_stream_item(self._task_id)
+        )
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id.hex()})"
+
+    def __del__(self):
+        # abandoning the generator releases the owner's stream bookkeeping
+        # (a failed or half-consumed stream must not pin state forever)
+        try:
+            from . import _worker_api
+        except ImportError:
+            return  # interpreter shutdown
+        worker = _worker_api.maybe_get_core_worker()
+        if worker is None:
+            return
+        try:
+            worker.loop.call_soon_threadsafe(
+                worker.drop_stream, self._task_id
+            )
+        except RuntimeError:
+            pass

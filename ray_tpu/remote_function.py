@@ -145,6 +145,12 @@ class RemoteFunction:
         fn_hash = self._ensure_exported(worker)
         task_args = prepare_args(worker, args, kwargs)
         num_returns = options["num_returns"]
+        # streaming generators: yielded items become their own objects as
+        # they are produced (reference: num_returns="streaming" ->
+        # ObjectRefGenerator, _private/object_ref_generator.py:32)
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 0
         from .util.scheduling_strategies import to_protocol_strategy
 
         strategy = to_protocol_strategy(options.get("scheduling_strategy"))
@@ -175,9 +181,14 @@ class RemoteFunction:
             retry_exceptions=bool(options["retry_exceptions"]),
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_index,
+            is_streaming_generator=streaming,
             runtime_env=_normalize_runtime_env(options.get("runtime_env"), worker),
         )
         return_ids = _worker_api.run_on_worker_loop(worker.submit_task(spec))
+        if streaming:
+            from .object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id)
         refs = [ObjectRef(oid, worker.address) for oid in return_ids]
         if num_returns == 0:
             return None

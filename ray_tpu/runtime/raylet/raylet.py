@@ -93,6 +93,9 @@ class Raylet:
         self._leases: Dict[UniqueID, Lease] = {}
         # spilled primary copies: object id -> file path (reference: N14)
         self._spilled: Dict[ObjectID, str] = {}
+        # owner-freed objects still pinned by zero-copy readers: freed for
+        # real when the last reader releases (see handle_free_objects)
+        self._deferred_frees: set = set()
         # unmet demands for the autoscaler: task_id -> (resources, selector, ts)
         self._infeasible_demands: Dict[TaskID, tuple] = {}
         self._restore_locks: Dict[ObjectID, asyncio.Lock] = {}
@@ -714,11 +717,21 @@ class Raylet:
 
     async def handle_store_release(self, object_id: ObjectID):
         self.store.release(object_id)
+        if object_id in self._deferred_frees:
+            # the owner freed this object while a zero-copy reader held a
+            # pin; now that the pin count may have dropped, retry
+            if self.store.free_if_unpinned(object_id) is not False:
+                self._deferred_frees.discard(object_id)
         return True
 
     async def handle_free_objects(self, object_ids: List[ObjectID]):
         for oid in object_ids:
-            self.store.free(oid)
+            # NEVER free a block a concurrent zero-copy reader still pins —
+            # the allocator would hand the space to the next create and the
+            # reader's live numpy views would silently change contents.
+            # Pinned objects free later, on the releasing store_release.
+            if self.store.free_if_unpinned(oid) is False:
+                self._deferred_frees.add(oid)
             path = self._spilled.pop(oid, None)
             if path is not None:
                 try:

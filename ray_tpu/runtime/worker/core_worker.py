@@ -97,6 +97,22 @@ class _ActorClientState:
         self.incarnation = -1
 
 
+class _StreamState:
+    """Owner-side progress of one streaming-generator task."""
+
+    __slots__ = ("reported", "total", "error", "next_read", "event")
+
+    def __init__(self):
+        self.reported: set = set()  # indices whose objects have arrived
+        self.total: Optional[int] = None  # set at end-of-stream
+        self.error: Optional[bytes] = None
+        self.next_read = 0
+        self.event = asyncio.Event()
+
+    def pulse(self):
+        self.event.set()
+
+
 class CoreWorker:
     def __init__(
         self,
@@ -143,6 +159,10 @@ class CoreWorker:
         self._actors: Dict[ActorID, _ActorClientState] = {}
         self._subscriber: Optional[SubscriberClient] = None
 
+        # streaming generators (owner side): task_id -> stream progress
+        # (reference: ObjectRefStream, task_manager.h:67)
+        self._streams: Dict[TaskID, _StreamState] = {}
+
         # execution side
         self._function_cache: Dict[str, Callable] = {}
         self._actor_instance: Any = None
@@ -153,8 +173,12 @@ class CoreWorker:
         self._caller_expected_seq: Dict[WorkerID, int] = defaultdict(int)
         self._caller_parked: Dict[WorkerID, Dict[int, tuple]] = defaultdict(dict)
         # completed replies by (caller, seq) for duplicate-delivery dedup
-        # (bounded; insertion-ordered dict doubles as an LRU-ish window)
-        self._caller_replies: Dict[WorkerID, Dict[int, TaskReply]] = defaultdict(dict)
+        # (bounded by entries and bytes; insertion-ordered dict = LRU window)
+        self._caller_replies: Dict[WorkerID, Dict[int, tuple]] = defaultdict(dict)
+        # in-flight executions by (caller, seq): duplicates share the outcome
+        self._caller_inflight: Dict[WorkerID, Dict[int, asyncio.Future]] = (
+            defaultdict(dict)
+        )
         self._execution_lock = asyncio.Lock()
         self._exit_requested = False
 
@@ -199,6 +223,9 @@ class CoreWorker:
         s.register("add_object_location", self._handle_add_object_location)
         s.register("wait_object", self._handle_wait_object)
         s.register("decref", self._handle_decref)
+        # streaming generator item delivery (reference:
+        # ReportGeneratorItemReturns RPC, core_worker.proto:507)
+        s.register("report_generator_item", self._handle_report_generator_item)
         # executor services
         s.register("push_task", self._handle_push_task)
         s.register("create_actor", self._handle_create_actor)
@@ -540,6 +567,8 @@ class CoreWorker:
         for oid in return_ids:
             self._owned.add(oid)
             self.memory_store.entry(oid)  # create pending entry
+        if spec.is_streaming_generator:
+            self._streams[spec.task_id] = _StreamState()
         self._pending_tasks[spec.task_id] = spec
         arg_ids = self._pin_task_args(spec)
         self.record_task_event(
@@ -702,16 +731,74 @@ class CoreWorker:
             elif ret.in_plasma:
                 node_addr = ret.node_id
                 self.memory_store.put_plasma(ret.object_id, ret.size, node_addr)
+        if reply.num_streamed is not None:
+            state = self._streams.get(spec.task_id)
+            if state is not None:
+                state.total = reply.num_streamed
+                state.pulse()
         self.record_task_event(spec.task_id, state="FINISHED", attempt=attempt)
 
     def _fail_task(self, spec: TaskSpec, error: Exception, attempt: int = 0):
         packed = serialization.pack(error)
         for oid in spec.return_object_ids():
             self.memory_store.put_error(oid, packed)
+        stream = self._streams.get(spec.task_id)
+        if stream is not None:
+            stream.error = packed
+            stream.pulse()
         self.record_task_event(
             spec.task_id, state="FAILED", error=type(error).__name__,
             attempt=attempt,
         )
+
+    # -- streaming generators (owner side) ---------------------------------
+
+    async def _handle_report_generator_item(
+        self, task_id: TaskID, index: int, value: Optional[bytes],
+        size: int = 0, in_plasma: bool = False, node_addr=None,
+    ):
+        object_id = ObjectID.for_task_return(task_id, index)
+        if value is not None:
+            self.memory_store.put_value(object_id, value)
+        else:
+            self.memory_store.put_plasma(object_id, size, node_addr)
+        self._owned.add(object_id)
+        state = self._streams.get(task_id)
+        if state is not None:
+            state.reported.add(index)
+            state.pulse()
+        return True
+
+    async def next_stream_item(self, task_id: TaskID) -> Optional[ObjectRef]:
+        """Next ObjectRef of a streaming task, in yield order; None at
+        end-of-stream (reference: TryReadObjectRefStream, core_worker.h:306).
+        Items already yielded remain readable even if the task later fails —
+        the error surfaces when reading PAST the last delivered item."""
+        state = self._streams.get(task_id)
+        if state is None:
+            return None
+        while True:
+            if state.next_read in state.reported:
+                i = state.next_read
+                state.next_read += 1
+                return ObjectRef(
+                    ObjectID.for_task_return(task_id, i), self.address
+                )
+            if state.error is not None:
+                # terminal: drop the stream so an abandoned/failed stream
+                # doesn't pin its state for the process lifetime
+                self._streams.pop(task_id, None)
+                raise serialization.unpack(state.error)
+            if state.total is not None and state.next_read >= state.total:
+                self._streams.pop(task_id, None)
+                return None
+            state.event.clear()
+            await state.event.wait()
+
+    def drop_stream(self, task_id: TaskID):
+        """Consumer abandoned the generator: release owner-side stream
+        bookkeeping (called from ObjectRefGenerator.__del__)."""
+        self._streams.pop(task_id, None)
 
     # ------------------------------------------------------------------
     # actor submission (reference: actor_task_submitter.h)
@@ -777,6 +864,7 @@ class CoreWorker:
                 state.incarnation = incarnation
                 for i, (spec, _fut) in enumerate(state.queue):
                     spec.sequence_number = i
+                    spec.sequence_incarnation = incarnation
                 state.seq = len(state.queue)
             asyncio.ensure_future(self._flush_actor_queue(state))
         elif info.state == ActorState.DEAD:
@@ -806,6 +894,7 @@ class CoreWorker:
             self.memory_store.entry(oid)
         arg_ids = self._pin_task_args(spec)
         spec.sequence_number = state.seq
+        spec.sequence_incarnation = state.incarnation
         state.seq += 1
         fut: asyncio.Future = self.loop.create_future()
         if state.state == ActorState.DEAD:
@@ -836,25 +925,39 @@ class CoreWorker:
                 ActorState.ALIVE,
             ):
                 if self._actor_retries_allowed(spec):
+                    self._apply_actor_info(info)
+                    alive_now = (
+                        state.state == ActorState.ALIVE
+                        and state.address is not None
+                    )
                     if (
-                        info.state == ActorState.ALIVE
-                        and getattr(info, "num_restarts", 0)
-                        == state.incarnation
+                        alive_now
+                        and spec.sequence_incarnation == state.incarnation
                     ):
-                        # same incarnation (transient RPC failure, executor
-                        # still alive): resend with the ORIGINAL seq — the
+                        # same incarnation the seq was issued under and the
+                        # executor lives: resend the ORIGINAL seq — the
                         # client can't know whether the lost call executed.
                         # Never executed -> runs in order; executed with the
-                        # reply lost -> the executor's reply cache answers
-                        # the duplicate (see _handle_actor_task).
+                        # reply lost -> the executor dedups by seq (see
+                        # _handle_actor_task).
+                        asyncio.ensure_future(
+                            self._push_actor_task(state, spec, fut)
+                        )
+                    elif alive_now:
+                        # issued under a DEAD incarnation, and the new
+                        # executor's numbering is already live (its renumber
+                        # pass happened before this failure surfaced): take
+                        # a fresh seq in the current generation
+                        spec.sequence_number = state.seq
+                        spec.sequence_incarnation = state.incarnation
+                        state.seq += 1
                         asyncio.ensure_future(
                             self._push_actor_task(state, spec, fut)
                         )
                     else:
-                        # park BEFORE applying: a new-incarnation ALIVE
-                        # renumbers the whole queue including this spec
+                        # restart in progress: park; the ALIVE renumber
+                        # stamps fresh seq + incarnation for the whole queue
                         state.queue.append((spec, fut))
-                        self._apply_actor_info(info)
                     return
                 self._apply_actor_info(info)
             if not fut.done():
@@ -920,6 +1023,10 @@ class CoreWorker:
         try:
             fn = await self._load_function(spec.function)
             args, kwargs = await self._unflatten(spec)
+            if spec.is_streaming_generator:
+                return await self._run_streaming_generator(
+                    fn, args, kwargs, spec
+                )
             try:
                 result = await self._run_user_code(fn, args, kwargs, spec)
             except Exception as e:  # noqa: BLE001
@@ -950,6 +1057,68 @@ class CoreWorker:
                 ref = ObjectRef(arg.object_id, arg.owner_address, _register=False)
                 resolved.append(await self._get_one(ref, None))
         return reconstruct(structure, resolved)
+
+    async def _run_streaming_generator(
+        self, fn, args, kwargs, spec: TaskSpec
+    ) -> TaskReply:
+        """Drive a user generator, shipping each yielded item to the owner
+        as its own object as soon as it exists (reference: the streaming-
+        generator execution path reporting via ReportGeneratorItemReturns).
+        Items stream while the generator is still running — the consumer
+        overlaps with production."""
+        _SENTINEL = object()
+        try:
+            gen = fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001
+            return self._error_reply(spec, e)
+        if not hasattr(gen, "__next__") and not hasattr(gen, "__anext__"):
+            return self._error_reply(
+                spec,
+                TypeError(
+                    'num_returns="streaming" requires a generator function'
+                ),
+            )
+        owner = self.client_pool.get(*spec.owner_address)
+        count = 0
+        while True:
+            try:
+                if hasattr(gen, "__anext__"):
+                    try:
+                        item = await gen.__anext__()
+                    except StopAsyncIteration:
+                        break
+                else:
+                    item = await self.loop.run_in_executor(
+                        self._executor_pool, next, gen, _SENTINEL
+                    )
+                    if item is _SENTINEL:
+                        break
+            except Exception as e:  # noqa: BLE001 — generator raised mid-stream
+                reply = self._error_reply(spec, e)
+                reply.num_streamed = count
+                return reply
+            object_id = ObjectID.for_task_return(spec.task_id, count)
+            meta, bufs = serialization.serialize(item)
+            size = serialization.packed_size(meta, bufs)
+            if size <= self.config.max_direct_call_object_size:
+                packed = bytearray(size)
+                serialization.pack_into(meta, bufs, memoryview(packed))
+                await owner.call(
+                    "report_generator_item", spec.task_id, count,
+                    bytes(packed), size, False, None,
+                )
+            else:
+                await self._put_plasma(
+                    object_id, meta, bufs, size, primary=True
+                )
+                await owner.call(
+                    "report_generator_item", spec.task_id, count,
+                    None, size, True, self.raylet_address,
+                )
+            count += 1
+        return TaskReply(
+            task_id=spec.task_id, returns=[], error=None, num_streamed=count
+        )
 
     async def _run_user_code(self, fn, args, kwargs, spec: TaskSpec):
         if asyncio.iscoroutinefunction(fn):
@@ -1037,59 +1206,96 @@ class CoreWorker:
         ORIGINAL seq (the client cannot know whether the lost RPC executed);
         stale seqs answer from the reply cache instead of re-executing."""
         caller = spec.owner_worker_id
+        seq = spec.sequence_number
+        inflight = self._caller_inflight[caller]
+        existing = inflight.get(seq)
+        if existing is not None:
+            # duplicate delivery racing the ORIGINAL (connection died while
+            # the call executes; the client resent): share its outcome —
+            # re-executing here is the double-apply this dedup exists to
+            # prevent. shield(): this duplicate's cancellation must not
+            # cancel the original execution.
+            return await asyncio.shield(existing)
         expected = self._caller_expected_seq[caller]
-        if spec.sequence_number < expected:
-            # duplicate delivery: the call already executed but its reply
-            # was lost in flight (reference: the dedup the executor does by
-            # seq-no). Serve the cached reply.
-            cached = self._caller_replies[caller].get(spec.sequence_number)
+        if seq < expected:
+            # duplicate delivery after completion: reply was lost in flight
+            # (reference: the dedup the executor does by seq-no). Serve the
+            # cached reply.
+            cached = self._caller_replies[caller].get(seq)
             if cached is not None:
-                return cached
+                return cached[0]
             return self._error_reply(
                 spec,
                 RuntimeError(
-                    f"duplicate actor task seq {spec.sequence_number} "
+                    f"duplicate actor task seq {seq} "
                     f"(expected {expected}) with evicted reply"
                 ),
             )
-        if spec.sequence_number != expected:
-            # park until predecessors arrive
-            parked = self._caller_parked[caller]
-            ev = asyncio.Event()
-            parked[spec.sequence_number] = ev
-            await ev.wait()
+        fut: asyncio.Future = self.loop.create_future()
+        inflight[seq] = fut
+        try:
+            if seq != expected:
+                # park until predecessors arrive
+                parked = self._caller_parked[caller]
+                ev = asyncio.Event()
+                parked[seq] = ev
+                await ev.wait()
 
-        def _advance():
-            self._caller_expected_seq[caller] = spec.sequence_number + 1
-            nxt = self._caller_parked[caller].pop(spec.sequence_number + 1, None)
-            if nxt is not None:
-                nxt.set()
+            def _advance():
+                self._caller_expected_seq[caller] = seq + 1
+                nxt = self._caller_parked[caller].pop(seq + 1, None)
+                if nxt is not None:
+                    nxt.set()
 
-        def _cache_reply(reply: TaskReply):
-            replies = self._caller_replies[caller]
-            replies[spec.sequence_number] = reply
-            while len(replies) > 64:
-                replies.pop(next(iter(replies)))
+            def _cache_reply(reply: TaskReply):
+                size = sum(
+                    len(r.value) if r.value is not None else 64
+                    for r in reply.returns
+                )
+                replies = self._caller_replies[caller]
+                replies[seq] = (reply, size)
+                # bound by entries AND bytes: dedup only needs a short
+                # window, not an unbounded payload pin
+                total = sum(s for _r, s in replies.values())
+                while replies and (len(replies) > 64 or total > 4 * 1024 * 1024):
+                    _k, (_r, s) = next(iter(replies.items()))
+                    replies.pop(_k)
+                    total -= s
 
-        max_conc = self._actor_spec.max_concurrency if self._actor_spec else 1
-        if max_conc > 1:
-            # concurrent actor (reference: async/threaded actors via
-            # OutOfOrderActorSchedulingQueue): ordering guarantees start
-            # order only — release the next task as soon as this one begins;
-            # a semaphore still caps in-flight executions at max_concurrency
-            if self._actor_semaphore is None:
-                self._actor_semaphore = asyncio.Semaphore(max_conc)
-            _advance()
-            async with self._actor_semaphore:
+            max_conc = (
+                self._actor_spec.max_concurrency if self._actor_spec else 1
+            )
+            if max_conc > 1:
+                # concurrent actor (reference: async/threaded actors via
+                # OutOfOrderActorSchedulingQueue): ordering guarantees start
+                # order only — release the next task as soon as this one
+                # begins; a semaphore caps in-flight executions
+                if self._actor_semaphore is None:
+                    self._actor_semaphore = asyncio.Semaphore(max_conc)
+                _advance()
+                async with self._actor_semaphore:
+                    reply = await self._execute_actor_task(spec)
+                    _cache_reply(reply)
+                    fut.set_result(reply)
+                    return reply
+            try:
                 reply = await self._execute_actor_task(spec)
                 _cache_reply(reply)
+                fut.set_result(reply)
                 return reply
-        try:
-            reply = await self._execute_actor_task(spec)
-            _cache_reply(reply)
-            return reply
+            finally:
+                _advance()
         finally:
-            _advance()
+            inflight.pop(seq, None)
+            if not fut.done():
+                # execution path failed before producing a reply: unblock
+                # any duplicate awaiting the shared outcome
+                fut.set_exception(
+                    RuntimeError("actor task aborted before completion")
+                )
+                # the exception is consumed by duplicates if any; otherwise
+                # mark it retrieved
+                fut.exception()
 
     async def _execute_actor_task(self, spec: TaskSpec) -> TaskReply:
         if self._actor_instance is None:

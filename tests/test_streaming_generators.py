@@ -1,0 +1,95 @@
+"""Streaming generators (reference: num_returns="streaming" ->
+ObjectRefGenerator backed by ObjectRefStream, task_manager.h:67 and
+ReportGeneratorItemReturns, core_worker.proto:507)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.object_ref import ObjectRefGenerator
+
+
+def test_basic_stream(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    g = gen.remote(6)
+    assert isinstance(g, ObjectRefGenerator)
+    out = [ray_tpu.get(ref, timeout=60) for ref in g]
+    assert out == [i * i for i in range(6)]
+
+
+def test_items_stream_before_task_finishes(ray_start_regular):
+    """The first item is consumable while the producer still runs."""
+    @ray_tpu.remote
+    def warm():
+        return True
+
+    ray_tpu.get(warm.remote(), timeout=60)  # absorb worker-spawn latency
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        yield "first"
+        time.sleep(3.0)
+        yield "second"
+
+    g = slow_gen.remote()
+    t0 = time.time()
+    first = ray_tpu.get(next(g), timeout=60)
+    first_latency = time.time() - t0
+    assert first == "first"
+    assert first_latency < 2.5  # did not wait for the full 3s producer
+    assert ray_tpu.get(next(g), timeout=60) == "second"
+    with pytest.raises(StopIteration):
+        next(g)
+
+
+def test_large_items_via_plasma(ray_start_regular):
+    import numpy as np
+
+    @ray_tpu.remote(num_returns="streaming")
+    def big_gen():
+        for i in range(3):
+            yield np.full((300_000,), i, np.float32)  # > inline threshold
+
+    vals = [ray_tpu.get(r, timeout=120) for r in big_gen.remote()]
+    assert [float(v[0]) for v in vals] == [0.0, 1.0, 2.0]
+    assert all(v.shape == (300_000,) for v in vals)
+
+
+def test_mid_stream_error_after_yields(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming", max_retries=0)
+    def bad_gen():
+        yield 1
+        yield 2
+        raise RuntimeError("stream broke")
+
+    g = bad_gen.remote()
+    assert ray_tpu.get(next(g), timeout=60) == 1
+    assert ray_tpu.get(next(g), timeout=60) == 2
+    with pytest.raises(Exception, match="stream broke"):
+        next(g)
+
+
+def test_non_generator_function_errors(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming", max_retries=0)
+    def not_a_gen():
+        return 42
+
+    g = not_a_gen.remote()
+    with pytest.raises(Exception, match="generator"):
+        next(g)
+
+
+def test_actor_streaming_unsupported_is_clear(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def gen(self):
+            yield 1
+
+    a = A.remote()
+    with pytest.raises(NotImplementedError, match="streaming"):
+        a.gen.options(num_returns="streaming").remote()
