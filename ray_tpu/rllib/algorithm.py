@@ -205,9 +205,11 @@ class PPO:
 PPOConfig.algo_class = PPO
 
 
-def as_trainable(config: PPOConfig):
-    """Adapt to a Tune trainable: tune.Tuner(rllib.as_trainable(cfg), ...).
-    Overrides from the trial's param space are applied onto the config."""
+def as_trainable(config):
+    """Adapt ANY algorithm config (PPO/IMPALA/DQN/SAC/BC...) to a Tune
+    trainable: tune.Tuner(rllib.as_trainable(cfg), ...). Overrides from the
+    trial's param space are applied onto the config (reference: Algorithm
+    being a Tune Trainable, rllib/algorithms/algorithm.py:212)."""
 
     def _train_fn(trial_config: dict):
         from .. import tune

@@ -146,6 +146,7 @@ class IMPALA:
         obs_dim, act_dim, discrete = space_dims(
             probe.observation_space, probe.action_space
         )
+        self.observation_space = probe.observation_space
         try:
             probe.close()
         except Exception:
@@ -332,6 +333,17 @@ class IMPALA:
         self.params = jax.tree.map(jnp.asarray, state["params"])
         self.opt_state = self.tx.init(self.params)
         self.iteration = state["iteration"]
+
+    def compute_single_action(self, obs):
+        from .env import encode_obs
+        from .models import sample_actions
+
+        enc = encode_obs(self.observation_space, np.asarray(obs)[None])
+        actions, _, _ = sample_actions(
+            self.model, self.params, jnp.asarray(enc),
+            jax.random.PRNGKey(self.iteration),
+        )
+        return np.asarray(actions)[0]
 
     def stop(self):
         for r in self.runners:

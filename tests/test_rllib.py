@@ -324,3 +324,25 @@ def test_bc_clones_expert(cluster, tmp_path):
     algo2 = config.build()
     algo2.restore(ckpt)
     assert algo2.compute_single_action(np.array([1.0, 0, 0, 0], np.float32)) == 1
+
+
+def test_impala_compute_single_action_and_tune_adapter(cluster):
+    from ray_tpu import rllib
+
+    config = (
+        rllib.IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=1,
+                     rollout_fragment_length=8)
+        .training(num_batches_per_iter=1)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    try:
+        algo.train()
+        assert algo.compute_single_action(np.zeros(4, np.float32)) in (0, 1)
+    finally:
+        algo.stop()
+    # generic as_trainable works for non-PPO configs
+    trainable = rllib.as_trainable(config)
+    assert callable(trainable)
