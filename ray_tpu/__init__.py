@@ -23,10 +23,71 @@ from .api import (
     shutdown,
     wait,
 )
-from .object_ref import ObjectRef
+from ._internal.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    UniqueID,
+    WorkerID,
+)
+from .object_ref import ObjectRef, ObjectRefGenerator
+from .runtime_context import get_runtime_context
 from . import exceptions
 
 __version__ = "0.1.0"
+
+
+def get_tpu_ids():
+    """Chip indices allocated to the current worker (reference role:
+    ray.get_gpu_ids, _private/worker.py:1170, for the TPU resource)."""
+    import os
+
+    raw = os.environ.get("TPU_VISIBLE_CHIPS", "")
+    return [int(x) for x in raw.split(",") if x.strip().isdigit()]
+
+
+def get_gpu_ids():
+    """GPU analogue kept for API familiarity; this framework schedules TPU
+    chips (see get_tpu_ids)."""
+    import os
+
+    raw = os.environ.get("CUDA_VISIBLE_DEVICES", "")
+    return [int(x) for x in raw.split(",") if x.strip().isdigit()]
+
+
+def timeline(filename=None):
+    """Chrome-trace export of the cluster task timeline (reference:
+    ray.timeline)."""
+    from .util.tracing import timeline as _timeline
+
+    return _timeline(filename)
+
+
+# Lazy subpackages (PEP 562): `import ray_tpu; ray_tpu.data...` works like
+# the reference's eager subpackage attributes without importing the heavy
+# jax-dependent libraries at top-level import time.
+_LAZY_SUBMODULES = (
+    "autoscaler", "client", "collective", "dag", "data", "experimental",
+    "llm", "models", "ops", "parallel", "rllib", "serve", "testing", "train",
+    "tune", "util", "cross_language",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        module = importlib.import_module(f".{name}", __name__)
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY_SUBMODULES))
 
 __all__ = [
     "init",
@@ -44,6 +105,19 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "ObjectRef",
+    "ObjectRefGenerator",
+    "get_runtime_context",
+    "get_tpu_ids",
+    "get_gpu_ids",
+    "timeline",
+    "ActorID",
+    "TaskID",
+    "ObjectID",
+    "NodeID",
+    "JobID",
+    "WorkerID",
+    "PlacementGroupID",
+    "UniqueID",
     "exceptions",
     "__version__",
 ]

@@ -1,0 +1,41 @@
+"""Cross-language call surface.
+
+Role-equivalent of the reference's ``ray.cross_language``
+(cross_language.py:15-66 — java_function/cpp_function descriptors invoked
+through msgpack serialization). Direction matters: this framework's
+cross-language path is INBOUND — non-Python clients call named Python
+functions through the client server's xlang endpoint with a C++ frontend
+(`ray_tpu/_native/xlang_client.cc`, JSON args over a mini-pickle wire).
+Outbound calls INTO C++/Java worker runtimes require those runtimes, which
+are not part of this framework; the stubs below say so explicitly instead
+of failing deep in submission.
+"""
+
+from __future__ import annotations
+
+_HINT = (
+    "; this framework's cross-language support is inbound (C++/other "
+    "languages calling Python via the client server's xlang endpoint — "
+    "see ray_tpu/_native/xlang_client.cc)"
+)
+
+
+def cpp_function(function_name: str):
+    raise NotImplementedError(
+        f"outbound calls into C++ workers are not supported"
+        f" (requested {function_name!r})" + _HINT
+    )
+
+
+def java_function(class_name: str, function_name: str):
+    raise NotImplementedError(
+        f"outbound calls into Java workers are not supported"
+        f" (requested {class_name}.{function_name})" + _HINT
+    )
+
+
+def java_actor_class(class_name: str):
+    raise NotImplementedError(
+        f"Java actor classes are not supported (requested {class_name!r})"
+        + _HINT
+    )
