@@ -1,0 +1,146 @@
+"""Core-ops microbenchmark suite.
+
+Role-equivalent of the reference's microbenchmark
+(_private/ray_perf.py:95-200 driven by release/microbenchmark/
+run_microbenchmark.py): timed throughput of the hot runtime operations —
+put/get, task submission sync/async, actor calls sync/async, wait over many
+refs. Run via ``python -m ray_tpu._internal.perf`` or
+``ray_tpu microbenchmark``; prints one line per metric.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List
+
+
+def _rate(n_ops: int, fn: Callable[[], None]) -> float:
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    return n_ops / dt if dt > 0 else float("inf")
+
+
+def run_microbenchmarks(
+    *, small: bool = False, init_kwargs: Dict = None
+) -> Dict[str, float]:
+    """Returns {metric: ops_per_second}. ``small`` shrinks op counts for CI.
+
+    The op set mirrors ray_perf.py's: single-client put/get, batch put GB/s,
+    tasks sync (per-call get) and async (fan-out then drain), 1:1 actor
+    calls sync/async, wait over 1k refs.
+    """
+    import numpy as np
+
+    import ray_tpu
+
+    scale = 0.1 if small else 1.0
+    results: Dict[str, float] = {}
+    owns_cluster = not ray_tpu.is_initialized()
+    if owns_cluster:
+        ray_tpu.init(**(init_kwargs or {"num_cpus": 4}))
+
+    try:
+        # -- puts/gets ------------------------------------------------------
+        n = max(int(1000 * scale), 50)
+        payload = b"x" * 1024
+
+        def put_loop():
+            for _ in range(n):
+                ray_tpu.put(payload)
+
+        results["single_client_put_1kb"] = _rate(n, put_loop)
+
+        refs = [ray_tpu.put(payload) for _ in range(n)]
+
+        def get_loop():
+            for r in refs:
+                ray_tpu.get(r)
+
+        results["single_client_get_1kb"] = _rate(n, get_loop)
+
+        # put gigabytes (plasma path)
+        nbig = max(int(10 * scale), 2)
+        big = np.zeros(10 * 1024 * 1024, np.uint8)  # 10 MB
+        t0 = time.perf_counter()
+        big_refs = [ray_tpu.put(big + i) for i in range(nbig)]
+        for r in big_refs:
+            ray_tpu.get(r)
+        dt = time.perf_counter() - t0
+        results["single_client_put_get_gb_s"] = (
+            nbig * big.nbytes * 2 / dt / 1e9
+        )
+
+        # -- tasks ----------------------------------------------------------
+        @ray_tpu.remote
+        def noop(x=None):
+            return x
+
+        ray_tpu.get(noop.remote())  # warm worker pool
+
+        nt = max(int(200 * scale), 20)
+
+        def tasks_sync():
+            for _ in range(nt):
+                ray_tpu.get(noop.remote())
+
+        results["single_client_tasks_sync"] = _rate(nt, tasks_sync)
+
+        def tasks_async():
+            ray_tpu.get([noop.remote() for _ in range(nt)])
+
+        results["single_client_tasks_async"] = _rate(nt, tasks_async)
+
+        # -- actors ---------------------------------------------------------
+        @ray_tpu.remote
+        class Echo:
+            def ping(self, x=None):
+                return x
+
+        actor = Echo.remote()
+        ray_tpu.get(actor.ping.remote())
+
+        na = max(int(200 * scale), 20)
+
+        def actor_sync():
+            for _ in range(na):
+                ray_tpu.get(actor.ping.remote())
+
+        results["one_to_one_actor_calls_sync"] = _rate(na, actor_sync)
+
+        def actor_async():
+            ray_tpu.get([actor.ping.remote() for _ in range(na)])
+
+        results["one_to_one_actor_calls_async"] = _rate(na, actor_async)
+
+        # -- wait over many refs -------------------------------------------
+        nw = max(int(1000 * scale), 100)
+        wait_refs: List = [ray_tpu.put(i) for i in range(nw)]
+        t0 = time.perf_counter()
+        ready, not_ready = ray_tpu.wait(
+            wait_refs, num_returns=len(wait_refs), timeout=60
+        )
+        dt = time.perf_counter() - t0
+        results[f"single_client_wait_{nw}_refs_s"] = dt
+        assert len(ready) == nw
+    finally:
+        if owns_cluster:
+            ray_tpu.shutdown()
+    return results
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--small", action="store_true")
+    args = parser.parse_args()
+    for metric, value in run_microbenchmarks(small=args.small).items():
+        unit = "s" if metric.endswith("_s") and "gb" not in metric else (
+            "GB/s" if "gb_s" in metric else "ops/s"
+        )
+        print(f"{metric}: {value:.2f} {unit}")
+
+
+if __name__ == "__main__":
+    main()

@@ -88,6 +88,14 @@ def _connected(args):
     return ray_tpu
 
 
+def cmd_microbenchmark(args):
+    from .._internal.perf import run_microbenchmarks
+
+    for metric, value in run_microbenchmarks(small=args.small).items():
+        print(f"{metric}: {value:.2f}")
+    return 0
+
+
 def cmd_status(args):
     _connected(args)
     from ..util import state
@@ -219,6 +227,13 @@ def main(argv=None):
     )
     p.add_argument("--address", required=True, help="head host:port")
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser(
+        "microbenchmark", help="core-ops throughput suite "
+        "(reference: release/microbenchmark)",
+    )
+    p.add_argument("--small", action="store_true")
+    p.set_defaults(fn=cmd_microbenchmark)
 
     args = parser.parse_args(argv)
     return args.fn(args) or 0

@@ -1,0 +1,59 @@
+"""Microbenchmark suite smoke (reference: _private/ray_perf.py metrics run
+in release/microbenchmark) — correctness of the harness, not speed."""
+
+import ray_tpu
+from ray_tpu._internal.perf import run_microbenchmarks
+
+
+def test_microbenchmarks_produce_all_metrics(shutdown_only):
+    results = run_microbenchmarks(small=True)
+    expected = {
+        "single_client_put_1kb",
+        "single_client_get_1kb",
+        "single_client_put_get_gb_s",
+        "single_client_tasks_sync",
+        "single_client_tasks_async",
+        "one_to_one_actor_calls_sync",
+        "one_to_one_actor_calls_async",
+        "single_client_wait_100_refs_s",
+    }
+    assert expected <= set(results)
+    for metric, value in results.items():
+        assert value > 0, (metric, value)
+    assert not ray_tpu.is_initialized()  # the suite cleans up after itself
+
+
+def test_scale_smoke_queued_tasks(shutdown_only):
+    """Queue-depth envelope smoke (BASELINE.md 'tasks queued on a single
+    node'): hundreds of queued no-op tasks on 2 workers all complete
+    correctly. (Sized for the 1-core CI box; the envelope itself is
+    documented in BASELINE.md.)"""
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    refs = [f.remote(i) for i in range(400)]
+    out = ray_tpu.get(refs, timeout=600)
+    assert out == list(range(400))
+
+
+def test_scale_smoke_many_actors(shutdown_only):
+    """Actor-count envelope smoke: 40 concurrently alive zero-cpu actors."""
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    actors = [A.remote(i) for i in range(16)]
+    assert ray_tpu.get([a.who.remote() for a in actors], timeout=600) == list(
+        range(16)
+    )
+    for a in actors:
+        ray_tpu.kill(a)
