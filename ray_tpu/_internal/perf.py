@@ -21,10 +21,22 @@ def _rate(n_ops: int, fn: Callable[[], None]) -> float:
     return n_ops / dt if dt > 0 else float("inf")
 
 
+def metric_unit(metric: str) -> str:
+    """Unit per metric: ops/s by default; *_gb_s rates are GB/s and
+    *_refs_s entries are durations in seconds (lower is better)."""
+    if "gb_s" in metric:
+        return "GB/s"
+    if metric.endswith("_s"):
+        return "s"
+    return "ops/s"
+
+
 def run_microbenchmarks(
     *, small: bool = False, init_kwargs: Dict = None
 ) -> Dict[str, float]:
-    """Returns {metric: ops_per_second}. ``small`` shrinks op counts for CI.
+    """Returns {metric: value} — see ``metric_unit`` for each metric's unit
+    (most are ops/s; ``*_gb_s`` is GB/s; ``*_refs_s`` is a duration where
+    LOWER is better). ``small`` shrinks op counts for CI.
 
     The op set mirrors ray_perf.py's: single-client put/get, batch put GB/s,
     tasks sync (per-call get) and async (fan-out then drain), 1:1 actor
@@ -38,7 +50,9 @@ def run_microbenchmarks(
     results: Dict[str, float] = {}
     owns_cluster = not ray_tpu.is_initialized()
     if owns_cluster:
-        ray_tpu.init(**(init_kwargs or {"num_cpus": 4}))
+        ray_tpu.init(
+            **(init_kwargs if init_kwargs is not None else {"num_cpus": 4})
+        )
 
     try:
         # -- puts/gets ------------------------------------------------------
@@ -129,17 +143,18 @@ def run_microbenchmarks(
     return results
 
 
+def print_results(results: Dict[str, float]) -> None:
+    for metric, value in results.items():
+        print(f"{metric}: {value:.2f} {metric_unit(metric)}")
+
+
 def main():
     import argparse
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--small", action="store_true")
     args = parser.parse_args()
-    for metric, value in run_microbenchmarks(small=args.small).items():
-        unit = "s" if metric.endswith("_s") and "gb" not in metric else (
-            "GB/s" if "gb_s" in metric else "ops/s"
-        )
-        print(f"{metric}: {value:.2f} {unit}")
+    print_results(run_microbenchmarks(small=args.small))
 
 
 if __name__ == "__main__":

@@ -89,10 +89,9 @@ def _connected(args):
 
 
 def cmd_microbenchmark(args):
-    from .._internal.perf import run_microbenchmarks
+    from .._internal.perf import print_results, run_microbenchmarks
 
-    for metric, value in run_microbenchmarks(small=args.small).items():
-        print(f"{metric}: {value:.2f}")
+    print_results(run_microbenchmarks(small=args.small))
     return 0
 
 

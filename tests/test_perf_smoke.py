@@ -40,7 +40,8 @@ def test_scale_smoke_queued_tasks(shutdown_only):
 
 
 def test_scale_smoke_many_actors(shutdown_only):
-    """Actor-count envelope smoke: 40 concurrently alive zero-cpu actors."""
+    """Actor-count envelope smoke: 16 concurrently alive zero-cpu actors
+    (sized for the 1-core CI box; the reference envelope is BASELINE.md's)."""
     ray_tpu.init(num_cpus=4)
 
     @ray_tpu.remote(num_cpus=0)
