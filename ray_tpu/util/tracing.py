@@ -146,3 +146,32 @@ def device_trace(log_dir: str = "/tmp/ray_tpu_trace"):
         yield log_dir
     finally:
         stop_device_trace()
+
+
+@contextmanager
+def device_profile(logdir: str, *, host_tracer_level: int = 2):
+    """Capture a device (TPU/XLA) profile around a block of jax work
+    (SURVEY §5: 'jax.profiler traces + XPlane export' as the TPU analogue of
+    the reference's NVTX/torch profiling flags). Writes an XPlane trace a
+    TensorBoard profiler plugin can open:
+
+        with ray_tpu.util.tracing.device_profile("/tmp/prof"):
+            train_step(...)
+    """
+    import jax
+
+    jax.profiler.start_trace(
+        logdir, create_perfetto_link=False, create_perfetto_trace=False
+    )
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate_device_trace(name: str):
+    """Named region inside a device profile (jax.profiler.TraceAnnotation):
+    shows up in the XPlane timeline around the annotated host-side dispatch."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -170,3 +170,23 @@ def test_cli_status_and_list(cluster):
     assert out.returncode == 0, out.stderr
     nodes = json.loads(out.stdout)
     assert len(nodes) >= 1
+
+
+def test_device_profile_writes_xplane(tmp_path):
+    """jax.profiler wrapper produces an XPlane trace dir (SURVEY §5)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.util import tracing
+
+    logdir = str(tmp_path / "prof")
+    with tracing.device_profile(logdir):
+        with tracing.annotate_device_trace("matmul_block"):
+            x = jnp.ones((64, 64))
+            jax.block_until_ready(x @ x)
+    found = []
+    for root, _dirs, files in os.walk(logdir):
+        found.extend(f for f in files if f.endswith((".pb", ".xplane.pb")))
+    assert found, f"no profile artifacts under {logdir}"
