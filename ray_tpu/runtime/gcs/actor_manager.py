@@ -113,7 +113,12 @@ class GcsActorManager:
 
     def get_by_name(self, name: str, namespace: str) -> Optional[ActorInfo]:
         actor_id = self._named.get((namespace, name))
-        return self._actors.get(actor_id) if actor_id else None
+        info = self._actors.get(actor_id) if actor_id else None
+        if info is not None and info.state == ActorState.DEAD:
+            # a dead actor's name is free again (reference: named-actor
+            # lookup misses after death); callers re-create under the name
+            return None
+        return info
 
     def list_actors(self):
         return list(self._actors.values())

@@ -131,6 +131,15 @@ class DeploymentHandle:
         metadata = None
         if self._multiplexed_model_id:
             metadata = {"multiplexed_model_id": self._multiplexed_model_id}
+        # response chaining (reference: passing DeploymentResponse into a
+        # downstream .remote — serve/handle.py): a response argument becomes
+        # its ObjectRef, which the task-arg machinery resolves to the VALUE
+        # before the replica method runs — no blocking .result() in between
+        def chain(x):
+            return x._to_object_ref() if isinstance(x, DeploymentResponse) else x
+
+        args = tuple(chain(a) for a in args)
+        kwargs = {k: chain(v) for k, v in kwargs.items()}
         ref = replica.handle_request.remote(self._method, args, kwargs, metadata)
         return DeploymentResponse(ref)
 

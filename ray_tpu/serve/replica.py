@@ -55,6 +55,27 @@ class Replica:
             from .multiplex import _set_multiplexed_model_id
 
             _set_multiplexed_model_id(metadata["multiplexed_model_id"])
+        # response chaining (reference: DeploymentResponse args resolve to
+        # their values before the method runs): the handle converted chained
+        # responses to ObjectRefs; they arrive nested inside the args tuple
+        # (only top-level task args auto-resolve), so resolve here
+        from ..object_ref import ObjectRef
+
+        if any(isinstance(a, ObjectRef) for a in args) or any(
+            isinstance(v, ObjectRef) for v in kwargs.values()
+        ):
+            from .. import api as ray_api
+
+            async def resolve(x):
+                if isinstance(x, ObjectRef):
+                    loop = asyncio.get_running_loop()
+                    return await loop.run_in_executor(
+                        None, lambda: ray_api.get(x, timeout=60)
+                    )
+                return x
+
+            args = tuple([await resolve(a) for a in args])
+            kwargs = {k: await resolve(v) for k, v in kwargs.items()}
         try:
             if self._is_function:
                 fn = self._callable

@@ -416,3 +416,33 @@ def test_grpc_ingress(cluster):
         assert out2 == "HI"
     finally:
         serve.shutdown()
+
+
+def test_response_chaining(cluster):
+    """A DeploymentResponse passed into another handle call resolves to its
+    VALUE before the downstream method runs (reference: model composition by
+    passing responses between deployments)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Adder:
+        def __call__(self, x):
+            assert isinstance(x, int), f"chained arg not resolved: {x!r}"
+            return x + 1
+
+    serve.run(Doubler.bind(), name="chain_doubler", _proxy=False)
+    serve.run(Adder.bind(), name="chain_adder", _proxy=False)
+    try:
+        doubler = serve.get_app_handle("chain_doubler")
+        adder = serve.get_app_handle("chain_adder")
+        resp = doubler.remote(20)          # -> 40 (not awaited)
+        out = adder.remote(resp).result(timeout_s=60)
+        assert out == 41
+    finally:
+        serve.delete("chain_doubler")
+        serve.delete("chain_adder")
