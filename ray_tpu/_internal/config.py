@@ -63,6 +63,10 @@ class Config:
     health_check_timeout_s: float = 10.0
     task_retry_delay_s: float = 0.05
     actor_restart_delay_s: float = 0.1
+    # Durable GCS metadata (reference: RedisStoreClient,
+    # redis_store_client.h:126). Empty = in-memory tables; a path selects the
+    # sqlite WAL backend so actors/PGs/KV/jobs survive a GCS restart.
+    gcs_storage_path: str = ""
 
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0

@@ -12,7 +12,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Dict, Optional, TYPE_CHECKING
+import pickle
+from typing import Dict, Optional, Set, TYPE_CHECKING
+
+import cloudpickle
 
 from ..._internal.ids import ActorID, NodeID, WorkerID
 from ..._internal.protocol import ActorInfo, ActorState, TaskSpec
@@ -20,6 +23,7 @@ from ...exceptions import ActorUnschedulableError
 
 if TYPE_CHECKING:
     from .server import GcsServer
+    from .store import StoreClient
 
 logger = logging.getLogger(__name__)
 
@@ -33,6 +37,71 @@ class GcsActorManager:
         # node_id -> set of actor ids placed there
         self._by_node: Dict[NodeID, set] = {}
         self._by_worker: Dict[WorkerID, ActorID] = {}
+
+    # -- persistence (reference: GcsActorTable on the store client) --------
+
+    def _persist(self, info: ActorInfo):
+        try:
+            self._gcs.storage.put(
+                "actors", info.actor_id.hex(), cloudpickle.dumps(info)
+            )
+        except Exception:
+            logger.exception("failed to persist actor %s", info.actor_id)
+
+    def restore_from(self, storage: "StoreClient") -> Set[NodeID]:
+        """Reload the actor directory after a GCS restart. ALIVE actors keep
+        their addresses (their workers are expected to still run); PENDING/
+        RESTARTING actors get their scheduling loop kicked again. Returns the
+        node ids that restored ALIVE actors reference so the server can
+        grace-period them (reference: gcs_actor_manager.cc Initialize())."""
+        nodes: Set[NodeID] = set()
+        for key, raw in storage.get_all("actors").items():
+            try:
+                info: ActorInfo = pickle.loads(raw)
+            except Exception:
+                logger.exception("dropping unreadable actor record %s", key)
+                continue
+            self._actors[info.actor_id] = info
+            if info.name and info.state != ActorState.DEAD:
+                self._named[(info.namespace, info.name)] = info.actor_id
+            if info.state == ActorState.ALIVE:
+                if info.node_id is not None:
+                    self._by_node.setdefault(info.node_id, set()).add(
+                        info.actor_id
+                    )
+                    nodes.add(info.node_id)
+                if info.worker_id is not None:
+                    self._by_worker[info.worker_id] = info.actor_id
+            elif info.state in (
+                ActorState.PENDING_CREATION,
+                ActorState.RESTARTING,
+            ):
+                self._gcs.spawn(self._schedule(info))
+        if self._actors:
+            logger.info("restored %d actor record(s)", len(self._actors))
+        return nodes
+
+    def reconcile_node(self, node_id: NodeID, live_worker_ids):
+        """A raylet (re-)registered, reporting which workers it still runs:
+        ALIVE actors bound to vanished workers on that node died while the
+        GCS was away — put them through the normal failure path."""
+        if live_worker_ids is None:
+            return
+        live = set(live_worker_ids)
+        for actor_id in list(self._by_node.get(node_id, ())):
+            info = self._actors.get(actor_id)
+            if (
+                info is not None
+                and info.state == ActorState.ALIVE
+                and info.worker_id is not None
+                and info.worker_id not in live
+            ):
+                self._by_worker.pop(info.worker_id, None)
+                self._gcs.spawn(
+                    self._handle_actor_failure(
+                        actor_id, "worker lost while GCS was down"
+                    )
+                )
 
     # -- registration / scheduling ----------------------------------------
 
@@ -62,7 +131,8 @@ class GcsActorManager:
         self._actors[actor_id] = info
         if spec.actor_name:
             self._named[name_key] = actor_id
-        asyncio.ensure_future(self._schedule(info))
+        self._persist(info)
+        self._gcs.spawn(self._schedule(info))
         return info
 
     async def _schedule(self, info: ActorInfo):
@@ -99,6 +169,7 @@ class GcsActorManager:
             info.worker_id = worker_id
             self._by_node.setdefault(node_id, set()).add(info.actor_id)
             self._by_worker[worker_id] = info.actor_id
+            self._persist(info)
             self._publish(info)
             logger.info("actor %s alive on %s", info.actor_id, worker_addr)
             return
@@ -147,13 +218,14 @@ class GcsActorManager:
             info.num_restarts += 1
             info.state = ActorState.RESTARTING
             info.address = None
+            self._persist(info)
             self._publish(info)
             logger.info(
                 "restarting actor %s (%d/%s): %s",
                 actor_id, info.num_restarts,
                 "inf" if unlimited else info.max_restarts, reason,
             )
-            asyncio.ensure_future(self._schedule(info))
+            self._gcs.spawn(self._schedule(info))
         else:
             await self._mark_dead(info, reason)
 
@@ -161,6 +233,7 @@ class GcsActorManager:
         info.state = ActorState.DEAD
         info.death_cause = reason
         info.address = None
+        self._persist(info)
         self._publish(info)
 
     async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):

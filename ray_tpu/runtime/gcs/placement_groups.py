@@ -18,7 +18,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Dict, List, Optional, TYPE_CHECKING
+import pickle
+from typing import Dict, List, Optional, Set, TYPE_CHECKING
+
+import cloudpickle
 
 from ..._internal.ids import NodeID, PlacementGroupID
 from ..._internal.protocol import (
@@ -31,6 +34,7 @@ from ..._internal.protocol import (
 
 if TYPE_CHECKING:
     from .server import GcsServer
+    from .store import StoreClient
 
 logger = logging.getLogger(__name__)
 
@@ -51,12 +55,56 @@ class GcsPlacementGroupManager:
         self._named: Dict[str, PlacementGroupID] = {}
         self._ready_events: Dict[PlacementGroupID, asyncio.Event] = {}
 
+    # -- persistence (reference: GcsPlacementGroupTable) -------------------
+
+    def _persist(self, info: PlacementGroupInfo):
+        try:
+            self._gcs.storage.put(
+                "pgs", info.placement_group_id.hex(), cloudpickle.dumps(info)
+            )
+        except Exception:
+            logger.exception(
+                "failed to persist placement group %s", info.placement_group_id
+            )
+
+    def restore_from(self, storage: "StoreClient") -> Set[NodeID]:
+        """Reload placement groups after a GCS restart: CREATED groups keep
+        their bundle placements (the raylets still hold the reservations);
+        pending groups re-enter the scheduling loop. Returns node ids that
+        committed bundles reference for the server's re-registration grace
+        window."""
+        nodes: Set[NodeID] = set()
+        for key, raw in storage.get_all("pgs").items():
+            try:
+                info: PlacementGroupInfo = pickle.loads(raw)
+            except Exception:
+                logger.exception("dropping unreadable pg record %s", key)
+                continue
+            if info.state == PlacementGroupState.REMOVED:
+                continue
+            self._groups[info.placement_group_id] = info
+            if info.name:
+                self._named[info.name] = info.placement_group_id
+            ev = asyncio.Event()
+            self._ready_events[info.placement_group_id] = ev
+            if info.state == PlacementGroupState.CREATED:
+                ev.set()
+                for bundle in info.bundles:
+                    if bundle.node_id is not None:
+                        nodes.add(bundle.node_id)
+            else:
+                self._gcs.spawn(self._schedule_with_retry(info))
+        if self._groups:
+            logger.info("restored %d placement group(s)", len(self._groups))
+        return nodes
+
     async def create(self, info: PlacementGroupInfo) -> PlacementGroupID:
         self._groups[info.placement_group_id] = info
         if info.name:
             self._named[info.name] = info.placement_group_id
         self._ready_events[info.placement_group_id] = asyncio.Event()
-        asyncio.ensure_future(self._schedule_with_retry(info))
+        self._persist(info)
+        self._gcs.spawn(self._schedule_with_retry(info))
         return info.placement_group_id
 
     async def _schedule_with_retry(self, info: PlacementGroupInfo):
@@ -68,6 +116,7 @@ class GcsPlacementGroupManager:
             ok = await self._try_schedule(info)
             if ok:
                 info.state = PlacementGroupState.CREATED
+                self._persist(info)
                 self._ready_events[info.placement_group_id].set()
                 self._gcs.publisher.publish(
                     f"placement_group:{info.placement_group_id.hex()}", info
@@ -231,6 +280,7 @@ class GcsPlacementGroupManager:
                 except Exception:
                     pass
                 bundle.node_id = None
+        self._gcs.storage.delete("pgs", pg_id.hex())
         self._gcs.publisher.publish(f"placement_group:{pg_id.hex()}", info)
 
     async def on_node_death(self, node_id: NodeID):
@@ -252,5 +302,6 @@ class GcsPlacementGroupManager:
                         pass
                 bundle.node_id = None
             info.state = PlacementGroupState.RESCHEDULING
+            self._persist(info)
             self._ready_events[info.placement_group_id].clear()
-            asyncio.ensure_future(self._schedule_with_retry(info))
+            self._gcs.spawn(self._schedule_with_retry(info))

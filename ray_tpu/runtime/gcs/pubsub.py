@@ -88,7 +88,25 @@ class SubscriberClient:
             self._task = asyncio.ensure_future(self._poll_loop())
 
     async def _poll_loop(self):
+        resubscribe = False
         while not self._stopped:
+            if resubscribe:
+                # the publisher process restarted and lost its subscription
+                # table: re-announce every channel before polling again, or
+                # published messages silently stop routing to us
+                try:
+                    for pattern in list(self._callbacks):
+                        await self._client.call(
+                            "subscribe", self.subscriber_id, pattern
+                        )
+                    resubscribe = False
+                except asyncio.CancelledError:
+                    return
+                except Exception:
+                    if self._stopped:
+                        return
+                    await asyncio.sleep(0.5)
+                    continue
             try:
                 messages = await self._client.call(
                     "subscriber_poll", self.subscriber_id, timeout=60.0
@@ -98,6 +116,7 @@ class SubscriberClient:
             except Exception:
                 if self._stopped:
                     return
+                resubscribe = True
                 await asyncio.sleep(0.5)
                 continue
             for channel, message in messages:

@@ -6,17 +6,23 @@ pubsub, the actor directory/scheduler, the placement-group manager, job
 accounting, cluster resource views, and raylet health checking. Every other
 component finds the cluster through this service's address.
 
-Storage is the in-memory store client equivalent; all tables live in process
-(reference: InMemoryStoreClient). A persistent backend can be slotted in by
-swapping the plain dicts for a store client.
+Storage is pluggable (reference: store_client/): the working set stays in
+plain dicts for O(1) serving, with write-through to a ``StoreClient``. With
+``gcs_storage_path`` configured the sqlite WAL backend makes actors, PGs,
+jobs, and the internal KV survive a GCS restart; raylets re-register when
+their resource report lands on a GCS that does not know them (reference:
+NotifyGCSRestart, node_manager.proto:426).
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import pickle
 import time
 from typing import Dict, List, Optional, Tuple
+
+import cloudpickle
 
 from ..._internal.config import Config
 from ..._internal.event_loop import PeriodicRunner
@@ -24,6 +30,7 @@ from ..._internal.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
 from ..._internal.protocol import (
     label_match,
     ActorInfo,
+    ActorState,
     NodeInfo,
     PlacementGroupInfo,
     TaskSpec,
@@ -32,16 +39,18 @@ from ..._internal.rpc import ClientPool, RpcClient, RpcServer
 from .actor_manager import GcsActorManager
 from .placement_groups import GcsPlacementGroupManager
 from .pubsub import Publisher
+from .store import StoreClient, make_store
 
 logger = logging.getLogger(__name__)
 
 
 class GcsServer:
-    def __init__(self, config: Config):
+    def __init__(self, config: Config, storage: Optional[StoreClient] = None):
         self.config = config
         self.server = RpcServer("gcs")
         self.publisher = Publisher()
         self.client_pool = ClientPool("gcs-out")
+        self.storage = storage or make_store(config.gcs_storage_path)
         self.actor_manager = GcsActorManager(self)
         self.pg_manager = GcsPlacementGroupManager(self)
 
@@ -61,8 +70,28 @@ class GcsServer:
         self._autoscaling_state: Optional[dict] = None
         self._runner: Optional[PeriodicRunner] = None
         self.address: Optional[Tuple[str, int]] = None
+        # Nodes referenced by restored actors/PGs that have not re-registered
+        # yet: given one health-check window to come back, then declared dead
+        # (their raylets may have died with the previous GCS).
+        self._restored_nodes_pending: Dict[NodeID, float] = {}
+        # Background scheduling loops (actor/PG placement): tracked so stop()
+        # cancels them — a killed-and-restarted GCS must not leave zombie
+        # schedulers from the old instance double-creating actors.
+        self._bg_tasks: set = set()
+        self._stopped = False
+
+    def spawn(self, coro):
+        """ensure_future with lifecycle tracking; no-op after stop()."""
+        if self._stopped:
+            coro.close()
+            return None
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self._restore_state()
         self.server.register_service(self)
         self.server.register("subscribe", self._handle_subscribe)
         self.server.register("subscriber_poll", self._handle_subscriber_poll)
@@ -74,10 +103,48 @@ class GcsServer:
         return self.address
 
     async def stop(self):
+        self._stopped = True
+        for task in list(self._bg_tasks):
+            task.cancel()
+        self._bg_tasks.clear()
         if self._runner:
             self._runner.stop()
         await self.server.stop()
         await self.client_pool.close_all()
+        self.storage.close()
+
+    # -- persistence -------------------------------------------------------
+
+    def _restore_state(self):
+        """Reload durable tables on startup (reference: the GCS table
+        reload path in gcs_server.cc + gcs_init_data.h). With the in-memory
+        backend every table is empty and this is a no-op."""
+        self._kv = self.storage.get_all("kv")
+        for key, raw in self.storage.get_all("jobs").items():
+            try:
+                self._jobs[JobID.from_hex(key)] = pickle.loads(raw)
+            except Exception:
+                logger.exception("dropping unreadable job record %s", key)
+        raw_next = self.storage.get("meta", "next_job")
+        if raw_next is not None:
+            self._next_job = int(raw_next)
+        restored_nodes = set()
+        restored_nodes |= self.actor_manager.restore_from(self.storage)
+        restored_nodes |= self.pg_manager.restore_from(self.storage)
+        if restored_nodes:
+            deadline = time.time() + self.config.health_check_timeout_s
+            self._restored_nodes_pending = {
+                nid: deadline for nid in restored_nodes
+            }
+            logger.info(
+                "GCS restored state referencing %d node(s); waiting for "
+                "re-registration", len(restored_nodes),
+            )
+
+    def _persist_job(self, job_id: JobID):
+        job = self._jobs.get(job_id)
+        if job is not None:
+            self.storage.put("jobs", job_id.hex(), cloudpickle.dumps(job))
 
     # -- helpers -----------------------------------------------------------
 
@@ -131,15 +198,34 @@ class GcsServer:
 
     # -- node table --------------------------------------------------------
 
-    async def handle_register_node(self, info: NodeInfo):
+    async def handle_register_node(
+        self, info: NodeInfo, live_worker_ids=None, actor_workers=None
+    ):
         self._nodes[info.node_id] = info
         self._node_last_seen[info.node_id] = time.time()
+        self._restored_nodes_pending.pop(info.node_id, None)
         self.publisher.publish("node", ("alive", info))
+        # Re-registration after a GCS restart: name the actor workers this
+        # raylet still runs whose actors have moved on — e.g. the node missed
+        # the grace window, its actors restarted elsewhere, and now two
+        # incarnations would run side effects. Computed BEFORE reconcile so
+        # current records are compared, then vanished workers are failed.
+        stale_workers = []
+        if actor_workers:
+            for worker_id, actor_id in actor_workers.items():
+                actor = self.actor_manager.get(actor_id)
+                if (
+                    actor is None
+                    or actor.state == ActorState.DEAD
+                    or actor.worker_id != worker_id
+                ):
+                    stale_workers.append(worker_id)
+        self.actor_manager.reconcile_node(info.node_id, live_worker_ids)
         logger.info(
             "node %s registered: %s labels=%s", info.node_id, info.resources_total,
             info.labels,
         )
-        return True
+        return {"ok": True, "stale_workers": stale_workers}
 
     async def handle_unregister_node(self, node_id: NodeID):
         await self._mark_node_dead(node_id, "drained")
@@ -156,6 +242,11 @@ class GcsServer:
         subscribed raylets for spillback decisions. ``demands`` carries the
         raylet's queued lease requests for the autoscaler (reference:
         GcsAutoscalerStateManager, gcs_autoscaler_state_manager.h:41)."""
+        if node_id not in self._nodes:
+            # this GCS restarted and does not know the reporter: tell the
+            # raylet to re-register (reference: NotifyGCSRestart /
+            # RegisterNodeAgain, node_manager.proto:426)
+            return "unknown_node"
         self._node_last_seen[node_id] = time.time()
         prev = self._node_available.get(node_id)
         self._node_available[node_id] = available
@@ -216,6 +307,34 @@ class GcsServer:
             last = self._node_last_seen.get(node_id, now)
             if now - last > self.config.health_check_timeout_s:
                 await self._mark_node_dead(node_id, "health check timed out")
+        # Nodes referenced by restored state that never re-registered: their
+        # raylets died with the previous GCS — fail their actors/bundles.
+        for node_id, deadline in list(self._restored_nodes_pending.items()):
+            if now > deadline and node_id not in self._nodes:
+                self._restored_nodes_pending.pop(node_id, None)
+                logger.warning(
+                    "restored node %s never re-registered; declaring dead",
+                    node_id,
+                )
+                # synthesize the dead broadcast _mark_node_dead would have
+                # sent: surviving raylets must drop the node from their
+                # cluster views or spillback keeps targeting it. Only the
+                # node_id survived the restart, so the stub carries that.
+                self.publisher.publish(
+                    "node",
+                    (
+                        "dead",
+                        NodeInfo(
+                            node_id=node_id,
+                            address=("", 0),
+                            object_store_address="",
+                            resources_total={},
+                            alive=False,
+                        ),
+                    ),
+                )
+                await self.actor_manager.on_node_death(node_id)
+                await self.pg_manager.on_node_death(node_id)
 
     async def _mark_node_dead(self, node_id: NodeID, reason: str):
         node = self._nodes.get(node_id)
@@ -240,6 +359,7 @@ class GcsServer:
         if not overwrite and key in self._kv:
             return False
         self._kv[key] = value
+        self.storage.put("kv", key, value)
         return True
 
     async def handle_kv_get(self, key: str) -> Optional[bytes]:
@@ -249,6 +369,7 @@ class GcsServer:
         return {k: self._kv.get(k) for k in keys}
 
     async def handle_kv_del(self, key: str):
+        self.storage.delete("kv", key)
         return self._kv.pop(key, None) is not None
 
     async def handle_kv_exists(self, key: str):
@@ -374,6 +495,8 @@ class GcsServer:
         job_id = JobID.from_int(self._next_job)
         self._next_job += 1
         self._jobs[job_id] = {"metadata": metadata, "start_time": time.time()}
+        self.storage.put("meta", "next_job", str(self._next_job).encode())
+        self._persist_job(job_id)
         self.publisher.publish("job", ("started", job_id))
         return job_id
 
@@ -381,6 +504,7 @@ class GcsServer:
         job = self._jobs.get(job_id)
         if job is not None:
             job["end_time"] = time.time()
+            self._persist_job(job_id)
         await self.actor_manager.on_job_finished(job_id)
         self.publisher.publish("job", ("finished", job_id))
         return True

@@ -76,6 +76,25 @@ class Node:
     def node_id(self):
         return self.raylet.node_id
 
+    def kill_gcs_for_testing(self):
+        """Abruptly stop the GCS service (FT tests: the head process dies).
+        In-flight subscriber polls and RPCs fail exactly as they would on a
+        real GCS crash; tables die with the process unless gcs_storage_path
+        points at the durable backend."""
+        assert self.gcs is not None, "only the head node hosts the GCS"
+        self.loop_thread.run(self.gcs.stop(), timeout=10)
+
+    def restart_gcs_for_testing(self):
+        """Start a fresh GcsServer on the SAME address, reloading state from
+        the configured storage backend (reference: GCS restart with a Redis
+        backend + NotifyGCSRestart reconnects)."""
+        host, port = self.gcs_address
+        self.gcs = GcsServer(self.config)
+        self.gcs_address = self.loop_thread.run(
+            self.gcs.start(host, port), timeout=30
+        )
+        return self.gcs_address
+
     def stop(self):
         dashboard = getattr(self, "dashboard", None)
         if dashboard is not None:

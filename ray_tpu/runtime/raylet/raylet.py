@@ -148,14 +148,7 @@ class Raylet:
             auth_token=self.config.cluster_auth_token,
         )
         gcs = self.client_pool.get(*self.gcs_address)
-        info = NodeInfo(
-            node_id=self.node_id,
-            address=self.address,
-            object_store_address=self.store.session_id,
-            resources_total=self.resources.total_float(),
-            labels=dict(self.resources.labels),
-            is_head=self.is_head,
-        )
+        info = self._node_info()
         await gcs.call("register_node", info)
         self._cluster_nodes[self.node_id] = info
         # cluster view subscription
@@ -198,12 +191,73 @@ class Raylet:
         avail = self.resources.available_float()
         gcs = self.client_pool.get(*self.gcs_address)
         try:
-            await gcs.call(
+            reply = await gcs.call(
                 "report_resources", self.node_id, avail, self._pending_demands()
             )
         except Exception:
-            pass
+            return
+        if reply == "unknown_node":
+            # the GCS restarted and lost the node table: re-register,
+            # reporting which workers are still alive so restored actor
+            # records can be reconciled (reference: raylet reconnect on
+            # NotifyGCSRestart, node_manager.proto:426)
+            await self._reregister_with_gcs()
         self._last_reported = avail
+
+    def _node_info(self) -> NodeInfo:
+        return NodeInfo(
+            node_id=self.node_id,
+            address=self.address,
+            object_store_address=self.store.session_id,
+            resources_total=self.resources.total_float(),
+            labels=dict(self.resources.labels),
+            is_head=self.is_head,
+        )
+
+    async def _reregister_with_gcs(self):
+        logger.warning(
+            "GCS does not know node %s (restart?); re-registering", self.node_id
+        )
+        gcs = self.client_pool.get(*self.gcs_address)
+        live_workers = (
+            list(self.worker_pool._registered.keys())
+            if self.worker_pool is not None
+            else []
+        )
+        # which live workers host which actors: the restarted GCS reconciles
+        # these against its restored directory and names the stale ones —
+        # e.g. this node missed the re-registration grace window and its
+        # actors were already restarted elsewhere; the old incarnations must
+        # not keep running side effects
+        actor_workers = {
+            lease.worker.worker_id: lease.spec.actor_id
+            for lease in self._leases.values()
+            if getattr(lease.spec, "actor_id", None) is not None
+        }
+        try:
+            reply = await gcs.call(
+                "register_node", self._node_info(), live_workers, actor_workers
+            )
+        except Exception:
+            logger.exception("re-registration with GCS failed; will retry")
+            return
+        stale = reply.get("stale_workers") if isinstance(reply, dict) else None
+        for worker_id in stale or []:
+            handle = (
+                self.worker_pool._registered.get(worker_id)
+                if self.worker_pool is not None
+                else None
+            )
+            if handle is not None:
+                logger.warning(
+                    "killing stale actor worker %s (pid %s): its actor moved "
+                    "on while this node was out of contact", worker_id,
+                    handle.pid,
+                )
+                try:
+                    os.kill(handle.pid, 9)
+                except ProcessLookupError:
+                    pass
 
     def _pending_demands(self) -> List[dict]:
         """Aggregate queued lease requests into resource-demand buckets for

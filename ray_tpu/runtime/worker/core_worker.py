@@ -80,7 +80,7 @@ class _ActorClientState:
 
     __slots__ = (
         "actor_id", "state", "address", "seq", "queue", "death_cause",
-        "incarnation",
+        "incarnation", "reconciling",
     )
 
     def __init__(self, actor_id: ActorID):
@@ -95,6 +95,9 @@ class _ActorClientState:
         # executor's per-caller counters die with its process, so the queue
         # renumbers from 0 exactly once per new incarnation
         self.incarnation = -1
+        # a GCS re-poll loop runs while calls are parked (missed/raced
+        # pubsub edges must not strand the queue forever)
+        self.reconciling = False
 
 
 class _StreamState:
@@ -850,6 +853,23 @@ class CoreWorker:
         state = self._actors.get(info.actor_id)
         if state is None:
             return
+        incarnation = getattr(info, "num_restarts", 0)
+        if info.state != ActorState.DEAD:
+            # Staleness guard: a get_actor snapshot can race a fresher pubsub
+            # update (the awaited RPC returns state captured before the edge
+            # was published). Applying the stale RESTARTING over a newer
+            # ALIVE clears state.address with no later pubsub edge to undo
+            # it, parking calls forever. GCS state is ordered by
+            # (num_restarts, aliveness); never go backwards. DEAD is
+            # terminal and always applies.
+            stale = incarnation < state.incarnation or (
+                incarnation == state.incarnation
+                and info.state != ActorState.ALIVE
+                and state.state == ActorState.ALIVE
+                and state.address is not None
+            )
+            if stale:
+                return
         state.state = info.state
         state.death_cause = info.death_cause
         if info.state == ActorState.ALIVE and info.address is not None:
@@ -859,7 +879,6 @@ class CoreWorker:
             # from 0 in FIFO order. A repeated ALIVE for the same
             # incarnation (pubsub + get_actor race) must NOT renumber —
             # calls already delivered under this numbering would collide.
-            incarnation = getattr(info, "num_restarts", 0)
             if incarnation != state.incarnation:
                 state.incarnation = incarnation
                 for i, (spec, _fut) in enumerate(state.queue):
@@ -883,6 +902,38 @@ class CoreWorker:
             spec, fut = state.queue.popleft()
             asyncio.ensure_future(self._push_actor_task(state, spec, fut))
 
+    def _ensure_actor_reconciler(self, state: _ActorClientState):
+        """Poll GCS while calls sit parked: pubsub is the fast path for
+        actor-state edges, but a dropped or raced ALIVE edge must not
+        strand the queue forever (reference: actor_task_submitter.h's
+        fallback resolution through the GCS client). The staleness guard
+        in _apply_actor_info makes re-applying snapshots safe."""
+        if state.reconciling:
+            return
+        state.reconciling = True
+
+        async def _reconcile():
+            delay = 0.5
+            try:
+                while (
+                    state.queue
+                    and state.address is None
+                    and state.state != ActorState.DEAD
+                ):
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 5.0)
+                    try:
+                        gcs = self.client_pool.get(*self.gcs_address)
+                        info = await gcs.call("get_actor", state.actor_id)
+                    except Exception:
+                        continue
+                    if info is not None:
+                        self._apply_actor_info(info)
+            finally:
+                state.reconciling = False
+
+        asyncio.ensure_future(_reconcile())
+
     async def submit_actor_task(self, spec: TaskSpec) -> List[ObjectID]:
         state = self._actors.get(spec.actor_id)
         if state is None:
@@ -901,69 +952,117 @@ class CoreWorker:
             fut.set_exception(ActorDiedError(spec.actor_id, state.death_cause))
         elif state.address is None:
             state.queue.append((spec, fut))
+            self._ensure_actor_reconciler(state)
         else:
             asyncio.ensure_future(self._push_actor_task(state, spec, fut))
         asyncio.ensure_future(self._finish_actor_task(spec, fut, arg_ids))
         return return_ids
 
     async def _push_actor_task(self, state, spec: TaskSpec, fut: asyncio.Future):
+        # Re-read the address HERE, not at scheduling time: this coroutine is
+        # ensure_future-ed while the actor looks ALIVE, but a death report
+        # can land before it runs, clearing state.address. Dereferencing the
+        # stale None raised TypeError (not RpcError), killed this task, and
+        # orphaned ``fut`` — the call then hung forever (the exact chaos-test
+        # failure mode: kill #2 racing the restart flush of kill #1).
+        addr = state.address
+        if addr is None:
+            if state.state == ActorState.DEAD:
+                if not fut.done():
+                    fut.set_exception(
+                        ActorDiedError(spec.actor_id, state.death_cause or "dead")
+                    )
+            else:
+                state.queue.append((spec, fut))
+                self._ensure_actor_reconciler(state)
+            return
         try:
-            worker = self.client_pool.get(*state.address)
+            worker = self.client_pool.get(*addr)
             reply = await worker.call("actor_task", spec, timeout=None)
             if not fut.done():
                 fut.set_result(reply)
         except RpcError:
-            # actor may be restarting: check authoritative state
-            gcs = self.client_pool.get(*self.gcs_address)
             try:
-                info = await gcs.call("get_actor", spec.actor_id)
-            except Exception:
-                info = None
-            if info is not None and info.state in (
-                ActorState.RESTARTING,
-                ActorState.PENDING_CREATION,
-                ActorState.ALIVE,
-            ):
-                if self._actor_retries_allowed(spec):
-                    self._apply_actor_info(info)
-                    alive_now = (
-                        state.state == ActorState.ALIVE
-                        and state.address is not None
-                    )
-                    if (
-                        alive_now
-                        and spec.sequence_incarnation == state.incarnation
-                    ):
-                        # same incarnation the seq was issued under and the
-                        # executor lives: resend the ORIGINAL seq — the
-                        # client can't know whether the lost call executed.
-                        # Never executed -> runs in order; executed with the
-                        # reply lost -> the executor dedups by seq (see
-                        # _handle_actor_task).
-                        asyncio.ensure_future(
-                            self._push_actor_task(state, spec, fut)
-                        )
-                    elif alive_now:
-                        # issued under a DEAD incarnation, and the new
-                        # executor's numbering is already live (its renumber
-                        # pass happened before this failure surfaced): take
-                        # a fresh seq in the current generation
-                        spec.sequence_number = state.seq
-                        spec.sequence_incarnation = state.incarnation
-                        state.seq += 1
-                        asyncio.ensure_future(
-                            self._push_actor_task(state, spec, fut)
-                        )
-                    else:
-                        # restart in progress: park; the ALIVE renumber
-                        # stamps fresh seq + incarnation for the whole queue
-                        state.queue.append((spec, fut))
-                    return
-                self._apply_actor_info(info)
+                await self._recover_actor_push(state, spec, fut)
+            except Exception as e:  # noqa: BLE001 — never orphan the future
+                if not fut.done():
+                    fut.set_exception(e)
+        except Exception as e:  # noqa: BLE001 — never orphan the call future:
+            # an unexpected error here would leave the caller's get() hanging
             if not fut.done():
-                fut.set_exception(
-                    ActorDiedError(spec.actor_id, "connection lost")
+                fut.set_exception(e)
+
+    async def _recover_actor_push(
+        self, state, spec: TaskSpec, fut: asyncio.Future
+    ):
+        """Connection to the actor's worker failed: consult GCS, then retry,
+        park, or fail the call (reference: actor_task_submitter.h's
+        DisconnectRpcClient -> resolve-actor-state flow)."""
+        # actor may be restarting: check authoritative state
+        gcs = self.client_pool.get(*self.gcs_address)
+        try:
+            info = await gcs.call("get_actor", spec.actor_id)
+        except Exception:
+            info = None
+        if info is not None and info.state in (
+            ActorState.RESTARTING,
+            ActorState.PENDING_CREATION,
+            ActorState.ALIVE,
+        ):
+            if self._actor_retries_allowed(spec):
+                self._apply_actor_info(info)
+                alive_now = (
+                    state.state == ActorState.ALIVE
+                    and state.address is not None
                 )
+                if (
+                    alive_now
+                    and spec.sequence_incarnation == state.incarnation
+                ):
+                    # same incarnation the seq was issued under and the
+                    # executor lives: resend the ORIGINAL seq — the
+                    # client can't know whether the lost call executed.
+                    # Never executed -> runs in order; executed with the
+                    # reply lost -> the executor dedups by seq (see
+                    # _handle_actor_task). Backoff first: when GCS has
+                    # not yet observed the worker's death it still
+                    # reports ALIVE at the old address, and an immediate
+                    # resend spins connect-fail cycles that burn the
+                    # whole max_task_retries budget in milliseconds —
+                    # faster than any death report can land.
+                    await asyncio.sleep(0.2)
+                    asyncio.ensure_future(
+                        self._push_actor_task(state, spec, fut)
+                    )
+                elif alive_now:
+                    # issued under a DEAD incarnation, and the new
+                    # executor's numbering is already live (its renumber
+                    # pass happened before this failure surfaced): take
+                    # a fresh seq in the current generation
+                    spec.sequence_number = state.seq
+                    spec.sequence_incarnation = state.incarnation
+                    state.seq += 1
+                    asyncio.ensure_future(
+                        self._push_actor_task(state, spec, fut)
+                    )
+                else:
+                    # restart in progress: park; the ALIVE renumber
+                    # stamps fresh seq + incarnation for the whole queue
+                    state.queue.append((spec, fut))
+                    self._ensure_actor_reconciler(state)
+                return
+        if info is not None:
+            # apply even (especially) a DEAD snapshot: keeping a stale ALIVE
+            # address would make every later submit push to the dead address
+            # and pay a GCS round-trip per call; applying flips the fast-fail
+            # DEAD path on and records the real death cause
+            self._apply_actor_info(info)
+        if not fut.done():
+            fut.set_exception(
+                ActorDiedError(
+                    spec.actor_id, state.death_cause or "connection lost"
+                )
+            )
 
     def _actor_retries_allowed(self, spec: TaskSpec) -> bool:
         if spec.max_task_retries == 0:
@@ -1255,9 +1354,16 @@ class CoreWorker:
                 replies = self._caller_replies[caller]
                 replies[seq] = (reply, size)
                 # bound by entries AND bytes: dedup only needs a short
-                # window, not an unbounded payload pin
+                # window, not an unbounded payload pin. Never evict down to
+                # zero: a single reply over the byte budget must stay
+                # cached until the next one lands, or a duplicate delivery
+                # after a lost reply gets "evicted reply" instead of the
+                # result — breaking exactly-once precisely for
+                # large-payload methods.
                 total = sum(s for _r, s in replies.values())
-                while replies and (len(replies) > 64 or total > 4 * 1024 * 1024):
+                while len(replies) > 1 and (
+                    len(replies) > 64 or total > 4 * 1024 * 1024
+                ):
                     _k, (_r, s) = next(iter(replies.items()))
                     replies.pop(_k)
                     total -= s

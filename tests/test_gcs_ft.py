@@ -1,0 +1,123 @@
+"""GCS fault tolerance: kill the GCS mid-workload, restart it from durable
+storage, and verify actors / named lookups / placement groups / KV resume
+(reference: the GCS-FT suites backed by RedisStoreClient,
+src/ray/gcs/store_client/redis_store_client.h:126, and raylet reconnect via
+NotifyGCSRestart, src/ray/protobuf/node_manager.proto:426)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime.gcs.store import SqliteStoreClient
+from ray_tpu.util.placement_group import placement_group, placement_group_table
+
+
+def test_sqlite_store_roundtrip(tmp_path):
+    path = str(tmp_path / "gcs.db")
+    store = SqliteStoreClient(path)
+    store.put("kv", "a", b"1")
+    store.put("kv", "a", b"2")  # upsert
+    store.put("actors", "a", b"actor-a")
+    assert store.get("kv", "a") == b"2"
+    assert store.get("kv", "missing") is None
+    store.delete("kv", "a")
+    assert store.get("kv", "a") is None
+    assert store.get_all("actors") == {"a": b"actor-a"}
+    store.close()
+    # durability: a second client sees the first one's writes
+    again = SqliteStoreClient(path)
+    assert again.get("actors", "a") == b"actor-a"
+    again.close()
+
+
+def test_gcs_restart_preserves_cluster(shutdown_only, tmp_path):
+    node = ray_tpu.init(
+        num_cpus=4,
+        _system_config={"gcs_storage_path": str(tmp_path / "gcs.db")},
+    )
+
+    @ray_tpu.remote(max_restarts=2)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(name="survivor").remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=60)
+
+    from ray_tpu import _worker_api
+
+    def _kv(method, *args):
+        worker = _worker_api.get_core_worker()
+        return _worker_api.run_on_worker_loop(
+            worker.client_pool.get(*worker.gcs_address).call(method, *args)
+        )
+
+    _kv("kv_put", "ft-key", b"ft-value", True)
+
+    node.kill_gcs_for_testing()
+    node.restart_gcs_for_testing()
+
+    # the actor's worker never died: calls must keep working through the
+    # restarted GCS (client + raylet reconnect transparently)
+    assert ray_tpu.get(c.incr.remote(), timeout=120) == 2
+
+    # named-actor lookup resolves from restored state
+    h = ray_tpu.get_actor("survivor")
+    assert ray_tpu.get(h.incr.remote(), timeout=60) == 3
+
+    # the placement group record survived with its committed bundles
+    restored = placement_group_table()
+    assert any(
+        row["placement_group_id"] == pg.id.hex() and row["state"] == "CREATED"
+        for row in restored
+    )
+
+    # internal KV survived
+    assert _kv("kv_get", "ft-key") == b"ft-value"
+
+
+def test_gcs_restart_restores_actor_after_worker_death(shutdown_only, tmp_path):
+    """An actor whose worker dies WHILE the GCS is down is restarted after
+    the GCS comes back: the re-registering raylet reports its live workers
+    and the reconciler routes the dead one through the restart path."""
+    node = ray_tpu.init(
+        num_cpus=2,
+        _system_config={"gcs_storage_path": str(tmp_path / "gcs.db")},
+    )
+
+    @ray_tpu.remote(max_restarts=5, max_task_retries=5)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+
+    # find the actor's worker pid via the raylet lease table
+    import os
+    import signal
+
+    pids = [lease.worker.pid for lease in node.raylet._leases.values()]
+    assert pids, "actor worker must hold a lease"
+
+    node.kill_gcs_for_testing()
+    for pid in pids:
+        os.kill(pid, signal.SIGKILL)
+    time.sleep(0.5)
+    node.restart_gcs_for_testing()
+
+    # state reset proves a restart happened; the call itself succeeding
+    # proves the restored directory scheduled a new worker
+    assert ray_tpu.get(c.incr.remote(), timeout=120) == 1
