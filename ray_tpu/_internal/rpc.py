@@ -35,8 +35,10 @@ _LEN = struct.Struct("<I")
 _MAX_FRAME = 1 << 31
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> Any:
-    header = await reader.readexactly(4)
+async def _read_frame(
+    reader: asyncio.StreamReader, preread_header: Optional[bytes] = None
+) -> Any:
+    header = preread_header or await reader.readexactly(4)
     (length,) = _LEN.unpack(header)
     if length > _MAX_FRAME:
         raise RpcError(f"frame too large: {length}")
@@ -58,6 +60,15 @@ def _write_frame(writer: asyncio.StreamWriter, payload: Any):
 
 _auth_token: Optional[str] = None
 
+# Pre-pickle auth preamble: with a token set, the FIRST bytes of every
+# connection are [magic][u32 len][token] checked with a constant-time compare
+# BEFORE any pickle.loads runs — pickle deserialization is arbitrary code
+# execution, so the token must gate it, not follow it. Without a token the
+# transport assumes a trusted network (single-host / private VPC), as the
+# reference does with auth disabled.
+_AUTH_MAGIC = b"RTA1"
+_MAX_TOKEN = 4096
+
 
 def set_auth_token(token: Optional[str]):
     """Process-wide shared secret. When set, every RpcServer in this process
@@ -67,6 +78,26 @@ def set_auth_token(token: Optional[str]):
     argv JSON, which is world-readable through /proc/<pid>/cmdline."""
     global _auth_token
     _auth_token = token or None
+
+
+async def _consume_auth_preamble(reader: asyncio.StreamReader) -> bool:
+    """Read [u32 len][token] (the magic was already consumed) and validate.
+    Any malformed or mismatched preamble rejects the peer. With auth disabled
+    server-side the token is consumed and ignored, so a token-bearing client
+    talking to a no-auth server degrades gracefully instead of the magic
+    bytes being misparsed as an 826 MB frame header that hangs every call."""
+    import hmac
+
+    try:
+        (tlen,) = _LEN.unpack(await reader.readexactly(4))
+        if tlen > _MAX_TOKEN:
+            return False
+        token = (await reader.readexactly(tlen)).decode("utf-8", "strict")
+    except Exception:
+        return False
+    if _auth_token is None:
+        return True
+    return hmac.compare_digest(token, _auth_token)
 
 
 # ---------------------------------------------------------------------------
@@ -152,9 +183,34 @@ class RpcServer:
         tasks: set[asyncio.Task] = set()
         self._conns.add(writer)
         try:
+            # First 4 bytes are either the auth-preamble magic or the first
+            # frame's length header. Auth is decided BEFORE the frame loop:
+            # no pickle from an unauthenticated peer is ever parsed
+            # (deserialization is code execution). peer_meta stays empty on
+            # rejection, so no death callbacks fire either.
+            try:
+                first = await reader.readexactly(4)
+            except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+                return
+            preread: Optional[bytes] = None
+            if first == _AUTH_MAGIC:
+                if not await _consume_auth_preamble(reader):
+                    logger.warning(
+                        "%s: auth preamble failed, dropping connection",
+                        self.name,
+                    )
+                    return
+            elif _auth_token is not None:
+                logger.warning(
+                    "%s: missing auth preamble, dropping connection", self.name
+                )
+                return
+            else:
+                preread = first
             while True:
                 try:
-                    frame = await _read_frame(reader)
+                    frame = await _read_frame(reader, preread)
+                    preread = None
                 except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
                     break
                 except Exception:
@@ -316,6 +372,10 @@ class RpcClient:
                         )
                     await asyncio.sleep(delay)
                     delay = min(delay * 2, 0.5)
+            if _auth_token is not None:
+                # pre-pickle handshake: must be the first bytes on the wire
+                tok = _auth_token.encode()
+                self._writer.write(_AUTH_MAGIC + _LEN.pack(len(tok)) + tok)
             meta = dict(self._register_meta or {})
             if _auth_token is not None:
                 meta["auth_token"] = _auth_token

@@ -228,7 +228,10 @@ class XlangClient {
       throw std::runtime_error("bad host " + host);
     if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
       throw std::runtime_error("connect to " + host + " failed");
-    if (!auth_token.empty()) Register(auth_token);
+    if (!auth_token.empty()) {
+      SendAuthPreamble(auth_token);
+      Register(auth_token);
+    }
   }
 
   ~XlangClient() {
@@ -266,6 +269,18 @@ class XlangClient {
   }
 
  private:
+  // Pre-pickle handshake: servers with auth enabled read [magic]["RTA1"]
+  // [u32le len][token] as the connection's first bytes, BEFORE parsing any
+  // pickle frame (mirrors _check_auth_preamble in _internal/rpc.py).
+  void SendAuthPreamble(const std::string& token) {
+    SendAll("RTA1", 4);
+    uint32_t n = static_cast<uint32_t>(token.size());
+    char hdr[4];
+    for (int i = 0; i < 4; ++i) hdr[i] = static_cast<char>((n >> (i * 8)) & 0xff);
+    SendAll(hdr, 4);
+    SendAll(token.data(), token.size());
+  }
+
   void Register(const std::string& token) {
     Pickler p;
     p.Mark();

@@ -225,12 +225,12 @@ class ActorHandle:
         worker = _worker_api.get_core_worker()
         task_args = prepare_args(worker, args, kwargs)
         num_returns = options.get("num_returns", 1)
-        if num_returns == "streaming":
-            raise NotImplementedError(
-                'num_returns="streaming" is supported for task functions '
-                "only, not actor methods (reference parity gap: actor "
-                "streaming generators)"
-            )
+        # actor streaming generators (reference: python/ray/actor.py:516-548):
+        # yielded items become their own objects as they are produced, same
+        # ObjectRefGenerator surface as task generators
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 0
         spec = TaskSpec(
             task_id=worker.next_task_id(),
             job_id=worker.job_id,
@@ -245,8 +245,13 @@ class ActorHandle:
             owner_address=worker.address,
             actor_id=self._actor_id,
             max_task_retries=self._max_task_retries,
+            is_streaming_generator=streaming,
         )
         return_ids = _worker_api.run_on_worker_loop(worker.submit_actor_task(spec))
+        if streaming:
+            from .object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id)
         refs = [ObjectRef(oid, worker.address) for oid in return_ids]
         if num_returns == 0:
             return None

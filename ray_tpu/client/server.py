@@ -31,6 +31,7 @@ class ClientServer:
     ALLOWED_OPS = frozenset({
         "put", "get_objects", "wait", "submit_task", "create_actor",
         "submit_actor_task", "kill_actor", "attach_actor",
+        "next_stream_item", "drop_stream",
     })
 
     def __init__(self, gcs_address: Tuple[str, int], config: Optional[Config] = None):
@@ -151,9 +152,43 @@ class ClientServer:
             self._pin([result], client_id)
         elif op in ("submit_task", "submit_actor_task"):
             self._pin(result, client_id)
+        elif op == "next_stream_item" and result is not None:
+            # stream items the client read: pin like any other client-held
+            # ref (the item ObjectRef lives on the client with no in-cluster
+            # refcount presence)
+            self._pin([result.id], client_id)
         return result
 
+    # control-plane calls a client may relay — GCS reads, KV, jobs, and
+    # placement groups. Mirrors ALLOWED_OPS: an open relay would let one
+    # client call exit_worker/free_objects on raylets and other workers,
+    # breaking sessions it doesn't own.
+    ALLOWED_PROXY_METHODS = frozenset({
+        "register_job", "finish_job", "list_jobs",
+        "get_all_nodes", "cluster_resources", "cluster_available_resources",
+        "get_cluster_resource_state", "get_autoscaling_state",
+        "get_actor", "get_actor_by_name", "list_actors",
+        "create_placement_group", "remove_placement_group",
+        "get_placement_group", "get_placement_group_by_name",
+        "pg_wait_ready", "list_placement_groups",
+        "kv_put", "kv_get", "kv_del", "kv_multi_get", "kv_exists", "kv_keys",
+        "list_task_events",
+    })
+
+    # device-object resolution must reach the OWNING WORKER, not the GCS
+    # (experimental/device_objects.py fetches by owner address); these two
+    # read/free handlers are the only worker-addressed relays permitted
+    ALLOWED_WORKER_PROXY_METHODS = frozenset({
+        "fetch_device_object", "free_device_object",
+    })
+
     async def _handle_proxy_rpc(self, address, method: str, *args):
+        if method in self.ALLOWED_WORKER_PROXY_METHODS:
+            pass  # any worker address
+        elif tuple(address) != tuple(self.gcs_address):
+            raise ValueError("proxy_rpc may only target the GCS")
+        elif method not in self.ALLOWED_PROXY_METHODS:
+            raise ValueError(f"proxy_rpc method {method!r} not allowed")
         return await self.worker.client_pool.get(*tuple(address)).call(
             method, *args
         )

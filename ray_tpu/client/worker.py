@@ -147,6 +147,27 @@ class ClientWorker:
             "worker_op", self._client_id, "kill_actor", actor_id, no_restart
         )
 
+    async def next_stream_item(self, task_id: TaskID):
+        """Streaming-generator reads proxy to the owning server worker; the
+        returned item refs are pinned server-side for this session like any
+        other client-held ref."""
+        return await self._server.call(
+            "worker_op", self._client_id, "next_stream_item", task_id
+        )
+
+    def drop_stream(self, task_id: TaskID):
+        """Sync fire-and-forget like CoreWorker.drop_stream — invoked from
+        ObjectRefGenerator.__del__ via call_soon_threadsafe on this loop."""
+        import asyncio
+
+        task = asyncio.ensure_future(
+            self._server.call(
+                "worker_op", self._client_id, "drop_stream", task_id
+            )
+        )
+        self._background_tasks.add(task)
+        task.add_done_callback(self._background_tasks.discard)
+
     def attach_actor(self, actor_id, info=None):
         """Synchronous and non-blocking on CoreWorker — and it MUST stay
         non-blocking here: handle unpickling invokes it from a callback ON

@@ -161,6 +161,8 @@ class CoreWorker:
         # actor submission state
         self._actors: Dict[ActorID, _ActorClientState] = {}
         self._subscriber: Optional[SubscriberClient] = None
+        # parked-queue GCS re-poll loops, cancelled at shutdown
+        self._reconciler_tasks: set = set()
 
         # streaming generators (owner side): task_id -> stream progress
         # (reference: ObjectRefStream, task_manager.h:67)
@@ -265,6 +267,8 @@ class CoreWorker:
                 pass
         if self._event_flush_task:
             self._event_flush_task.cancel()
+        for task in list(self._reconciler_tasks):
+            task.cancel()
         if self._subscriber:
             await self._subscriber.close()
         await self.server.stop()
@@ -770,6 +774,13 @@ class CoreWorker:
         if state is not None:
             state.reported.add(index)
             state.pulse()
+        else:
+            # stream already dropped/terminated (state is created at submit
+            # time, so None means the consumer abandoned it): free the item
+            # we just stored, or a still-producing generator pins every
+            # remaining yield for the process lifetime. _maybe_free respects
+            # live ObjectRefs, so re-reports of already-read items survive.
+            self._maybe_free(object_id)
         return True
 
     async def next_stream_item(self, task_id: TaskID) -> Optional[ObjectRef]:
@@ -791,6 +802,7 @@ class CoreWorker:
                 # terminal: drop the stream so an abandoned/failed stream
                 # doesn't pin its state for the process lifetime
                 self._streams.pop(task_id, None)
+                self._free_unread_stream_items(task_id, state)
                 raise serialization.unpack(state.error)
             if state.total is not None and state.next_read >= state.total:
                 self._streams.pop(task_id, None)
@@ -801,7 +813,17 @@ class CoreWorker:
     def drop_stream(self, task_id: TaskID):
         """Consumer abandoned the generator: release owner-side stream
         bookkeeping (called from ObjectRefGenerator.__del__)."""
-        self._streams.pop(task_id, None)
+        state = self._streams.pop(task_id, None)
+        if state is not None:
+            self._free_unread_stream_items(task_id, state)
+
+    def _free_unread_stream_items(self, task_id: TaskID, state: "_StreamState"):
+        """Indices reported but never read have no ObjectRef driving their
+        refcount: free them explicitly, or an abandoned/failed half-consumed
+        stream pins its objects for the process lifetime."""
+        for index in state.reported:
+            if index >= state.next_read:
+                self._maybe_free(ObjectID.for_task_return(task_id, index))
 
     # ------------------------------------------------------------------
     # actor submission (reference: actor_task_submitter.h)
@@ -929,10 +951,14 @@ class CoreWorker:
                         continue
                     if info is not None:
                         self._apply_actor_info(info)
+            except asyncio.CancelledError:
+                pass
             finally:
                 state.reconciling = False
 
-        asyncio.ensure_future(_reconcile())
+        task = asyncio.ensure_future(_reconcile())
+        self._reconciler_tasks.add(task)
+        task.add_done_callback(self._reconciler_tasks.discard)
 
     async def submit_actor_task(self, spec: TaskSpec) -> List[ObjectID]:
         state = self._actors.get(spec.actor_id)
@@ -943,6 +969,11 @@ class CoreWorker:
         for oid in return_ids:
             self._owned.add(oid)
             self.memory_store.entry(oid)
+        if spec.is_streaming_generator:
+            # actor streaming generators share the task-side stream machinery
+            # (reference: actor.py:516-548 — same ObjectRefGenerator surface);
+            # item delivery and end-of-stream reporting are caller-agnostic
+            self._streams[spec.task_id] = _StreamState()
         arg_ids = self._pin_task_args(spec)
         spec.sequence_number = state.seq
         spec.sequence_incarnation = state.incarnation
@@ -1441,6 +1472,12 @@ class CoreWorker:
             args, kwargs = await self._unflatten(spec)
         except Exception as e:  # noqa: BLE001
             return self._error_reply(spec, e)
+        if spec.is_streaming_generator:
+            # the bound method drives the same item-shipping loop as task
+            # generators; the seq slot is held until the generator finishes,
+            # preserving sequential actor semantics while the CONSUMER
+            # overlaps via item-level delivery
+            return await self._run_streaming_generator(method, args, kwargs, spec)
         # tensor_transport="device": DeviceObjectRef args resolve to their
         # on-device pytrees; results with arrays park in the device store
         # (reference: @ray.method(tensor_transport=...), P13). Resolution

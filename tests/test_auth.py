@@ -77,3 +77,40 @@ def test_wrong_token_rejected(shutdown_only, reset_token):
         denied = probe(bad)
         assert denied.returncode != 0, (bad, denied.stdout)
         assert "GOT" not in denied.stdout
+
+
+def test_no_pickle_before_auth(shutdown_only, reset_token):
+    """Auth gates DESERIALIZATION, not just dispatch: a crafted pickle frame
+    from an unauthenticated peer must never be loads()-ed — pickle parsing is
+    arbitrary code execution, so checking the token after parsing would make
+    it decorative (the preamble handshake in _internal/rpc.py)."""
+    import os
+    import pickle
+    import socket
+    import struct
+    import tempfile
+    import time
+
+    import ray_tpu
+
+    node = ray_tpu.init(
+        num_cpus=1, _system_config={"cluster_auth_token": "s3cret"}
+    )
+    gcs_host, gcs_port = node.gcs_address
+    sentinel = os.path.join(
+        tempfile.gettempdir(), f"ray_tpu_auth_rce_{os.getpid()}"
+    )
+
+    class Exploit:
+        def __reduce__(self):
+            return (open, (sentinel, "w"))
+
+    payload = pickle.dumps((1, "get_all_nodes", (Exploit(),), {}))
+    with socket.create_connection((gcs_host, gcs_port), timeout=10) as sock:
+        # no preamble: the first bytes are a raw frame containing the exploit
+        sock.sendall(struct.pack("<I", len(payload)) + payload)
+        sock.settimeout(10)
+        # server must drop the connection without ever parsing the frame
+        assert sock.recv(1) == b""
+    time.sleep(0.2)
+    assert not os.path.exists(sentinel), "pre-auth pickle was deserialized!"

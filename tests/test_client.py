@@ -57,6 +57,25 @@ CLIENT_SCRIPT = textwrap.dedent(
     res = ray_tpu.cluster_resources()
     assert res.get("CPU", 0) >= 1
 
+    # streaming generators proxy stream reads through the client server
+    # (tasks and actor methods; items pin server-side for this session)
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    assert [ray_tpu.get(r, timeout=60) for r in gen.remote(4)] == [0, 10, 20, 30]
+
+    @ray_tpu.remote
+    class Gen:
+        def squares(self, n):
+            for i in range(n):
+                yield i * i
+
+    gactor = Gen.remote()
+    g = gactor.squares.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r, timeout=60) for r in g] == [0, 1, 4, 9]
+
     ray_tpu.shutdown()
     print("CLIENT_OK")
     """
