@@ -168,6 +168,18 @@ class CoreWorker:
         # (reference: ObjectRefStream, task_manager.h:67)
         self._streams: Dict[TaskID, _StreamState] = {}
 
+        # lineage (owner side; reference: ObjectRecoveryManager,
+        # object_recovery_manager.h:41 + TaskManager lineage pinning): the
+        # creating spec is retained per plasma-stored return of a retriable
+        # normal task so a lost copy can be rebuilt by re-execution. Lineage
+        # holds a submitted-ref pin on the task's by-ref args, keeping them
+        # materialized (or themselves reconstructable) for transitive
+        # recovery.
+        self._lineage: Dict[ObjectID, TaskSpec] = {}
+        self._lineage_arg_pins: Dict[ObjectID, List[ObjectID]] = {}
+        self._reconstructing: Dict[TaskID, asyncio.Future] = {}
+        self._reconstruct_budget: Dict[TaskID, int] = {}
+
         # execution side
         self._function_cache: Dict[str, Callable] = {}
         self._actor_instance: Any = None
@@ -231,6 +243,9 @@ class CoreWorker:
         # streaming generator item delivery (reference:
         # ReportGeneratorItemReturns RPC, core_worker.proto:507)
         s.register("report_generator_item", self._handle_report_generator_item)
+        # borrower-triggered lineage recovery (reference:
+        # object_recovery_manager.h:41 — owner re-executes the creating task)
+        s.register("reconstruct_object", self._handle_reconstruct_object)
         # executor services
         s.register("push_task", self._handle_push_task)
         s.register("create_actor", self._handle_create_actor)
@@ -316,6 +331,12 @@ class CoreWorker:
                     asyncio.ensure_future(client.call_oneway("free_objects", [object_id]))
                 except Exception:
                     pass
+        # out-of-scope object needs no lineage; releasing its arg pins may
+        # cascade-free upstream objects whose only consumer this lineage was
+        self._lineage.pop(object_id, None)
+        pins = self._lineage_arg_pins.pop(object_id, None)
+        if pins:
+            self._release_for_task(pins)
 
     def _pin_task_args(self, spec: TaskSpec) -> List[ObjectID]:
         """Pin a task's by-ref args until the call completes. Without this a
@@ -404,6 +425,63 @@ class CoreWorker:
     def _is_self(self, address) -> bool:
         return address is not None and tuple(address) == tuple(self.address or ())
 
+    # ------------------------------------------------------------------
+    # lineage reconstruction (reference: object_recovery_manager.h:41)
+    # ------------------------------------------------------------------
+
+    async def _reconstruct_object(self, object_id: ObjectID) -> bool:
+        """Re-execute the task that created ``object_id`` to rebuild its lost
+        value, bounded by the task's max_retries. Concurrent requests for any
+        return of the same task share one re-execution. Transitively-lost
+        args recover through the same path: the re-executed task's arg fetch
+        fails on its executor, which asks this owner to reconstruct them."""
+        spec = self._lineage.get(object_id)
+        if spec is None:
+            return False
+        existing = self._reconstructing.get(spec.task_id)
+        if existing is not None:
+            return await asyncio.shield(existing)
+        budget = self._reconstruct_budget.setdefault(
+            spec.task_id, max(spec.max_retries, 1)
+        )
+        if budget <= 0:
+            return False
+        self._reconstruct_budget[spec.task_id] = budget - 1
+        fut: asyncio.Future = self.loop.create_future()
+        self._reconstructing[spec.task_id] = fut
+        try:
+            logger.warning(
+                "reconstructing object %s by re-executing task %s (%s)",
+                object_id, spec.task_id, spec.function.qualname,
+            )
+            for oid in spec.return_object_ids():
+                self.memory_store.reset_pending(oid)
+            done = asyncio.Event()
+            self._task_done_events[spec.task_id] = done
+            self._launch_task(spec)
+            await done.wait()
+            entry = self.memory_store.get_if_exists(object_id)
+            ok = (
+                entry is not None
+                and entry.is_available()
+                and entry.error is None
+            )
+            fut.set_result(ok)
+            return ok
+        except Exception:
+            logger.exception("reconstruction of %s failed", object_id)
+            if not fut.done():
+                fut.set_result(False)
+            return False
+        finally:
+            self._reconstructing.pop(spec.task_id, None)
+            if not fut.done():
+                fut.set_result(False)
+
+    async def _handle_reconstruct_object(self, object_id: ObjectID) -> bool:
+        """Borrower-triggered recovery: only the owner holds lineage."""
+        return await self._reconstruct_object(object_id)
+
     async def _materialize(self, ref: ObjectRef, entry) -> Any:
         if entry.error is not None:
             raise serialization.unpack(entry.error)
@@ -418,11 +496,42 @@ class CoreWorker:
         owner_addr = ref.owner_address if not self._is_self(ref.owner_address) else (
             self.address
         )
-        reply = await raylet.call(
-            "store_get", ref.id, owner_addr, timeout=self.config.rpc_call_timeout_s
-        )
-        if not reply["ok"]:
-            raise ObjectLostError(ref.id, "object not found in any store")
+        attempts = 0
+        while True:
+            reply = await raylet.call(
+                "store_get", ref.id, owner_addr,
+                timeout=self.config.rpc_call_timeout_s,
+            )
+            if reply["ok"]:
+                break
+            # every copy is gone (node death, unspilled eviction): try
+            # lineage reconstruction — re-execute the creating task
+            # (reference: ObjectRecoveryManager, object_recovery_manager.h:41)
+            recovered = False
+            if attempts < 3:
+                if ref.id in self._owned or self._is_self(ref.owner_address):
+                    recovered = await self._reconstruct_object(ref.id)
+                elif ref.owner_address is not None:
+                    # borrower: only the owner holds the lineage spec
+                    try:
+                        recovered = await self.client_pool.get(
+                            *ref.owner_address
+                        ).call("reconstruct_object", ref.id)
+                    except Exception:
+                        # transient owner RPC failure (likely riding out the
+                        # same node-death event): back off and retry instead
+                        # of declaring a reconstructable object lost
+                        attempts += 1
+                        await asyncio.sleep(0.5)
+                        continue
+            if not recovered:
+                raise ObjectLostError(ref.id, "object not found in any store")
+            attempts += 1
+            # a nondeterministic re-execution may return a small value
+            # inline instead of via plasma
+            entry = self.memory_store.get_if_exists(ref.id)
+            if entry is not None and entry.value is not None:
+                return serialization.unpack(entry.value)
         if reply.get("data") is not None:
             # spilled object served inline (arena full of pinned readers):
             # plain copy, no pin to manage
@@ -570,6 +679,11 @@ class CoreWorker:
     async def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
         """Register the pending task and launch the async submission pipeline.
         Return object ids are immediately valid futures in the memory store."""
+        return self._launch_task(spec)
+
+    def _launch_task(self, spec: TaskSpec) -> List[ObjectID]:
+        """Bookkeeping + pipeline launch, shared by first submission and
+        lineage re-execution (_reconstruct_object)."""
         return_ids = spec.return_object_ids()
         for oid in return_ids:
             self._owned.add(oid)
@@ -738,6 +852,20 @@ class CoreWorker:
             elif ret.in_plasma:
                 node_addr = ret.node_id
                 self.memory_store.put_plasma(ret.object_id, ret.size, node_addr)
+        if (
+            spec.task_type == TaskType.NORMAL_TASK
+            and spec.max_retries > 0
+            and not spec.is_streaming_generator
+        ):
+            for ret in reply.returns:
+                if ret.in_plasma and ret.object_id not in self._lineage:
+                    self._lineage[ret.object_id] = spec
+                    arg_ids = [
+                        a.object_id for a in spec.args if a.object_id is not None
+                    ]
+                    if arg_ids:
+                        self._lineage_arg_pins[ret.object_id] = arg_ids
+                        self._retain_for_task(arg_ids)
         if reply.num_streamed is not None:
             state = self._streams.get(spec.task_id)
             if state is not None:

@@ -117,9 +117,12 @@ def start(
         try:
             controller = ray_api.get_actor(CONTROLLER_NAME)
         except ValueError:
-            Controller = ray_api.remote(num_cpus=0, name=CONTROLLER_NAME)(
-                ServeController
-            )
+            # restartable: on crash the GCS re-creates it and __init__
+            # recovers goal state from the KV checkpoint, re-adopting live
+            # replicas (reference: controller.py:98-148)
+            Controller = ray_api.remote(
+                num_cpus=0, name=CONTROLLER_NAME, max_restarts=-1
+            )(ServeController)
             controller = Controller.remote()
             ray_api.get(controller.ping.remote())
         _state["controller"] = controller

@@ -57,6 +57,9 @@ class _DeploymentState:
         self.version = 0
 
 
+CHECKPOINT_KEY = "serve:controller_ckpt"
+
+
 class ServeController:
     def __init__(self):
         self._apps: Dict[str, Dict[str, str]] = {}  # app -> short -> full name
@@ -64,10 +67,137 @@ class ServeController:
         self._lock = threading.RLock()
         self._running = True
         self._reconcile_interval_s = 0.25
+        # goal state persists to GCS KV; a restarted controller re-adopts
+        # live replicas instead of abandoning them (reference:
+        # controller.py:98-148 checkpoint/recover)
+        self._dirty = False
+        # serializes snapshot+write so concurrent checkpoints (reconcile
+        # thread vs deploy RPC thread) cannot land out of order and regress
+        # the durable state to an older snapshot
+        self._ckpt_lock = threading.Lock()
+        self._recover_from_checkpoint()
         self._thread = threading.Thread(
             target=self._run_control_loop, daemon=True, name="serve-reconcile"
         )
         self._thread.start()
+
+    # -- checkpoint / recovery ----------------------------------------------
+
+    def _kv_call(self, method: str, *args):
+        from .. import _worker_api
+
+        worker = _worker_api.get_core_worker()
+        return _worker_api.run_on_worker_loop(
+            worker.client_pool.get(*worker.gcs_address).call(method, *args)
+        )
+
+    def _checkpoint(self):
+        """Persist goal state + live replica handles to GCS KV. Called from
+        the reconcile loop when membership/config changed, and synchronously
+        on deploy/delete so the goal state is durable before the API
+        returns."""
+        import cloudpickle
+
+        with self._ckpt_lock:
+            with self._lock:
+                data = {
+                    "apps": {a: dict(n) for a, n in self._apps.items()},
+                    "deployments": {
+                        full: {
+                            "config": dep.config,
+                            "cls_bytes": dep.cls_bytes,
+                            "init_args": dep.init_args,
+                            "init_kwargs": dep.init_kwargs,
+                            "target_replicas": dep.target_replicas,
+                            "next_replica_idx": dep.next_replica_idx,
+                            "replicas": [
+                                (r.replica_id, r.handle, r.state)
+                                for r in dep.replicas.values()
+                            ],
+                        }
+                        for full, dep in self._deployments.items()
+                    },
+                }
+                self._dirty = False
+            try:
+                self._kv_call(
+                    "kv_put", CHECKPOINT_KEY, cloudpickle.dumps(data), True
+                )
+            except Exception:
+                # a failed write must be retried: without re-marking dirty
+                # the change would stay unpersisted until some unrelated
+                # later change, and a crash in that window recovers stale
+                # membership
+                logger.exception("serve controller checkpoint failed")
+                with self._lock:
+                    self._dirty = True
+
+    def _recover_from_checkpoint(self):
+        import pickle
+
+        from .. import api
+
+        try:
+            raw = self._kv_call("kv_get", CHECKPOINT_KEY)
+        except Exception:
+            logger.exception("serve checkpoint read failed; starting fresh")
+            return
+        if not raw:
+            return
+        try:
+            data = pickle.loads(raw)
+        except Exception:
+            logger.exception("serve checkpoint unreadable; starting fresh")
+            return
+        # probe every saved replica CONCURRENTLY under one shared deadline:
+        # live ones are re-adopted with no churn; unresponsive ones are
+        # killed (not just dropped — an alive-but-slow replica left orphaned
+        # would double-serve next to its replacement) and converge replaces
+        # them
+        probes = []  # (dep, rid, handle, probe_ref)
+        deps: Dict[str, _DeploymentState] = {}
+        for full, d in data.get("deployments", {}).items():
+            dep = _DeploymentState(
+                d["config"], d["cls_bytes"], d["init_args"], d["init_kwargs"]
+            )
+            dep.target_replicas = d["target_replicas"]
+            dep.next_replica_idx = d["next_replica_idx"]
+            deps[full] = dep
+            for rid, handle, _state in d["replicas"]:
+                try:
+                    probes.append((dep, rid, handle, handle.check_health.remote()))
+                except Exception:
+                    probes.append((dep, rid, handle, None))
+        deadline = time.time() + 15.0
+        adopted = dead = 0
+        for dep, rid, handle, ref in probes:
+            healthy = False
+            if ref is not None:
+                try:
+                    healthy = bool(
+                        api.get(ref, timeout=max(deadline - time.time(), 0.5))
+                    )
+                except Exception:
+                    healthy = False
+            if healthy:
+                replica = _ReplicaState(rid, handle)
+                replica.state = "RUNNING"
+                dep.replicas[rid] = replica
+                adopted += 1
+            else:
+                dead += 1
+                try:
+                    api.kill(handle)
+                except Exception:
+                    pass
+        self._deployments.update(deps)
+        self._apps = {a: dict(n) for a, n in data.get("apps", {}).items()}
+        if self._deployments:
+            logger.info(
+                "serve controller recovered: %d app(s), %d deployment(s); "
+                "%d replica(s) re-adopted, %d dead",
+                len(self._apps), len(self._deployments), adopted, dead,
+            )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -76,6 +206,8 @@ class ServeController:
         while self._running:
             try:
                 self._reconcile_once()
+                if self._dirty:
+                    self._checkpoint()
             except Exception:
                 logger.exception("serve reconcile iteration failed")
             time.sleep(self._reconcile_interval_s)
@@ -90,6 +222,11 @@ class ServeController:
             dep.target_replicas = 0
             for rid in list(dep.replicas):
                 self._stop_replica(dep, rid)
+        try:
+            # intentional teardown: a later controller must start fresh
+            self._kv_call("kv_del", CHECKPOINT_KEY)
+        except Exception:
+            pass
         return True
 
     # -- deploy API ----------------------------------------------------------
@@ -132,6 +269,7 @@ class ServeController:
         for dep in removed:
             for rid in list(dep.replicas):
                 self._stop_replica(dep, rid)
+        self._checkpoint()
         return True
 
     def delete_application(self, app_name: str) -> bool:
@@ -145,6 +283,7 @@ class ServeController:
         for dep in deps:
             for rid in list(dep.replicas):
                 self._stop_replica(dep, rid)
+        self._checkpoint()
         return True
 
     # -- reconcile -----------------------------------------------------------
@@ -177,6 +316,7 @@ class ServeController:
                     with self._lock:
                         dep.replicas.pop(rid, None)
                         dep.version += 1
+                        self._dirty = True
                     try:
                         api.kill(replica.handle)
                     except Exception:
@@ -229,6 +369,7 @@ class ServeController:
                         with self._lock:
                             replica.state = "RUNNING"
                             dep.version += 1
+                            self._dirty = True
                 except TimeoutError:
                     if (
                         time.time() - replica.started_at
@@ -274,6 +415,7 @@ class ServeController:
         )
         with self._lock:
             dep.replicas[rid] = _ReplicaState(rid, handle)
+            self._dirty = True
 
     def _stop_replica(self, dep: _DeploymentState, rid: str):
         from .. import api
@@ -283,6 +425,7 @@ class ServeController:
             if replica is None:
                 return
             dep.version += 1
+            self._dirty = True
         try:
             api.get(
                 replica.handle.prepare_for_shutdown.remote(
