@@ -25,6 +25,7 @@ logger = logging.getLogger(__name__)
 # per-process: dag_id -> list[asyncio.Task]
 _dag_loops: Dict[int, List[asyncio.Task]] = {}
 _dag_channels: Dict[int, List[str]] = {}
+_dag_writer_channels: Dict[int, List[str]] = {}
 
 
 async def handle_dag_init(worker, instance, dag_id: int, plans: List[dict],
@@ -33,10 +34,13 @@ async def handle_dag_init(worker, instance, dag_id: int, plans: List[dict],
     mgr = ensure_channel_manager(worker)
     loops = _dag_loops.setdefault(dag_id, [])
     chans = _dag_channels.setdefault(dag_id, [])
+    wchans = _dag_writer_channels.setdefault(dag_id, [])
     for plan in plans:
         for _uuid, cid in plan["inputs"]:
             mgr.ensure_queue(cid, buffer_size)
             chans.append(cid)
+        for _addr, cid in plan["outputs"]:
+            wchans.append(cid)
         loops.append(
             asyncio.ensure_future(_node_loop(worker, instance, mgr, plan))
         )
@@ -49,6 +53,10 @@ async def handle_dag_teardown(worker, instance, dag_id: int) -> bool:
     mgr = ensure_channel_manager(worker)
     for cid in _dag_channels.pop(dag_id, []):
         mgr.close(cid)
+    # free this executor's pinned writer slots — without this, repeated
+    # compile/teardown cycles on a long-lived actor pin arena space forever
+    for cid in _dag_writer_channels.pop(dag_id, []):
+        mgr.close_writer(cid)
     return True
 
 

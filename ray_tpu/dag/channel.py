@@ -2,20 +2,27 @@
 
 Role-equivalent of the reference's shared-memory channels
 (python/ray/experimental/channel/shared_memory_channel.py and
-common.ChannelInterface): a single-writer, bounded, ordered pipe between two
-workers. The reference implements them as mutable plasma objects with
-versioned reads; here a channel is a bounded asyncio queue on the reader's
-CoreWorker fed by direct worker-to-worker RPC pushes — the compiled fast
-path rides the persistent RPC connections and skips the scheduler, GCS, and
-object store entirely. Backpressure is the reader's bounded buffer: the
-``chan_push`` reply is withheld until the value is enqueued, and the writer
-caps unacknowledged pushes with a send window.
+common.ChannelInterface, backed by HandlePushMutableObject,
+node_manager.h:662): a single-writer, bounded, ordered pipe between two
+workers. Control (seq + doorbell) rides direct worker-to-worker RPC on the
+persistent connections, skipping the scheduler and GCS. The PAYLOAD plane
+splits by size: small values travel packed inside the doorbell frame; large
+values are written once into the C++ shm arena (store.cc) and the reader
+maps the segment — intra-node delivery is zero-copy (one pack_into the
+mmap, zero-copy views out), cross-node falls back to the chunked object
+pull. Backpressure is the reader's bounded buffer: the push reply is
+withheld until the value is enqueued, and the writer caps unacknowledged
+pushes with a send window. Arena slots free when the reader acks
+consumption; reader-held views defer the free via store pins.
 """
 
 from __future__ import annotations
 
 import asyncio
 from typing import Any, Dict, Tuple
+
+from .._internal import serialization
+from .._internal.ids import ObjectID
 
 
 class ChannelClosed(Exception):
@@ -44,6 +51,49 @@ class DagError:
         self.exc = exc
 
 
+class _Slot:
+    """Writer-side reusable arena slot (reference: the mutable plasma
+    objects behind shared_memory_channel.py). Allocated once, pinned so
+    eviction/spill can never reclaim it, overwritten in place for every
+    message, recycled when the reader acks consumption."""
+
+    __slots__ = ("object_id", "segment", "capacity", "in_use", "oneshot")
+
+    def __init__(self, object_id, segment, capacity):
+        self.object_id = object_id
+        self.segment = segment
+        self.capacity = capacity
+        self.in_use = False
+        # overflow slots (allocated past the window while the consumer held
+        # every pooled slot) free on ack instead of recycling
+        self.oneshot = False
+
+
+class _Packed:
+    """Sub-threshold payload already serialized by the size check: ship the
+    packed bytes instead of paying a second pickling in the RPC frame."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = data
+
+
+class _ShmDoorbell:
+    """Reader-side descriptor of a message parked in the writer's arena:
+    same-host readers map the segment zero-copy; cross-host readers pull a
+    copy through the object plane. The ack recycles the writer's slot."""
+
+    __slots__ = ("chan_id", "object_id", "segment", "size", "owner_address")
+
+    def __init__(self, chan_id, object_id, segment, size, owner_address):
+        self.chan_id = chan_id
+        self.object_id = object_id
+        self.segment = segment
+        self.size = size
+        self.owner_address = owner_address
+
+
 class ChannelManager:
     """Per-CoreWorker registry of reader-side channel buffers plus the
     writer-side push windows."""
@@ -56,6 +106,15 @@ class ChannelManager:
         # writer-side send windows: (chan_id) -> semaphore
         self._windows: Dict[str, asyncio.Semaphore] = {}
         self._window_size = default_buffer
+        # writer-side reusable arena slots per channel + reuse wakeups
+        self._slot_pools: Dict[str, list] = {}
+        self._slot_waiters: Dict[str, asyncio.Event] = {}
+        # slots surviving their channel because a reader-held view deferred
+        # the ack past close_writer; freed when the ack lands
+        self._orphan_slots: Dict = {}
+        # perf/testing hook: overrides config.max_direct_call_object_size as
+        # the shm cut-over without mutating the worker-wide config
+        self.shm_threshold_override: int = 0
 
     # -- reader side ---------------------------------------------------------
 
@@ -78,9 +137,84 @@ class ChannelManager:
         if chan_id in self._closed:
             raise ChannelClosed(chan_id)
         seq, payload = await self.ensure_queue(chan_id).get()
+        if isinstance(payload, _ShmDoorbell):
+            payload = await self._read_shm(payload)
+        elif isinstance(payload, _Packed):
+            payload = serialization.unpack(payload.data)
         if isinstance(payload, _Stop):
             raise ChannelClosed(chan_id)
         return payload
+
+    async def _read_shm(self, bell: _ShmDoorbell) -> Any:
+        worker = self._worker
+
+        def _ack():
+            # recycle the writer's slot — only once the reader has no view
+            # of it left, or the next message would overwrite live data
+            try:
+                if worker.loop.is_closed():
+                    return
+                worker.loop.call_soon_threadsafe(
+                    lambda: asyncio.ensure_future(
+                        worker.client_pool.get(*bell.owner_address).call_oneway(
+                            "chan_shm_done", bell.chan_id, bell.object_id
+                        )
+                    )
+                )
+            except RuntimeError:
+                pass
+
+        try:
+            view = worker.store_client.read(bell.segment, bell.size)
+        except Exception:
+            # cross-host: the writer's arena file is not mappable here —
+            # pull a COPY through the object plane, ack immediately (the
+            # copy is ours; freeing the local replica avoids a stale hit
+            # when the slot is reused under the same object id)
+            from ..object_ref import ObjectRef
+
+            ref = ObjectRef(bell.object_id, bell.owner_address, _register=False)
+            raylet = worker.client_pool.get(*worker.raylet_address)
+            reply = await raylet.call("store_get", ref.id, bell.owner_address)
+            if not reply.get("ok"):
+                _ack()
+                raise ChannelClosed(bell.chan_id)
+            if reply.get("data") is not None:
+                data = reply["data"]
+            else:
+                local = worker.store_client.read(
+                    reply["segment"], reply["size"]
+                )
+                data = bytes(local)
+                await raylet.call_oneway("store_release", ref.id)
+            await raylet.call_oneway("free_objects", [ref.id])
+            _ack()
+            return serialization.unpack(data)
+        # same-host zero-copy: values alias the writer's slot; the ack is
+        # deferred to the moment the last deserialized view is released
+        return serialization.unpack_with_release(view, _ack)
+
+    async def handle_shm_done(self, chan_id: str, object_id) -> bool:
+        """Writer side: reader consumed a slot's message — recycle it, or
+        free it outright if it was an overflow allocation or its channel
+        is already gone."""
+        orphan = self._orphan_slots.pop(object_id, None)
+        if orphan is not None:
+            self._free_slot_ids([object_id])
+            return True
+        pool = self._slot_pools.get(chan_id, [])
+        for slot in pool:
+            if slot.object_id == object_id:
+                if slot.oneshot:
+                    pool.remove(slot)
+                    self._free_slot_ids([object_id])
+                else:
+                    slot.in_use = False
+                waiter = self._slot_waiters.get(chan_id)
+                if waiter is not None:
+                    waiter.set()
+                break
+        return True
 
     def close(self, chan_id: str):
         self._closed.add(chan_id)
@@ -92,9 +226,43 @@ class ChannelManager:
             except asyncio.QueueFull:
                 pass
 
+    def close_writer(self, chan_id: str):
+        """Writer-side channel teardown: free this channel's arena slots.
+        Slots whose ack is still outstanding (the reader may hold live
+        zero-copy views of them) are ORPHANED, not freed — freeing under a
+        live view would let the arena recycle bytes a held numpy array
+        still aliases. Orphans free when their ack finally arrives."""
+        pool = self._slot_pools.pop(chan_id, [])
+        self._slot_waiters.pop(chan_id, None)
+        self._windows.pop(chan_id, None)
+        to_free = []
+        for slot in pool:
+            if slot.in_use:
+                self._orphan_slots[slot.object_id] = slot
+            else:
+                to_free.append(slot.object_id)
+        if to_free:
+            self._free_slot_ids(to_free)
+
+    def _free_slot_ids(self, object_ids):
+        worker = self._worker
+
+        async def _free():
+            try:
+                raylet = worker.client_pool.get(*worker.raylet_address)
+                for oid in object_ids:
+                    await raylet.call_oneway("store_release", oid)
+                    await raylet.call_oneway("free_objects", [oid])
+            except Exception:
+                pass
+
+        asyncio.ensure_future(_free())
+
     def close_all(self):
         for chan_id in list(self._queues):
             self.close(chan_id)
+        for chan_id in list(self._slot_pools):
+            self.close_writer(chan_id)
 
     # -- writer side ----------------------------------------------------------
 
@@ -103,22 +271,114 @@ class ChannelManager:
     ):
         """Send one value to a reader. Pushes on one channel are pipelined up
         to the send window; frame order over the persistent connection plus
-        the reader's FIFO buffer preserve seq order."""
+        the reader's FIFO buffer preserve seq order. Payloads above the
+        inline threshold park in the shm arena and only the doorbell
+        travels — intra-node readers map the segment zero-copy."""
         window = self._windows.get(chan_id)
         if window is None:
             window = asyncio.Semaphore(self._window_size)
             self._windows[chan_id] = window
         await window.acquire()
         client = self._worker.client_pool.get(*reader_address)
+        worker = self._worker
+
+        bell = None
+        threshold = (
+            self.shm_threshold_override
+            or worker.config.max_direct_call_object_size
+        )
+        if not isinstance(payload, (_Stop, DagError)):
+            try:
+                meta, bufs = serialization.serialize(payload)
+                size = serialization.packed_size(meta, bufs)
+            except Exception:
+                size = 0  # unserializable here: let the RPC layer report it
+            if size > threshold:
+                slot = await self._acquire_slot(chan_id, size)
+                worker.store_client.write(slot.segment, meta, bufs, size)
+                bell = _ShmDoorbell(
+                    chan_id, slot.object_id, slot.segment, size, worker.address
+                )
+            elif size > 0:
+                # already serialized for the size check: ship the packed
+                # bytes, not a second pickling of the object
+                packed = bytearray(size)
+                serialization.pack_into(meta, bufs, memoryview(packed))
+                payload = _Packed(bytes(packed))
 
         async def _push():
             try:
-                await client.call("chan_push", chan_id, seq, payload, timeout=None)
+                if bell is not None:
+                    await client.call(
+                        "chan_push_shm", chan_id, seq, bell.object_id,
+                        bell.segment, bell.size, bell.owner_address,
+                        timeout=None,
+                    )
+                else:
+                    await client.call(
+                        "chan_push", chan_id, seq, payload, timeout=None
+                    )
             finally:
                 window.release()
 
         # fire pipelined; caller may await the returned task for a barrier
         return asyncio.ensure_future(_push())
+
+    async def _acquire_slot(self, chan_id: str, size: int) -> _Slot:
+        """Reuse a free slot with enough capacity, else allocate a fresh
+        one. Slots are pinned in the arena (a reader pin via store_get that
+        is never released), so LRU eviction and spill can never reclaim a
+        live channel buffer out from under an in-place overwrite."""
+        pool = self._slot_pools.setdefault(chan_id, [])
+        for slot in pool:
+            if not slot.in_use and slot.capacity >= size:
+                slot.in_use = True
+                return slot
+        # every pooled slot is busy (the consumer may legitimately HOLD
+        # zero-copy views of prior results, deferring their acks forever):
+        # wait briefly for a recycle, then allocate an overflow slot — the
+        # arena grows with the consumer's live data instead of deadlocking
+        if len(pool) >= self._window_size:
+            waiter = self._slot_waiters.setdefault(chan_id, asyncio.Event())
+            waiter.clear()
+            try:
+                await asyncio.wait_for(waiter.wait(), timeout=0.05)
+            except asyncio.TimeoutError:
+                pass
+            for slot in pool:
+                if not slot.in_use and slot.capacity >= size:
+                    slot.in_use = True
+                    return slot
+        slot = await self._alloc_slot(size)
+        slot.oneshot = len(pool) >= self._window_size
+        slot.in_use = True
+        pool.append(slot)
+        return slot
+
+    async def _alloc_slot(self, size: int) -> _Slot:
+        worker = self._worker
+        capacity = max(size, 1 << 20)
+        object_id = ObjectID.from_random()
+        raylet = worker.client_pool.get(*worker.raylet_address)
+        reply = await raylet.call("store_create", object_id, capacity)
+        if not reply.get("ok"):
+            raise ChannelClosed(
+                f"cannot allocate channel slot: {reply.get('error')}"
+            )
+        segment = reply["segment"]
+        await raylet.call("store_seal", object_id, True)
+        # permanent pin: exempts the slot from LRU eviction AND spill
+        await raylet.call("store_get", object_id, worker.address)
+        return _Slot(object_id, segment, capacity)
+
+    async def handle_push_shm(
+        self, chan_id: str, seq: int, object_id, segment: str, size: int,
+        owner_address,
+    ) -> bool:
+        return await self.handle_push(
+            chan_id, seq,
+            _ShmDoorbell(chan_id, object_id, segment, size, tuple(owner_address)),
+        )
 
 
 def ensure_channel_manager(worker) -> ChannelManager:
@@ -129,4 +389,6 @@ def ensure_channel_manager(worker) -> ChannelManager:
         mgr = ChannelManager(worker)
         worker._channel_manager = mgr
         worker.server.register("chan_push", mgr.handle_push)
+        worker.server.register("chan_push_shm", mgr.handle_push_shm)
+        worker.server.register("chan_shm_done", mgr.handle_shm_done)
     return mgr

@@ -75,7 +75,12 @@ class ServeController:
         # thread vs deploy RPC thread) cannot land out of order and regress
         # the durable state to an older snapshot
         self._ckpt_lock = threading.Lock()
-        self._recover_from_checkpoint()
+        try:
+            self._recover_from_checkpoint()
+        except Exception:
+            # never let recovery crash __init__: with max_restarts=-1 that
+            # would restart-loop the controller forever on a bad checkpoint
+            logger.exception("serve checkpoint recovery failed; starting fresh")
         self._thread = threading.Thread(
             target=self._run_control_loop, daemon=True, name="serve-reconcile"
         )
@@ -157,13 +162,21 @@ class ServeController:
         probes = []  # (dep, rid, handle, probe_ref)
         deps: Dict[str, _DeploymentState] = {}
         for full, d in data.get("deployments", {}).items():
-            dep = _DeploymentState(
-                d["config"], d["cls_bytes"], d["init_args"], d["init_kwargs"]
-            )
-            dep.target_replicas = d["target_replicas"]
-            dep.next_replica_idx = d["next_replica_idx"]
+            try:
+                dep = _DeploymentState(
+                    d["config"], d["cls_bytes"], d["init_args"], d["init_kwargs"]
+                )
+                dep.target_replicas = d["target_replicas"]
+                dep.next_replica_idx = d["next_replica_idx"]
+                replicas = list(d["replicas"])
+            except Exception:
+                # schema drift (checkpoint from another controller version):
+                # skip this record rather than crash — with max_restarts=-1
+                # an exception here would restart-loop the controller forever
+                logger.exception("skipping malformed checkpoint record %s", full)
+                continue
             deps[full] = dep
-            for rid, handle, _state in d["replicas"]:
+            for rid, handle, _state in replicas:
                 try:
                     probes.append((dep, rid, handle, handle.check_health.remote()))
                 except Exception:

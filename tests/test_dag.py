@@ -162,3 +162,36 @@ def test_ref_single_consumption(ray_start_regular):
             ref.get()
     finally:
         compiled.teardown()
+
+
+def test_compiled_large_payloads_shm_path(ray_start_regular):
+    """Payloads over the inline threshold ride reusable pinned arena slots
+    (reference: mutable shared-memory channel objects,
+    shared_memory_channel.py / node_manager.h:662 HandlePushMutableObject):
+    many iterations must reuse slots correctly, including when the consumer
+    HOLDS previous results (live zero-copy views defer slot recycling)."""
+    import numpy as np
+
+    @ray_tpu.remote
+    class Scaler:
+        def scale(self, x):
+            return x * 2.0
+
+    a = Scaler.remote()
+    with InputNode() as inp:
+        dag = a.scale.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        held = []
+        for i in range(12):
+            arr = np.full((300_000,), float(i), np.float32)  # ~1.2 MB
+            out = compiled.execute(arr).get()
+            assert out.shape == (300_000,)
+            assert float(out[0]) == i * 2.0
+            held.append(out)  # hold every result: slots must not be reused
+            # while these views are alive, yet execution must not deadlock
+        # all held values still intact (no slot was overwritten under us)
+        for i, out in enumerate(held):
+            assert float(out[0]) == i * 2.0, i
+    finally:
+        compiled.teardown()
