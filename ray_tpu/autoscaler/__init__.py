@@ -11,8 +11,8 @@ gang demands (placement groups with TPU bundles) scale whole ICI-connected
 slices instead of individual VMs.
 """
 
-from .config import NodeTypeConfig, AutoscalingConfig
-from .node_provider import NodeProvider, FakeMultiNodeProvider
+from .config import NodeTypeConfig, AutoscalingConfig, tpu_slice_node_type
+from .node_provider import NodeProvider, FakeMultiNodeProvider, TpuSliceProvider
 from .scheduler import ResourceScheduler, SchedulingDecision
 from .autoscaler import Autoscaler, AutoscalerMonitor
 
@@ -21,6 +21,8 @@ __all__ = [
     "AutoscalingConfig",
     "NodeProvider",
     "FakeMultiNodeProvider",
+    "TpuSliceProvider",
+    "tpu_slice_node_type",
     "ResourceScheduler",
     "SchedulingDecision",
     "Autoscaler",

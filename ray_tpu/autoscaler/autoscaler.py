@@ -103,10 +103,17 @@ class Autoscaler:
 
         terminated = []
         for inst in instances:
-            node_id = getattr(self._provider, "node_id_of", lambda _i: None)(
-                inst.instance_id
-            )
-            if node_id is None or node_id not in idle_node_ids:
+            # grouped instances (TPU slices) are idle only when EVERY host
+            # is idle — scale-down retires whole ICI domains or nothing
+            ids_of = getattr(self._provider, "node_ids_of", None)
+            if ids_of is not None:
+                node_ids = ids_of(inst.instance_id)
+            else:
+                node_id = getattr(
+                    self._provider, "node_id_of", lambda _i: None
+                )(inst.instance_id)
+                node_ids = [node_id] if node_id is not None else []
+            if not node_ids or not all(n in idle_node_ids for n in node_ids):
                 self._idle_since.pop(inst.instance_id, None)
                 continue
             since = self._idle_since.setdefault(inst.instance_id, now)

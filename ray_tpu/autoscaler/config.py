@@ -21,6 +21,44 @@ class NodeTypeConfig:
     labels: Dict[str, str] = field(default_factory=dict)
     min_workers: int = 0
     max_workers: int = 10
+    # Atomic launch groups (reference: the TPU provider's slice-granular
+    # node groups, _private/accelerators/tpu.py:213 + gcp/node_provider.py):
+    # one create_node launches ``group_size`` hosts that live and die
+    # together — a whole ICI-connected slice. ``resources`` is PER HOST;
+    # ``head_resources`` lands only on host 0 (the slice-claim resource).
+    group_size: int = 1
+    head_resources: Dict[str, float] = field(default_factory=dict)
+
+
+def tpu_slice_node_type(
+    pod_type: str,
+    *,
+    cpus_per_host: float = 2.0,
+    min_slices: int = 0,
+    max_slices: int = 4,
+) -> NodeTypeConfig:
+    """Node type for whole-slice scale units of one TPU pod type: min/max
+    count SLICES, each launch contributes every host of one slice with the
+    topology labels and head resource reserve_tpu_slice() pins to."""
+    from .._internal.accelerators import (
+        TPU_POD_TYPE_LABEL,
+        chips_per_host,
+        pod_type_num_hosts,
+        tpu_head_resource,
+    )
+
+    return NodeTypeConfig(
+        name=f"tpu-slice-{pod_type}",
+        resources={
+            "TPU": float(chips_per_host(pod_type)),
+            "CPU": cpus_per_host,
+        },
+        labels={TPU_POD_TYPE_LABEL: pod_type},
+        min_workers=min_slices,
+        max_workers=max_slices,
+        group_size=pod_type_num_hosts(pod_type),
+        head_resources={tpu_head_resource(pod_type): 1.0},
+    )
 
 
 @dataclass

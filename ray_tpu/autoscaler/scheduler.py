@@ -84,7 +84,7 @@ class ResourceScheduler:
                     _consume(node.capacity, resources)
                     return True
             return self._open_node(resources, selector, planned,
-                                   planned_counts, decision)
+                                   planned_counts, decision) is not None
 
         # Plain task/actor demands.
         for demand in cluster_state.get("pending_demands", []):
@@ -114,39 +114,59 @@ class ResourceScheduler:
                         placed = True
                         break
                 if not placed:
-                    if self._open_node(bundle, {}, planned, planned_counts,
-                                       decision):
-                        used.append(planned[-1])
+                    opened = self._open_node(bundle, {}, planned,
+                                             planned_counts, decision)
+                    if opened is not None:
+                        # the host the bundle actually landed on (for
+                        # grouped slice types this is host 0, not the last
+                        # host appended) — spread exclusion must track it
+                        used.append(opened)
                     else:
                         decision.infeasible.append({"resources": bundle})
         return decision
 
     def _open_node(self, resources, selector, planned, planned_counts,
-                   decision) -> bool:
-        """Launch the smallest feasible node type for this demand."""
+                   decision) -> Optional[_PlannedNode]:
+        """Launch the smallest feasible node type for this demand; returns
+        the planned host the demand landed on (None if infeasible). Grouped
+        types (TPU slices) launch atomically: one decision contributes every
+        host of the slice to the planned pool, with the head resource on
+        host 0 — so a slice-claim bundle opens exactly one slice and the
+        remaining hosts absorb the worker-gang bundles."""
         candidates: List[NodeTypeConfig] = []
         for t in self._config.node_types:
             labels = {**t.labels, "ray.io/node-type": t.name}
             if not _labels_match(labels, selector):
                 continue
-            if not _fits(dict(t.resources), resources):
+            host0 = {**t.resources, **t.head_resources}
+            if not (_fits(dict(host0), resources)
+                    or _fits(dict(t.resources), resources)):
                 continue
             if planned_counts.get(t.name, 0) >= t.max_workers:
                 continue
             candidates.append(t)
         if not candidates:
-            return False
+            return None
         total_planned = sum(planned_counts.values())
         if total_planned >= self._config.max_workers:
-            return False
-        best = min(candidates, key=lambda t: sum(t.resources.values()))
+            return None
+        best = min(
+            candidates,
+            key=lambda t: sum(t.resources.values()) * t.group_size,
+        )
         planned_counts[best.name] = planned_counts.get(best.name, 0) + 1
         decision.launches[best.name] = decision.launches.get(best.name, 0) + 1
-        node = _PlannedNode(
-            best.name,
-            best.resources,
-            {**best.labels, "ray.io/node-type": best.name},
-        )
-        _consume(node.capacity, resources)  # the demand that opened this node
-        planned.append(node)
-        return True
+        labels = {**best.labels, "ray.io/node-type": best.name}
+        hosts = []
+        for host_idx in range(best.group_size):
+            capacity = dict(best.resources)
+            if host_idx == 0:
+                capacity.update(best.head_resources)
+            node = _PlannedNode(best.name, capacity, labels)
+            planned.append(node)
+            hosts.append(node)
+        for node in hosts:
+            if _fits(node.capacity, resources):
+                _consume(node.capacity, resources)
+                return node
+        return hosts[0]

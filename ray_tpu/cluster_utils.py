@@ -104,6 +104,7 @@ class AutoscalingCluster:
         idle_timeout_s: float = 60.0,
         update_interval_s: float = 0.25,
         max_workers: int = 20,
+        provider_cls=None,
     ):
         from .autoscaler import (
             AutoscalerMonitor,
@@ -126,7 +127,8 @@ class AutoscalingCluster:
             update_interval_s=update_interval_s,
             max_workers=max_workers,
         )
-        self.provider = FakeMultiNodeProvider(self.cluster, self.config)
+        provider_cls = provider_cls or FakeMultiNodeProvider
+        self.provider = provider_cls(self.cluster, self.config)
         self.monitor = AutoscalerMonitor(
             self.config, self.provider, self.cluster.gcs_address
         )

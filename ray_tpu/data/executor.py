@@ -65,6 +65,13 @@ class DataContext:
         self.max_in_flight_tasks = 0  # 0 => derive from cluster CPUs
         self.actor_pool_in_flight_per_actor = 2
         self.target_max_block_size = 128 * 1024 * 1024
+        # Object-store budget backpressure (reference: resource_manager.py:47
+        # + backpressure_policy/resource_budget_backpressure_policy.py):
+        # admission of new block tasks pauses while local arena usage
+        # exceeds this fraction of capacity, so a wide map over large
+        # blocks drains instead of forcing eviction/spill of pinned blocks.
+        # <= 0 disables the policy.
+        self.store_memory_fraction = 0.5
 
     @classmethod
     def get_current(cls) -> "DataContext":
@@ -373,17 +380,42 @@ def _exec(op: Op) -> Iterator[RefBundle]:
     raise NotImplementedError(f"no physical operator for {op}")
 
 
+def _store_over_budget() -> bool:
+    """Local arena usage above the configured fraction of capacity — the
+    admission gate of the store-budget backpressure policy (reference:
+    resource_budget_backpressure_policy.py)."""
+    fraction = DataContext.get_current().store_memory_fraction
+    if fraction <= 0:
+        return False
+    try:
+        from .. import _worker_api
+
+        stats = _worker_api.get_node().raylet.store.stats()
+        return stats["used"] > stats["capacity"] * fraction
+    except Exception:
+        return False
+
+
 def _ordered_pipeline(submissions, cap: int) -> Iterator[RefBundle]:
     """Keep up to ``cap`` tasks in flight, yield results in submission order
     (the reference's default: operators preserve block order; backpressure =
     bounded in-flight, execution/backpressure_policy/concurrency_cap…).
-    Blocking on the FIFO head still overlaps: the tail keeps executing."""
+    Blocking on the FIFO head still overlaps: the tail keeps executing.
+
+    Two admission gates: the in-flight cap, and the object-store budget —
+    when completed-but-unconsumed blocks push arena usage past the budget,
+    admission pauses (one task always stays in flight for progress) until
+    the consumer drains the head and its blocks release."""
     from collections import deque
 
     queue: deque = deque()
     exhausted = False
     while not exhausted or queue:
-        while not exhausted and len(queue) < cap:
+        while (
+            not exhausted
+            and len(queue) < cap
+            and (not queue or not _store_over_budget())
+        ):
             try:
                 queue.append(next(submissions))
             except StopIteration:

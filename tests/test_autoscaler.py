@@ -202,3 +202,65 @@ def test_scale_down_when_idle(autoscaling_cluster):
             break
         time.sleep(0.5)
     assert not autoscaling_cluster.provider.non_terminated_nodes()
+
+
+def test_tpu_slice_provider_scales_pending_slice_up_and_down(shutdown_only):
+    """A pending slice reservation scales the cluster up by EXACTLY one
+    whole slice (all hosts, atomically: head resource on worker 0, slice
+    labels on every host), and the slice retires as one unit after idle
+    timeout (reference: slice-granular node groups,
+    _private/accelerators/tpu.py:213, gcp/node_provider.py:63)."""
+    from ray_tpu.autoscaler import TpuSliceProvider, tpu_slice_node_type
+    from ray_tpu.util.tpu import reserve_tpu_slice
+
+    slice_type = tpu_slice_node_type(
+        "v5e-16", cpus_per_host=2.0, min_slices=0, max_slices=2
+    )
+    assert slice_type.group_size == 2  # v5e-16 = 2 hosts x 8 chips
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 2},
+        worker_node_types=[slice_type],
+        idle_timeout_s=3.0,
+        update_interval_s=0.25,
+        provider_cls=TpuSliceProvider,
+    )
+    cluster.start()
+    cluster.connect()
+    try:
+        # no TPU nodes yet; reserving a slice parks a pending head-resource
+        # PG the autoscaler must satisfy by launching ONE slice
+        reservation = reserve_tpu_slice("v5e-16", timeout=120.0)
+        assert reservation.num_hosts == 2
+
+        instances = cluster.provider.non_terminated_nodes()
+        assert len(instances) == 1, [i.instance_id for i in instances]
+
+        # exactly one slice: 2 TPU hosts sharing one slice name, head has 3
+        nodes = [n for n in ray_tpu.nodes() if n["Resources"].get("TPU")]
+        assert len(nodes) == 2
+        slice_names = {
+            n["Labels"]["ray.io/tpu-slice-name"] for n in nodes
+        }
+        assert len(slice_names) == 1
+        heads = [
+            n for n in nodes
+            if any(k.endswith("-head") for k in n["Resources"])
+        ]
+        assert len(heads) == 1
+
+        # release the reservation: the slice idles and retires WHOLE
+        reservation.release()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if not cluster.provider.non_terminated_nodes():
+                break
+            time.sleep(0.5)
+        assert not cluster.provider.non_terminated_nodes()
+        assert not [
+            n for n in ray_tpu.nodes()
+            if n["Alive"] and n["Resources"].get("TPU")
+        ]
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
