@@ -67,6 +67,10 @@ class Config:
     # redis_store_client.h:126). Empty = in-memory tables; a path selects the
     # sqlite WAL backend so actors/PGs/KV/jobs survive a GCS restart.
     gcs_storage_path: str = ""
+    # External spill tier (reference: _private/external_storage.py:399):
+    # empty = node-local disk; an fsspec URI prefix ("memory://spill",
+    # "gs://bucket/cluster") sends spilled primary copies to that store.
+    spill_storage_uri: str = ""
 
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0

@@ -60,24 +60,35 @@ async def handle_dag_teardown(worker, instance, dag_id: int) -> bool:
     return True
 
 
+async def _read_inputs(mgr, inputs) -> tuple:
+    """Read one execution's inputs; (values, stopped)."""
+    values: Dict[Any, Any] = {}
+    for upstream_uuid, cid in inputs:
+        try:
+            values[upstream_uuid] = await mgr.read(cid)
+        except ChannelClosed:
+            return values, True
+    return values, False
+
+
 async def _node_loop(worker, instance, mgr, plan: dict):
     method = getattr(instance, plan["method"], None)
     inputs: List = plan["inputs"]  # [(upstream_uuid, chan_id)]
     outputs: List = plan["outputs"]  # [(reader_address, chan_id)]
     seq = 0
+    # Overlapped schedule (reference: dag_node_operation.py's READ/COMPUTE/
+    # WRITE reordering): the NEXT execution's input reads run as a prefetch
+    # task while the current execution computes on the executor thread —
+    # cross-node pulls and shm mapping of seq n+1 hide behind seq n's
+    # compute, the async analogue of the reference's explicit op schedule.
+    read_task = asyncio.ensure_future(_read_inputs(mgr, inputs))
     try:
         while True:
-            values: Dict[Any, Any] = {}
-            stopped = False
-            for upstream_uuid, cid in inputs:
-                try:
-                    values[upstream_uuid] = await mgr.read(cid)
-                except ChannelClosed:
-                    stopped = True
-                    break
+            values, stopped = await read_task
             if stopped:
                 await _fan_out(worker, mgr, outputs, -1, STOP)
                 return
+            read_task = asyncio.ensure_future(_read_inputs(mgr, inputs))
             result = await _run_node(worker, instance, method, plan, values)
             await _fan_out(worker, mgr, outputs, seq, result)
             seq += 1
@@ -85,6 +96,9 @@ async def _node_loop(worker, instance, mgr, plan: dict):
         return
     except Exception:
         logger.exception("compiled-dag loop for %s crashed", plan["method"])
+    finally:
+        if not read_task.done():
+            read_task.cancel()
 
 
 async def _run_node(worker, instance, method, plan: dict, values: Dict):

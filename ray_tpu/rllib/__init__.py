@@ -13,6 +13,7 @@ from .env import VectorEnv, make_env
 from .env_runner import EnvRunner
 from .impala import APPOConfig, IMPALA, IMPALAConfig
 from .learner import PPOLearner
+from .offline import CQL, CQLConfig, IQL, IQLConfig, MARWIL, MARWILConfig
 from .sac import SAC, SACConfig
 
 __all__ = [
@@ -27,6 +28,12 @@ __all__ = [
     "SACConfig",
     "BC",
     "BCConfig",
+    "MARWIL",
+    "MARWILConfig",
+    "CQL",
+    "CQLConfig",
+    "IQL",
+    "IQLConfig",
     "ReplayBuffer",
     "as_trainable",
     "PPOLearner",

@@ -1,0 +1,632 @@
+"""Offline RL algorithms: MARWIL, CQL, IQL.
+
+Role-equivalents of the reference's offline family
+(rllib/algorithms/marwil/ — advantage-re-weighted imitation;
+rllib/algorithms/cql/ — conservative Q-learning penalizing out-of-dataset
+actions; rllib/algorithms/iql/ — implicit Q-learning via expectile
+regression). TPU-first like the rest of this rllib: every update epoch is
+one jitted ``lax.scan`` over shuffled minibatches, the offline dataset is a
+single host->device transfer, and no environment interaction happens during
+training (evaluate() rolls out greedily for reporting only).
+
+Dataset schema: {"obs": [N,D], "actions": [N] or [N,A], "rewards": [N],
+"dones": [N]} as arrays or a ray_tpu.data.Dataset of such rows ("next_obs"
+additionally for CQL/IQL; MARWIL derives returns from episode boundaries).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .config_base import AlgorithmConfig
+from .env import encode_obs, make_env, space_dims
+from .models import (
+    ActorCritic,
+    QNetwork,
+    SquashedGaussianActor,
+    TwinQ,
+    log_prob_entropy,
+    squashed_sample_logp,
+)
+
+
+def _materialize_offline(data, obs_space, obs_dim, discrete, need_next=False):
+    """Normalize an offline dataset to device-ready arrays."""
+    from ..data.dataset import Dataset
+
+    if isinstance(data, Dataset):
+        rows = data.take_all()
+        cols: Dict[str, np.ndarray] = {}
+        for key in rows[0]:
+            cols[key] = np.stack([np.asarray(r[key]) for r in rows])
+        data = cols
+    out = {
+        "obs": encode_obs(obs_space, np.asarray(data["obs"], np.float32)),
+        "rewards": np.asarray(data.get("rewards", np.zeros(len(data["obs"]))),
+                              np.float32).reshape(-1),
+        "dones": np.asarray(data.get("dones", np.zeros(len(data["obs"]))),
+                            np.float32).reshape(-1),
+    }
+    actions = np.asarray(data["actions"])
+    if discrete:
+        out["actions"] = actions.astype(np.int64).reshape(len(actions))
+    else:
+        out["actions"] = actions.astype(np.float32).reshape(len(actions), -1)
+    if need_next:
+        if "next_obs" not in data:
+            raise ValueError("CQL/IQL offline data requires 'next_obs'")
+        out["next_obs"] = encode_obs(
+            obs_space, np.asarray(data["next_obs"], np.float32)
+        )
+    assert out["obs"].shape[1] == obs_dim
+    return out
+
+
+def _discounted_returns(rewards: np.ndarray, dones: np.ndarray, gamma: float):
+    """Reward-to-go within episodes (episode boundaries = dones)."""
+    returns = np.zeros_like(rewards)
+    acc = 0.0
+    for i in range(len(rewards) - 1, -1, -1):
+        acc = rewards[i] + gamma * acc * (1.0 - dones[i])
+        returns[i] = acc
+    return returns
+
+
+class _OfflineBase:
+    """Shared surface: env probing, minibatch scan driver, evaluation,
+    checkpointing (mirrors the BC implementation this family extends)."""
+
+    def __init__(self, config):
+        if config.env_spec is None:
+            raise ValueError("config.environment(...) is required")
+        if config.input_data is None:
+            raise ValueError("config.offline_data(...) is required")
+        self.config = config
+        self.iteration = 0
+        probe = make_env(config.env_spec, config.env_config)()
+        self._obs_space = probe.observation_space
+        self._act_space = probe.action_space
+        self.obs_dim, self.act_dim, self.discrete = space_dims(
+            probe.observation_space, probe.action_space
+        )
+        try:
+            probe.close()
+        except Exception:
+            pass
+        self._key = jax.random.PRNGKey(config.seed)
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _minibatch_perm(self, key, n_rows):
+        mb = min(self.config.train_batch_size, n_rows)
+        n_mb = max(n_rows // mb, 1)
+        return jax.random.permutation(key, n_rows)[: n_mb * mb].reshape(
+            n_mb, mb
+        )
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        env = make_env(self.config.env_spec, self.config.env_config)()
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=self.config.seed + ep)
+            done, total, steps = False, 0.0, 0
+            while not done and steps < 1000:
+                action = self.compute_single_action(obs)
+                obs, r, term, trunc, _ = env.step(action)
+                total += float(r)
+                done = term or trunc
+                steps += 1
+            returns.append(total)
+        try:
+            env.close()
+        except Exception:
+            pass
+        return {
+            "episode_return_mean": float(np.mean(returns)),
+            "num_episodes": num_episodes,
+        }
+
+    # -- checkpointing ------------------------------------------------------
+
+    def _state_dict(self) -> dict:
+        raise NotImplementedError
+
+    def _load_state_dict(self, state: dict):
+        raise NotImplementedError
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        name = type(self).__name__.lower()
+        with open(os.path.join(checkpoint_dir, f"{name}_state.pkl"), "wb") as f:
+            pickle.dump(
+                jax.tree.map(np.asarray, self._state_dict()), f
+            )
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str):
+        name = type(self).__name__.lower()
+        with open(os.path.join(checkpoint_dir, f"{name}_state.pkl"), "rb") as f:
+            self._load_state_dict(pickle.load(f))
+
+
+# ---------------------------------------------------------------------------
+# MARWIL
+# ---------------------------------------------------------------------------
+
+
+class MARWILConfig(AlgorithmConfig):
+    """reference: marwil/marwil.py MARWILConfig. beta=0 degrades to BC."""
+
+    def __init__(self):
+        super().__init__()
+        self.input_data: Any = None
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.num_epochs_per_iter = 1
+        self.beta = 1.0  # advantage exponent temperature
+        self.vf_coeff = 1.0
+        self.gamma = 0.99
+        # exp(beta * A) is clipped here for stability (reference: MARWIL's
+        # moving-average advantage normalizer serves the same purpose)
+        self.max_advantage_weight = 20.0
+
+    def offline_data(self, input_data) -> "MARWILConfig":
+        self.input_data = input_data
+        return self
+
+    def training(self, **kwargs) -> "MARWILConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown MARWIL option {k!r}")
+            setattr(self, k, v)
+        return self
+
+
+class MARWIL(_OfflineBase):
+    """Monotonic advantage re-weighted imitation learning: supervised policy
+    learning where each (s, a) is weighted exp(beta * advantage), with the
+    baseline V learned jointly (reference: marwil/marwil.py:24)."""
+
+    def __init__(self, config: MARWILConfig):
+        super().__init__(config)
+        self.model = ActorCritic(action_dim=self.act_dim, discrete=self.discrete)
+        self.params = self.model.init(
+            self._next_key(), jnp.zeros((1, self.obs_dim))
+        )["params"]
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        data = _materialize_offline(
+            config.input_data, self._obs_space, self.obs_dim, self.discrete
+        )
+        data["returns"] = _discounted_returns(
+            data["rewards"], data["dones"], config.gamma
+        )
+        self._data = jax.tree.map(jnp.asarray, data)
+        self._epoch_fn = jax.jit(self._epoch_impl)
+
+    def _loss(self, params, batch):
+        out, values = self.model.apply({"params": params}, batch["obs"])
+        logp, _ = log_prob_entropy(self.discrete, out, batch["actions"])
+        advantage = batch["returns"] - values
+        weight = jnp.minimum(
+            jnp.exp(self.config.beta * jax.lax.stop_gradient(advantage)),
+            self.config.max_advantage_weight,
+        )
+        policy_loss = -jnp.mean(weight * logp)
+        vf_loss = jnp.mean(advantage**2)
+        return policy_loss + self.config.vf_coeff * vf_loss, (
+            policy_loss, vf_loss,
+        )
+
+    def _epoch_impl(self, params, opt_state, key, data):
+        def step(carry, idx):
+            params, opt_state = carry
+            batch = jax.tree.map(lambda x: x[idx], data)
+            (loss, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
+                params, batch
+            )
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        perm = self._minibatch_perm(key, data["obs"].shape[0])
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), perm
+        )
+        return params, opt_state, jnp.mean(losses)
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        losses = []
+        for _ in range(self.config.num_epochs_per_iter):
+            self.params, self.opt_state, loss = self._epoch_fn(
+                self.params, self.opt_state, self._next_key(), self._data
+            )
+            losses.append(float(loss))
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "marwil_loss": float(np.mean(losses)),
+            "num_samples": int(self._data["obs"].shape[0]),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def compute_single_action(self, obs):
+        enc = encode_obs(self._obs_space, np.asarray(obs)[None])
+        out, _ = self.model.apply({"params": self.params}, jnp.asarray(enc))
+        if self.discrete:
+            return int(np.asarray(jnp.argmax(out, axis=-1))[0])
+        mean, _ = out
+        return np.asarray(mean)[0]
+
+    def _state_dict(self):
+        return {"params": self.params, "iteration": self.iteration}
+
+    def _load_state_dict(self, state):
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.iteration = state["iteration"]
+
+
+# ---------------------------------------------------------------------------
+# CQL (discrete)
+# ---------------------------------------------------------------------------
+
+
+class CQLConfig(AlgorithmConfig):
+    """reference: cql/cql.py CQLConfig (the conservative penalty on top of
+    a Q-learner; discrete action spaces here — the logsumexp is exact)."""
+
+    def __init__(self):
+        super().__init__()
+        self.input_data: Any = None
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.num_epochs_per_iter = 1
+        self.gamma = 0.99
+        self.tau = 0.005  # polyak for the target network
+        self.cql_alpha = 1.0  # weight of the conservative penalty
+
+    def offline_data(self, input_data) -> "CQLConfig":
+        self.input_data = input_data
+        return self
+
+    def training(self, **kwargs) -> "CQLConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown CQL option {k!r}")
+            setattr(self, k, v)
+        return self
+
+
+class CQL(_OfflineBase):
+    """Conservative Q-learning: a double-DQN-style backup (argmax from the
+    online network, value from the target network — removing the max-
+    operator overestimation bias) plus the CQL regularizer
+    alpha * (logsumexp_a Q(s,a) - Q(s, a_data)) that pushes down
+    out-of-dataset action values (reference: cql/cql.py:34,
+    cql/torch/cql_torch_learner.py)."""
+
+    def __init__(self, config: CQLConfig):
+        super().__init__(config)
+        if not self.discrete:
+            raise ValueError(
+                "this CQL implements discrete action spaces (exact "
+                "logsumexp); use IQL for continuous offline control"
+            )
+        self.model = QNetwork(action_dim=self.act_dim)
+        self.params = self.model.init(
+            self._next_key(), jnp.zeros((1, self.obs_dim))
+        )["params"]
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._data = jax.tree.map(
+            jnp.asarray,
+            _materialize_offline(
+                config.input_data, self._obs_space, self.obs_dim,
+                self.discrete, need_next=True,
+            ),
+        )
+        self._epoch_fn = jax.jit(self._epoch_impl)
+
+    def _loss(self, params, target_params, batch):
+        q = self.model.apply({"params": params}, batch["obs"])
+        q_taken = jnp.take_along_axis(
+            q, batch["actions"][:, None], axis=1
+        )[:, 0]
+        # decoupled selection/evaluation (double DQN): the online net picks
+        # the action, the target net scores it
+        q_next_online = self.model.apply({"params": params}, batch["next_obs"])
+        best = jnp.argmax(q_next_online, axis=1)
+        q_next_target = self.model.apply(
+            {"params": target_params}, batch["next_obs"]
+        )
+        target = batch["rewards"] + self.config.gamma * (
+            1.0 - batch["dones"]
+        ) * jnp.take_along_axis(q_next_target, best[:, None], axis=1)[:, 0]
+        bellman = jnp.mean((q_taken - jax.lax.stop_gradient(target)) ** 2)
+        conservative = jnp.mean(
+            jax.scipy.special.logsumexp(q, axis=1) - q_taken
+        )
+        return bellman + self.config.cql_alpha * conservative, (
+            bellman, conservative,
+        )
+
+    def _epoch_impl(self, params, target_params, opt_state, key, data):
+        def step(carry, idx):
+            params, target_params, opt_state = carry
+            batch = jax.tree.map(lambda x: x[idx], data)
+            (loss, _aux), grads = jax.value_and_grad(
+                self._loss, has_aux=True
+            )(params, target_params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_params = jax.tree.map(
+                lambda t, p: (1 - self.config.tau) * t + self.config.tau * p,
+                target_params, params,
+            )
+            return (params, target_params, opt_state), loss
+
+        perm = self._minibatch_perm(key, data["obs"].shape[0])
+        (params, target_params, opt_state), losses = jax.lax.scan(
+            step, (params, target_params, opt_state), perm
+        )
+        return params, target_params, opt_state, jnp.mean(losses)
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        losses = []
+        for _ in range(self.config.num_epochs_per_iter):
+            (
+                self.params, self.target_params, self.opt_state, loss,
+            ) = self._epoch_fn(
+                self.params, self.target_params, self.opt_state,
+                self._next_key(), self._data,
+            )
+            losses.append(float(loss))
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "cql_loss": float(np.mean(losses)),
+            "num_samples": int(self._data["obs"].shape[0]),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def compute_single_action(self, obs):
+        enc = encode_obs(self._obs_space, np.asarray(obs)[None])
+        q = self.model.apply({"params": self.params}, jnp.asarray(enc))
+        return int(np.asarray(jnp.argmax(q, axis=-1))[0])
+
+    def _state_dict(self):
+        return {
+            "params": self.params,
+            "target_params": self.target_params,
+            "iteration": self.iteration,
+        }
+
+    def _load_state_dict(self, state):
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.target_params = jax.tree.map(jnp.asarray, state["target_params"])
+        self.iteration = state["iteration"]
+
+
+# ---------------------------------------------------------------------------
+# IQL
+# ---------------------------------------------------------------------------
+
+
+class IQLConfig(AlgorithmConfig):
+    """reference: the IQL family (implicit Q-learning; expectile value
+    regression + advantage-weighted policy extraction)."""
+
+    def __init__(self):
+        super().__init__()
+        self.input_data: Any = None
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.num_epochs_per_iter = 1
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.expectile = 0.7  # tau in the expectile loss
+        self.awr_beta = 3.0  # advantage-weighted regression temperature
+        self.max_advantage_weight = 100.0
+
+    def offline_data(self, input_data) -> "IQLConfig":
+        self.input_data = input_data
+        return self
+
+    def training(self, **kwargs) -> "IQLConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown IQL option {k!r}")
+            setattr(self, k, v)
+        return self
+
+
+class IQL(_OfflineBase):
+    """Implicit Q-learning: V learned by expectile regression against Q
+    (never queries out-of-dataset actions), Q by bellman against V(s'),
+    policy by advantage-weighted regression — discrete (QNetwork) and
+    continuous (TwinQ + squashed Gaussian actor; Box bounds respected:
+    Q consumes raw env actions, the policy normalizes through [-1, 1])
+    action spaces."""
+
+    def __init__(self, config: IQLConfig):
+        super().__init__(config)
+        key_q, key_v, key_pi = jax.random.split(self._next_key(), 3)
+        zo = jnp.zeros((1, self.obs_dim))
+        self.vf = QNetwork(action_dim=1)  # scalar V head
+        if self.discrete:
+            self.qf = QNetwork(action_dim=self.act_dim)
+            q_params = self.qf.init(key_q, zo)["params"]
+            self.actor = ActorCritic(action_dim=self.act_dim, discrete=True)
+            pi_params = self.actor.init(key_pi, zo)["params"]
+        else:
+            self.qf = TwinQ()
+            q_params = self.qf.init(
+                key_q, zo, jnp.zeros((1, self.act_dim))
+            )["params"]
+            self.actor = SquashedGaussianActor(action_dim=self.act_dim)
+            pi_params = self.actor.init(key_pi, zo)["params"]
+            # Box bounds: the squashed policy lives in [-1, 1]; dataset
+            # actions normalize into that range for the AWR log-prob and
+            # emitted actions rescale back (same mapping as sac.py)
+            low = np.asarray(self._act_space.low, np.float32).reshape(-1)
+            high = np.asarray(self._act_space.high, np.float32).reshape(-1)
+            self._act_mid = jnp.asarray((low + high) / 2.0)
+            self._act_half = jnp.asarray((high - low) / 2.0)
+        self.state = {
+            "q": q_params,
+            "target_q": jax.tree.map(jnp.copy, q_params),
+            "v": self.vf.init(key_v, zo)["params"],
+            "pi": pi_params,
+        }
+        self.tx = optax.adam(config.lr)
+        self.opt_state = {
+            name: self.tx.init(self.state[name]) for name in ("q", "v", "pi")
+        }
+        self._data = jax.tree.map(
+            jnp.asarray,
+            _materialize_offline(
+                config.input_data, self._obs_space, self.obs_dim,
+                self.discrete, need_next=True,
+            ),
+        )
+        self._epoch_fn = jax.jit(self._epoch_impl)
+
+    # -- per-network losses -------------------------------------------------
+
+    def _q_of(self, q_params, obs, actions):
+        if self.discrete:
+            q = self.qf.apply({"params": q_params}, obs)
+            return jnp.take_along_axis(q, actions[:, None], axis=1)[:, 0]
+        q1, q2 = self.qf.apply({"params": q_params}, obs, actions)
+        return jnp.minimum(q1, q2)
+
+    def _v_loss(self, v_params, state, batch):
+        q = jax.lax.stop_gradient(
+            self._q_of(state["target_q"], batch["obs"], batch["actions"])
+        )
+        v = self.vf.apply({"params": v_params}, batch["obs"])[:, 0]
+        diff = q - v
+        weight = jnp.where(diff > 0, self.config.expectile,
+                           1 - self.config.expectile)
+        return jnp.mean(weight * diff**2)
+
+    def _q_loss(self, q_params, state, batch):
+        next_v = jax.lax.stop_gradient(
+            self.vf.apply({"params": state["v"]}, batch["next_obs"])[:, 0]
+        )
+        target = batch["rewards"] + self.config.gamma * (
+            1.0 - batch["dones"]
+        ) * next_v
+        if self.discrete:
+            q = self.qf.apply({"params": q_params}, batch["obs"])
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1
+            )[:, 0]
+            return jnp.mean((q_taken - target) ** 2)
+        q1, q2 = self.qf.apply(
+            {"params": q_params}, batch["obs"], batch["actions"]
+        )
+        return jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+
+    def _pi_loss(self, pi_params, state, batch):
+        q = self._q_of(state["target_q"], batch["obs"], batch["actions"])
+        v = self.vf.apply({"params": state["v"]}, batch["obs"])[:, 0]
+        weight = jnp.minimum(
+            jnp.exp(self.config.awr_beta * jax.lax.stop_gradient(q - v)),
+            self.config.max_advantage_weight,
+        )
+        if self.discrete:
+            out, _ = self.actor.apply({"params": pi_params}, batch["obs"])
+            logp, _ = log_prob_entropy(True, out, batch["actions"])
+        else:
+            mean, log_std = self.actor.apply(
+                {"params": pi_params}, batch["obs"]
+            )
+            # log-prob of the DATASET action under the squashed Gaussian,
+            # normalized from env bounds into the policy's [-1, 1] range
+            eps = 1e-6
+            normed = (batch["actions"] - self._act_mid) / self._act_half
+            pre = jnp.arctanh(jnp.clip(normed, -1 + eps, 1 - eps))
+            var = jnp.exp(2 * log_std)
+            base = -0.5 * ((pre - mean) ** 2 / var + 2 * log_std
+                           + jnp.log(2 * jnp.pi))
+            correction = jnp.log(1 - jnp.tanh(pre) ** 2 + eps)
+            logp = jnp.sum(base - correction, axis=-1)
+        return -jnp.mean(weight * logp)
+
+    def _epoch_impl(self, state, opt_state, key, data):
+        def step(carry, idx):
+            state, opt_state = carry
+            batch = jax.tree.map(lambda x: x[idx], data)
+            losses = {}
+            for name, loss_fn in (
+                ("v", self._v_loss), ("q", self._q_loss), ("pi", self._pi_loss),
+            ):
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    state[name], state, batch
+                )
+                updates, opt_state[name] = self.tx.update(
+                    grads, opt_state[name], state[name]
+                )
+                state[name] = optax.apply_updates(state[name], updates)
+                losses[name] = loss
+            state["target_q"] = jax.tree.map(
+                lambda t, p: (1 - self.config.tau) * t + self.config.tau * p,
+                state["target_q"], state["q"],
+            )
+            return (state, opt_state), losses["v"] + losses["q"] + losses["pi"]
+
+        perm = self._minibatch_perm(key, data["obs"].shape[0])
+        (state, opt_state), losses = jax.lax.scan(
+            step, (state, opt_state), perm
+        )
+        return state, opt_state, jnp.mean(losses)
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        losses = []
+        for _ in range(self.config.num_epochs_per_iter):
+            self.state, self.opt_state, loss = self._epoch_fn(
+                self.state, self.opt_state, self._next_key(), self._data
+            )
+            losses.append(float(loss))
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "iql_loss": float(np.mean(losses)),
+            "num_samples": int(self._data["obs"].shape[0]),
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def compute_single_action(self, obs):
+        enc = jnp.asarray(encode_obs(self._obs_space, np.asarray(obs)[None]))
+        if self.discrete:
+            out, _ = self.actor.apply({"params": self.state["pi"]}, enc)
+            return int(np.asarray(jnp.argmax(out, axis=-1))[0])
+        mean, _ = self.actor.apply({"params": self.state["pi"]}, enc)
+        action = self._act_mid + self._act_half * jnp.tanh(mean)
+        return np.asarray(action)[0]
+
+    def _state_dict(self):
+        return {"state": self.state, "iteration": self.iteration}
+
+    def _load_state_dict(self, state):
+        self.state = jax.tree.map(jnp.asarray, state["state"])
+        self.iteration = state["iteration"]
+
+
+MARWILConfig.algo_class = MARWIL
+CQLConfig.algo_class = CQL
+IQLConfig.algo_class = IQL

@@ -40,6 +40,7 @@ from ..._internal.protocol import (
 from ..._internal.rpc import ClientPool, RpcServer
 from ...exceptions import ObjectStoreFullError
 from ..gcs.pubsub import SubscriberClient
+from ..object_store import spill_storage
 from ..object_store.native_store import create_object_store
 from .memory_monitor import (
     GroupByOwnerWorkerKillingPolicy,
@@ -99,6 +100,9 @@ class Raylet:
         # unmet demands for the autoscaler: task_id -> (resources, selector, ts)
         self._infeasible_demands: Dict[TaskID, tuple] = {}
         self._restore_locks: Dict[ObjectID, asyncio.Lock] = {}
+        # background spill deletions: the loop keeps only weak task refs,
+        # so untracked fire-and-forget tasks can be GC'd mid-flight
+        self._bg_tasks: set = set()
         self._restore_lock_holds: Dict[ObjectID, int] = {}
         self._lease_seq = itertools.count()
         # scheduling-class FIFO queues of pending lease requests
@@ -625,6 +629,18 @@ class Raylet:
         os.makedirs(path, exist_ok=True)
         return path
 
+    def _spill_ref(self, object_id: ObjectID) -> str:
+        """Where a spilled copy lives: node-local disk by default, or an
+        external object store when ``spill_storage_uri`` is configured
+        (reference: _private/external_storage.py:399 — the S3/GCS tier)."""
+        uri = self.config.spill_storage_uri
+        if uri:
+            return (
+                f"{uri.rstrip('/')}/"
+                f"{self.session_id}_{self.node_id.hex()[:6]}/{object_id.hex()}"
+            )
+        return os.path.join(self._spill_dir(), object_id.hex())
+
     async def _create_with_spill(self, object_id: ObjectID, size: int) -> str:
         """store.create, spilling LRU primary copies to disk under memory
         pressure instead of failing."""
@@ -649,13 +665,17 @@ class Raylet:
         view = self.store.read_local(object_id)
         if view is None:
             return  # vanished (freed/evicted) — space may already be back
-        path = os.path.join(self._spill_dir(), object_id.hex())
-        # copy out, then write off-loop: disk I/O on the event loop would
-        # stall heartbeats and lease dispatch (reference: spill workers are
-        # separate IO processes, worker_pool.h io worker pool)
+        path = self._spill_ref(object_id)
+        # copy out, then write off-loop: disk/network I/O on the event loop
+        # would stall heartbeats and lease dispatch (reference: spill
+        # workers are separate IO processes, worker_pool.h io worker pool)
         data = bytes(view)
         del view
-        await asyncio.to_thread(_write_file, path, data)
+        try:
+            await asyncio.to_thread(spill_storage.write, path, data)
+        except Exception:
+            logger.exception("spill write failed for %s; skipping", object_id)
+            return
         # a reader may have pinned the object during the await; freeing then
         # would reallocate a block a live zero-copy view still aliases.
         # freed is None when the object vanished during the write (a
@@ -663,10 +683,7 @@ class Raylet:
         # resurrect a freed object on a later stale get
         freed = self.store.free_if_unpinned(object_id)
         if freed is not True:
-            try:
-                os.remove(path)
-            except OSError:
-                pass
+            await asyncio.to_thread(spill_storage.delete, path)
             return
         self._spilled[object_id] = path
         logger.info("spilled %s (%d bytes) to %s", object_id, len(data), path)
@@ -691,9 +708,15 @@ class Raylet:
                 if path is None:
                     return self.store.contains(object_id)
                 try:
-                    data = await asyncio.to_thread(_read_file, path)
+                    data = await asyncio.to_thread(spill_storage.read, path)
+                except spill_storage.SpillStorageError:
+                    # transient backend failure: the blob is still there —
+                    # keep the pointer and let the caller retry
+                    logger.warning("spill restore of %s failed transiently",
+                                   object_id)
+                    return False
                 except OSError:
-                    # file vanished (concurrent free / external cleanup)
+                    # copy vanished (concurrent free / external cleanup)
                     self._spilled.pop(object_id, None)
                     return self.store.contains(object_id)
                 await self._create_with_spill(object_id, len(data))
@@ -701,10 +724,7 @@ class Raylet:
                 self.store.seal(object_id)
                 self.store.pin_primary(object_id)  # restored copy stays primary
                 self._spilled.pop(object_id, None)
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
+                await asyncio.to_thread(spill_storage.delete, path)
                 return True
         finally:
             # drop the per-object lock only when no other coroutine is
@@ -754,10 +774,10 @@ class Raylet:
                 path = self._spilled.get(object_id)
                 if path is not None:
                     try:
-                        data = await asyncio.to_thread(_read_file, path)
+                        data = await asyncio.to_thread(spill_storage.read, path)
                         return {"ok": True, "data": data}
-                    except OSError:
-                        pass  # raced with a concurrent restore; fall through
+                    except (OSError, spill_storage.SpillStorageError):
+                        pass  # raced with restore, or transient backend error
         if owner_address is not None:
             pulled = await self._pull_object(object_id, owner_address)
             if pulled:
@@ -788,10 +808,11 @@ class Raylet:
                 self._deferred_frees.add(oid)
             path = self._spilled.pop(oid, None)
             if path is not None:
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
+                task = asyncio.ensure_future(
+                    asyncio.to_thread(spill_storage.delete, path)
+                )
+                self._bg_tasks.add(task)
+                task.add_done_callback(self._bg_tasks.discard)
         return True
 
     async def handle_fetch_object(self, object_id: ObjectID, offset: int, length: int):
@@ -808,11 +829,11 @@ class Raylet:
             if path is not None:
                 try:
                     total, chunk = await asyncio.to_thread(
-                        _read_file_range, path, offset, length
+                        spill_storage.read_range, path, offset, length
                     )
                     return {"total": total, "data": chunk}
-                except OSError:
-                    pass  # spill file raced with restore/free; fall through
+                except (OSError, spill_storage.SpillStorageError):
+                    pass  # spill copy raced with restore/free, or transient
             # a concurrent restore may have just completed (and popped the
             # _spilled entry + deleted the file): retry the store before
             # declaring the object absent
@@ -899,21 +920,4 @@ class Raylet:
         return True
 
 
-def _write_file(path: str, data: bytes):
-    with open(path, "wb") as f:
-        f.write(data)
 
-
-def _read_file(path: str) -> bytes:
-    with open(path, "rb") as f:
-        return f.read()
-
-
-def _read_file_range(path: str, offset: int, length: int):
-    """(total_size, bytes at [offset, offset+length)) without reading the
-    whole spill file per chunk."""
-    with open(path, "rb") as f:
-        f.seek(0, os.SEEK_END)
-        total = f.tell()
-        f.seek(offset)
-        return total, f.read(length)
