@@ -58,3 +58,64 @@ def test_scale_smoke_many_actors(shutdown_only):
     )
     for a in actors:
         ray_tpu.kill(a)
+
+
+def test_scale_100_virtual_nodes(shutdown_only):
+    """Scalability quantification (BASELINE.md's 2,000-node envelope,
+    scaled to a 1-core CI box): a 100-raylet in-process cluster must
+    register quickly, serve O(n) cluster views fast, and dispatch work
+    across the full node set. Prints timings for BENCH_LOG.md."""
+    import time
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args=dict(num_cpus=1))
+    t0 = time.perf_counter()
+    for i in range(99):
+        cluster.add_node(num_cpus=1, resources={f"node{i}": 1.0})
+    register_s = time.perf_counter() - t0
+    cluster.connect()
+    try:
+        import ray_tpu as rt
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len(rt.nodes()) >= 100:
+                break
+            time.sleep(0.2)
+        nodes = rt.nodes()
+        assert len(nodes) == 100, len(nodes)
+
+        t0 = time.perf_counter()
+        for _ in range(20):
+            res = rt.cluster_resources()
+        view_ms = (time.perf_counter() - t0) / 20 * 1000
+        assert res.get("CPU", 0) == 100.0
+
+        # dispatch across distinct far nodes via custom-resource pinning
+        @rt.remote(num_cpus=0)
+        def where():
+            import os
+            return os.getpid()
+
+        t0 = time.perf_counter()
+        refs = [
+            where.options(resources={f"node{i * 12}": 1.0}).remote()
+            for i in range(8)
+        ]
+        pids = rt.get(refs, timeout=300)
+        dispatch_s = time.perf_counter() - t0
+        assert len(set(pids)) == 8  # eight distinct nodes executed
+
+        print(
+            f"scale100: register_99_nodes={register_s:.2f}s "
+            f"cluster_view={view_ms:.2f}ms "
+            f"8_cross_node_dispatch={dispatch_s:.2f}s"
+        )
+        assert register_s < 120
+        assert view_ms < 200
+    finally:
+        import ray_tpu
+
+        ray_tpu.shutdown()
+        cluster.shutdown()
