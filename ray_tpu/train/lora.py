@@ -1,0 +1,43 @@
+"""LoRA parameter partitioning for frozen-base fine-tuning.
+
+Reference role: the reference fine-tunes through torch/PEFT outside Ray core
+(BASELINE.json config 3 — Llama-2-7B LoRA via JaxTrainer); here the split is
+a pytree transform so ``jax.grad`` differentiates ONLY the adapter leaves and
+the optimizer state exists ONLY for them. The frozen base rides through the
+loss closure untouched — no wgrad compute, no adamw moments for 7B params.
+
+Leaves named ``lora_a``/``lora_b`` (models/llama.py:LoRADense) are adapters;
+everything else is base.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+from flax import traverse_util
+
+
+def _is_lora_key(path: Tuple[str, ...]) -> bool:
+    return path[-1] in ("lora_a", "lora_b")
+
+
+def split_lora(params: Any) -> Tuple[Dict, Dict]:
+    """Split a flax param dict into (base, lora) trees of flat dicts."""
+    flat = traverse_util.flatten_dict(params)
+    base = {k: v for k, v in flat.items() if not _is_lora_key(k)}
+    lora = {k: v for k, v in flat.items() if _is_lora_key(k)}
+    return base, lora
+
+
+def merge_lora(base: Dict, lora: Dict) -> Any:
+    """Inverse of split_lora: one nested param dict for model.apply."""
+    return traverse_util.unflatten_dict({**base, **lora})
+
+
+def lora_label_fn(params: Any) -> Any:
+    """Per-leaf 'lora'/'frozen' labels for optax.multi_transform when a
+    caller prefers masking over splitting (keeps one tree, e.g. for
+    orbax checkpoints of the full state)."""
+    flat = traverse_util.flatten_dict(params)
+    labels = {k: ("lora" if _is_lora_key(k) else "frozen") for k in flat}
+    return traverse_util.unflatten_dict(labels)

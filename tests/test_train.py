@@ -259,3 +259,70 @@ def test_elastic_scaling_policy_units():
     assert elastic.min_workers == 2 and elastic.max_workers == 6
     with pytest.raises(ValueError):
         ElasticScalingPolicy(rt_train.ScalingConfig(num_workers=1), 3, 2)
+
+
+def test_lora_split_merge_and_frozen_base():
+    """train/lora.py: split/merge roundtrip; grads exist only for adapter
+    leaves; an optimizer step leaves the frozen base bit-identical
+    (BASELINE.json config 3 — LoRA-only optimizer state)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.llama import LlamaConfig, init_params, next_token_loss
+    from ray_tpu.parallel.sharding import unbox_params
+    from ray_tpu.train.lora import lora_label_fn, merge_lora, split_lora
+
+    cfg = LlamaConfig.tiny(lora_rank=4)
+    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
+    base, lora = split_lora(params)
+    assert lora, "tiny(lora_rank=4) must produce adapter leaves"
+    assert all(k[-1] in ("lora_a", "lora_b") for k in lora)
+    merged = merge_lora(base, lora)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params,
+        merged,
+    )
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+
+    def loss_fn(lp):
+        return next_token_loss(cfg, None, merge_lora(base, lp), tokens)
+
+    grads = jax.grad(loss_fn)(lora)
+    # lora_b initializes to zero, so lora_a grads vanish at step 0 — but
+    # lora_b grads must be live (nonzero) for the adapters to train
+    b_norm = sum(
+        float(jnp.abs(g).sum()) for k, g in grads.items() if k[-1] == "lora_b"
+    )
+    assert b_norm > 0.0
+
+    opt = optax.adamw(1e-2)
+    opt_state = opt.init(lora)
+    # optimizer state exists ONLY for adapter leaves (the point of the split)
+    n_moment_leaves = len(jax.tree.leaves(opt_state[0].mu))
+    assert n_moment_leaves == len(jax.tree.leaves(lora))
+    updates, _ = opt.update(grads, opt_state, lora)
+    lora2 = optax.apply_updates(lora, updates)
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(lora), jax.tree.leaves(lora2))
+    )
+    assert changed
+    # frozen base stays bit-identical through the step: it was never handed
+    # to the optimizer, and the merged tree still contains the originals
+    base_after, _ = split_lora(merge_lora(base, lora2))
+    assert set(base_after) == set(base)
+    for k in base:
+        np.testing.assert_array_equal(np.asarray(base[k]), np.asarray(base_after[k]))
+
+    labels = lora_label_fn(params)
+    from flax import traverse_util
+
+    flat_labels = traverse_util.flatten_dict(labels)
+    assert {v for v in flat_labels.values()} == {"lora", "frozen"}
+    assert all(
+        (v == "lora") == (k[-1] in ("lora_a", "lora_b"))
+        for k, v in flat_labels.items()
+    )
