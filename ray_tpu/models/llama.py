@@ -342,18 +342,24 @@ def init_params(config: LlamaConfig, rng, mesh: Optional[Mesh] = None, seq: int 
     return model.init(rng, tokens)["params"]
 
 
-def next_token_loss(config: LlamaConfig, mesh, params, tokens):
-    """Causal LM loss: model sees the full (sp-divisible) sequence; the loss
-    pairs logits[:, :-1] with tokens[:, 1:].
+def nll_from_logits(logits, tokens):
+    """Next-token NLL from full-sequence logits: pairs logits[:, :-1] with
+    tokens[:, 1:].
 
     nll = logsumexp(logits) - logits[target]: no [B, S, vocab] f32
     log-softmax intermediate (at bench shapes that tensor alone is ~1 GB of
     HBM traffic the fused form never writes)."""
-    model = Llama(config, mesh)
-    logits = model.apply({"params": params}, tokens)[:, :-1]
+    logits = logits[:, :-1]
     targets = tokens[:, 1:]
     lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
     tgt = jnp.take_along_axis(
         logits, targets[..., None], axis=-1
     )[..., 0].astype(jnp.float32)
     return (lse - tgt).mean()
+
+
+def next_token_loss(config: LlamaConfig, mesh, params, tokens):
+    """Causal LM loss: model sees the full (sp-divisible) sequence; see
+    nll_from_logits for the fused-NLL numerics."""
+    model = Llama(config, mesh)
+    return nll_from_logits(model.apply({"params": params}, tokens), tokens)

@@ -12,6 +12,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.custom_partitioning import custom_partitioning
 from jax.experimental.pallas import tpu as pltpu
 
 
@@ -44,13 +45,59 @@ def _rmsnorm_fwd_impl(x2, w, eps, block_rows):
     )(x2, w)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def _rmsnorm(x2, w, eps):
+# --- GSPMD partitioning rule -------------------------------------------------
+# A pallas_call is an opaque custom-call to the SPMD partitioner: without a
+# rule it REPLICATES the operand (all-gather of the full batch on every chip,
+# then dynamic-slice back — the "involuntary full rematerialization" path),
+# which turned per-chip collective bytes linear in the dp degree. Rows are
+# independent, so declare: x row-sharded / feature dim unsharded, w
+# replicated, out like x. Covers both partitioners: callbacks for GSPMD,
+# einsum-style sharding_rule for Shardy.
+
+
+def _row_sharding(mesh, x_sharding):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = getattr(x_sharding, "spec", None)
+    row = spec[0] if spec else None
+    return NamedSharding(mesh, P(row, None))
+
+
+def _rmsnorm_infer_sharding(eps, mesh, arg_infos, result_infos):
+    return _row_sharding(mesh, arg_infos[0].sharding)
+
+
+def _rmsnorm_partition(eps, mesh, arg_infos, result_infos):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x_sharding = _row_sharding(mesh, arg_infos[0].sharding)
+    w_sharding = NamedSharding(mesh, P())
+
+    def lower_fn(x2, w):
+        return _rmsnorm_fwd_impl(x2, w, eps, block_rows=256)
+
+    return mesh, lower_fn, x_sharding, (x_sharding, w_sharding)
+
+
+@functools.partial(custom_partitioning, static_argnums=(2,))
+def _rmsnorm_sharded(x2, w, eps):
     return _rmsnorm_fwd_impl(x2, w, eps, block_rows=256)
 
 
+_rmsnorm_sharded.def_partition(
+    partition=_rmsnorm_partition,
+    infer_sharding_from_operands=_rmsnorm_infer_sharding,
+    sharding_rule="i j, j -> i j",
+)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rmsnorm(x2, w, eps):
+    return _rmsnorm_sharded(x2, w, eps)
+
+
 def _rmsnorm_fwd(x2, w, eps):
-    return _rmsnorm_fwd_impl(x2, w, eps, block_rows=256), (x2, w)
+    return _rmsnorm_sharded(x2, w, eps), (x2, w)
 
 
 def _rmsnorm_bwd(eps, res, g):
