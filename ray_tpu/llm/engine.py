@@ -166,6 +166,58 @@ class LLMEngine(_DecodeModelBase):
         )
         return self._sample_tokens(logits, temps, jax.random.fold_in(rng, step))
 
+    def generate_stream(self, request: GenerationRequest):
+        """Token-by-token generation for ONE request: yields each generated
+        token id as soon as it is sampled (time-to-first-token = prefill
+        latency, not full-generation latency), then a final
+        GenerationResult. Same programs and sampling rule as generate(), so
+        at temperature 0 the streamed tokens equal the batch path's."""
+        cfg = self._cfg
+        plen = len(request.token_ids)
+        if plen + request.max_new_tokens > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({plen}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds max_seq_len "
+                f"({cfg.max_seq_len})"
+            )
+        if request.max_new_tokens <= 0:  # matches generate()'s empty result
+            yield GenerationResult(
+                token_ids=[], num_prompt_tokens=plen, finished_reason="length"
+            )
+            return
+        tokens = np.asarray([request.token_ids], np.int32)
+        logits, cache = self._prefill(self._params, jnp.asarray(tokens))
+        rng = jax.random.PRNGKey(0)
+        generated: List[int] = []
+        reason = "length"
+        last = self._sample_step(logits, request, rng, 0)
+        generated.append(last)
+        yield last
+        if request.eos_token_id is not None and last == request.eos_token_id:
+            reason = "eos"
+        else:
+            for step in range(1, request.max_new_tokens):
+                logits, cache = self._decode(
+                    self._params, cache, jnp.asarray([[last]], jnp.int32)
+                )
+                last = self._sample_step(logits, request, rng, step)
+                generated.append(last)
+                yield last
+                if (
+                    request.eos_token_id is not None
+                    and last == request.eos_token_id
+                ):
+                    reason = "eos"
+                    break
+        yield GenerationResult(
+            token_ids=generated,
+            num_prompt_tokens=plen,
+            finished_reason=reason,
+        )
+
+    def _sample_step(self, logits, request, rng, step) -> int:
+        return int(self._sample(logits, [request], rng, step)[0])
+
 
 @dataclasses.dataclass
 class _Slot:

@@ -63,7 +63,7 @@ class _LLMReplica:
 
             self._tokenizer = AutoTokenizer.from_pretrained(tokenizer_name)
 
-    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+    def _parse_request(self, request: Dict[str, Any]) -> GenerationRequest:
         token_ids = request.get("token_ids")
         if token_ids is None:
             prompt = request.get("prompt")
@@ -74,7 +74,7 @@ class _LLMReplica:
                     "'prompt' requires a tokenizer; deploy with tokenizer_name"
                 )
             token_ids = self._tokenizer.encode(prompt)
-        gen_req = GenerationRequest(
+        return GenerationRequest(
             token_ids=list(token_ids),
             max_new_tokens=int(
                 request.get("max_new_tokens", self._config.max_new_tokens)
@@ -84,7 +84,13 @@ class _LLMReplica:
             ),
             eos_token_id=request.get("eos_token_id"),
         )
-        result = self._engine.generate([gen_req])[0]
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        if request.get("stream"):
+            # through a plain (non-stream) handle this collapses to the
+            # buffered result; the HTTP/handle streaming path calls .stream
+            return list(self.stream(request))[-1]
+        result = self._engine.generate([self._parse_request(request)])[0]
         out: Dict[str, Any] = {
             "token_ids": result.token_ids,
             "num_prompt_tokens": result.num_prompt_tokens,
@@ -93,6 +99,40 @@ class _LLMReplica:
         if self._tokenizer is not None:
             out["text"] = self._tokenizer.decode(result.token_ids)
         return out
+
+    def stream(self, request: Dict[str, Any]):
+        """Token streaming (reference: ray.llm streaming responses through
+        serve — DeploymentResponseGenerator): yields one dict per generated
+        token as it is sampled, then a final summary dict. Time-to-first-
+        token is prefill latency instead of full-generation latency."""
+        gen_req = self._parse_request(request)
+        index = 0
+        all_ids: list = []
+        prev_text = ""
+        for item in self._engine.generate_stream(gen_req):
+            if isinstance(item, int):
+                out: Dict[str, Any] = {"token_id": item, "index": index}
+                if self._tokenizer is not None:
+                    # BPE/SentencePiece pieces don't decode standalone
+                    # (leading-space markers, multi-token unicode): decode
+                    # the running sequence and emit the delta so clients can
+                    # concatenate the streamed text verbatim
+                    all_ids.append(item)
+                    full = self._tokenizer.decode(all_ids)
+                    out["text"] = full[len(prev_text):]
+                    prev_text = full
+                index += 1
+                yield out
+            else:  # final GenerationResult
+                summary: Dict[str, Any] = {
+                    "token_ids": item.token_ids,
+                    "num_prompt_tokens": item.num_prompt_tokens,
+                    "finished_reason": item.finished_reason,
+                    "finished": True,
+                }
+                if self._tokenizer is not None:
+                    summary["text"] = self._tokenizer.decode(item.token_ids)
+                yield summary
 
 
 def build_llm_deployment(

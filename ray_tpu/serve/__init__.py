@@ -14,6 +14,7 @@ from .api import (
     get_app_handle,
     get_deployment_handle,
     grpc_proxy_address,
+    ingress,
     run,
     shutdown,
     start,
@@ -22,7 +23,11 @@ from .api import (
 from .batching import batch
 from .grpc_proxy import grpc_call
 from .config import AutoscalingConfig, DeploymentConfig
-from .handle import DeploymentHandle, DeploymentResponse
+from .handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
 from .multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
@@ -43,6 +48,8 @@ __all__ = [
     "get_deployment_handle",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
+    "ingress",
     "AutoscalingConfig",
     "DeploymentConfig",
 ]

@@ -99,6 +99,78 @@ def deployment(_target=None, **options):
     return wrap
 
 
+def ingress(asgi_app):
+    """Mount an ASGI app as a deployment's HTTP interface (reference:
+    @serve.ingress, serve/api.py:181 — FastAPI apps become deployments).
+
+    ``asgi_app`` is any ASGI-3 callable ``async app(scope, receive, send)``
+    (FastAPI/Starlette instances qualify). The decorated class gains an
+    ``__asgi__`` streaming method: the HTTP proxy forwards (scope, body) to
+    it and relays the ASGI send-events back as they are produced, so
+    streaming responses reach the client incrementally. The deployment
+    instance is exposed to the app at ``scope["ray_tpu.replica"]``."""
+
+    def decorator(cls):
+        if not inspect.isclass(cls):
+            raise TypeError("@serve.ingress decorates a deployment class")
+        cls.__ray_tpu_asgi_app__ = staticmethod(asgi_app)
+
+        async def __asgi__(self, scope: dict, body: bytes):
+            import asyncio
+
+            app = self.__ray_tpu_asgi_app__
+            queue: asyncio.Queue = asyncio.Queue()
+            _DONE = object()
+            scope = dict(scope)
+            scope["ray_tpu.replica"] = self
+            body_sent = False
+
+            async def receive():
+                nonlocal body_sent
+                if not body_sent:
+                    body_sent = True
+                    return {
+                        "type": "http.request",
+                        "body": body or b"",
+                        "more_body": False,
+                    }
+                # block forever: an eager http.disconnect makes Starlette's
+                # listen_for_disconnect cancel StreamingResponses mid-stream.
+                # Disconnect propagation is the proxy's job; if the app
+                # parks a task here it is cancelled in the finally below.
+                await asyncio.Event().wait()
+
+            async def send(event):
+                await queue.put(event)
+
+            async def run_app():
+                try:
+                    await app(scope, receive, send)
+                except Exception as e:  # noqa: BLE001 — relayed to the proxy
+                    await queue.put({"type": "asgi.error", "error": repr(e)})
+                finally:
+                    await queue.put(_DONE)
+
+            task = asyncio.ensure_future(run_app())
+            try:
+                while True:
+                    event = await queue.get()
+                    if event is _DONE:
+                        break
+                    yield event
+            finally:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+
+        cls.__asgi__ = __asgi__
+        return cls
+
+    return decorator
+
+
 # -- controller / proxy management -------------------------------------------
 
 
@@ -179,6 +251,26 @@ def run(
         cfg = dataclasses.replace(cfg)
         if route_prefix is not None and node is app.root:
             cfg.route_prefix = route_prefix
+        # ingress/streaming/ASGI detection: the proxy needs to know how to
+        # talk to the app root (reference: the proxy always speaks ASGI to
+        # ingress replicas, proxy.py:805; here plain JSON deployments keep
+        # the request/response path and generator/ASGI roots stream)
+        target = node.deployment._target
+        cfg.asgi = cfg.asgi or getattr(
+            target, "__ray_tpu_asgi_app__", None
+        ) is not None
+        call = target if not inspect.isclass(target) else getattr(
+            target, "__call__", None
+        )
+        cfg.stream = cfg.stream or (
+            call is not None
+            and (
+                inspect.isgeneratorfunction(call)
+                or inspect.isasyncgenfunction(call)
+            )
+        )
+        if node is app.root:
+            cfg.ingress = True
         # nested bound deployments become handles at replica init time
         init_args = _replace_bound(node.init_args, controller, name)
         init_kwargs = _replace_bound(node.init_kwargs, controller, name)

@@ -52,6 +52,12 @@ class DeploymentConfig:
     # replicas still STARTING after this are replaced (raise for slow model
     # loads; reference: initial_health_check_timeout_s semantics)
     startup_timeout_s: float = 300.0
+    # streaming/ASGI ingress flags; serve.run auto-detects stream (generator
+    # __call__) and asgi (@serve.ingress) and marks the app root as ingress
+    # so the HTTP proxy knows how to talk to it
+    stream: bool = False
+    asgi: bool = False
+    ingress: bool = False
 
 
 @dataclass

@@ -473,6 +473,27 @@ class ServeController:
                 }
             return out
 
+    def get_ingress_info(self, app_name: str) -> Dict[str, Any]:
+        """How the proxy should talk to the app root: plain request/response,
+        item streaming, or ASGI (reference: the proxy's per-app ingress
+        resolution, serve/_private/proxy.py:805)."""
+        with self._lock:
+            first = None
+            for short, full in self._apps.get(app_name, {}).items():
+                dep = self._deployments.get(full)
+                if dep is None:
+                    continue
+                info = {
+                    "deployment": short,
+                    "stream": getattr(dep.config, "stream", False),
+                    "asgi": getattr(dep.config, "asgi", False),
+                }
+                if first is None:
+                    first = info
+                if getattr(dep.config, "ingress", False):
+                    return info
+            return first or {}
+
     def list_applications(self) -> List[str]:
         with self._lock:
             return list(self._apps.keys())

@@ -34,6 +34,35 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Streaming response (reference: serve/handle.py:557
+    DeploymentResponseGenerator): iterating yields each item the replica's
+    generator produces, as soon as it is reported — the first item is
+    consumable while the replica is still generating."""
+
+    def __init__(self, ref_gen, timeout_s: Optional[float] = 60.0):
+        self._ref_gen = ref_gen
+        self._timeout_s = timeout_s
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        ref = next(self._ref_gen)  # raises StopIteration at end of stream
+        return api.get(ref, timeout=self._timeout_s)
+
+    def close(self):
+        """Stop consuming; abandoning the underlying ObjectRefGenerator
+        releases the owner's stream bookkeeping (object_ref.py __del__)."""
+        close = getattr(self._ref_gen, "close", None)
+        if close is not None:
+            close()
+        self._ref_gen = iter(())
+
+    def _to_object_ref_gen(self):
+        return self._ref_gen
+
+
 class Router:
     """Per-process replica picker for one application."""
 
@@ -90,12 +119,13 @@ class Router:
 class DeploymentHandle:
     def __init__(self, controller, app_name: str, deployment: str,
                  method: str = "__call__", multiplexed_model_id: str = "",
-                 _router: Optional[list] = None):
+                 stream: bool = False, _router: Optional[list] = None):
         self._controller = controller
         self._app_name = app_name
         self._deployment = deployment
         self._method = method
         self._multiplexed_model_id = multiplexed_model_id
+        self._stream = stream
         # the router depends only on (controller, app_name), both immutable
         # across options()/method handles — a shared mutable holder means
         # whichever handle first routes a request creates the Router and all
@@ -103,7 +133,8 @@ class DeploymentHandle:
         self._router_holder: list = _router if _router is not None else [None]
 
     def options(self, *, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self._controller,
             self._app_name,
@@ -112,6 +143,7 @@ class DeploymentHandle:
             multiplexed_model_id
             if multiplexed_model_id is not None
             else self._multiplexed_model_id,
+            stream if stream is not None else self._stream,
             _router=self._router_holder,
         )
 
@@ -121,10 +153,11 @@ class DeploymentHandle:
         # handle.other_method.remote(...) sugar
         return DeploymentHandle(
             self._controller, self._app_name, self._deployment, name,
-            self._multiplexed_model_id, _router=self._router_holder,
+            self._multiplexed_model_id, self._stream,
+            _router=self._router_holder,
         )
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         if self._router_holder[0] is None:
             self._router_holder[0] = Router(self._controller, self._app_name)
         replica = self._router_holder[0].pick(self._deployment)
@@ -140,6 +173,13 @@ class DeploymentHandle:
 
         args = tuple(chain(a) for a in args)
         kwargs = {k: chain(v) for k, v in kwargs.items()}
+        if self._stream:
+            # replica-side async generator shipped item-by-item through the
+            # runtime's streaming-generator path (ObjectRefGenerator)
+            ref_gen = replica.handle_request_stream.options(
+                num_returns="streaming"
+            ).remote(self._method, args, kwargs, metadata)
+            return DeploymentResponseGenerator(ref_gen)
         ref = replica.handle_request.remote(self._method, args, kwargs, metadata)
         return DeploymentResponse(ref)
 
@@ -147,5 +187,5 @@ class DeploymentHandle:
         return (
             DeploymentHandle,
             (self._controller, self._app_name, self._deployment, self._method,
-             self._multiplexed_model_id),
+             self._multiplexed_model_id, self._stream),
         )

@@ -50,19 +50,43 @@ class LocalDeploymentResponse:
         return self._future.result(timeout_s)
 
 
+class LocalResponseGenerator:
+    """Local-mode mirror of DeploymentResponseGenerator: drains a queue fed
+    by the generator running on the local loop, so items arrive as produced."""
+
+    _DONE = object()
+
+    def __init__(self, queue):
+        self._queue = queue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._DONE:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
 class LocalDeploymentHandle:
     """Calls the in-process instance directly (reference: the local-mode
     handle in local_testing_mode.py)."""
 
     def __init__(self, instances: Dict[str, Any], deployment: str,
-                 method: str = "__call__", multiplexed_model_id: str = ""):
+                 method: str = "__call__", multiplexed_model_id: str = "",
+                 stream: bool = False):
         self._instances = instances
         self._deployment = deployment
         self._method = method
         self._multiplexed_model_id = multiplexed_model_id
+        self._stream = stream
 
     def options(self, *, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None):
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None):
         return LocalDeploymentHandle(
             self._instances,
             self._deployment,
@@ -70,6 +94,7 @@ class LocalDeploymentHandle:
             multiplexed_model_id
             if multiplexed_model_id is not None
             else self._multiplexed_model_id,
+            stream if stream is not None else self._stream,
         )
 
     def __getattr__(self, name: str):
@@ -77,10 +102,66 @@ class LocalDeploymentHandle:
             raise AttributeError(name)
         return LocalDeploymentHandle(
             self._instances, self._deployment, name,
-            self._multiplexed_model_id,
+            self._multiplexed_model_id, self._stream,
         )
 
+    def _remote_stream(self, *args, **kwargs) -> "LocalResponseGenerator":
+        import inspect
+        import queue as queue_mod
+
+        instance = self._instances[self._deployment]
+        method = (
+            instance
+            if self._method == "__call__" and not hasattr(instance, "__call__")
+            else getattr(instance, self._method)
+        )
+        out: queue_mod.Queue = queue_mod.Queue()
+        loop = _LocalLoop.get().loop
+        model_id = self._multiplexed_model_id
+
+        _SENTINEL = object()
+
+        async def drive():
+            import contextvars
+
+            try:
+                if model_id:
+                    from .multiplex import _set_multiplexed_model_id
+
+                    _set_multiplexed_model_id(model_id)
+                gen = method(*args, **kwargs)
+                if inspect.isasyncgen(gen):
+                    async for item in gen:
+                        out.put(item)
+                elif inspect.isgenerator(gen):
+                    # sync generators step on a thread under the copied
+                    # context (generator bodies see the context of each
+                    # next(), so the model-id var must ride along); a
+                    # blocking next() must not freeze the shared local loop
+                    ctx = contextvars.copy_context()
+                    while True:
+                        item = await loop.run_in_executor(
+                            None, lambda: ctx.run(next, gen, _SENTINEL)
+                        )
+                        if item is _SENTINEL:
+                            break
+                        out.put(item)
+                else:
+                    raise TypeError(
+                        "stream=True requires a generator method; "
+                        f"{self._method!r} returned {type(gen).__name__}"
+                    )
+            except Exception as e:  # noqa: BLE001 — relayed to the consumer
+                out.put(e)
+            finally:
+                out.put(LocalResponseGenerator._DONE)
+
+        asyncio.run_coroutine_threadsafe(drive(), loop)
+        return LocalResponseGenerator(out)
+
     def remote(self, *args, **kwargs) -> LocalDeploymentResponse:
+        if self._stream:
+            return self._remote_stream(*args, **kwargs)
         import contextvars
 
         instance = self._instances[self._deployment]

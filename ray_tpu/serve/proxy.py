@@ -27,6 +27,7 @@ class HTTPProxy:
         self._port = port
         self._routes: Dict[str, str] = {}
         self._handles: Dict[str, object] = {}
+        self._ingress: Dict[str, dict] = {}
         self._ready = threading.Event()
         self._error: Optional[str] = None
         self._thread = threading.Thread(
@@ -86,6 +87,8 @@ class HTTPProxy:
             self._routes = api.get(
                 self._controller.get_app_route_prefixes.remote(), timeout=10
             )
+            # re-deploys may flip an app's ingress mode (stream/asgi)
+            self._ingress.clear()
         except Exception:
             logger.exception("route refresh failed")
 
@@ -113,7 +116,11 @@ class HTTPProxy:
                 {"error": f"no app for path {path}"}, status=404
             )
         prefix, app_name = match
+        info = await self._ingress_info(app_name)
+        if info.get("asgi"):
+            return await self._handle_asgi(request, app_name, path, prefix)
         body: object = None
+        raw = b""
         if request.body_exists:
             raw = await request.read()
             if raw:
@@ -121,6 +128,8 @@ class HTTPProxy:
                     body = json.loads(raw)
                 except json.JSONDecodeError:
                     body = raw.decode("utf-8", "replace")
+        if info.get("stream"):
+            return await self._handle_stream(request, app_name, body)
         # forward to the app's ingress deployment off-loop (the handle API
         # is blocking); one thread per in-flight request keeps the proxy
         # loop responsive
@@ -133,17 +142,207 @@ class HTTPProxy:
             return web.json_response({"result": result})
         return web.Response(body=bytes(result))
 
-    def _call_ingress(self, app_name: str, path: str, prefix: str, body):
+    _INGRESS_TTL_S = 5.0
+
+    async def _ingress_info(self, app_name: str) -> dict:
+        import time
+
+        cached = self._ingress.get(app_name)
+        if cached is not None and time.time() - cached[0] < self._INGRESS_TTL_S:
+            return cached[1]
+        from .. import api
+
+        def fetch():
+            try:
+                return api.get(
+                    self._controller.get_ingress_info.remote(app_name),
+                    timeout=10,
+                )
+            except Exception:
+                logger.exception("ingress info fetch failed")
+                return {}
+
+        info = await asyncio.get_event_loop().run_in_executor(None, fetch)
+        self._ingress[app_name] = (time.time(), info)
+        return info
+
+    def _get_handle(self, app_name: str):
         from .api import get_app_handle
 
+        handle = self._handles.get(app_name)
+        if handle is None:
+            handle = get_app_handle(app_name, _controller=self._controller)
+            self._handles[app_name] = handle
+        return handle
+
+    def _call_ingress(self, app_name: str, path: str, prefix: str, body):
         try:
-            handle = self._handles.get(app_name)
-            if handle is None:
-                handle = get_app_handle(app_name, _controller=self._controller)
-                self._handles[app_name] = handle
-            return handle.remote(body).result(timeout_s=60)
+            return self._get_handle(app_name).remote(body).result(timeout_s=60)
         except Exception as e:  # noqa: BLE001
             return e
+
+    # -- streaming -----------------------------------------------------------
+
+    async def _iter_stream(self, make_gen):
+        """Drive a blocking DeploymentResponseGenerator on a pool thread,
+        relaying items onto the aiohttp loop as they arrive — the proxy
+        event loop never blocks on the next item. Closing this generator
+        (client disconnect, early break) stops the pump so the pool thread
+        is released instead of draining the rest of the replica's stream
+        into the queue."""
+        loop = asyncio.get_event_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+        _DONE = object()
+        stop = threading.Event()
+
+        def pump():
+            gen = None
+            try:
+                gen = make_gen()
+                for item in gen:
+                    if stop.is_set():
+                        break
+                    loop.call_soon_threadsafe(queue.put_nowait, item)
+            except Exception as e:  # noqa: BLE001 — relayed to the consumer
+                loop.call_soon_threadsafe(queue.put_nowait, e)
+            finally:
+                close = getattr(gen, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                loop.call_soon_threadsafe(queue.put_nowait, _DONE)
+
+        loop.run_in_executor(None, pump)
+        try:
+            while True:
+                item = await queue.get()
+                if item is _DONE:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+    async def _handle_stream(self, request, app_name: str, body):
+        """Generator ingress -> chunked HTTP: newline-delimited JSON, or SSE
+        when the client asks for text/event-stream (reference: proxy
+        streaming of DeploymentResponseGenerator outputs)."""
+        from aiohttp import web
+
+        sse = "text/event-stream" in request.headers.get("Accept", "")
+        resp = web.StreamResponse()
+        resp.content_type = "text/event-stream" if sse else "application/x-ndjson"
+        await resp.prepare(request)
+
+        def make_gen():
+            return self._get_handle(app_name).options(stream=True).remote(body)
+
+        from contextlib import aclosing
+
+        try:
+            async with aclosing(self._iter_stream(make_gen)) as stream:
+                async for item in stream:
+                    if isinstance(item, (bytes, bytearray)):
+                        chunk = bytes(item)
+                    elif sse:
+                        chunk = f"data: {json.dumps(item)}\n\n".encode()
+                    else:
+                        chunk = (json.dumps(item) + "\n").encode()
+                    await resp.write(chunk)
+        except Exception as e:  # noqa: BLE001 — stream already started
+            err = json.dumps({"error": repr(e)})
+            # keep the error inside the negotiated framing or SSE parsers
+            # silently drop it
+            await resp.write(
+                f"data: {err}\n\n".encode() if sse else (err + "\n").encode()
+            )
+        await resp.write_eof()
+        return resp
+
+    async def _handle_asgi(self, request, app_name: str, path: str,
+                           prefix: str):
+        """ASGI ingress: build an ASGI-3 HTTP scope from the aiohttp
+        request, stream it through the replica's __asgi__ method, and relay
+        response-start/body events back as they arrive (reference: the
+        proxy's ASGI protocol with ingress replicas, proxy.py:805)."""
+        from aiohttp import web
+
+        root = prefix.rstrip("/")
+        scope = {
+            "type": "http",
+            "asgi": {"version": "3.0", "spec_version": "2.3"},
+            "http_version": "1.1",
+            "method": request.method,
+            "scheme": "http",
+            "path": path[len(root):] or "/" if path.startswith(root) else path,
+            "raw_path": path.encode(),
+            "root_path": root,
+            "query_string": request.query_string.encode(),
+            "headers": [
+                (k.lower().encode(), v.encode())
+                for k, v in request.headers.items()
+            ],
+            "client": None,
+            "server": (self._host, self._port),
+        }
+        body = await request.read() if request.body_exists else b""
+
+        def make_gen():
+            return (
+                self._get_handle(app_name)
+                .options(stream=True, method_name="__asgi__")
+                .remote(scope, body)
+            )
+
+        from contextlib import aclosing
+
+        resp = None
+
+        async def relay():
+            nonlocal resp
+            async with aclosing(self._iter_stream(make_gen)) as stream:
+                async for event in stream:
+                    etype = event.get("type")
+                    if etype == "http.response.start":
+                        resp = web.StreamResponse(
+                            status=event.get("status", 200)
+                        )
+                        for k, v in event.get("headers", []):
+                            name = k.decode() if isinstance(k, bytes) else k
+                            val = v.decode() if isinstance(v, bytes) else v
+                            # aiohttp computes framing itself
+                            if name.lower() not in ("content-length",
+                                                    "transfer-encoding"):
+                                resp.headers[name] = val
+                        await resp.prepare(request)
+                    elif etype == "http.response.body":
+                        if resp is None:
+                            raise RuntimeError(
+                                "ASGI app sent body before response start"
+                            )
+                        await resp.write(event.get("body", b""))
+                        if not event.get("more_body"):
+                            return
+                    elif etype == "asgi.error":
+                        raise RuntimeError(
+                            event.get("error", "ASGI app failed")
+                        )
+
+        try:
+            await relay()
+        except Exception as e:  # noqa: BLE001
+            if resp is None:
+                return web.json_response({"error": repr(e)}, status=500)
+            await resp.write(json.dumps({"error": repr(e)}).encode())
+        if resp is None:
+            return web.json_response(
+                {"error": "ASGI app sent no response"}, status=500
+            )
+        await resp.write_eof()
+        return resp
 
     # -- control -------------------------------------------------------------
 

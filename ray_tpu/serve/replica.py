@@ -48,9 +48,10 @@ class Replica:
 
     # -- request path --------------------------------------------------------
 
-    async def handle_request(self, method: str, args: tuple, kwargs: dict,
-                             metadata: Optional[dict] = None):
-        self._ongoing += 1
+    async def _prepare_call(self, method: str, args: tuple, kwargs: dict,
+                            metadata: Optional[dict]):
+        """Shared request setup: multiplex context, chained-response
+        resolution, target-callable lookup."""
         if metadata and metadata.get("multiplexed_model_id"):
             from .multiplex import _set_multiplexed_model_id
 
@@ -76,11 +77,19 @@ class Replica:
 
             args = tuple([await resolve(a) for a in args])
             kwargs = {k: await resolve(v) for k, v in kwargs.items()}
+        if self._is_function:
+            fn = self._callable
+        else:
+            fn = getattr(self._callable, method or "__call__")
+        return fn, args, kwargs
+
+    async def handle_request(self, method: str, args: tuple, kwargs: dict,
+                             metadata: Optional[dict] = None):
+        self._ongoing += 1
         try:
-            if self._is_function:
-                fn = self._callable
-            else:
-                fn = getattr(self._callable, method or "__call__")
+            fn, args, kwargs = await self._prepare_call(
+                method, args, kwargs, metadata
+            )
             if inspect.iscoroutinefunction(fn):
                 return await fn(*args, **kwargs)
             # sync user code must not block the worker's event loop (it
@@ -93,6 +102,57 @@ class Replica:
             return await loop.run_in_executor(
                 self._pool, lambda: ctx.run(fn, *args, **kwargs)
             )
+        finally:
+            self._ongoing -= 1
+            self._total_served += 1
+
+    async def handle_request_stream(self, method: str, args: tuple,
+                                    kwargs: dict,
+                                    metadata: Optional[dict] = None):
+        """Streaming request path (reference: replica.py generator handling
+        behind DeploymentResponseGenerator, serve/handle.py:557): the user
+        method must be a (sync or async) generator; every yielded item ships
+        to the caller through the runtime's streaming-generator machinery as
+        soon as it exists."""
+        _SENTINEL = object()
+        self._ongoing += 1
+        try:
+            fn, args, kwargs = await self._prepare_call(
+                method, args, kwargs, metadata
+            )
+            if inspect.isasyncgenfunction(fn):
+                async for item in fn(*args, **kwargs):
+                    yield item
+                return
+            if inspect.iscoroutinefunction(fn):
+                raise TypeError(
+                    f"stream=True requires a generator method; "
+                    f"{method!r} is a coroutine function"
+                )
+            import contextvars
+
+            loop = asyncio.get_running_loop()
+            ctx = contextvars.copy_context()
+            gen = await loop.run_in_executor(
+                self._pool, lambda: ctx.run(fn, *args, **kwargs)
+            )
+            if not inspect.isgenerator(gen):
+                raise TypeError(
+                    f"stream=True requires a generator method; {method!r} "
+                    f"returned {type(gen).__name__}"
+                )
+            # drive the sync generator on the pool: each next() may block on
+            # user compute and must stay off the worker's event loop. Every
+            # step runs under the copied context — generator bodies see the
+            # context active at each next(), not at creation, so a bare
+            # next() would drop the multiplexed-model-id var.
+            while True:
+                item = await loop.run_in_executor(
+                    self._pool, lambda: ctx.run(next, gen, _SENTINEL)
+                )
+                if item is _SENTINEL:
+                    return
+                yield item
         finally:
             self._ongoing -= 1
             self._total_served += 1

@@ -251,3 +251,63 @@ def test_tp_sharded_decode_matches_single_device():
         cb_out = cb.run_until_complete()[rid].token_ids
     assert tp_out == ref_out
     assert cb_out == ref_out
+
+
+def test_engine_generate_stream_matches_batch(tiny_engine):
+    """generate_stream yields the same greedy tokens generate() produces,
+    one at a time, ending with the summary GenerationResult."""
+    cfg, params, engine = tiny_engine
+    prompt = [3, 14, 15, 92, 65, 35]
+    req = GenerationRequest(token_ids=prompt, max_new_tokens=6)
+    ref = engine.generate([GenerationRequest(token_ids=prompt,
+                                             max_new_tokens=6)])[0]
+    items = list(engine.generate_stream(req))
+    tokens, summary = items[:-1], items[-1]
+    assert tokens == ref.token_ids
+    assert summary.token_ids == ref.token_ids
+    assert summary.finished_reason == ref.finished_reason
+    assert summary.num_prompt_tokens == len(prompt)
+
+
+def test_llm_serve_token_streaming_e2e(ray_start_regular):
+    """Token-streaming end-to-end through serve (the reference's
+    DeploymentResponseGenerator path for ray.llm): the first token arrives
+    before the full completion exists, and the streamed tokens equal the
+    buffered result."""
+    import time as _time
+
+    from ray_tpu import serve
+
+    llm_config = LLMConfig(
+        model_id="llama-stream-tiny",
+        max_seq_len=64,
+        max_new_tokens=8,
+        resources_per_replica={"CPU": 1.0},
+    )
+    app = build_llm_deployment(llm_config)
+    serve.start(proxy=False)
+    handle = serve.run(app, name="llm-stream", route_prefix=None, _proxy=False)
+    try:
+        request = {"token_ids": [1, 2, 3, 4], "max_new_tokens": 6}
+        buffered = handle.remote(dict(request)).result(timeout_s=120)
+
+        gen = handle.options(stream=True, method_name="stream").remote(
+            dict(request)
+        )
+        t0 = _time.time()
+        first = next(gen)
+        first_latency = _time.time() - t0
+        rest = list(gen)
+        assert first["index"] == 0
+        streamed_tokens = [first["token_id"]] + [
+            d["token_id"] for d in rest if "token_id" in d
+        ]
+        summary = rest[-1]
+        assert summary.get("finished") is True
+        assert streamed_tokens == buffered["token_ids"]
+        assert summary["token_ids"] == buffered["token_ids"]
+        # TTFT sanity: the first token must not wait for the whole stream
+        # (tiny model decodes fast; just assert it beat the full wall time)
+        assert first_latency < 60
+    finally:
+        serve.shutdown()
