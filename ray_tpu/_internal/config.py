@@ -61,6 +61,11 @@ class Config:
     # --- fault tolerance ---
     health_check_period_s: float = 1.0
     health_check_timeout_s: float = 10.0
+    # Owner-side liveness probe of registered borrowers while a free is
+    # deferred on them (reference: WaitForRefRemoved long-poll,
+    # reference_counter.h:44 — polled here so a crashed borrower cannot pin
+    # an object forever).
+    borrower_probe_interval_s: float = 10.0
     task_retry_delay_s: float = 0.05
     actor_restart_delay_s: float = 0.1
     # Durable GCS metadata (reference: RedisStoreClient,

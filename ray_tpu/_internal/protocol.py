@@ -105,6 +105,11 @@ class TaskArg:
     value: Optional[bytes] = None  # packed serialization
     # owner address for by-reference args, so the executor can fetch/subscribe
     owner_address: Optional[Address] = None
+    # nested-ref containment (reference: reference_counter.h:44 contained-in
+    # accounting): a ref serialized INSIDE a container arg, listed here
+    # pin-only so the owner keeps it alive while the task is in flight; the
+    # executor resolves it from the pickled structure, not from this entry
+    nested: bool = False
 
 
 @dataclass
@@ -281,3 +286,9 @@ class TaskReply:
     # streaming generator tasks: total items yielded (reference: the
     # end-of-stream accounting behind ObjectRefStream, task_manager.h:67)
     num_streamed: Optional[int] = None
+    # borrower piggyback (reference: borrowed-refs accounting returned with
+    # the task reply, reference_counter.h:44): (executor_address, [ids]) of
+    # by-ref args the executor STILL holds at reply time (e.g. stashed in
+    # actor state). The owner registers these borrowers before releasing its
+    # submitted-task pins, closing the register-vs-unpin race.
+    borrowed_refs: Optional[tuple] = None

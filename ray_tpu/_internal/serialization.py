@@ -147,3 +147,33 @@ def dumps(value: Any) -> bytes:
 
 def loads(raw: bytes) -> Any:
     return pickle.loads(raw)
+
+
+# -- nested-ref collection ----------------------------------------------------
+# While active (per thread), ObjectRef.__reduce__ records every ref being
+# serialized, so arg flattening can pin refs nested inside containers for the
+# task's flight time (reference: reference_counter.h:44 contained-in refs).
+
+import contextlib
+import threading as _threading
+
+_ref_collector = _threading.local()
+
+
+@contextlib.contextmanager
+def collect_refs():
+    """Context manager yielding a list that accumulates each ObjectRef
+    serialized (at any nesting depth) within the with-block."""
+    prev = getattr(_ref_collector, "refs", None)
+    _ref_collector.refs = collected = []
+    try:
+        yield collected
+    finally:
+        _ref_collector.refs = prev
+
+
+def record_serialized_ref(ref) -> None:
+    """Called from ObjectRef.__reduce__."""
+    refs = getattr(_ref_collector, "refs", None)
+    if refs is not None:
+        refs.append(ref)

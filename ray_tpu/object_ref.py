@@ -68,7 +68,12 @@ class ObjectRef:
 
     def __reduce__(self):
         # Serializing a ref (into task args or object values) makes the
-        # receiver a borrower; the owner address travels with the ref.
+        # receiver a borrower; the owner address travels with the ref. An
+        # active arg-flattening collector records the ref so nested refs get
+        # pinned for the task's flight (serialization.collect_refs).
+        from ._internal import serialization
+
+        serialization.record_serialized_ref(self)
         return (_deserialize_ref, (self.id, self.owner_address))
 
     def future(self):

@@ -75,12 +75,22 @@ def _normalize_runtime_env(runtime_env, worker):
 
 def prepare_args(worker, args: tuple, kwargs: dict) -> List[TaskArg]:
     """Flatten into TaskArgs: slot 0 is the pickled structure, the rest are
-    top-level by-reference args."""
+    top-level by-reference args, then pin-only entries for refs nested
+    inside containers (nested-ref containment, reference_counter.h:44 — the
+    owner keeps them alive for the task's flight; the executor resolves them
+    from the structure and registers as their borrower on deserialize)."""
     structure, extracted = arglib.flatten(args, kwargs)
-    task_args = [TaskArg(value=serialization.pack(structure))]
+    with serialization.collect_refs() as nested:
+        packed = serialization.pack(structure)
+    task_args = [TaskArg(value=packed)]
     for ref in extracted:
         owner = ref.owner_address or worker.address
         task_args.append(TaskArg(object_id=ref.id, owner_address=owner))
+    for ref in nested:
+        owner = ref.owner_address or worker.address
+        task_args.append(
+            TaskArg(object_id=ref.id, owner_address=owner, nested=True)
+        )
     return task_args
 
 

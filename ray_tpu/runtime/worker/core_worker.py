@@ -148,6 +148,18 @@ class CoreWorker:
         self._submitted_refs: Dict[ObjectID, int] = defaultdict(int)
         self._owned: set = set()
         self._ref_lock = threading.Lock()
+        # borrower protocol (reference: reference_counter.h:44 borrower
+        # registration + WaitForRefRemoved): owner side tracks which remote
+        # workers hold a deserialized copy of an owned ref and defers the
+        # free until every borrower unregisters (or a liveness probe prunes
+        # a dead one); borrower side remembers which ids it borrowed so it
+        # can unregister on its last local decref and answer probes.
+        self._borrowers: Dict[ObjectID, set] = defaultdict(set)
+        self._borrower_probe_tasks: Dict[ObjectID, asyncio.Task] = {}
+        self._borrowed_owner: Dict[ObjectID, Tuple[str, int]] = {}
+        # strong refs for fire-and-forget protocol RPCs (a bare
+        # ensure_future can be GC'd mid-flight)
+        self._bg_tasks: set = set()
 
         # task bookkeeping
         self._current_task_id = TaskID.of(self.job_id)
@@ -240,6 +252,10 @@ class CoreWorker:
         s.register("add_object_location", self._handle_add_object_location)
         s.register("wait_object", self._handle_wait_object)
         s.register("decref", self._handle_decref)
+        # borrower protocol (reference: reference_counter.h:44)
+        s.register("register_borrower", self._handle_register_borrower)
+        s.register("unregister_borrower", self._handle_unregister_borrower)
+        s.register("check_borrow", self._handle_check_borrow)
         # streaming generator item delivery (reference:
         # ReportGeneratorItemReturns RPC, core_worker.proto:507)
         s.register("report_generator_item", self._handle_report_generator_item)
@@ -284,6 +300,8 @@ class CoreWorker:
             self._event_flush_task.cancel()
         for task in list(self._reconciler_tasks):
             task.cancel()
+        for task in list(self._borrower_probe_tasks.values()):
+            task.cancel()
         if self._subscriber:
             await self._subscriber.close()
         await self.server.stop()
@@ -296,8 +314,47 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def register_ref(self, ref: ObjectRef):
+        new_borrow = False
         with self._ref_lock:
             self._local_refs[ref.id] += 1
+            # a deserialized ref owned elsewhere makes this process a
+            # borrower: tell the owner so it defers the free until we drop
+            # our last local ref (reference: borrower registration on
+            # deserialize, reference_counter.h:44)
+            if (
+                ref.owner_address is not None
+                and self.address is not None
+                and not self._is_self(ref.owner_address)
+                and ref.id not in self._owned
+                and ref.id not in self._borrowed_owner
+            ):
+                self._borrowed_owner[ref.id] = tuple(ref.owner_address)
+                new_borrow = True
+        if new_borrow and not self.loop.is_closed():
+            try:
+                self.loop.call_soon_threadsafe(
+                    self._send_borrow_rpc, "register_borrower",
+                    tuple(ref.owner_address), ref.id,
+                )
+            except RuntimeError:
+                pass
+
+    def _send_borrow_rpc(self, method: str, owner_addr, object_id: ObjectID,
+                         borrower_addr=None):
+        """Fire-and-forget borrower-protocol RPC (loop thread only).
+        borrower_addr defaults to this process; pass another worker's
+        address to register a THIRD party (reply-borne forwarding)."""
+        try:
+            client = self.client_pool.get(*owner_addr)
+            task = asyncio.ensure_future(
+                client.call_oneway(
+                    method, object_id, borrower_addr or self.address
+                )
+            )
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
+        except Exception:
+            pass
 
     def unregister_ref(self, ref: ObjectRef):
         """Called from ObjectRef.__del__ — possibly on any thread."""
@@ -317,10 +374,23 @@ class CoreWorker:
                 or self._submitted_refs.get(object_id, 0) > 0
             ):
                 return
+            owned = object_id in self._owned
+            if owned and self._borrowers.get(object_id):
+                # remote borrowers still hold the ref: defer the free and
+                # keep ownership state; the unregister handler (or the
+                # liveness probe pruning a dead borrower) re-runs this
+                self._ensure_borrower_probe(object_id)
+                return
             self._local_refs.pop(object_id, None)
             self._submitted_refs.pop(object_id, None)
-            owned = object_id in self._owned
             self._owned.discard(object_id)
+            self._borrowers.pop(object_id, None)
+            borrowed_from = self._borrowed_owner.pop(object_id, None)
+        if borrowed_from is not None and not owned:
+            # we were a borrower: release our registration with the owner
+            self._send_borrow_rpc(
+                "unregister_borrower", borrowed_from, object_id
+            )
         if not owned:
             return
         entry = self.memory_store.delete(object_id)
@@ -665,6 +735,85 @@ class CoreWorker:
         self._maybe_free(object_id)
         return True
 
+    # -- borrower protocol (owner side) ------------------------------------
+
+    async def _handle_register_borrower(self, object_id: ObjectID, addr):
+        with self._ref_lock:
+            if object_id in self._owned:
+                self._borrowers[object_id].add(tuple(addr))
+                return True
+        # already freed: the borrower's get will miss and fall back to
+        # lineage reconstruction if available
+        return False
+
+    async def _handle_unregister_borrower(self, object_id: ObjectID, addr):
+        with self._ref_lock:
+            holders = self._borrowers.get(object_id)
+            if holders is not None:
+                holders.discard(tuple(addr))
+                empty = not holders
+            else:
+                empty = False
+        if empty:
+            self._maybe_free(object_id)
+        return True
+
+    async def _handle_check_borrow(self, object_id: ObjectID) -> bool:
+        """Liveness probe from an owner: does this process still hold a
+        local reference to the borrowed id? (the long-poll analogue of
+        WaitForRefRemoved, crash-tolerant because the OWNER polls)"""
+        with self._ref_lock:
+            return object_id in self._borrowed_owner
+
+    def _ensure_borrower_probe(self, object_id: ObjectID):
+        """While a free is deferred on borrowers, periodically verify each
+        borrower is alive and still holding; prune dead ones so a crashed
+        borrower can never pin an object forever."""
+        if object_id in self._borrower_probe_tasks:
+            return
+        task = asyncio.ensure_future(self._probe_borrowers(object_id))
+        self._borrower_probe_tasks[object_id] = task
+
+    _BORROWER_PROBE_MISSES = 3
+
+    async def _probe_borrowers(self, object_id: ObjectID):
+        # a borrower is pruned only after N CONSECUTIVE failed probes — one
+        # timed-out RPC (long GC pause, transient connection break) must not
+        # free an object a live borrower still holds
+        misses: Dict[tuple, int] = {}
+        try:
+            while True:
+                await asyncio.sleep(self.config.borrower_probe_interval_s)
+                with self._ref_lock:
+                    addrs = list(self._borrowers.get(object_id, ()))
+                if not addrs:
+                    break
+                for addr in addrs:
+                    holding = False
+                    try:
+                        holding = await self.client_pool.get(*addr).call(
+                            "check_borrow", object_id, timeout=5.0
+                        )
+                    except Exception:  # dead/unreachable borrower
+                        holding = False
+                    key = tuple(addr)
+                    if holding:
+                        misses.pop(key, None)
+                        continue
+                    misses[key] = misses.get(key, 0) + 1
+                    if misses[key] >= self._BORROWER_PROBE_MISSES:
+                        with self._ref_lock:
+                            holders = self._borrowers.get(object_id)
+                            if holders is not None:
+                                holders.discard(key)
+                with self._ref_lock:
+                    empty = not self._borrowers.get(object_id)
+                if empty:
+                    self._maybe_free(object_id)
+                    break
+        finally:
+            self._borrower_probe_tasks.pop(object_id, None)
+
     async def _handle_ping(self):
         return {"worker_id": self.worker_id}
 
@@ -735,7 +884,7 @@ class CoreWorker:
         """Inline small owned args once available (reference:
         LocalDependencyResolver)."""
         for arg in spec.args:
-            if arg.object_id is None:
+            if arg.object_id is None or getattr(arg, "nested", False):
                 continue
             if self._is_self(arg.owner_address) or arg.object_id in self._owned:
                 entry = await self.memory_store.wait_available(arg.object_id, None)
@@ -772,6 +921,8 @@ class CoreWorker:
         if reply.error is not None:
             if reply.retriable_failure and attempt < spec.max_retries:
                 return False
+            # the failed executor may still have stashed an arg ref
+            self._register_reply_borrowers(reply)
             err_obj = serialization.unpack(reply.error)
             if not isinstance(err_obj, Exception):
                 err_obj = TaskError(spec.function.qualname, str(err_obj))
@@ -845,7 +996,32 @@ class CoreWorker:
                 return n.address
         return None
 
+    def _register_reply_borrowers(self, reply: TaskReply):
+        """Register the executor as a borrower of args it kept, BEFORE the
+        submitted-task pins release (callers guarantee ordering), so an arg
+        stashed in actor state survives the owner dropping its own handle
+        (reference: reply-borne borrower accounting, reference_counter.h:44).
+        Ids this process does not own are forwarded to their true owner —
+        a submitter that is itself only a borrower must not swallow them."""
+        if not reply.borrowed_refs:
+            return
+        addr, held = reply.borrowed_refs
+        forward = []
+        with self._ref_lock:
+            for oid in held:
+                if oid in self._owned:
+                    self._borrowers[oid].add(tuple(addr))
+                else:
+                    owner_addr = self._borrowed_owner.get(oid)
+                    if owner_addr is not None:
+                        forward.append((owner_addr, oid))
+        for owner_addr, oid in forward:
+            self._send_borrow_rpc(
+                "register_borrower", owner_addr, oid, borrower_addr=addr
+            )
+
     def _process_reply(self, spec: TaskSpec, reply: TaskReply, attempt: int = 0):
+        self._register_reply_borrowers(reply)
         for ret in reply.returns:
             if ret.value is not None:
                 self.memory_store.put_value(ret.object_id, ret.value)
@@ -1233,20 +1409,31 @@ class CoreWorker:
     async def _finish_actor_task(
         self, spec: TaskSpec, fut: asyncio.Future, arg_ids: List[ObjectID]
     ):
+        # borrower registration must precede the pin release (the finally) or
+        # the free could race an executor-stashed arg ref; the finally also
+        # guarantees the release when reply post-processing itself raises
+        # (e.g. an error payload whose exception class can't unpickle here)
         try:
-            reply: TaskReply = await fut
-        except Exception as e:  # noqa: BLE001
-            self._fail_task(spec, e)
-            return
+            try:
+                reply: TaskReply = await fut
+            except Exception as e:  # noqa: BLE001
+                self._fail_task(spec, e)
+                return
+            try:
+                if reply.error is not None:
+                    # a method can stash an arg ref and THEN raise: the
+                    # error reply still carries the borrow piggyback
+                    self._register_reply_borrowers(reply)
+                    err = serialization.unpack(reply.error)
+                    if not isinstance(err, Exception):
+                        err = TaskError(spec.function.qualname, str(err))
+                    self._fail_task(spec, err)
+                else:
+                    self._process_reply(spec, reply)
+            except Exception as e:  # noqa: BLE001 — malformed reply
+                self._fail_task(spec, e)
         finally:
             self._release_for_task(arg_ids)
-        if reply.error is not None:
-            err = serialization.unpack(reply.error)
-            if not isinstance(err, Exception):
-                err = TaskError(spec.function.qualname, str(err))
-            self._fail_task(spec, err)
-        else:
-            self._process_reply(spec, reply)
 
     async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         gcs = self.client_pool.get(*self.gcs_address)
@@ -1309,6 +1496,8 @@ class CoreWorker:
         structure = serialization.unpack(spec.args[0].value)
         resolved = []
         for arg in spec.args[1:]:
+            if getattr(arg, "nested", False):
+                continue  # pin-only entry; the ref lives in the structure
             if arg.value is not None:
                 resolved.append(serialization.unpack(arg.value))
             else:
@@ -1375,7 +1564,8 @@ class CoreWorker:
                 )
             count += 1
         return TaskReply(
-            task_id=spec.task_id, returns=[], error=None, num_streamed=count
+            task_id=spec.task_id, returns=[], error=None, num_streamed=count,
+            borrowed_refs=self._held_arg_refs(spec),
         )
 
     async def _run_user_code(self, fn, args, kwargs, spec: TaskSpec):
@@ -1397,6 +1587,7 @@ class CoreWorker:
             task_id=spec.task_id,
             returns=[],
             error=packed,
+            borrowed_refs=self._held_arg_refs(spec),
             retriable_failure=False,
         )
 
@@ -1436,7 +1627,26 @@ class CoreWorker:
                         size=size,
                     )
                 )
-        return TaskReply(task_id=spec.task_id, returns=returns, error=None)
+        return TaskReply(
+            task_id=spec.task_id, returns=returns, error=None,
+            borrowed_refs=self._held_arg_refs(spec),
+        )
+
+    def _held_arg_refs(self, spec: TaskSpec) -> Optional[tuple]:
+        """By-ref args this executor still holds at reply time (user code
+        stashed the deserialized ObjectRef, e.g. in actor state)."""
+        held = []
+        with self._ref_lock:
+            for a in spec.args:
+                if (
+                    a.object_id is not None
+                    and self._local_refs.get(a.object_id, 0) > 0
+                    and a.object_id in self._borrowed_owner
+                ):
+                    held.append(a.object_id)
+        if not held:
+            return None
+        return (self.address, held)
 
     # -- actor execution ---------------------------------------------------
 
