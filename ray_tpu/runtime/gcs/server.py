@@ -57,6 +57,10 @@ class GcsServer:
         self._nodes: Dict[NodeID, NodeInfo] = {}
         self._node_available: Dict[NodeID, Dict[str, float]] = {}
         self._node_last_seen: Dict[NodeID, float] = {}
+        # versioned delta sync (reference: RaySyncer ray_syncer.h:89): the
+        # last applied per-raylet report version; a mismatched base on an
+        # incoming delta triggers a resync (raylet re-sends a full snapshot)
+        self._node_sync_versions: Dict[NodeID, int] = {}
         self._kv: Dict[str, bytes] = {}
         self._jobs: Dict[JobID, dict] = {}
         self._next_job = 1
@@ -234,12 +238,23 @@ class GcsServer:
     async def handle_get_all_nodes(self) -> List[NodeInfo]:
         return list(self._nodes.values())
 
-    async def handle_report_resources(
-        self, node_id: NodeID, available: Dict[str, float], demands=None
+    async def handle_report_resources_delta(
+        self,
+        node_id: NodeID,
+        version: int,
+        base_version: Optional[int],
+        changed: Optional[Dict[str, float]] = None,
+        removed: Optional[list] = None,
+        demands: Optional[list] = None,
     ):
-        """Periodic resource view from each raylet (role of RaySyncer
-        RESOURCE_VIEW streams, ray_syncer.h:89). Deltas are re-broadcast to
-        subscribed raylets for spillback decisions. ``demands`` carries the
+        """Versioned, delta-suppressed resource view from each raylet (role
+        of RaySyncer RESOURCE_VIEW streams, ray_syncer.h:89): steady-state
+        reports carry no payload (pure liveness heartbeat); a change ships
+        only the touched resource keys against the last acked version;
+        ``base_version=None`` is a full snapshot (registration or resync).
+        A base mismatch (GCS restart, lost report) returns ``resync`` and
+        the raylet re-sends a snapshot. Applied views are re-broadcast to
+        subscribed raylets for spillback decisions; ``demands`` carries the
         raylet's queued lease requests for the autoscaler (reference:
         GcsAutoscalerStateManager, gcs_autoscaler_state_manager.h:41)."""
         if node_id not in self._nodes:
@@ -248,13 +263,29 @@ class GcsServer:
             # RegisterNodeAgain, node_manager.proto:426)
             return "unknown_node"
         self._node_last_seen[node_id] = time.time()
-        prev = self._node_available.get(node_id)
-        self._node_available[node_id] = available
-        if demands is not None:
-            self._node_demands[node_id] = demands
-        if prev != available:
-            self.publisher.publish("resource_view", (node_id, available))
-        return True
+        if base_version is None:
+            # full snapshot
+            avail = dict(changed or {})
+            self._node_available[node_id] = avail
+            self._node_sync_versions[node_id] = version
+            if demands is not None:
+                self._node_demands[node_id] = demands
+            self.publisher.publish("resource_view", (node_id, avail))
+            return {"ack": version}
+        if self._node_sync_versions.get(node_id) != base_version:
+            return {"resync": True}
+        if version != base_version:
+            avail = dict(self._node_available.get(node_id, {}))
+            for key, value in (changed or {}).items():
+                avail[key] = value
+            for key in removed or ():
+                avail.pop(key, None)
+            self._node_available[node_id] = avail
+            self._node_sync_versions[node_id] = version
+            if demands is not None:
+                self._node_demands[node_id] = demands
+            self.publisher.publish("resource_view", (node_id, avail))
+        return {"ack": version}
 
     async def handle_get_cluster_resource_state(self) -> dict:
         """Autoscaler view of the cluster (reference:
@@ -342,6 +373,11 @@ class GcsServer:
             return
         node.alive = False
         self._node_available.pop(node_id, None)
+        # invalidate the delta-sync stream: if this raylet was only
+        # partitioned and reports again, a base-version match would apply
+        # its delta onto the now-empty availability dict and publish a
+        # partial view forever — a popped version forces a resync/snapshot
+        self._node_sync_versions.pop(node_id, None)
         logger.warning("node %s dead: %s", node_id, reason)
         self.publisher.publish("node", ("dead", node))
         await self.actor_manager.on_node_death(node_id)

@@ -115,7 +115,11 @@ class Raylet:
         self._cluster_available: Dict[NodeID, Dict[str, float]] = {}
         self._subscriber: Optional[SubscriberClient] = None
         self._runner: Optional[PeriodicRunner] = None
-        self._last_reported: Optional[Dict[str, float]] = None
+        # versioned delta sync state (reference: ray_syncer.h:89)
+        self._sync_version = 0
+        self._acked_avail: Optional[Dict[str, float]] = None
+        self._acked_demands: Optional[list] = None
+        self._needs_full_sync = True
         self._stopped = False
         # OOM defense (reference: MemoryMonitor + WorkerKillingPolicy)
         self.memory_monitor = MemoryMonitor(config.memory_usage_threshold)
@@ -192,11 +196,38 @@ class Raylet:
         await self.client_pool.close_all()
 
     async def _report_resources(self):
+        """Versioned delta report (reference: RaySyncer ray_syncer.h:89):
+        steady state sends an empty heartbeat against the acked version;
+        changes send only the touched keys; registration/resync sends a full
+        snapshot. The GCS acks the applied version — O(changes), not
+        O(nodes x report rate), on the wire and in GCS work."""
         avail = self.resources.available_float()
+        demands = self._pending_demands()
         gcs = self.client_pool.get(*self.gcs_address)
+        if self._needs_full_sync or self._acked_avail is None:
+            self._sync_version += 1
+            payload = dict(
+                version=self._sync_version, base_version=None,
+                changed=avail, demands=demands,
+            )
+        else:
+            changed = {
+                k: v for k, v in avail.items()
+                if self._acked_avail.get(k) != v
+            }
+            removed = [k for k in self._acked_avail if k not in avail]
+            demands_changed = demands != self._acked_demands
+            base = self._sync_version
+            if changed or removed or demands_changed:
+                self._sync_version += 1
+            payload = dict(
+                version=self._sync_version, base_version=base,
+                changed=changed or None, removed=removed or None,
+                demands=demands if demands_changed else None,
+            )
         try:
             reply = await gcs.call(
-                "report_resources", self.node_id, avail, self._pending_demands()
+                "report_resources_delta", self.node_id, **payload
             )
         except Exception:
             return
@@ -205,8 +236,15 @@ class Raylet:
             # reporting which workers are still alive so restored actor
             # records can be reconciled (reference: raylet reconnect on
             # NotifyGCSRestart, node_manager.proto:426)
+            self._needs_full_sync = True
             await self._reregister_with_gcs()
-        self._last_reported = avail
+            return
+        if isinstance(reply, dict) and reply.get("resync"):
+            self._needs_full_sync = True
+            return
+        self._needs_full_sync = False
+        self._acked_avail = avail
+        self._acked_demands = demands
 
     def _node_info(self) -> NodeInfo:
         return NodeInfo(

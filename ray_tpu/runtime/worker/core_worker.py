@@ -80,7 +80,7 @@ class _ActorClientState:
 
     __slots__ = (
         "actor_id", "state", "address", "seq", "queue", "death_cause",
-        "incarnation", "reconciling",
+        "incarnation", "reconciling", "creation_arg_pins",
     )
 
     def __init__(self, actor_id: ActorID):
@@ -91,6 +91,12 @@ class _ActorClientState:
         # tasks parked while the actor is pending/restarting
         self.queue: deque = deque()
         self.death_cause = ""
+        # creation-arg submitted-ref pins, held for the actor's LIFETIME:
+        # restarts re-run __init__ from the stored spec, so its by-ref args
+        # (top-level and nested) must stay fetchable until the actor is
+        # terminally DEAD (reference: actor creation spec retention +
+        # reference_counter.h:44 contained-in refs)
+        self.creation_arg_pins: Optional[List[ObjectID]] = None
         # which restart generation our sequence numbering belongs to: the
         # executor's per-caller counters die with its process, so the queue
         # renumbers from 0 exactly once per new incarnation
@@ -919,10 +925,11 @@ class CoreWorker:
             except Exception:
                 pass
         if reply.error is not None:
+            # the failed executor may still have stashed an arg ref — even
+            # one that will be retried elsewhere keeps its borrow
+            self._register_reply_borrowers(reply)
             if reply.retriable_failure and attempt < spec.max_retries:
                 return False
-            # the failed executor may still have stashed an arg ref
-            self._register_reply_borrowers(reply)
             err_obj = serialization.unpack(reply.error)
             if not isinstance(err_obj, Exception):
                 err_obj = TaskError(spec.function.qualname, str(err_obj))
@@ -1135,6 +1142,7 @@ class CoreWorker:
 
     async def create_actor(self, spec: TaskSpec, detached: bool) -> ActorID:
         state = _ActorClientState(spec.actor_id)
+        state.creation_arg_pins = self._pin_task_args(spec)
         self._actors[spec.actor_id] = state
         await self._subscriber.subscribe(
             f"actor:{spec.actor_id.hex()}", self._on_actor_update
@@ -1198,6 +1206,10 @@ class CoreWorker:
                 return
         state.state = info.state
         state.death_cause = info.death_cause
+        if info.state == ActorState.DEAD and state.creation_arg_pins:
+            # terminal: no restart will re-run __init__, creation args may go
+            pins, state.creation_arg_pins = state.creation_arg_pins, None
+            self._release_for_task(pins)
         if info.state == ActorState.ALIVE and info.address is not None:
             state.address = info.address
             # New incarnation ONLY: the executor's per-caller sequence
@@ -1469,13 +1481,17 @@ class CoreWorker:
             fn = await self._load_function(spec.function)
             args, kwargs = await self._unflatten(spec)
             if spec.is_streaming_generator:
-                return await self._run_streaming_generator(
-                    fn, args, kwargs, spec
-                )
+                coro = self._run_streaming_generator(fn, args, kwargs, spec)
+                args = kwargs = None  # this frame outlives the stream
+                return await coro
             try:
                 result = await self._run_user_code(fn, args, kwargs, spec)
             except Exception as e:  # noqa: BLE001
                 return self._error_reply(spec, e)
+            # drop the execution frame's own holds on deserialized arg refs
+            # BEFORE computing the reply's borrowed_refs: only refs user
+            # code actually stashed should register as borrows
+            args = kwargs = None
             return await self._build_reply(spec, result)
         except Exception as e:  # noqa: BLE001 — system error: retriable
             logger.exception("system error executing %s", spec.task_id)
@@ -1483,6 +1499,7 @@ class CoreWorker:
                 task_id=spec.task_id,
                 returns=[],
                 error=serialization.pack(e),
+                borrowed_refs=self._held_arg_refs(spec),
                 retriable_failure=True,
             )
         finally:
@@ -1518,6 +1535,7 @@ class CoreWorker:
             gen = fn(*args, **kwargs)
         except Exception as e:  # noqa: BLE001
             return self._error_reply(spec, e)
+        args = kwargs = None  # only gen (and user stashes) hold refs now
         if not hasattr(gen, "__next__") and not hasattr(gen, "__anext__"):
             return self._error_reply(
                 spec,
@@ -1563,6 +1581,9 @@ class CoreWorker:
                     None, size, True, self.raylet_address,
                 )
             count += 1
+        # the exhausted generator's closure still pins the deserialized
+        # args; drop it so borrowed_refs reflects only user-stashed refs
+        del gen
         return TaskReply(
             task_id=spec.task_id, returns=[], error=None, num_streamed=count,
             borrowed_refs=self._held_arg_refs(spec),
@@ -1815,7 +1836,9 @@ class CoreWorker:
             # generators; the seq slot is held until the generator finishes,
             # preserving sequential actor semantics while the CONSUMER
             # overlaps via item-level delivery
-            return await self._run_streaming_generator(method, args, kwargs, spec)
+            coro = self._run_streaming_generator(method, args, kwargs, spec)
+            args = kwargs = None  # this frame outlives the stream
+            return await coro
         # tensor_transport="device": DeviceObjectRef args resolve to their
         # on-device pytrees; results with arrays park in the device store
         # (reference: @ray.method(tensor_transport=...), P13). Resolution
@@ -1852,6 +1875,8 @@ class CoreWorker:
             from ...experimental import device_objects
 
             result = device_objects.wrap_result(result)
+        # only user-stashed refs should survive into borrowed_refs
+        args = kwargs = None
         return await self._build_reply(spec, result)
 
     async def _handle_exit_worker(self):

@@ -62,8 +62,26 @@ def test_borrowed_put_object_survives_owner_drop(borrower_cluster):
     out = ray_tpu.get(h.read.remote(), timeout=60)
     np.testing.assert_array_equal(out, arr)
 
-    # once the borrower drops too, the owner may free: a later read fails
+    # once the borrower gracefully drops too, the deferred free happens —
+    # via the unregister RPC, well before any probe interval
+    from ray_tpu import _worker_api
+
+    oid = None
+    worker = _worker_api.get_core_worker()
+    with worker._ref_lock:
+        candidates = [o for o in worker._owned if worker._borrowers.get(o)]
+    assert len(candidates) == 1, candidates
+    oid = candidates[0]
     assert ray_tpu.get(h.drop.remote(), timeout=30) is True
+    deadline = time.time() + 15
+    freed = False
+    while time.time() < deadline:
+        with worker._ref_lock:
+            freed = oid not in worker._owned
+        if freed:
+            break
+        time.sleep(0.25)
+    assert freed, "object leaked after the last borrower unregistered"
 
 
 def test_no_reconstruction_while_borrower_holds(borrower_cluster):
