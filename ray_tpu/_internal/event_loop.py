@@ -52,6 +52,35 @@ class LoopThread:
             self.loop.close()
 
 
+class BackgroundTasks:
+    """Strong-ref registry for fire-and-forget asyncio tasks.
+
+    A bare ``asyncio.ensure_future`` keeps no strong reference: the event
+    loop may GC the task mid-flight and the side effect (an ack RPC, a
+    deferred free) silently never happens. Every component that fires
+    one-way work registers it here instead (the pattern previously copied
+    in raylet/gcs/channel/core_worker)."""
+
+    def __init__(self):
+        self._tasks: set = set()
+
+    def track(self, task: asyncio.Task) -> asyncio.Task:
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    def spawn(self, coro) -> asyncio.Task:
+        return self.track(asyncio.ensure_future(coro))
+
+    def cancel_all(self) -> None:
+        for task in list(self._tasks):
+            task.cancel()
+        self._tasks.clear()
+
+    def __len__(self) -> int:
+        return len(self._tasks)
+
+
 class PeriodicRunner:
     """Recurring callback on a loop; injectable/fakeable for tests
     (reference: common/asio PeriodicalRunner + fake_periodical_runner.h)."""

@@ -115,6 +115,14 @@ class ChannelManager:
         # perf/testing hook: overrides config.max_direct_call_object_size as
         # the shm cut-over without mutating the worker-wide config
         self.shm_threshold_override: int = 0
+        # strong refs for fire-and-forget acks/frees: a GC'd ack task would
+        # permanently leak the pinned arena slot it was about to release
+        from .._internal.event_loop import BackgroundTasks
+
+        self._bg = BackgroundTasks()
+
+    def _track(self, task: asyncio.Task) -> None:
+        self._bg.track(task)
 
     # -- reader side ---------------------------------------------------------
 
@@ -155,9 +163,13 @@ class ChannelManager:
                 if worker.loop.is_closed():
                     return
                 worker.loop.call_soon_threadsafe(
-                    lambda: asyncio.ensure_future(
-                        worker.client_pool.get(*bell.owner_address).call_oneway(
-                            "chan_shm_done", bell.chan_id, bell.object_id
+                    lambda: self._track(
+                        asyncio.ensure_future(
+                            worker.client_pool.get(
+                                *bell.owner_address
+                            ).call_oneway(
+                                "chan_shm_done", bell.chan_id, bell.object_id
+                            )
                         )
                     )
                 )
@@ -256,7 +268,7 @@ class ChannelManager:
             except Exception:
                 pass
 
-        asyncio.ensure_future(_free())
+        self._track(asyncio.ensure_future(_free()))
 
     def close_all(self):
         for chan_id in list(self._queues):

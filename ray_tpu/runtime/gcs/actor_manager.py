@@ -37,6 +37,10 @@ class GcsActorManager:
         # node_id -> set of actor ids placed there
         self._by_node: Dict[NodeID, set] = {}
         self._by_worker: Dict[WorkerID, ActorID] = {}
+        # terminally-dead actor ids (compacted durable records): consulted
+        # when a re-registering raylet asks whether its actor workers are
+        # stale after a GCS restart
+        self._tombstones: Set[ActorID] = set()
 
     # -- persistence (reference: GcsActorTable on the store client) --------
 
@@ -55,6 +59,11 @@ class GcsActorManager:
         node ids that restored ALIVE actors reference so the server can
         grace-period them (reference: gcs_actor_manager.cc Initialize())."""
         nodes: Set[NodeID] = set()
+        for key in storage.get_all("actor_tombstones"):
+            try:
+                self._tombstones.add(ActorID.from_hex(key))
+            except Exception:
+                logger.exception("dropping unreadable tombstone %s", key)
         for key, raw in storage.get_all("actors").items():
             try:
                 info: ActorInfo = pickle.loads(raw)
@@ -179,6 +188,9 @@ class GcsActorManager:
 
     # -- queries -----------------------------------------------------------
 
+    def is_tombstoned(self, actor_id: ActorID) -> bool:
+        return actor_id in self._tombstones
+
     def get(self, actor_id: ActorID) -> Optional[ActorInfo]:
         return self._actors.get(actor_id)
 
@@ -233,7 +245,20 @@ class GcsActorManager:
         info.state = ActorState.DEAD
         info.death_cause = reason
         info.address = None
-        self._persist(info)
+        # DEAD is terminal (no restart path leads out of it): compact the
+        # full durable record to a tiny tombstone, or the actors table grows
+        # without bound and every GCS restart reloads all historical dead
+        # actors. The tombstone (vs outright deletion) lets a restarted GCS
+        # still judge a re-registering raylet's worker for this actor stale
+        # — a zombie incarnation must not keep running side effects.
+        self._tombstones.add(info.actor_id)
+        try:
+            self._gcs.storage.delete("actors", info.actor_id.hex())
+            self._gcs.storage.put(
+                "actor_tombstones", info.actor_id.hex(), b"1"
+            )
+        except Exception:
+            logger.exception("failed to compact dead actor %s", info.actor_id)
         self._publish(info)
 
     async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):

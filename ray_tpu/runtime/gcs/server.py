@@ -25,7 +25,7 @@ from typing import Dict, List, Optional, Tuple
 import cloudpickle
 
 from ..._internal.config import Config
-from ..._internal.event_loop import PeriodicRunner
+from ..._internal.event_loop import BackgroundTasks, PeriodicRunner
 from ..._internal.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
 from ..._internal.protocol import (
     label_match,
@@ -81,7 +81,7 @@ class GcsServer:
         # Background scheduling loops (actor/PG placement): tracked so stop()
         # cancels them — a killed-and-restarted GCS must not leave zombie
         # schedulers from the old instance double-creating actors.
-        self._bg_tasks: set = set()
+        self._bg = BackgroundTasks()
         self._stopped = False
 
     def spawn(self, coro):
@@ -89,10 +89,7 @@ class GcsServer:
         if self._stopped:
             coro.close()
             return None
-        task = asyncio.ensure_future(coro)
-        self._bg_tasks.add(task)
-        task.add_done_callback(self._bg_tasks.discard)
-        return task
+        return self._bg.spawn(coro)
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self._restore_state()
@@ -108,9 +105,7 @@ class GcsServer:
 
     async def stop(self):
         self._stopped = True
-        for task in list(self._bg_tasks):
-            task.cancel()
-        self._bg_tasks.clear()
+        self._bg.cancel_all()
         if self._runner:
             self._runner.stop()
         await self.server.stop()
@@ -218,12 +213,18 @@ class GcsServer:
         if actor_workers:
             for worker_id, actor_id in actor_workers.items():
                 actor = self.actor_manager.get(actor_id)
-                if (
-                    actor is None
-                    or actor.state == ActorState.DEAD
-                    or actor.worker_id != worker_id
-                ):
+                if actor is not None:
+                    if (
+                        actor.state == ActorState.DEAD
+                        or actor.worker_id != worker_id
+                    ):
+                        stale_workers.append(worker_id)
+                elif self.actor_manager.is_tombstoned(actor_id):
+                    # terminally dead, record compacted to a tombstone
                     stale_workers.append(worker_id)
+                # unknown with no tombstone: a blank (in-memory) GCS restart
+                # — judging the worker stale here would SIGKILL every live
+                # actor in the cluster on a transient GCS bounce
         self.actor_manager.reconcile_node(info.node_id, live_worker_ids)
         logger.info(
             "node %s registered: %s labels=%s", info.node_id, info.resources_total,
@@ -275,16 +276,21 @@ class GcsServer:
         if self._node_sync_versions.get(node_id) != base_version:
             return {"resync": True}
         if version != base_version:
-            avail = dict(self._node_available.get(node_id, {}))
-            for key, value in (changed or {}).items():
-                avail[key] = value
-            for key in removed or ():
-                avail.pop(key, None)
-            self._node_available[node_id] = avail
             self._node_sync_versions[node_id] = version
             if demands is not None:
                 self._node_demands[node_id] = demands
-            self.publisher.publish("resource_view", (node_id, avail))
+            if changed or removed:
+                avail = dict(self._node_available.get(node_id, {}))
+                for key, value in (changed or {}).items():
+                    avail[key] = value
+                for key in removed or ():
+                    avail.pop(key, None)
+                self._node_available[node_id] = avail
+                # demands-only deltas feed the autoscaler, not the
+                # resource_view fan-out — broadcasting an unchanged
+                # availability map per period would re-create the very
+                # O(nodes x rate) cost delta sync removes
+                self.publisher.publish("resource_view", (node_id, avail))
         return {"ack": version}
 
     async def handle_get_cluster_resource_state(self) -> dict:

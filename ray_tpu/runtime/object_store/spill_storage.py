@@ -35,8 +35,16 @@ def write(ref: str, data: bytes) -> None:
         return
     import fsspec
 
-    with fsspec.open(ref, "wb") as f:
+    # same tmp+rename discipline as the local path: a crash mid-write must
+    # leave either nothing under the final key or a fully-formed blob —
+    # never a truncated object a later restore would read as valid data.
+    # (On object stores mv is copy+delete, but the final key still only
+    # ever holds complete bytes; an orphaned .tmp key is never read.)
+    fs, path = fsspec.core.url_to_fs(ref)
+    tmp_path = f"{path}.tmp-{os.getpid()}"
+    with fs.open(tmp_path, "wb") as f:
         f.write(data)
+    fs.mv(tmp_path, path)
 
 
 def read(ref: str) -> bytes:

@@ -27,7 +27,7 @@ from collections import defaultdict, deque
 from typing import Dict, List, Optional, Tuple
 
 from ..._internal.config import Config
-from ..._internal.event_loop import PeriodicRunner
+from ..._internal.event_loop import BackgroundTasks, PeriodicRunner
 from ..._internal.ids import NodeID, ObjectID, PlacementGroupID, UniqueID, WorkerID
 from ..._internal.protocol import (
     label_match,
@@ -102,7 +102,7 @@ class Raylet:
         self._restore_locks: Dict[ObjectID, asyncio.Lock] = {}
         # background spill deletions: the loop keeps only weak task refs,
         # so untracked fire-and-forget tasks can be GC'd mid-flight
-        self._bg_tasks: set = set()
+        self._bg = BackgroundTasks()
         self._restore_lock_holds: Dict[ObjectID, int] = {}
         self._lease_seq = itertools.count()
         # scheduling-class FIFO queues of pending lease requests
@@ -846,11 +846,7 @@ class Raylet:
                 self._deferred_frees.add(oid)
             path = self._spilled.pop(oid, None)
             if path is not None:
-                task = asyncio.ensure_future(
-                    asyncio.to_thread(spill_storage.delete, path)
-                )
-                self._bg_tasks.add(task)
-                task.add_done_callback(self._bg_tasks.discard)
+                self._bg.spawn(asyncio.to_thread(spill_storage.delete, path))
         return True
 
     async def handle_fetch_object(self, object_id: ObjectID, offset: int, length: int):

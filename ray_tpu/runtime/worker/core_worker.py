@@ -165,7 +165,9 @@ class CoreWorker:
         self._borrowed_owner: Dict[ObjectID, Tuple[str, int]] = {}
         # strong refs for fire-and-forget protocol RPCs (a bare
         # ensure_future can be GC'd mid-flight)
-        self._bg_tasks: set = set()
+        from ..._internal.event_loop import BackgroundTasks
+
+        self._bg = BackgroundTasks()
 
         # task bookkeeping
         self._current_task_id = TaskID.of(self.job_id)
@@ -352,13 +354,11 @@ class CoreWorker:
         address to register a THIRD party (reply-borne forwarding)."""
         try:
             client = self.client_pool.get(*owner_addr)
-            task = asyncio.ensure_future(
+            self._bg.spawn(
                 client.call_oneway(
                     method, object_id, borrower_addr or self.address
                 )
             )
-            self._bg_tasks.add(task)
-            task.add_done_callback(self._bg_tasks.discard)
         except Exception:
             pass
 
@@ -1393,9 +1393,22 @@ class CoreWorker:
                         self._push_actor_task(state, spec, fut)
                     )
                 else:
-                    # restart in progress: park; the ALIVE renumber
-                    # stamps fresh seq + incarnation for the whole queue
-                    state.queue.append((spec, fut))
+                    # restart in progress: park IN SUBMISSION ORDER — later
+                    # calls may have parked directly while this one was in
+                    # flight, and the ALIVE renumber pass stamps fresh seqs
+                    # front-to-back, so a tail append would execute the
+                    # recovered call out of order
+                    key = (spec.sequence_incarnation, spec.sequence_number)
+                    q = state.queue
+                    idx = len(q)
+                    for i, (parked_spec, _) in enumerate(q):
+                        if (
+                            parked_spec.sequence_incarnation,
+                            parked_spec.sequence_number,
+                        ) > key:
+                            idx = i
+                            break
+                    q.insert(idx, (spec, fut))
                     self._ensure_actor_reconciler(state)
                 return
         if info is not None:
