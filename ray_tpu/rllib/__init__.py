@@ -8,11 +8,26 @@ with the learner compiling to the TPU instead of torch DDP.
 
 from .algorithm import PPO, PPOConfig, as_trainable
 from .bc import BC, BCConfig
+from .connectors import (
+    ClipActions,
+    Connector,
+    ConnectorContext,
+    ConnectorPipeline,
+    FlattenObservations,
+    Lambda,
+    NormalizeObservations,
+)
 from .dqn import DQN, DQNConfig, ReplayBuffer
 from .env import VectorEnv, make_env
 from .env_runner import EnvRunner
 from .impala import APPOConfig, IMPALA, IMPALAConfig
 from .learner import PPOLearner
+from .multi_agent import (
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
+from .multi_agent_env import MultiAgentEnv
 from .offline import CQL, CQLConfig, IQL, IQLConfig, MARWIL, MARWILConfig
 from .sac import SAC, SACConfig
 
@@ -40,4 +55,15 @@ __all__ = [
     "EnvRunner",
     "VectorEnv",
     "make_env",
+    "MultiAgentEnv",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "MultiAgentEnvRunner",
+    "Connector",
+    "ConnectorContext",
+    "ConnectorPipeline",
+    "FlattenObservations",
+    "NormalizeObservations",
+    "ClipActions",
+    "Lambda",
 ]

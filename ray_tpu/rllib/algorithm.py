@@ -26,7 +26,35 @@ from .learner import PPOLearner
 from .models import compute_gae
 
 
+def gae_batch(rollouts, gamma: float, lam: float) -> Dict[str, np.ndarray]:
+    """Shared postprocess: per-rollout GAE + [T, N] -> [T*N] flatten ->
+    one concatenated PPO batch. Used by the single-agent algorithm and by
+    every policy of the multi-agent one — advantage math lives ONCE."""
+    obs, actions, logp, adv, ret = [], [], [], [], []
+    for ro in rollouts:
+        a, r = compute_gae(
+            ro["rewards"], ro["values"], ro["dones"], ro["last_values"],
+            gamma, lam,
+        )
+        T, N = ro["rewards"].shape
+        obs.append(ro["obs"].reshape(T * N, -1))
+        actions.append(ro["actions"].reshape(T * N, *ro["actions"].shape[2:]))
+        logp.append(ro["logp"].reshape(T * N))
+        adv.append(a.reshape(T * N))
+        ret.append(r.reshape(T * N))
+    return {
+        "obs": np.concatenate(obs).astype(np.float32),
+        "actions": np.concatenate(actions),
+        "logp_old": np.concatenate(logp),
+        "advantages": np.concatenate(adv),
+        "returns": np.concatenate(ret),
+    }
+
+
 class PPOConfig(AlgorithmConfig):
+    #: connector factories are honored by this algorithm's runners
+    supports_connectors = True
+
     def __init__(self):
         super().__init__()
         self.num_envs_per_runner = 4
@@ -84,11 +112,27 @@ class PPO:
                 config.num_envs_per_runner,
                 config.rollout_len,
                 config.seed + 1000 * (i + 1),
+                config.env_to_module_connector,
+                config.module_to_env_connector,
             )
             for i in range(config.num_env_runners)
         ]
         api.get([r.ping.remote() for r in self.runners])
         self._ep_return_window: List[float] = []
+        # driver-side env-to-module pipeline for compute_single_action —
+        # inference must see the SAME transform the policy trained on.
+        # (Stateful connector stats, e.g. running normalizers, live
+        # per-runner and are not merged back; the reference syncs connector
+        # state periodically — documented gap.)
+        from .connectors import ConnectorContext, default_env_to_module
+
+        self._infer_ctx = ConnectorContext(
+            self.observation_space, self.action_space
+        )
+        self._infer_connector = (
+            config.env_to_module_connector() if config.env_to_module_connector
+            else default_env_to_module()
+        )
 
     # -- training -----------------------------------------------------------
 
@@ -123,32 +167,11 @@ class PPO:
         }
 
     def _postprocess(self, rollouts):
-        obs, actions, logp, adv, ret = [], [], [], [], []
+        batch = gae_batch(rollouts, self.config.gamma, self.config.lam)
         ep_returns, ep_lengths = [], []
         for ro in rollouts:
-            a, r = compute_gae(
-                ro["rewards"],
-                ro["values"],
-                ro["dones"],
-                ro["last_values"],
-                self.config.gamma,
-                self.config.lam,
-            )
-            T, N = ro["rewards"].shape
-            obs.append(ro["obs"].reshape(T * N, -1))
-            actions.append(ro["actions"].reshape(T * N, *ro["actions"].shape[2:]))
-            logp.append(ro["logp"].reshape(T * N))
-            adv.append(a.reshape(T * N))
-            ret.append(r.reshape(T * N))
             ep_returns.extend(ro["episode_returns"])
             ep_lengths.extend(ro["episode_lengths"])
-        batch = {
-            "obs": np.concatenate(obs).astype(np.float32),
-            "actions": np.concatenate(actions),
-            "logp_old": np.concatenate(logp),
-            "advantages": np.concatenate(adv),
-            "returns": np.concatenate(ret),
-        }
         return batch, ep_returns, ep_lengths
 
     # -- checkpointing (reference: Checkpointable) --------------------------
@@ -180,11 +203,13 @@ class PPO:
         import jax
         import jax.numpy as jnp
 
-        from .env import encode_obs
         from .models import sample_actions
 
         key = jax.random.PRNGKey(self.iteration)
-        encoded = encode_obs(self.observation_space, np.asarray(obs)[None])
+        encoded = np.asarray(
+            self._infer_connector(np.asarray(obs)[None], self._infer_ctx),
+            np.float32,
+        )
         actions, _, _ = sample_actions(
             self.learner.model,
             self.learner.params,

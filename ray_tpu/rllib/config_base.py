@@ -26,6 +26,8 @@ class AlgorithmConfig:
         self.seed = 0
         self.num_cpus_per_runner = 1.0
         self.num_tpus_for_learner = 0.0
+        self.env_to_module_connector = None
+        self.module_to_env_connector = None
 
     def environment(self, env, env_config: Optional[dict] = None):
         self.env_spec = env
@@ -38,6 +40,8 @@ class AlgorithmConfig:
         num_envs_per_env_runner: Optional[int] = None,
         rollout_fragment_length: Optional[int] = None,
         num_cpus_per_env_runner: Optional[float] = None,
+        env_to_module_connector=None,
+        module_to_env_connector=None,
     ):
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -47,6 +51,20 @@ class AlgorithmConfig:
             self.rollout_len = rollout_fragment_length
         if num_cpus_per_env_runner is not None:
             self.num_cpus_per_runner = num_cpus_per_env_runner
+        # zero-arg factories returning a Connector/ConnectorPipeline
+        # (reference: config.env_runners(env_to_module_connector=...)) —
+        # factories, not instances, so stateful connectors stay per-runner
+        if env_to_module_connector is not None or module_to_env_connector is not None:
+            if not getattr(self, "supports_connectors", False):
+                raise NotImplementedError(
+                    f"{type(self).__name__} runners do not consume connector "
+                    "pipelines yet (PPO/MultiAgentPPO do); configuring one "
+                    "here would be silently dropped"
+                )
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
 
     def training(self, **kwargs):

@@ -24,10 +24,17 @@ class EnvRunner:
         num_envs: int,
         rollout_len: int,
         seed: int,
+        env_to_module_connector=None,
+        module_to_env_connector=None,
     ):
         import jax
 
-        from .env import VectorEnv, encode_obs, make_env, space_dims
+        from .connectors import (
+            ConnectorContext,
+            default_env_to_module,
+            default_module_to_env,
+        )
+        from .env import VectorEnv, make_env, space_dims
         from .models import init_actor_critic, sample_actions
 
         factory = make_env(env_spec, env_config)
@@ -38,7 +45,23 @@ class EnvRunner:
         )
         self._model, _ = init_actor_critic(obs_dim, act_dim, discrete, seed)
         self._key = jax.random.PRNGKey(seed)
-        self._encode = lambda o: encode_obs(self._vec.observation_space, o)
+        # connector pipelines (reference: connector_pipeline_v2): factories
+        # so per-runner stateful connectors (e.g. running normalizers) are
+        # never shared across processes
+        self._ctx = ConnectorContext(
+            self._vec.observation_space, self._vec.action_space
+        )
+        self._env_to_module = (
+            env_to_module_connector() if env_to_module_connector
+            else default_env_to_module()
+        )
+        self._module_to_env = (
+            module_to_env_connector() if module_to_env_connector
+            else default_module_to_env()
+        )
+        self._encode = lambda o: np.asarray(
+            self._env_to_module(o, self._ctx), np.float32
+        )
         self._obs = self._encode(self._vec.reset(seed=seed))
         self._discrete = discrete
         # episode-return bookkeeping
@@ -75,7 +98,8 @@ class EnvRunner:
             act_buf[t] = actions
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(values)
-            next_obs, rewards, terms, truncs = self._vec.step(actions)
+            env_actions = self._module_to_env(actions, self._ctx)
+            next_obs, rewards, terms, truncs = self._vec.step(env_actions)
             next_obs = self._encode(next_obs)
             dones = terms | truncs
             rew_buf[t] = rewards

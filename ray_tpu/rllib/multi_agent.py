@@ -1,0 +1,356 @@
+"""Multi-agent PPO: per-policy module dict over a MultiAgentEnv.
+
+Role-equivalent of the reference's multi-agent stack (MultiAgentEnv +
+MultiAgentRLModuleSpec + per-module learner updates): agents map to policies
+via ``policy_mapping_fn``; each policy owns one ActorCritic module + one
+optimizer state; agents sharing a policy train it with their pooled
+experience (parameter sharing), separate policies update independently —
+each policy's epoch loop is the same single jitted lax.scan program the
+single-agent learner runs.
+
+Rollout layout: simultaneous-move envs (multi_agent_env.py contract) give
+rectangular per-policy arrays [T, n_agents_of_policy], which reuse the
+single-agent GAE and minibatch machinery unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import api
+from .algorithm import PPOConfig, gae_batch
+from .connectors import (
+    ConnectorContext,
+    default_env_to_module,
+    default_module_to_env,
+)
+from .env import space_dims
+from .learner import PPOLearner
+from .multi_agent_env import episode_done
+
+
+class MultiAgentPPOConfig(PPOConfig):
+    """PPOConfig + .multi_agent(policies, policy_mapping_fn)."""
+
+    def __init__(self):
+        super().__init__()
+        self.policies: List[str] = []
+        self.policy_mapping_fn: Optional[Callable[[str], str]] = None
+
+    def multi_agent(
+        self,
+        policies: List[str],
+        policy_mapping_fn: Callable[[str], str],
+    ):
+        """``policies``: policy ids; ``policy_mapping_fn(agent_id) ->
+        policy_id`` (reference: AlgorithmConfig.multi_agent)."""
+        self.policies = list(policies)
+        self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+class MultiAgentEnvRunner:
+    """Rollout actor over one MultiAgentEnv instance (reference:
+    MultiAgentEnvRunner, rllib/env/multi_agent_env_runner.py): steps the env
+    dict-wise, batching each policy's agents through that policy's module."""
+
+    def __init__(
+        self,
+        env_spec,
+        env_config: Optional[dict],
+        policies: List[str],
+        mapping_items: List,
+        rollout_len: int,
+        seed: int,
+        env_to_module_connector=None,
+        module_to_env_connector=None,
+    ):
+        import jax
+
+        from .env import make_env
+        from .models import init_actor_critic, sample_actions
+
+        self._env = make_env(env_spec, env_config)()
+        self._agents = list(self._env.possible_agents)
+        mapping = dict(mapping_items)
+        self._policy_of = {a: mapping[a] for a in self._agents}
+        # stable per-policy agent ordering -> rectangular [T, nA] buffers
+        self._agents_of = {
+            pid: [a for a in self._agents if self._policy_of[a] == pid]
+            for pid in policies
+        }
+        self._rollout_len = rollout_len
+        self._key = jax.random.PRNGKey(seed)
+        self._models = {}
+        self._ctxs = {}
+        self._sample_fns = {}
+        self._e2m = {}
+        self._m2e = {}
+        for pid in policies:
+            agents = self._agents_of[pid]
+            if not agents:
+                continue
+            obs_space = self._env.observation_space(agents[0])
+            act_space = self._env.action_space(agents[0])
+            obs_dim, act_dim, discrete = space_dims(obs_space, act_space)
+            model, _ = init_actor_critic(obs_dim, act_dim, discrete, seed)
+            self._models[pid] = model
+            self._ctxs[pid] = ConnectorContext(obs_space, act_space)
+            self._e2m[pid] = (
+                env_to_module_connector() if env_to_module_connector
+                else default_env_to_module()
+            )
+            self._m2e[pid] = (
+                module_to_env_connector() if module_to_env_connector
+                else default_module_to_env()
+            )
+            self._sample_fns[pid] = jax.jit(
+                lambda params, obs, key, _m=model: sample_actions(
+                    _m, params, obs, key
+                )
+            )
+        obs, _ = self._env.reset(seed=seed)
+        self._obs = obs
+        self._ep_return = 0.0
+        self._ep_len = 0
+        self._completed: List = []
+
+    def _encode(self, pid: str, obs_rows: List) -> np.ndarray:
+        return np.asarray(
+            self._e2m[pid](np.stack(obs_rows), self._ctxs[pid]), np.float32
+        )
+
+    def sample(self, params_by_policy: Dict[str, Any]) -> Dict[str, Any]:
+        """Roll ``rollout_len`` env steps; returns per-policy [T, nA]
+        trajectory arrays + episode stats (episode return = the TEAM sum
+        over all agents, the cooperative objective)."""
+        import jax
+
+        T = self._rollout_len
+        buffers: Dict[str, Dict[str, list]] = {
+            pid: {k: [] for k in ("obs", "actions", "logp", "values", "rewards", "dones")}
+            for pid in self._models
+        }
+        for _ in range(T):
+            action_dict = {}
+            step_cache = {}
+            for pid, agents in self._agents_of.items():
+                if not agents:
+                    continue
+                self._key, sub = jax.random.split(self._key)
+                encoded = self._encode(pid, [self._obs[a] for a in agents])
+                actions, logp, values = self._sample_fns[pid](
+                    params_by_policy[pid], encoded, sub
+                )
+                actions = np.asarray(actions)
+                env_actions = self._m2e[pid](actions, self._ctxs[pid])
+                for i, agent in enumerate(agents):
+                    action_dict[agent] = env_actions[i]
+                step_cache[pid] = (encoded, actions, np.asarray(logp),
+                                   np.asarray(values))
+            obs, rewards, terms, truncs, _ = self._env.step(action_dict)
+            done = episode_done(terms, truncs)
+            self._ep_return += float(
+                sum(rewards.get(a, 0.0) for a in self._agents)
+            )
+            self._ep_len += 1
+            for pid, agents in self._agents_of.items():
+                if not agents:
+                    continue
+                encoded, actions, logp, values = step_cache[pid]
+                buf = buffers[pid]
+                buf["obs"].append(encoded)
+                buf["actions"].append(actions)
+                buf["logp"].append(logp)
+                buf["values"].append(values)
+                buf["rewards"].append(
+                    np.asarray([rewards.get(a, 0.0) for a in agents], np.float32)
+                )
+                buf["dones"].append(np.full(len(agents), done))
+            if done:
+                self._completed.append((self._ep_return, self._ep_len))
+                self._ep_return, self._ep_len = 0.0, 0
+                obs, _ = self._env.reset()
+            self._obs = obs
+        out: Dict[str, Any] = {}
+        for pid, agents in self._agents_of.items():
+            if not agents:
+                continue
+            buf = buffers[pid]
+            self._key, sub = jax.random.split(self._key)
+            encoded = self._encode(pid, [self._obs[a] for a in agents])
+            _, _, last_values = self._sample_fns[pid](
+                params_by_policy[pid], encoded, sub
+            )
+            out[pid] = {
+                "obs": np.stack(buf["obs"]),
+                "actions": np.stack(buf["actions"]),
+                "logp": np.stack(buf["logp"]),
+                "values": np.stack(buf["values"]),
+                "rewards": np.stack(buf["rewards"]),
+                "dones": np.stack(buf["dones"]),
+                "last_values": np.asarray(last_values),
+            }
+        completed, self._completed = self._completed, []
+        return {
+            "policies": out,
+            "episode_returns": [r for r, _ in completed],
+            "episode_lengths": [l for _, l in completed],
+        }
+
+    def ping(self):
+        return True
+
+    def stop(self):
+        try:
+            self._env.close()
+        except Exception:
+            pass
+        return True
+
+
+class MultiAgentPPO:
+    """Per-policy PPO learners over MultiAgentEnvRunner actors (reference:
+    Algorithm with a MultiAgent module dict; rollouts on CPU actors, every
+    policy's update is the jitted single-agent program)."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        if config.env_spec is None:
+            raise ValueError("config.environment(...) is required")
+        if not config.policies or config.policy_mapping_fn is None:
+            raise ValueError("config.multi_agent(policies, mapping_fn) is required")
+        self.config = config
+        self.iteration = 0
+        from .env import make_env
+
+        probe = make_env(config.env_spec, config.env_config)()
+        agents = list(probe.possible_agents)
+        if not agents:
+            raise ValueError("MultiAgentEnv.possible_agents is empty")
+        mapping_items = [(a, config.policy_mapping_fn(a)) for a in agents]
+        unknown = {p for _, p in mapping_items} - set(config.policies)
+        if unknown:
+            raise ValueError(f"mapping_fn produced unknown policies {unknown}")
+        self.learners: Dict[str, PPOLearner] = {}
+        for pid in config.policies:
+            pid_agents = [a for a, p in mapping_items if p == pid]
+            if not pid_agents:
+                continue
+            obs_dim, act_dim, discrete = space_dims(
+                probe.observation_space(pid_agents[0]),
+                probe.action_space(pid_agents[0]),
+            )
+            self.learners[pid] = PPOLearner(
+                obs_dim, act_dim, discrete,
+                lr=config.lr, clip_param=config.clip_param,
+                vf_coeff=config.vf_coeff, entropy_coeff=config.entropy_coeff,
+                num_epochs=config.num_epochs,
+                minibatch_size=config.minibatch_size,
+                max_grad_norm=config.max_grad_norm, seed=config.seed,
+            )
+        try:
+            probe.close()
+        except Exception:
+            pass
+        Runner = api.remote(num_cpus=config.num_cpus_per_runner)(
+            MultiAgentEnvRunner
+        )
+        self.runners = [
+            Runner.remote(
+                config.env_spec,
+                config.env_config,
+                list(self.learners.keys()),
+                mapping_items,
+                config.rollout_len,
+                config.seed + 1000 * (i + 1),
+                config.env_to_module_connector,
+                config.module_to_env_connector,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        api.get([r.ping.remote() for r in self.runners])
+        self._ep_return_window: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        params = {pid: l.get_params() for pid, l in self.learners.items()}
+        rollouts = api.get([r.sample.remote(params) for r in self.runners])
+        stats: Dict[str, Any] = {}
+        steps = 0
+        ep_returns: List[float] = []
+        ep_lengths: List[int] = []
+        for pid, learner in self.learners.items():
+            policy_rollouts = [
+                ro["policies"][pid] for ro in rollouts
+                if pid in ro["policies"]
+            ]
+            batch = gae_batch(
+                policy_rollouts, self.config.gamma, self.config.lam
+            )
+            steps += batch["obs"].shape[0]
+            pid_stats = learner.update(batch)
+            stats.update({f"{pid}/{k}": v for k, v in pid_stats.items()})
+        for ro in rollouts:
+            ep_returns.extend(ro["episode_returns"])
+            ep_lengths.extend(ro["episode_lengths"])
+        self.iteration += 1
+        self._ep_return_window.extend(ep_returns)
+        self._ep_return_window = self._ep_return_window[-100:]
+        mean_return = (
+            float(np.mean(self._ep_return_window))
+            if self._ep_return_window else float("nan")
+        )
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_return,
+            "num_episodes": len(ep_returns),
+            "episode_len_mean": float(np.mean(ep_lengths))
+            if ep_lengths else float("nan"),
+            "num_env_steps_sampled": steps,
+            "time_this_iter_s": time.time() - t0,
+            **stats,
+        }
+
+    # -- checkpointing ------------------------------------------------------
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(
+                {
+                    "learners": {
+                        pid: l.state_dict() for pid, l in self.learners.items()
+                    },
+                    "iteration": self.iteration,
+                },
+                f,
+            )
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str):
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        for pid, learner_state in state["learners"].items():
+            self.learners[pid].load_state_dict(learner_state)
+        self.iteration = state["iteration"]
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                api.kill(r)
+            except Exception:
+                pass
+        self.runners = []
+
+
+MultiAgentPPOConfig.algo_class = MultiAgentPPO

@@ -1,0 +1,56 @@
+"""MultiAgentEnv: the dict-keyed environment interface.
+
+Role-equivalent of the reference's MultiAgentEnv
+(rllib/env/multi_agent_env.py:30): observations, rewards, and done flags are
+dicts keyed by agent id; ``terminateds``/``truncateds`` carry the special
+``"__all__"`` key ending the episode for everyone. Agents map to policies
+through ``policy_mapping_fn`` (multi_agent.py) — several agents may share one
+policy (parameter sharing) or each own their own.
+
+The TPU-side restriction (documented, checked): **simultaneous-move** envs —
+every agent in ``possible_agents`` observes and acts on every step. That
+keeps per-policy rollouts rectangular ([T, n_agents] arrays), which is what
+the jitted GAE/update path consumes; turn-based games need a padding wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+
+class MultiAgentEnv:
+    #: stable agent ids, all present every step (simultaneous-move)
+    possible_agents: Tuple[str, ...] = ()
+
+    def observation_space(self, agent_id: str):
+        raise NotImplementedError
+
+    def action_space(self, agent_id: str):
+        raise NotImplementedError
+
+    def reset(self, seed: Optional[int] = None) -> Tuple[Dict[str, Any], Dict]:
+        """-> (obs_dict, infos_dict)"""
+        raise NotImplementedError
+
+    def step(
+        self, action_dict: Dict[str, Any]
+    ) -> Tuple[Dict, Dict, Dict, Dict, Dict]:
+        """-> (obs, rewards, terminateds, truncateds, infos), all dicts
+        keyed by agent id; terminateds/truncateds also carry "__all__"."""
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+def episode_done(terminateds: Dict, truncateds: Dict) -> bool:
+    """The episode ends when "__all__" is flagged (reference: the __all__
+    convention in multi_agent_env.py) or every agent is individually done."""
+    if terminateds.get("__all__") or truncateds.get("__all__"):
+        return True
+    agent_keys = {
+        k for k in (*terminateds, *truncateds) if k != "__all__"
+    }
+    return bool(agent_keys) and all(
+        terminateds.get(k) or truncateds.get(k) for k in agent_keys
+    )

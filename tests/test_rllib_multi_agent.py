@@ -1,0 +1,197 @@
+"""Multi-agent RLlib + connector pipelines (reference:
+rllib/env/multi_agent_env.py:30, connectors/connector_pipeline_v2.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import rllib
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, resources={"TPU": 0})
+    yield
+    ray_tpu.shutdown()
+
+
+class CoopMatch(rllib.MultiAgentEnv):
+    """2-agent cooperative toy: both agents see the same Discrete(3)
+    context; each earns +0.2 for matching it, and BOTH earn +1 more when
+    both match simultaneously (the cooperative coupling). Episodes run 8
+    steps with a fresh context each step; max team return/episode ~= 19.2."""
+
+    possible_agents = ("a0", "a1")
+    EP_LEN = 8
+    N = 3
+
+    def __init__(self, config=None):
+        self._rng = np.random.default_rng(0)
+        self._t = 0
+        self._ctx = 0
+
+    def observation_space(self, agent_id):
+        import gymnasium as gym
+
+        return gym.spaces.Discrete(self.N)
+
+    def action_space(self, agent_id):
+        import gymnasium as gym
+
+        return gym.spaces.Discrete(self.N)
+
+    def _obs(self):
+        return {a: self._ctx for a in self.possible_agents}
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._ctx = int(self._rng.integers(self.N))
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        hits = {a: int(action_dict[a]) == self._ctx for a in self.possible_agents}
+        both = all(hits.values())
+        rewards = {
+            a: 0.2 * hits[a] + (1.0 if both else 0.0)
+            for a in self.possible_agents
+        }
+        self._t += 1
+        done = self._t >= self.EP_LEN
+        self._ctx = int(self._rng.integers(self.N))
+        terms = {a: done for a in self.possible_agents}
+        terms["__all__"] = done
+        truncs = {a: False for a in self.possible_agents}
+        truncs["__all__"] = False
+        return self._obs(), rewards, terms, truncs, {}
+
+
+def test_multi_agent_ppo_learns_cooperative_toy(cluster):
+    """The verdict's acceptance bar: multi-agent PPO learns a 2-agent
+    cooperative toy in-suite (shared policy — parameter sharing)."""
+    config = (
+        rllib.MultiAgentPPOConfig()
+        .environment(CoopMatch)
+        .multi_agent(
+            policies=["shared"], policy_mapping_fn=lambda agent_id: "shared"
+        )
+        .env_runners(num_env_runners=1, rollout_fragment_length=128)
+        .training(lr=5e-3, num_epochs=6, minibatch_size=64, entropy_coeff=0.0)
+        .debugging(seed=7)
+    )
+    algo = config.build()
+    try:
+        first = algo.train()
+        result = first
+        for _ in range(25):
+            result = algo.train()
+            if result["episode_return_mean"] > 15.0:
+                break
+        # random play: P(match)=1/3 per agent -> E[return] ~ 8*(2*0.2/3+2/9)
+        # ~= 2.8; learned play approaches ~19.2
+        assert result["episode_return_mean"] > 15.0, result
+        assert result["episode_return_mean"] > first["episode_return_mean"]
+        assert "shared/loss" in result or any(
+            k.startswith("shared/") for k in result
+        )
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_separate_policies_and_checkpoint(cluster, tmp_path):
+    """Two separate policies update independently and round-trip a checkpoint."""
+    config = (
+        rllib.MultiAgentPPOConfig()
+        .environment(CoopMatch)
+        .multi_agent(
+            policies=["p0", "p1"],
+            policy_mapping_fn=lambda a: "p0" if a == "a0" else "p1",
+        )
+        .env_runners(num_env_runners=1, rollout_fragment_length=32)
+        .debugging(seed=3)
+    )
+    algo = config.build()
+    try:
+        result = algo.train()
+        assert result["num_env_steps_sampled"] == 64  # 32 steps x 2 agents
+        assert any(k.startswith("p0/") for k in result)
+        assert any(k.startswith("p1/") for k in result)
+        path = algo.save(str(tmp_path / "ckpt"))
+        before = {
+            pid: [np.asarray(x) for x in __import__("jax").tree.leaves(l.get_params())]
+            for pid, l in algo.learners.items()
+        }
+        algo.train()
+        algo.restore(path)
+        after = {
+            pid: [np.asarray(x) for x in __import__("jax").tree.leaves(l.get_params())]
+            for pid, l in algo.learners.items()
+        }
+        for pid in before:
+            for a, b in zip(before[pid], after[pid]):
+                np.testing.assert_array_equal(a, b)
+    finally:
+        algo.stop()
+
+
+def test_connector_pipeline_composition():
+    """ConnectorPipeline semantics: ordering, prepend/append/insert_after,
+    and the built-in flatten."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib import (
+        ConnectorContext,
+        ConnectorPipeline,
+        FlattenObservations,
+        Lambda,
+    )
+
+    ctx = ConnectorContext(gym.spaces.Discrete(4), gym.spaces.Discrete(2))
+    pipeline = ConnectorPipeline([FlattenObservations()])
+    out = pipeline(np.array([1, 3]), ctx)
+    np.testing.assert_array_equal(
+        out, [[0, 1, 0, 0], [0, 0, 0, 1]]
+    )
+
+    pipeline.append(Lambda(lambda d, c: d * 2.0, "double"))
+    pipeline.prepend(Lambda(lambda d, c: d, "ident"))
+    pipeline.insert_after(
+        FlattenObservations, Lambda(lambda d, c: d + 1.0, "inc")
+    )
+    # order: ident -> flatten -> inc -> double
+    out = pipeline(np.array([0]), ctx)
+    np.testing.assert_array_equal(out, [[4.0, 2.0, 2.0, 2.0]])
+    with pytest.raises(ValueError):
+        pipeline.insert_after(type("Nope", (), {}), Lambda(lambda d, c: d))
+
+
+def test_custom_connector_reaches_single_agent_runner(cluster):
+    """A custom env-to-module connector configured through the builder is
+    actually applied on the rollout path: scale CartPole obs by 0 and the
+    policy sees constant inputs -> logp is identical across timesteps."""
+    from ray_tpu.rllib import ConnectorPipeline, FlattenObservations, Lambda
+
+    def zero_obs():
+        return ConnectorPipeline(
+            [FlattenObservations(), Lambda(lambda d, c: d * 0.0, "zero")]
+        )
+
+    config = (
+        rllib.PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=1,
+            num_envs_per_env_runner=2,
+            rollout_fragment_length=16,
+            env_to_module_connector=zero_obs,
+        )
+        .debugging(seed=1)
+    )
+    algo = config.build()
+    try:
+        params = algo.learner.get_params()
+        ro = ray_tpu.get(algo.runners[0].sample.remote(params), timeout=120)
+        assert np.all(ro["obs"] == 0.0), "custom connector not applied"
+    finally:
+        algo.stop()
