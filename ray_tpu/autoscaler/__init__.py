@@ -13,6 +13,11 @@ slices instead of individual VMs.
 
 from .config import NodeTypeConfig, AutoscalingConfig, tpu_slice_node_type
 from .node_provider import NodeProvider, FakeMultiNodeProvider, TpuSliceProvider
+from .gce_tpu_provider import (
+    GceTpuQueuedResourceProvider,
+    NodeLaunchError,
+    QuotaExceededError,
+)
 from .scheduler import ResourceScheduler, SchedulingDecision
 from .autoscaler import Autoscaler, AutoscalerMonitor
 

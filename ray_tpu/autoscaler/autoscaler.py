@@ -42,10 +42,23 @@ class Autoscaler:
         """One reconcile tick (reference: autoscaler.py:169
         update_autoscaling_state)."""
         state = self._gcs_call("get_cluster_resource_state")
+        # providers that can lose ACTIVE capacity mid-life (preempted GCE
+        # slices) surface it here so the freed slot is replaceable this tick
+        preempt_check = getattr(self._provider, "check_preemptions", None)
+        if preempt_check is not None:
+            dropped = preempt_check()
+            if dropped:
+                logger.warning("preempted instances dropped: %s", dropped)
         instances = self._provider.non_terminated_nodes()
         counts: Dict[str, int] = {}
+        pending: Dict[str, int] = {}
         for inst in instances:
             counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
+            # still-provisioning instances get synthetic future capacity in
+            # the scheduler; providers without lifecycle states register
+            # their nodes ~immediately and report provisioning=False
+            if getattr(inst, "provisioning", False):
+                pending[inst.node_type] = pending.get(inst.node_type, 0) + 1
 
         # enforce min_workers
         launches: Dict[str, int] = {}
@@ -55,7 +68,12 @@ class Autoscaler:
                 launches[t.name] = deficit
 
         decision = self._scheduler.schedule(
-            state, {**counts, **launches}
+            state,
+            {**counts, **{k: counts.get(k, 0) + v for k, v in launches.items()}},
+            pending_counts={
+                k: pending.get(k, 0) + launches.get(k, 0)
+                for k in set(pending) | set(launches)
+            },
         )
         for name, n in decision.launches.items():
             launches[name] = launches.get(name, 0) + n

@@ -1,0 +1,246 @@
+"""GCE TPU queued-resources node provider.
+
+Role-equivalent of the reference's GCP node provider
+(autoscaler/_private/gcp/node_provider.py:63) specialized to the TPU
+``queuedResources`` API, which is how v4/v5 slices are actually obtained:
+create returns immediately and the resource moves through
+``WAITING_FOR_RESOURCES -> PROVISIONING -> ACTIVE`` (or ``FAILED``)
+asynchronously; creates hit quota (429) under contention; reads are
+eventually consistent (a just-created resource can 404 for a while); a
+slice can be preempted (ACTIVE -> FAILED) at any time.
+
+The HTTP layer is injectable — ``http(method, path, body) -> (status,
+dict)`` — so the full retry/backoff/eventual-consistency/partial-slice
+behavior is unit-testable against a mock API (the reference tests its GCP
+provider the same way), and a production binding is one function closing
+over google-auth credentials.
+
+Lifecycle mapping to the NodeProvider contract:
+- ``create_node`` POSTs the queued resource (bounded quota retries with
+  exponential backoff) and registers a PENDING instance.
+- ``non_terminated_nodes`` polls pending instances: ACTIVE with all hosts
+  ready becomes ACTIVE; FAILED (quota revoked, preempted, stockout) is
+  deleted remotely and dropped locally so the reconciler's next tick
+  relaunches; a 404 inside the consistency grace window is tolerated.
+  PENDING and ACTIVE instances both count as non-terminated — the
+  reconciler must not double-launch while a slice is provisioning.
+- ``terminate_node`` DELETEs with bounded retries.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .node_provider import NodeInstance, NodeProvider
+
+logger = logging.getLogger(__name__)
+
+HttpFn = Callable[[str, str, Optional[dict]], Tuple[int, dict]]
+
+_RETRYABLE = (429, 500, 503)
+
+
+class QuotaExceededError(Exception):
+    pass
+
+
+class NodeLaunchError(Exception):
+    pass
+
+
+class GceTpuInstance(NodeInstance):
+    def __init__(self, instance_id: str, node_type: str,
+                 registration_grace_s: float = 120.0):
+        super().__init__(instance_id, node_type)
+        self.status = "PENDING"  # PENDING | ACTIVE
+        self.created_at = time.time()
+        self.activated_at: Optional[float] = None
+        self.first_seen = False  # a successful GET clears the 404 grace
+        self._registration_grace_s = registration_grace_s
+
+    @property
+    def provisioning(self) -> bool:
+        """Synthesize this instance's capacity while it provisions AND for
+        a bounded grace after ACTIVE (hosts boot + raylets register). The
+        grace is a ceiling, not a latch: a slice whose hosts die later is
+        only phantom capacity until the grace expires, then its demand
+        relaunches — the failure mode a permanent not-yet-registered
+        heuristic would turn into a stall."""
+        if self.status == "PENDING":
+            return True
+        return (
+            self.activated_at is not None
+            and time.time() - self.activated_at < self._registration_grace_s
+        )
+
+
+class GceTpuQueuedResourceProvider(NodeProvider):
+    def __init__(
+        self,
+        config,
+        http: HttpFn,
+        *,
+        project: str = "project",
+        zone: str = "zone",
+        create_retries: int = 4,
+        delete_retries: int = 4,
+        backoff_s: float = 0.5,
+        consistency_grace_s: float = 30.0,
+        registration_grace_s: float = 120.0,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self._config = config
+        self._http = http
+        self._base = f"/projects/{project}/locations/{zone}/queuedResources"
+        self._create_retries = create_retries
+        self._delete_retries = delete_retries
+        self._backoff_s = backoff_s
+        self._consistency_grace_s = consistency_grace_s
+        self._registration_grace_s = registration_grace_s
+        self._sleep = sleep
+        self._counter = itertools.count()
+        self._lock = threading.Lock()
+        self._instances: Dict[str, GceTpuInstance] = {}
+
+    # -- NodeProvider ------------------------------------------------------
+
+    def create_node(self, node_type_name: str) -> NodeInstance:
+        node_type = self._config.type_by_name(node_type_name)
+        if node_type is None:
+            raise ValueError(f"unknown node type {node_type_name!r}")
+        name = f"qr-{node_type_name}-{next(self._counter)}"
+        body = {
+            "tpu": {
+                "node_spec": {
+                    "node": {
+                        "accelerator_type": node_type.labels.get(
+                            "ray.io/tpu-pod-type", node_type_name
+                        ),
+                    },
+                    "node_count": max(
+                        int(getattr(node_type, "group_size", 1) or 1), 1
+                    ),
+                }
+            }
+        }
+        last = None
+        for attempt in range(self._create_retries):
+            status, resp = self._http(
+                "POST", f"{self._base}?queued_resource_id={name}", body
+            )
+            if status == 200:
+                inst = GceTpuInstance(
+                    name, node_type_name,
+                    registration_grace_s=self._registration_grace_s,
+                )
+                with self._lock:
+                    self._instances[name] = inst
+                return inst
+            last = (status, resp)
+            if status in _RETRYABLE:
+                # quota/stockout: exponential backoff before the NEXT try
+                # (no pointless sleep after the final attempt)
+                if attempt < self._create_retries - 1:
+                    self._sleep(self._backoff_s * (2 ** attempt))
+                continue
+            raise NodeLaunchError(f"create {name}: HTTP {status}: {resp}")
+        raise QuotaExceededError(f"create {name} exhausted retries: {last}")
+
+    def terminate_node(self, instance_id: str) -> None:
+        with self._lock:
+            self._instances.pop(instance_id, None)
+        for attempt in range(self._delete_retries):
+            status, _ = self._http(
+                "DELETE", f"{self._base}/{instance_id}", None
+            )
+            if status in (200, 404):  # 404: already gone — fine
+                return
+            if status in _RETRYABLE:
+                if attempt < self._delete_retries - 1:
+                    self._sleep(self._backoff_s * (2 ** attempt))
+                continue
+            break
+        logger.warning("delete of %s did not confirm; orphan possible",
+                       instance_id)
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        self._poll()
+        with self._lock:
+            return list(self._instances.values())
+
+    # -- lifecycle polling -------------------------------------------------
+
+    def _poll(self) -> None:
+        with self._lock:
+            pending = [
+                i for i in self._instances.values() if i.status == "PENDING"
+            ]
+        for inst in pending:
+            try:
+                status, resp = self._http(
+                    "GET", f"{self._base}/{inst.instance_id}", None
+                )
+            except Exception:
+                logger.exception("poll of %s failed", inst.instance_id)
+                continue
+            if status == 404:
+                if inst.first_seen or (
+                    time.time() - inst.created_at > self._consistency_grace_s
+                ):
+                    # was visible before (or grace expired) and is now gone:
+                    # DELETE anyway (tolerates 404) — if the 404 was only
+                    # read-path lag, the resource would otherwise surface
+                    # later as an untracked, quota-eating orphan
+                    logger.warning("queued resource %s vanished",
+                                   inst.instance_id)
+                    self.terminate_node(inst.instance_id)
+                continue  # eventual consistency: not visible yet
+            if status != 200:
+                continue  # transient API error; retry next tick
+            inst.first_seen = True
+            state = resp.get("state", "")
+            if state == "ACTIVE":
+                # partial-slice guard: a multi-host slice only becomes
+                # usable when EVERY host is up; the API can report ACTIVE
+                # with hosts still joining
+                ready = resp.get("ready_node_count")
+                want = resp.get("node_count", 1)
+                if ready is not None and ready < want:
+                    continue
+                inst.status = "ACTIVE"
+                inst.activated_at = time.time()
+            elif state in ("FAILED", "SUSPENDED"):
+                logger.warning(
+                    "queued resource %s entered %s: deleting for relaunch",
+                    inst.instance_id, state,
+                )
+                self.terminate_node(inst.instance_id)
+            # WAITING_FOR_RESOURCES / PROVISIONING / ACCEPTED: keep waiting
+
+    # ACTIVE slices can be preempted later; surface that too
+    def check_preemptions(self) -> List[str]:
+        """Re-poll ACTIVE instances; drop (and DELETE) any the API reports
+        FAILED/missing. Returns dropped instance ids (chaos path: a slice
+        dying mid-life must free the reconciler to replace it)."""
+        with self._lock:
+            active = [
+                i for i in self._instances.values() if i.status == "ACTIVE"
+            ]
+        dropped = []
+        for inst in active:
+            try:
+                status, resp = self._http(
+                    "GET", f"{self._base}/{inst.instance_id}", None
+                )
+            except Exception:
+                continue
+            if status == 404 or (
+                status == 200 and resp.get("state") in ("FAILED", "SUSPENDED")
+            ):
+                self.terminate_node(inst.instance_id)
+                dropped.append(inst.instance_id)
+        return dropped

@@ -20,6 +20,12 @@ from typing import Dict, List, Optional, Tuple
 class NodeInstance:
     """Provider-side record of one launched node."""
 
+    #: True while the instance's future capacity should be SYNTHESIZED by
+    #: the scheduler (still provisioning / not yet registered as live GCS
+    #: nodes). In-process providers register instantly, so False here;
+    #: async cloud providers override.
+    provisioning = False
+
     def __init__(self, instance_id: str, node_type: str):
         self.instance_id = instance_id
         self.node_type = node_type

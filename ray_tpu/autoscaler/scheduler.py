@@ -58,10 +58,15 @@ class ResourceScheduler:
         self,
         cluster_state: dict,
         current_counts: Dict[str, int],
+        pending_counts: Optional[Dict[str, int]] = None,
     ) -> SchedulingDecision:
         """cluster_state is the GCS GetClusterResourceState reply;
-        current_counts is launched-but-maybe-not-yet-registered nodes per
-        type (so in-flight launches aren't double-counted)."""
+        current_counts is every provider instance per type (so max_workers
+        caps hold); pending_counts is the subset still PROVISIONING — their
+        future capacity is synthesized so the same unmet demand doesn't
+        relaunch every tick, but ONLY for instances the provider itself
+        reports pending: a dead-but-listed instance must NOT contribute
+        phantom capacity (that would stall its replacement forever)."""
         decision = SchedulingDecision()
 
         # Free capacity on live nodes.
@@ -75,6 +80,18 @@ class ResourceScheduler:
             )
         planned: List[_PlannedNode] = []
         planned_counts: Dict[str, int] = dict(current_counts)
+
+        # In-flight capacity (async providers — a GCE queued resource
+        # provisions for minutes): synthesize the future hosts of
+        # still-PENDING instances so their demand doesn't relaunch per tick.
+        for t in self._config.node_types:
+            labels = {**t.labels, "ray.io/node-type": t.name}
+            for _ in range((pending_counts or {}).get(t.name, 0)):
+                for host_idx in range(t.group_size):
+                    capacity = dict(t.resources)
+                    if host_idx == 0:
+                        capacity.update(t.head_resources)
+                    planned.append(_PlannedNode(t.name, capacity, labels))
 
         def try_place(resources: Dict[str, float], selector) -> bool:
             for node in free + planned:

@@ -264,3 +264,90 @@ def test_tpu_slice_provider_scales_pending_slice_up_and_down(shutdown_only):
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_tpu_slice_partial_launch_rolls_back(shutdown_only):
+    """Chaos: a host launch failing mid-slice must roll back the already
+    launched hosts — the cluster never holds a partial ICI domain."""
+    from ray_tpu.autoscaler import TpuSliceProvider, tpu_slice_node_type
+    from ray_tpu.cluster_utils import Cluster
+
+    slice_type = tpu_slice_node_type("v5e-16", min_slices=0, max_slices=2)
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        config = AutoscalingConfig(
+            node_types=[slice_type], idle_timeout_s=60, update_interval_s=0.25
+        )
+        provider = TpuSliceProvider(cluster, config)
+        launched = []
+        real_add = cluster.add_node
+
+        def flaky_add(**kw):
+            if launched:
+                raise RuntimeError("host 1 failed to boot")
+            launched.append(1)
+            return real_add(**kw)
+
+        cluster.add_node = flaky_add
+        with pytest.raises(RuntimeError, match="host 1"):
+            provider.create_node(slice_type.name)
+        assert provider.non_terminated_nodes() == []
+        # the half-launched host 0 was rolled back: its raylet was killed
+        # non-gracefully, so the GCS flags it dead after the health window
+        cluster.add_node = real_add
+        cluster.connect()
+        import ray_tpu as rt
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            live_tpu = [
+                n for n in rt.nodes()
+                if n["Alive"] and n["Resources"].get("TPU")
+            ]
+            if not live_tpu:
+                break
+            time.sleep(0.5)
+        assert not live_tpu
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_autoscaler_monitor_survives_provider_chaos(shutdown_only):
+    """Chaos: the provider raising mid-reconcile (every create fails) must
+    not kill the monitor loop; once the provider heals, scale-up happens."""
+    from ray_tpu.autoscaler import FakeMultiNodeProvider
+
+    class FlakyProvider(FakeMultiNodeProvider):
+        fail = True
+
+        def create_node(self, node_type_name):
+            if FlakyProvider.fail:
+                raise RuntimeError("cloud API down")
+            return super().create_node(node_type_name)
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1},
+        worker_node_types=[
+            dict(name="cpu-big", resources={"CPU": 4}, min_workers=0,
+                 max_workers=2)
+        ],
+        idle_timeout_s=60.0,
+        update_interval_s=0.2,
+        provider_cls=FlakyProvider,
+    )
+    cluster.start()
+    cluster.connect()
+    try:
+        @ray_tpu.remote(num_cpus=4)
+        def big():
+            return 99
+
+        ref = big.remote()  # infeasible until a cpu-big node appears
+        time.sleep(1.5)  # several failing reconcile ticks
+        assert cluster.provider.non_terminated_nodes() == []
+        FlakyProvider.fail = False  # provider heals
+        assert ray_tpu.get(ref, timeout=120) == 99
+        assert len(cluster.provider.non_terminated_nodes()) >= 1
+    finally:
+        cluster.shutdown()

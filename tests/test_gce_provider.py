@@ -1,0 +1,279 @@
+"""GCE TPU queued-resources provider against a mocked HTTP API
+(reference: autoscaler/_private/gcp/node_provider.py:63 — create ->
+pending -> ready/failed, quota errors, eventual consistency, chaos through
+the reconciler)."""
+
+import threading
+
+import pytest
+
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalingConfig,
+    GceTpuQueuedResourceProvider,
+    NodeTypeConfig,
+    QuotaExceededError,
+)
+
+
+class MockGceApi:
+    """In-memory queuedResources API with scriptable failure behaviors."""
+
+    def __init__(self):
+        self.resources = {}  # name -> dict(state, node_count, ready_node_count)
+        self.lock = threading.Lock()
+        self.quota_failures_remaining = 0
+        self.consistency_lag_polls = 0  # GETs that 404 after create
+        self.delete_failures_remaining = 0
+        self.provision_after_polls = 0  # GETs until WAITING -> ACTIVE
+        self.fail_instead_of_active = False
+        self.calls = []
+
+    def __call__(self, method, path, body):
+        with self.lock:
+            self.calls.append((method, path))
+            name = path.rsplit("/", 1)[-1].split("?")[0]
+            if method == "POST":
+                name = path.split("queued_resource_id=")[-1]
+                if self.quota_failures_remaining > 0:
+                    self.quota_failures_remaining -= 1
+                    return 429, {"error": "QUOTA_EXCEEDED"}
+                self.resources[name] = {
+                    "state": "WAITING_FOR_RESOURCES",
+                    "node_count": (body or {}).get("tpu", {})
+                    .get("node_spec", {}).get("node_count", 1),
+                    "ready_node_count": 0,
+                    "polls": 0,
+                    "lag": self.consistency_lag_polls,
+                }
+                return 200, {"name": name}
+            if method == "GET":
+                res = self.resources.get(name)
+                if res is None:
+                    return 404, {}
+                if res["lag"] > 0:
+                    res["lag"] -= 1
+                    return 404, {}
+                res["polls"] += 1
+                if (
+                    res["state"] == "WAITING_FOR_RESOURCES"
+                    and res["polls"] > self.provision_after_polls
+                ):
+                    if self.fail_instead_of_active:
+                        res["state"] = "FAILED"
+                    else:
+                        res["state"] = "ACTIVE"
+                        res["ready_node_count"] = res["node_count"]
+                return 200, dict(res)
+            if method == "DELETE":
+                if self.delete_failures_remaining > 0:
+                    self.delete_failures_remaining -= 1
+                    return 503, {"error": "transient"}
+                return (200, {}) if self.resources.pop(name, None) else (404, {})
+        raise AssertionError(f"unexpected {method} {path}")
+
+
+def _config(min_workers=0, group_size=4):
+    return AutoscalingConfig(
+        node_types=[
+            NodeTypeConfig(
+                name="v5e-16",
+                resources={"TPU": 4.0, "CPU": 2.0},
+                labels={"ray.io/tpu-pod-type": "v5litepod-16"},
+                min_workers=min_workers,
+                max_workers=4,
+                group_size=group_size,
+            )
+        ],
+        idle_timeout_s=9999,
+        update_interval_s=0.01,
+    )
+
+
+def _provider(api, config=None, **kw):
+    sleeps = []
+    provider = GceTpuQueuedResourceProvider(
+        config or _config(),
+        api,
+        sleep=sleeps.append,
+        consistency_grace_s=30.0,
+        **kw,
+    )
+    return provider, sleeps
+
+
+def test_create_pending_then_active():
+    api = MockGceApi()
+    api.provision_after_polls = 2
+    provider, _ = _provider(api)
+    inst = provider.create_node("v5e-16")
+    assert inst.status == "PENDING"
+    # stays pending while the API still reports WAITING_FOR_RESOURCES
+    assert provider.non_terminated_nodes()[0].status == "PENDING"
+    assert provider.non_terminated_nodes()[0].status == "PENDING"
+    # third poll crosses provision_after_polls
+    assert provider.non_terminated_nodes()[0].status == "ACTIVE"
+
+
+def test_quota_backoff_then_success():
+    api = MockGceApi()
+    api.quota_failures_remaining = 2
+    provider, sleeps = _provider(api)
+    inst = provider.create_node("v5e-16")
+    assert inst is not None
+    # two 429s -> two exponential backoffs before the successful attempt
+    assert len(sleeps) == 2 and sleeps[1] == 2 * sleeps[0]
+
+
+def test_quota_exhaustion_raises():
+    api = MockGceApi()
+    api.quota_failures_remaining = 99
+    provider, sleeps = _provider(api, create_retries=3)
+    with pytest.raises(QuotaExceededError):
+        provider.create_node("v5e-16")
+    # backoff only BETWEEN attempts: 3 attempts -> 2 sleeps
+    assert len(sleeps) == 2
+
+
+def test_eventual_consistency_grace():
+    """A fresh resource 404s for a few polls; the provider must NOT drop it."""
+    api = MockGceApi()
+    api.consistency_lag_polls = 2
+    provider, _ = _provider(api)
+    provider.create_node("v5e-16")
+    assert len(provider.non_terminated_nodes()) == 1  # 404 #1: tolerated
+    assert len(provider.non_terminated_nodes()) == 1  # 404 #2: tolerated
+    assert provider.non_terminated_nodes()[0].status in ("PENDING", "ACTIVE")
+
+
+def test_vanished_after_first_sighting_is_dropped():
+    api = MockGceApi()
+    api.provision_after_polls = 100  # stays WAITING (PENDING here)
+    provider, _ = _provider(api)
+    inst = provider.create_node("v5e-16")
+    provider.non_terminated_nodes()  # first successful GET (first_seen)
+    with api.lock:
+        del api.resources[inst.instance_id]  # resource vanishes server-side
+    assert provider.non_terminated_nodes() == []
+
+
+def test_partial_slice_stays_pending():
+    """ACTIVE with ready_node_count < node_count is not usable yet."""
+    api = MockGceApi()
+    provider, _ = _provider(api)
+    inst = provider.create_node("v5e-16")
+    with api.lock:
+        api.resources[inst.instance_id].update(
+            state="ACTIVE", ready_node_count=2
+        )
+    assert provider.non_terminated_nodes()[0].status == "PENDING"
+    with api.lock:
+        api.resources[inst.instance_id]["ready_node_count"] = 4
+    assert provider.non_terminated_nodes()[0].status == "ACTIVE"
+
+
+def test_failed_provision_deletes_and_frees_slot():
+    api = MockGceApi()
+    api.fail_instead_of_active = True
+    provider, _ = _provider(api)
+    inst = provider.create_node("v5e-16")
+    assert provider.non_terminated_nodes() == []
+    with api.lock:
+        assert inst.instance_id not in api.resources  # DELETEd remotely
+
+
+def test_terminate_retries_transient_failures():
+    api = MockGceApi()
+    provider, sleeps = _provider(api)
+    inst = provider.create_node("v5e-16")
+    api.delete_failures_remaining = 2
+    provider.terminate_node(inst.instance_id)
+    assert len(sleeps) == 2
+    with api.lock:
+        assert inst.instance_id not in api.resources
+
+
+def test_preempted_active_slice_is_dropped():
+    api = MockGceApi()
+    provider, _ = _provider(api)
+    inst = provider.create_node("v5e-16")
+    assert provider.non_terminated_nodes()[0].status == "ACTIVE"
+    with api.lock:
+        api.resources[inst.instance_id]["state"] = "FAILED"
+    assert provider.check_preemptions() == [inst.instance_id]
+    assert provider.non_terminated_nodes() == []
+
+
+# -- reconciler chaos ---------------------------------------------------------
+
+
+def _stub_gcs_state():
+    """Cluster state with an unmet TPU demand, to make the scheduler want
+    one v5e-16 slice."""
+    return {
+        "nodes": [],
+        "pending_demands": [
+            {"resources": {"TPU": 4.0}, "label_selector": {}, "count": 1}
+        ],
+        "pending_placement_groups": [],
+    }
+
+
+def test_reconciler_relaunches_after_failed_provision():
+    """Chaos: the first slice FAILS mid-provision; the next reconcile tick
+    must notice the freed slot and relaunch."""
+    api = MockGceApi()
+    api.fail_instead_of_active = True
+    provider, _ = _provider(api)
+    reports = []
+    autoscaler = Autoscaler(
+        _config(), provider,
+        lambda method, *a: _stub_gcs_state()
+        if method == "get_cluster_resource_state" else reports.append(a),
+    )
+    r1 = autoscaler.update()
+    assert len(r1["launched"]) == 1
+    # tick 2: the poll discovers FAILED, deletes the resource, and with the
+    # slot free the still-unmet demand relaunches in the same tick
+    r2 = autoscaler.update()
+    assert len(r2["launched"]) == 1
+    # the replacement provisions cleanly once the API stops failing
+    api.fail_instead_of_active = False
+    nodes = provider.non_terminated_nodes()
+    assert len(nodes) == 1 and nodes[0].status == "ACTIVE"
+
+
+def test_reconciler_survives_provider_raising_mid_scale_up():
+    """Chaos: create_node raises (quota hard-exhausted) mid-reconcile —
+    the tick completes, reports the failure, and later ticks recover."""
+    api = MockGceApi()
+    api.quota_failures_remaining = 99
+    provider, _ = _provider(api, create_retries=2)
+    autoscaler = Autoscaler(
+        _config(), provider,
+        lambda method, *a: _stub_gcs_state()
+        if method == "get_cluster_resource_state" else None,
+    )
+    r1 = autoscaler.update()  # must not raise
+    assert r1["launched"] == []
+    api.quota_failures_remaining = 0
+    r2 = autoscaler.update()
+    assert len(r2["launched"]) == 1
+
+
+def test_reconciler_does_not_double_launch_while_pending():
+    """A PENDING (still provisioning) slice counts against demand — the
+    reconciler must not stack a second launch on the same unmet demand."""
+    api = MockGceApi()
+    api.provision_after_polls = 100  # never becomes ACTIVE in this test
+    provider, _ = _provider(api)
+    autoscaler = Autoscaler(
+        _config(), provider,
+        lambda method, *a: _stub_gcs_state()
+        if method == "get_cluster_resource_state" else None,
+    )
+    r1 = autoscaler.update()
+    assert len(r1["launched"]) == 1
+    r2 = autoscaler.update()
+    assert r2["launched"] == []
+    assert len(provider.non_terminated_nodes()) == 1
