@@ -17,9 +17,10 @@ that is part of a TPU slice advertises:
 
 from __future__ import annotations
 
+import abc
 import glob
 import os
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Type
 
 # label keys (reference: common/constants.h:131-142)
 TPU_SLICE_NAME_LABEL = "ray.io/tpu-slice-name"
@@ -90,8 +91,111 @@ def tpu_head_resource(pod_type: str) -> str:
     return f"TPU-{pod_type}-head"
 
 
-class TpuAcceleratorManager:
+class AcceleratorManager(abc.ABC):
+    """Accelerator plugin interface (reference: the AcceleratorManager ABC,
+    _private/accelerators/accelerator.py:18, behind which the reference
+    registers 8 accelerator families). A plugin answers: what resource name
+    do I contribute, how many units does THIS node have, which labels and
+    extra resources ride along, and how is a worker restricted to a subset.
+
+    Register implementations with ``register_accelerator_manager`` —
+    ``detect_node_accelerators()`` folds every registered plugin into the
+    node's resources/labels at startup, so heterogeneous clusters (CPU-only
+    rollout nodes next to TPU learner nodes) fall out of per-node detection
+    rather than hardcoding."""
+
+    @staticmethod
+    @abc.abstractmethod
+    def get_resource_name() -> str:
+        """e.g. "TPU" / "GPU"."""
+
+    @staticmethod
+    @abc.abstractmethod
+    def get_current_node_num_accelerators() -> int:
+        """Units detected on this node (0 = plugin contributes nothing)."""
+
+    @staticmethod
+    def get_current_node_labels() -> Dict[str, str]:
+        return {}
+
+    @staticmethod
+    def get_current_node_additional_resources() -> Dict[str, float]:
+        """Extra resources beyond <name>: count (e.g. the slice-head
+        reservation resource)."""
+        return {}
+
+    @staticmethod
+    def get_visibility_env(instance_ids) -> Dict[str, str]:
+        """Env vars restricting a worker process to specific units."""
+        return {}
+
+
+_ACCELERATOR_MANAGERS: List[Type[AcceleratorManager]] = []
+
+
+def register_accelerator_manager(cls: Type[AcceleratorManager]) -> Type:
+    if cls not in _ACCELERATOR_MANAGERS:
+        _ACCELERATOR_MANAGERS.append(cls)
+    return cls
+
+
+def all_accelerator_managers() -> List[Type[AcceleratorManager]]:
+    return list(_ACCELERATOR_MANAGERS)
+
+
+def detect_node_accelerators(
+    exclude: Optional[set] = None,
+) -> Tuple[Dict[str, float], Dict[str, str]]:
+    """Fold every registered plugin into (resources, labels) for this node.
+    ``exclude`` suppresses plugins by resource name ENTIRELY — count,
+    additional resources, and labels: a user who passed num_tpus=0 opted
+    out of being a TPU node; leaking the slice-head resource/labels anyway
+    would make reserve_tpu_slice pick a chipless head."""
+    resources: Dict[str, float] = {}
+    labels: Dict[str, str] = {}
+    for manager in _ACCELERATOR_MANAGERS:
+        name = manager.get_resource_name()
+        if exclude and name in exclude:
+            continue
+        try:
+            count = manager.get_current_node_num_accelerators()
+        except Exception:
+            count = 0
+        if count <= 0:
+            continue
+        resources[name] = float(count)
+        resources.update(manager.get_current_node_additional_resources())
+        labels.update(manager.get_current_node_labels())
+    return resources, labels
+
+
+@register_accelerator_manager
+class TpuAcceleratorManager(AcceleratorManager):
     """Detection for the current node."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        return "TPU"
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        return TpuAcceleratorManager.detect_num_chips()
+
+    @staticmethod
+    def get_current_node_labels() -> Dict[str, str]:
+        return TpuAcceleratorManager.current_node_identity()
+
+    @staticmethod
+    def get_current_node_additional_resources() -> Dict[str, float]:
+        labels = TpuAcceleratorManager.current_node_identity()
+        pod_type = labels.get(TPU_POD_TYPE_LABEL)
+        if pod_type and labels.get(TPU_WORKER_ID_LABEL, "0") == "0":
+            return {tpu_head_resource(pod_type): 1.0}
+        return {}
+
+    @staticmethod
+    def get_visibility_env(instance_ids) -> Dict[str, str]:
+        return set_visible_chips(instance_ids)
 
     @staticmethod
     def detect_num_chips() -> int:
@@ -102,7 +206,11 @@ class TpuAcceleratorManager:
             for part in env.split(","):
                 total *= int(part)
             return total
-        chips = len(glob.glob("/dev/accel*")) or len(glob.glob("/dev/vfio/*"))
+        # numbered vfio devices only: /dev/vfio/vfio is the always-present
+        # control node, not a chip
+        chips = len(glob.glob("/dev/accel*")) or len(
+            glob.glob("/dev/vfio/[0-9]*")
+        )
         return chips
 
     @staticmethod
@@ -128,17 +236,6 @@ class TpuAcceleratorManager:
             labels[TPU_TOPOLOGY_LABEL] = topology
         return labels
 
-    @staticmethod
-    def node_resources_and_labels() -> Tuple[Dict[str, float], Dict[str, str]]:
-        chips = TpuAcceleratorManager.detect_num_chips()
-        resources: Dict[str, float] = {}
-        labels = TpuAcceleratorManager.current_node_identity()
-        if chips:
-            resources["TPU"] = float(chips)
-            pod_type = labels.get(TPU_POD_TYPE_LABEL)
-            if pod_type and labels.get(TPU_WORKER_ID_LABEL, "0") == "0":
-                resources[tpu_head_resource(pod_type)] = 1.0
-        return resources, labels
 
 
 def set_visible_chips(instance_ids) -> Dict[str, str]:
@@ -149,3 +246,34 @@ def set_visible_chips(instance_ids) -> Dict[str, str]:
         "TPU_VISIBLE_CHIPS": ids,
         "TPU_CHIPS_PER_PROCESS_BOUNDS": f"1,{max(len(instance_ids), 1)},1",
     }
+
+
+@register_accelerator_manager
+class GpuAcceleratorManager(AcceleratorManager):
+    """GPU count plugin (reference: nvidia_gpu.py behind the same ABC):
+    CUDA_VISIBLE_DEVICES wins when set, else /proc/driver/nvidia/gpus.
+    Deliberately count-only — this framework's compute path is TPU; the
+    plugin exists so heterogeneous clusters (GPU rollout nodes, CPU-only
+    nodes, TPU learners) model every node's resources correctly."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        return "GPU"
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        env = os.environ.get("CUDA_VISIBLE_DEVICES")
+        if env is not None:
+            # "-1" is the standard disable-GPUs convention; count only
+            # non-negative device tokens
+            return len([
+                d for d in env.split(",")
+                if d.strip() and not d.strip().startswith("-")
+            ])
+        return len(glob.glob("/proc/driver/nvidia/gpus/*"))
+
+    @staticmethod
+    def get_visibility_env(instance_ids) -> Dict[str, str]:
+        return {
+            "CUDA_VISIBLE_DEVICES": ",".join(str(i) for i in instance_ids)
+        }

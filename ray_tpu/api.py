@@ -96,10 +96,18 @@ def init(
             res["CPU"] = float(num_cpus)
         if num_tpus is not None:
             res["TPU"] = float(num_tpus)
-        else:
-            detected = _detect_tpu_chips()
-            if detected and "TPU" not in res:
-                res["TPU"] = float(detected)
+        # accelerator plugin detection (reference: the AcceleratorManager
+        # registry folding every family's detection into node resources,
+        # _private/accelerators/accelerator.py:18). An explicitly provided
+        # resource disables that plugin wholesale — num_tpus=0 means "not a
+        # TPU node", including the head resource and slice labels.
+        from ._internal.accelerators import detect_node_accelerators
+
+        detected_res, detected_labels = detect_node_accelerators(
+            exclude=set(res)
+        )
+        res.update(detected_res)
+        labels = {**detected_labels, **(labels or {})}
         node = Node(
             config,
             head=True,
@@ -132,14 +140,6 @@ def init(
     _worker_api.set_core_worker(worker, config, loop_thread=loop_thread, node=node)
     atexit.register(_atexit_shutdown)
     return node
-
-
-def _detect_tpu_chips() -> int:
-    """TPU autodetection hook (reference: TPUAcceleratorManager.
-    get_current_node_num_accelerators, _private/accelerators/tpu.py)."""
-    import glob
-
-    return len(glob.glob("/dev/accel*")) or 0
 
 
 def _find_raylet(loop_thread, gcs_address):

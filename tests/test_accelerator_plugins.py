@@ -1,0 +1,144 @@
+"""Accelerator plugin ABC + heterogeneous clusters (reference:
+_private/accelerators/accelerator.py:18 — one interface, many families)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu._internal import accelerators as acc
+
+
+def test_registry_contains_tpu_and_gpu():
+    names = {m.get_resource_name() for m in acc.all_accelerator_managers()}
+    assert {"TPU", "GPU"} <= names
+
+
+def test_detection_folds_registered_plugins(monkeypatch):
+    """A registered plugin's count/labels/extra resources land in the node
+    detection result; zero-count plugins contribute nothing."""
+
+    class FakeNpu(acc.AcceleratorManager):
+        @staticmethod
+        def get_resource_name():
+            return "NPU"
+
+        @staticmethod
+        def get_current_node_num_accelerators():
+            return 3
+
+        @staticmethod
+        def get_current_node_labels():
+            return {"ray.io/npu-flavor": "test"}
+
+        @staticmethod
+        def get_current_node_additional_resources():
+            return {"NPU-head": 1.0}
+
+    acc.register_accelerator_manager(FakeNpu)
+    try:
+        monkeypatch.setattr(
+            acc.TpuAcceleratorManager, "detect_num_chips", staticmethod(lambda: 0)
+        )
+        monkeypatch.setattr(
+            acc.GpuAcceleratorManager,
+            "get_current_node_num_accelerators",
+            staticmethod(lambda: 0),
+        )
+        resources, labels = acc.detect_node_accelerators()
+        assert resources == {"NPU": 3.0, "NPU-head": 1.0}
+        assert labels == {"ray.io/npu-flavor": "test"}
+    finally:
+        acc._ACCELERATOR_MANAGERS.remove(FakeNpu)
+
+
+def test_gpu_plugin_visibility_env_and_cuda_devices(monkeypatch):
+    monkeypatch.setenv("CUDA_VISIBLE_DEVICES", "0,1,2")
+    assert acc.GpuAcceleratorManager.get_current_node_num_accelerators() == 3
+    env = acc.GpuAcceleratorManager.get_visibility_env([1, 2])
+    assert env == {"CUDA_VISIBLE_DEVICES": "1,2"}
+    monkeypatch.setenv("CUDA_VISIBLE_DEVICES", "")
+    assert acc.GpuAcceleratorManager.get_current_node_num_accelerators() == 0
+
+
+def test_tpu_plugin_labels_and_head_resource(monkeypatch):
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+    monkeypatch.setenv("TPU_NAME", "my-slice")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-8")
+    resources = acc.TpuAcceleratorManager.get_current_node_additional_resources()
+    assert resources == {"TPU-v4-8-head": 1.0}
+    labels = acc.TpuAcceleratorManager.get_current_node_labels()
+    assert labels[acc.TPU_SLICE_NAME_LABEL] == "my-slice"
+    assert acc.TpuAcceleratorManager.get_current_node_num_accelerators() == 4
+    # worker 1 carries no head resource
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    assert acc.TpuAcceleratorManager.get_current_node_additional_resources() == {}
+
+
+def test_heterogeneous_cpu_rollout_tpu_learner_cluster():
+    """The framework's own RL story: CPU-only rollout nodes next to a TPU
+    learner node in ONE cluster, each actor landing on the right node kind
+    with correct per-node resources."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(
+        initialize_head=True,
+        head_node_args={"num_cpus": 1, "resources": {"CPU": 1.0}},
+    )
+    cluster.add_node(resources={"CPU": 2.0})  # rollout node A
+    cluster.add_node(resources={"CPU": 2.0})  # rollout node B
+    cluster.add_node(  # TPU learner node
+        resources={"CPU": 1.0, "TPU": 4.0},
+        labels={"ray.io/tpu-pod-type": "v5e-4"},
+    )
+    cluster.connect()
+    try:
+        nodes = ray_tpu.nodes()
+        tpu_nodes = [n for n in nodes if n["Resources"].get("TPU")]
+        cpu_only = [
+            n for n in nodes
+            if not n["Resources"].get("TPU") and not n["IsHead"]
+        ]
+        assert len(tpu_nodes) == 1 and len(cpu_only) == 2
+        assert tpu_nodes[0]["Labels"]["ray.io/tpu-pod-type"] == "v5e-4"
+
+        @ray_tpu.remote(num_cpus=2)
+        class Rollout:
+            def where(self):
+                import ray_tpu as rt
+
+                return rt.get_runtime_context().get_node_id()
+
+        @ray_tpu.remote(num_tpus=4)
+        class Learner:
+            def where(self):
+                import ray_tpu as rt
+
+                return rt.get_runtime_context().get_node_id()
+
+        rollouts = [Rollout.remote() for _ in range(2)]
+        learner = Learner.remote()
+        rollout_nodes = set(
+            ray_tpu.get([r.where.remote() for r in rollouts], timeout=120)
+        )
+        learner_node = ray_tpu.get(learner.where.remote(), timeout=120)
+        # the learner landed on THE TPU node; rollouts on the CPU nodes
+        assert learner_node == tpu_nodes[0]["NodeID"]
+        assert learner_node not in rollout_nodes
+        assert len(rollout_nodes) == 2  # one per 2-CPU node
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_explicit_tpu_opt_out_suppresses_plugin_extras(monkeypatch):
+    """num_tpus=0 on a TPU VM: the node must not leak the slice-head
+    resource or slice labels (reserve_tpu_slice would otherwise pick a
+    chipless head)."""
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+    monkeypatch.setenv("TPU_NAME", "optout-slice")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-8")
+    resources, labels = acc.detect_node_accelerators(exclude={"TPU"})
+    assert "TPU" not in resources
+    assert not any(k.endswith("-head") for k in resources)
+    assert acc.TPU_SLICE_NAME_LABEL not in labels
