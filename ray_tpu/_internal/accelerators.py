@@ -157,15 +157,18 @@ def detect_node_accelerators(
         name = manager.get_resource_name()
         if exclude and name in exclude:
             continue
+        # the whole plugin is fault-isolated: a misbehaving third-party
+        # detection (count, extras, OR labels) must not abort init()
         try:
             count = manager.get_current_node_num_accelerators()
+            if count <= 0:
+                continue
+            resources[name] = float(count)
+            resources.update(manager.get_current_node_additional_resources())
+            labels.update(manager.get_current_node_labels())
         except Exception:
-            count = 0
-        if count <= 0:
+            resources.pop(name, None)
             continue
-        resources[name] = float(count)
-        resources.update(manager.get_current_node_additional_resources())
-        labels.update(manager.get_current_node_labels())
     return resources, labels
 
 
@@ -274,6 +277,20 @@ class GpuAcceleratorManager(AcceleratorManager):
 
     @staticmethod
     def get_visibility_env(instance_ids) -> Dict[str, str]:
-        return {
-            "CUDA_VISIBLE_DEVICES": ",".join(str(i) for i in instance_ids)
-        }
+        # logical instance ids remap through a pre-existing parent mask:
+        # with CUDA_VISIBLE_DEVICES="2,3" the node's logical GPUs 0,1 ARE
+        # physical 2,3 — emitting raw logical ids would grant devices the
+        # parent explicitly excluded
+        parent = os.environ.get("CUDA_VISIBLE_DEVICES")
+        if parent:
+            physical = [
+                d.strip() for d in parent.split(",")
+                if d.strip() and not d.strip().startswith("-")
+            ]
+            mapped = [
+                physical[int(i)] if int(i) < len(physical) else str(i)
+                for i in instance_ids
+            ]
+        else:
+            mapped = [str(i) for i in instance_ids]
+        return {"CUDA_VISIBLE_DEVICES": ",".join(mapped)}

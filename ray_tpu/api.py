@@ -98,15 +98,17 @@ def init(
             res["TPU"] = float(num_tpus)
         # accelerator plugin detection (reference: the AcceleratorManager
         # registry folding every family's detection into node resources,
-        # _private/accelerators/accelerator.py:18). An explicitly provided
-        # resource disables that plugin wholesale — num_tpus=0 means "not a
-        # TPU node", including the head resource and slice labels.
+        # _private/accelerators/accelerator.py:18). An explicit ZERO opts
+        # out of that plugin wholesale — num_tpus=0 means "not a TPU node",
+        # including the head resource and slice labels; an explicit nonzero
+        # count overrides only the count and keeps the extras/labels.
         from ._internal.accelerators import detect_node_accelerators
 
         detected_res, detected_labels = detect_node_accelerators(
-            exclude=set(res)
+            exclude={k for k, v in res.items() if v == 0}
         )
-        res.update(detected_res)
+        for key, value in detected_res.items():
+            res.setdefault(key, value)
         labels = {**detected_labels, **(labels or {})}
         node = Node(
             config,

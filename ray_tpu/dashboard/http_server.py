@@ -181,6 +181,10 @@ class DashboardServer:
             ),
             ("GET", "/api/jobs"): lambda b: (200, jm.list(), None),
             ("POST", "/api/jobs"): self._submit_job,
+            # chrome-trace task timeline from the GCS task-event store
+            # (role of `ray timeline` + the React timeline view)
+            ("GET", "/api/timeline"): self._timeline,
+            ("GET", "/api/timeline/full"): self._timeline_full,
             ("GET", "/metrics"): self._metrics,
             # browser UI (role of the React frontend, dashboard/client/ —
             # here a dependency-free single page over the same REST API)
@@ -199,6 +203,17 @@ class DashboardServer:
             metadata=body.get("metadata"),
         )
         return 200, {"submission_id": submission_id}, None
+
+    def _timeline(self, body, limit: int = 250):
+        """UI refresh payload: recent events only — the browser renders the
+        last 80 bars; /api/timeline/full is the whole-trace download."""
+        from ..util.tracing import build_chrome_trace
+
+        events = self._gcs("list_task_events", None, limit)
+        return 200, {"traceEvents": build_chrome_trace(events)}, None
+
+    def _timeline_full(self, body):
+        return self._timeline(body, limit=100000)
 
     def _metrics(self, body):
         from ..util.metrics import prometheus_text
@@ -221,31 +236,113 @@ _INDEX_HTML = """<!doctype html>
   .ok { background: #d7f5dd; } .bad { background: #fde0e0; }
   #err { color: #b00; }
   code { background: #f5f5f5; padding: .1rem .3rem; }
+  .spark { display: inline-flex; align-items: center; gap: .6rem; }
+  .spark b { display: inline-block; width: 7rem; font-weight: 500; }
+  .sparksvg { background: #fafafa; border: 1px solid #eee; }
+  .tl { position: relative; background: #fafafa; border: 1px solid #eee;
+        margin-left: 6.5rem; }
+  .bar { position: absolute; height: 18px; background: #4a7; opacity: .8;
+         border-radius: 2px; min-width: 2px; }
+  .lane { position: absolute; left: -6.5rem; width: 6rem; font-size: .7rem;
+          color: #666; overflow: hidden; white-space: nowrap; }
 </style>
 </head>
 <body>
 <h1>ray_tpu dashboard</h1>
 <div id="err"></div>
 <h2>Cluster resources</h2><div id="resources">loading…</div>
+<h2>Utilization</h2><div id="sparklines"></div>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
+<h2>Placement groups</h2><table id="pgs"></table>
 <h2>Jobs</h2><table id="jobs"></table>
+<h2>Task timeline</h2><div id="timeline"></div>
 <h2>Recent tasks</h2><table id="tasks"></table>
 <script>
 async function j(p) { const r = await fetch(p); return r.json(); }
 function esc(v) {  // user-controlled strings (entrypoints, names) must not reach innerHTML raw
-  const d = document.createElement("div"); d.textContent = String(v ?? ""); return d.innerHTML;
+  const d = document.createElement("div"); d.textContent = String(v ?? "");
+  // textContent->innerHTML escapes &<> but NOT quotes; esc() output is also
+  // interpolated into attribute values (bar titles), so quotes must die too
+  return d.innerHTML.replace(/"/g, "&quot;").replace(/'/g, "&#39;");
 }
 function fill(id, rows, cols) {
   const t = document.getElementById(id);
   t.innerHTML = "<tr>" + cols.map(c => "<th>" + esc(c) + "</th>").join("") + "</tr>" +
     rows.map(r => "<tr>" + cols.map(c => "<td>" + esc(r[c]) + "</td>").join("") + "</tr>").join("");
 }
+// rolling per-series samples for the sparklines (client-side history —
+// the REST API is stateless; 60 samples at the 3s refresh = 3 minutes)
+const history = {};
+function sample(name, value) {
+  (history[name] = history[name] || []).push(value);
+  if (history[name].length > 60) history[name].shift();
+}
+function sparkline(name, values, suffix) {
+  const w = 180, h = 36, pad = 2;
+  const max = Math.max(...values, 1e-9), min = Math.min(...values, 0);
+  const span = (max - min) || 1;
+  const pts = values.map((v, i) => {
+    const x = pad + (w - 2 * pad) * (values.length === 1 ? 1 : i / (values.length - 1));
+    const y = h - pad - (h - 2 * pad) * ((v - min) / span);
+    return x.toFixed(1) + "," + y.toFixed(1);
+  }).join(" ");
+  const last = values[values.length - 1];
+  return '<span class="spark"><b>' + esc(name) + '</b> ' +
+    '<svg width="' + w + '" height="' + h + '" class="sparksvg">' +
+    '<polyline fill="none" stroke="#4a7" stroke-width="1.5" points="' + pts + '"/></svg> ' +
+    '<code>' + esc(Number(last).toFixed(1) + (suffix || "")) + '</code></span>';
+}
+const totals = {};  // series name -> denominator shown after the last value
+function renderSparklines(status) {
+  const nodes = (status.resource_state || {}).nodes || [];
+  let cpuUsed = 0, cpuTotal = 0, tpuUsed = 0, tpuTotal = 0;
+  for (const n of nodes) {
+    if (!n.alive) continue;
+    const t = n.resources_total || {}, a = n.available || {};
+    cpuTotal += t.CPU || 0; cpuUsed += (t.CPU || 0) - (a.CPU ?? t.CPU ?? 0);
+    tpuTotal += t.TPU || 0; tpuUsed += (t.TPU || 0) - (a.TPU ?? t.TPU ?? 0);
+  }
+  sample("CPU in use", cpuUsed); totals["CPU in use"] = " / " + cpuTotal;
+  sample("TPU in use", tpuUsed); totals["TPU in use"] = " / " + tpuTotal;
+  sample("alive nodes", nodes.filter(n => n.alive).length);
+  document.getElementById("sparklines").innerHTML = Object.entries(history)
+    .map(([name, values]) => sparkline(name, values, totals[name])).join("<br>");
+}
+function renderTimeline(trace) {
+  const events = (trace.traceEvents || []).slice(-80);
+  if (!events.length) {
+    document.getElementById("timeline").innerHTML = "<i>no finished tasks yet</i>";
+    return;
+  }
+  const t0 = Math.min(...events.map(e => e.ts));
+  const t1 = Math.max(...events.map(e => e.ts + e.dur));
+  const span = Math.max(t1 - t0, 1);
+  const lanes = {};  // pid (node) -> lane index
+  for (const e of events) if (!(e.pid in lanes)) lanes[e.pid] = Object.keys(lanes).length;
+  const rows = events.map(e => {
+    const left = 100 * (e.ts - t0) / span, width = Math.max(100 * e.dur / span, 0.4);
+    const top = lanes[e.pid] * 22;
+    const label = e.name + " (" + (e.dur / 1e3).toFixed(1) + "ms)";
+    return '<div class="bar" title="' + esc(label) + '" style="left:' + left +
+      '%;width:' + width + '%;top:' + top + 'px"></div>';
+  }).join("");
+  const laneLabels = Object.entries(lanes).map(([pid, i]) =>
+    '<div class="lane" style="top:' + (i * 22) + 'px">' + esc(String(pid).slice(0, 10)) + '</div>'
+  ).join("");
+  const height = Object.keys(lanes).length * 22 + 4;
+  document.getElementById("timeline").innerHTML =
+    '<div class="tl" style="height:' + height + 'px">' + laneLabels + rows + '</div>' +
+    '<small>' + events.length + ' most recent task executions, one lane per node; ' +
+    'full chrome trace at <code>/api/timeline</code></small>';
+}
 async function refresh() {
   try {
     const res = await j("/api/cluster_resources");
     document.getElementById("resources").innerHTML =
       "<code>" + esc(JSON.stringify(res)) + "</code>";
+    const status = await j("/api/cluster_status");
+    renderSparklines(status);
     const nodes = await j("/api/nodes");
     fill("nodes", nodes.map(n => ({
       id: (n.node_id || "").slice(0, 12),
@@ -260,11 +357,19 @@ async function refresh() {
       name: a.name || "", state: a.state || "",
       restarts: a.num_restarts ?? 0,
     })), ["id", "name", "state", "restarts"]);
+    const pgs = await j("/api/placement_groups");
+    fill("pgs", pgs.map(g => ({
+      id: (g.placement_group_id || "").slice(0, 12),
+      name: g.name || "", strategy: g.strategy || "",
+      state: g.state || "",
+      bundles: (g.bundles || []).length,
+    })), ["id", "name", "strategy", "state", "bundles"]);
     const jobs = await j("/api/jobs");
     fill("jobs", jobs.map(x => ({
       id: x.submission_id || x.job_id, status: x.status,
       entrypoint: x.entrypoint,
     })), ["id", "status", "entrypoint"]);
+    renderTimeline(await j("/api/timeline"));
     const tasks = await j("/api/tasks");
     fill("tasks", tasks.slice(-50).reverse().map(t => ({
       task: (t.task_id || "").slice(0, 12), name: t.name || "",

@@ -78,19 +78,9 @@ def export_spans(filename: str):
         json.dump({"traceEvents": get_spans()}, f)
 
 
-def timeline(filename: Optional[str] = None) -> List[dict]:
-    """Cluster-wide task timeline as chrome-trace events, reconstructed
-    from the GCS task-event store (reference: `ray timeline` building a
-    chrome trace from profile events). Returns the events; also writes
-    ``filename`` if given."""
-    from .. import _worker_api
-
-    worker = _worker_api.get_core_worker()
-    events = _worker_api.run_on_worker_loop(
-        worker.client_pool.get(*worker.gcs_address).call(
-            "list_task_events", None, 100000
-        )
-    )
+def build_chrome_trace(events: List[dict]) -> List[dict]:
+    """GCS task-event records -> chrome-trace complete ("X") events.
+    Shared by ``timeline()`` and the dashboard's /api/timeline."""
     trace: List[dict] = []
     for ev in events:
         start = ev.get("ts_running")
@@ -113,6 +103,23 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
                 },
             }
         )
+    return trace
+
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Cluster-wide task timeline as chrome-trace events, reconstructed
+    from the GCS task-event store (reference: `ray timeline` building a
+    chrome trace from profile events). Returns the events; also writes
+    ``filename`` if given."""
+    from .. import _worker_api
+
+    worker = _worker_api.get_core_worker()
+    events = _worker_api.run_on_worker_loop(
+        worker.client_pool.get(*worker.gcs_address).call(
+            "list_task_events", None, 100000
+        )
+    )
+    trace = build_chrome_trace(events)
     # driver-side spans join the same trace
     trace.extend(get_spans())
     if filename:

@@ -142,3 +142,47 @@ def test_explicit_tpu_opt_out_suppresses_plugin_extras(monkeypatch):
     assert "TPU" not in resources
     assert not any(k.endswith("-head") for k in resources)
     assert acc.TPU_SLICE_NAME_LABEL not in labels
+
+
+def test_gpu_visibility_remaps_through_parent_mask(monkeypatch):
+    """Logical ids must map through an existing CUDA_VISIBLE_DEVICES mask."""
+    monkeypatch.setenv("CUDA_VISIBLE_DEVICES", "2,3")
+    env = acc.GpuAcceleratorManager.get_visibility_env([0, 1])
+    assert env == {"CUDA_VISIBLE_DEVICES": "2,3"}
+    env = acc.GpuAcceleratorManager.get_visibility_env([1])
+    assert env == {"CUDA_VISIBLE_DEVICES": "3"}
+    monkeypatch.delenv("CUDA_VISIBLE_DEVICES")
+    assert acc.GpuAcceleratorManager.get_visibility_env([0, 1]) == {
+        "CUDA_VISIBLE_DEVICES": "0,1"
+    }
+
+
+def test_throwing_plugin_is_fault_isolated(monkeypatch):
+    class Broken(acc.AcceleratorManager):
+        @staticmethod
+        def get_resource_name():
+            return "BROKEN"
+
+        @staticmethod
+        def get_current_node_num_accelerators():
+            return 1
+
+        @staticmethod
+        def get_current_node_labels():
+            raise RuntimeError("metadata server down")
+
+    acc.register_accelerator_manager(Broken)
+    try:
+        monkeypatch.setattr(
+            acc.TpuAcceleratorManager, "detect_num_chips", staticmethod(lambda: 0)
+        )
+        monkeypatch.setattr(
+            acc.GpuAcceleratorManager,
+            "get_current_node_num_accelerators",
+            staticmethod(lambda: 0),
+        )
+        resources, labels = acc.detect_node_accelerators()
+        assert "BROKEN" not in resources  # partial contribution rolled back
+        assert labels == {}
+    finally:
+        acc._ACCELERATOR_MANAGERS.remove(Broken)

@@ -118,3 +118,36 @@ def test_index_page_served(dashboard_cluster):
         assert resp.headers["Content-Type"].startswith("text/html")
     assert "ray_tpu dashboard" in body
     assert "/api/cluster_resources" in body
+
+
+def test_timeline_endpoint_and_ui_panels(dashboard_cluster):
+    """/api/timeline serves chrome-trace events for executed tasks, and the
+    HTML UI carries the timeline/sparkline/placement-group panels
+    (scope-reduced role of the React timeline + metrics views)."""
+    dash = dashboard_cluster
+
+    @ray_tpu.remote
+    def traced(x):
+        time.sleep(0.05)
+        return x
+
+    assert ray_tpu.get(traced.remote(5), timeout=60) == 5
+    # task events flush to the GCS about once a second
+    deadline = time.time() + 20
+    events = []
+    while time.time() < deadline:
+        events = _get_json(dash.url + "/api/timeline")["traceEvents"]
+        if any(e["name"] == "traced" for e in events):
+            break
+        time.sleep(0.5)
+    mine = [e for e in events if e["name"] == "traced"]
+    assert mine, events[:3]
+    ev = mine[0]
+    assert ev["ph"] == "X" and ev["dur"] >= 0.05 * 1e6 * 0.5
+    assert ev["args"]["state"] in ("FINISHED", "RUNNING")
+
+    with urllib.request.urlopen(dash.url + "/", timeout=10) as resp:
+        html = resp.read().decode()
+    for anchor in ('id="timeline"', 'id="sparklines"', 'id="pgs"',
+                   "/api/timeline", "renderSparklines"):
+        assert anchor in html, anchor
