@@ -163,12 +163,16 @@ def detect_node_accelerators(
             count = manager.get_current_node_num_accelerators()
             if count <= 0:
                 continue
-            resources[name] = float(count)
-            resources.update(manager.get_current_node_additional_resources())
-            labels.update(manager.get_current_node_labels())
+            # stage all three contributions; merge only once the whole
+            # plugin succeeded (a label fetch failing after the head
+            # resource merged would otherwise leave a chipless slice head)
+            extra = dict(manager.get_current_node_additional_resources())
+            plugin_labels = dict(manager.get_current_node_labels())
         except Exception:
-            resources.pop(name, None)
             continue
+        resources[name] = float(count)
+        resources.update(extra)
+        labels.update(plugin_labels)
     return resources, labels
 
 
@@ -287,9 +291,12 @@ class GpuAcceleratorManager(AcceleratorManager):
                 d.strip() for d in parent.split(",")
                 if d.strip() and not d.strip().startswith("-")
             ]
+            # an id past the parent mask is an upstream scheduling bug;
+            # drop it rather than widen the mask to a device the parent
+            # explicitly excluded
             mapped = [
-                physical[int(i)] if int(i) < len(physical) else str(i)
-                for i in instance_ids
+                physical[int(i)] for i in instance_ids
+                if int(i) < len(physical)
             ]
         else:
             mapped = [str(i) for i in instance_ids]

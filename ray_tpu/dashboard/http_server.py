@@ -310,7 +310,9 @@ function renderSparklines(status) {
     .map(([name, values]) => sparkline(name, values, totals[name])).join("<br>");
 }
 function renderTimeline(trace) {
-  const events = (trace.traceEvents || []).slice(-80);
+  // /api/timeline lists newest-first: the head of the array is the most
+  // recent 80 task executions
+  const events = (trace.traceEvents || []).slice(0, 80);
   if (!events.length) {
     document.getElementById("timeline").innerHTML = "<i>no finished tasks yet</i>";
     return;
@@ -371,7 +373,7 @@ async function refresh() {
     })), ["id", "status", "entrypoint"]);
     renderTimeline(await j("/api/timeline"));
     const tasks = await j("/api/tasks");
-    fill("tasks", tasks.slice(-50).reverse().map(t => ({
+    fill("tasks", tasks.slice(0, 50).map(t => ({
       task: (t.task_id || "").slice(0, 12), name: t.name || "",
       state: t.state || "", type: t.type || "",
     })), ["task", "name", "state", "type"]);

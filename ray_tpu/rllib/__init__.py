@@ -18,6 +18,7 @@ from .connectors import (
     NormalizeObservations,
 )
 from .dqn import DQN, DQNConfig, ReplayBuffer
+from .dreamer import DreamerV3, DreamerV3Config
 from .env import VectorEnv, make_env
 from .env_runner import EnvRunner
 from .impala import APPOConfig, IMPALA, IMPALAConfig
@@ -49,6 +50,8 @@ __all__ = [
     "CQLConfig",
     "IQL",
     "IQLConfig",
+    "DreamerV3",
+    "DreamerV3Config",
     "ReplayBuffer",
     "as_trainable",
     "PPOLearner",

@@ -36,15 +36,19 @@ class VectorEnv:
         truncated envs are reset and their next obs replaces the terminal
         one (the terminal obs is not needed by PPO's bootstrap because
         value targets cut at dones)."""
-        obs, rewards, terms, truncs = [], [], [], []
+        obs, rewards, terms, truncs, raw = [], [], [], [], []
         for env, a in zip(self.envs, actions):
             o, r, term, trunc, _ = env.step(a)
+            raw.append(o)  # pre-reset: the TRUE arrival obs, terminal or not
             if term or trunc:
                 o, _ = env.reset()
             obs.append(o)
             rewards.append(r)
             terms.append(term)
             truncs.append(trunc)
+        # model-based learners (DreamerV3's continue head) need the terminal
+        # observation that auto-reset otherwise discards
+        self.last_raw_obs = np.stack(raw)
         return (
             np.stack(obs),
             np.asarray(rewards, np.float32),
