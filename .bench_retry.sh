@@ -1,0 +1,21 @@
+#!/bin/bash
+# Retry bench.py until the axon tunnel is back; append the first successful
+# measurement to /tmp/bench_success.json and exit.
+cd /root/repo
+for i in $(seq 1 40); do
+  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[retry $i] tunnel alive, running bench" >&2
+    out=$(timeout 1500 python bench.py 2>/tmp/bench_retry_stderr.log)
+    echo "$out"
+    val=$(echo "$out" | python -c "import json,sys; print(json.loads(sys.stdin.readline())['value'])" 2>/dev/null)
+    if [ -n "$val" ] && [ "$val" != "0.0" ]; then
+      echo "$out" > /tmp/bench_success.json
+      exit 0
+    fi
+    echo "[retry $i] bench returned zero/failed" >&2
+  else
+    echo "[retry $i] tunnel down" >&2
+  fi
+  sleep 300
+done
+exit 1

@@ -16,11 +16,13 @@ from .dataset import (
     from_pandas,
     range,
     range_tensor,
+    read_binary_files,
     read_csv,
     read_datasource,
     read_json,
     read_numpy,
     read_parquet,
+    read_text,
 )
 from .datasource import Datasource, ReadTask
 from .executor import ActorPoolStrategy, DataContext
@@ -52,5 +54,7 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_text",
+    "read_binary_files",
     "read_datasource",
 ]

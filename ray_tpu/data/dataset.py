@@ -507,6 +507,26 @@ def read_parquet(paths, *, columns=None, parallelism: int = -1) -> Dataset:
     )
 
 
+def read_text(paths, *, encoding: str = "utf-8",
+              drop_empty_lines: bool = True,
+              parallelism: int = -1) -> Dataset:
+    from .datasource import TextDatasource
+
+    return read_datasource(
+        TextDatasource(paths, encoding, drop_empty_lines),
+        parallelism=parallelism,
+    )
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      parallelism: int = -1) -> Dataset:
+    from .datasource import BinaryDatasource
+
+    return read_datasource(
+        BinaryDatasource(paths, include_paths), parallelism=parallelism
+    )
+
+
 # -- write helpers -----------------------------------------------------------
 
 

@@ -212,6 +212,44 @@ class NumpyDatasource(FileDatasource):
         return [{"data": arr}]
 
 
+class TextDatasource(FileDatasource):
+    """read_text (reference: data/read_api.py read_text): one row per line,
+    column "text"; blank trailing newline handling matches the reference
+    (drop_empty_lines)."""
+
+    def __init__(self, paths, encoding: str = "utf-8",
+                 drop_empty_lines: bool = True):
+        super().__init__(paths)
+        self._encoding = encoding
+        self._drop_empty = drop_empty_lines
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        with open(path, encoding=self._encoding) as f:
+            lines = [line.rstrip("\r\n") for line in f]
+        if self._drop_empty:
+            lines = [ln for ln in lines if ln]
+        if not lines:
+            return []
+        return [{"text": np.asarray(lines, dtype=object)}]
+
+
+class BinaryDatasource(FileDatasource):
+    """read_binary_files (reference: data/read_api.py read_binary_files):
+    one row per file with column "bytes" (+ "path" when requested)."""
+
+    def __init__(self, paths, include_paths: bool = False):
+        super().__init__(paths)
+        self._include_paths = include_paths
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        with open(path, "rb") as f:
+            payload = f.read()
+        block = {"bytes": np.asarray([payload], dtype=object)}
+        if self._include_paths:
+            block["path"] = np.asarray([path], dtype=object)
+        return [block]
+
+
 class ParquetDatasource(FileDatasource):
     def __init__(self, paths, columns: Optional[List[str]] = None):
         super().__init__(paths)

@@ -342,14 +342,20 @@ class SequenceReplayBuffer:
         slot_ids maps each sequence to its buffer slot."""
         for slot, seq in zip(slot_ids, sequences):
             T = len(seq["reward"])
-            for t in range(T):
-                j = self._pos[slot] % self._per
-                self._obs[slot, j] = seq["obs"][t]
-                self._act[slot, j] = seq["action"][t]
-                self._rew[slot, j] = seq["reward"][t]
-                self._first[slot, j] = seq["is_first"][t]
-                self._term[slot, j] = seq["is_terminal"][t]
-                self._pos[slot] += 1
+            if T == 0:
+                continue
+            # if a single append exceeds the ring, only the tail survives
+            if T > self._per:
+                seq = {k: v[-self._per:] for k, v in seq.items()}
+                self._pos[slot] += T - self._per
+                T = self._per
+            idx = (self._pos[slot] + np.arange(T)) % self._per
+            self._obs[slot, idx] = seq["obs"]
+            self._act[slot, idx] = seq["action"]
+            self._rew[slot, idx] = seq["reward"]
+            self._first[slot, idx] = seq["is_first"]
+            self._term[slot, idx] = seq["is_terminal"]
+            self._pos[slot] += T
         return int(self.size())
 
     def size(self) -> int:
@@ -562,6 +568,10 @@ class DreamerV3:
         obs_dim, act_dim, discrete = space_dims(
             probe.observation_space, probe.action_space
         )
+        self._obs_space = probe.observation_space
+        if not discrete:
+            self._act_low = np.asarray(probe.action_space.low, np.float32)
+            self._act_high = np.asarray(probe.action_space.high, np.float32)
         try:
             probe.close()
         except Exception:
@@ -779,7 +789,11 @@ class DreamerV3:
     def train(self) -> Dict[str, Any]:
         t0 = time.time()
         cfg = self.config
-        host_params = jax.tree.map(np.asarray, self.params)
+        # runners only act: ship wm + actor, not the critic heads
+        host_params = jax.tree.map(
+            np.asarray,
+            {"wm": self.params["wm"], "actor": self.params["actor"]},
+        )
         rollouts = api.get(
             [r.sample.remote(host_params) for r in self.runners]
         )
@@ -858,7 +872,7 @@ class DreamerV3:
         """One-step filter from an empty latent state (no carried context;
         for sustained rollouts use a DreamerRunner, which carries state)."""
         nets = self.nets
-        obs = np.asarray(obs, np.float32).reshape(1, -1)
+        obs = encode_obs(self._obs_space, np.asarray(obs)[None])
         wm = self.params["wm"]
         deter = jnp.zeros((1, self.config.deter_dim), jnp.float32)
         stoch = jnp.zeros((1, nets.stoch_dim), jnp.float32)
@@ -876,7 +890,11 @@ class DreamerV3:
         if nets.discrete:
             return int(jnp.argmax(out, -1)[0])
         mean, _ = out
-        return np.asarray(jnp.tanh(mean))[0]
+        a = np.asarray(jnp.tanh(mean))[0]
+        # same [-1,1] -> Box rescaling the rollout runners apply
+        return self._act_low + (a + 1.0) * 0.5 * (
+            self._act_high - self._act_low
+        )
 
     def stop(self):
         for r in self.runners:

@@ -9,11 +9,19 @@ from ._session import get_checkpoint, report
 from .schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandForBOHB,
     HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
 )
-from .searchers import BasicVariantGenerator, Searcher, TPESearcher
+from .searchers import (
+    BasicVariantGenerator,
+    BOHBSearcher,
+    HyperOptSearch,
+    OptunaSearch,
+    Searcher,
+    TPESearcher,
+)
 from .search import (
     choice,
     grid_search,
@@ -51,9 +59,13 @@ __all__ = [
     "MedianStoppingRule",
     "ASHAScheduler",
     "HyperBandScheduler",
+    "HyperBandForBOHB",
     "PopulationBasedTraining",
     "Searcher",
     "BasicVariantGenerator",
     "TPESearcher",
+    "BOHBSearcher",
+    "OptunaSearch",
+    "HyperOptSearch",
     "get_checkpoint",
 ]

@@ -190,6 +190,16 @@ class HyperBandScheduler:
         self._bracket(trial_id).on_trial_complete(trial_id)
 
 
+class HyperBandForBOHB(HyperBandScheduler):
+    """HyperBand variant paired with ``BOHBSearcher`` (reference:
+    schedulers/hb_bohb.py). The reference version fills brackets in order
+    so the model-based searcher sees complete rungs; this framework's
+    HyperBand is already asynchronous and streams every report to the
+    searcher via ``Searcher.on_trial_result``, so the pairing needs no
+    extra synchronization — the subclass exists to keep the reference's
+    scheduler/searcher pairing explicit."""
+
+
 class PopulationBasedTraining:
     """PBT (reference: schedulers/pbt.py PopulationBasedTraining): every
     ``perturbation_interval`` iterations a trial is ranked against the

@@ -224,3 +224,101 @@ class TPESearcher(Searcher):
         if isinstance(domain, RandInt):
             value = int(min(max(round(value), lo), hi - 1))
         return value
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model-based half (reference-equivalent of
+    tune/search/bohb/bohb_search.py TuneBOHB, which wraps HpBandSter; here
+    native). The key idea over plain TPE (Falkner et al. 2018): trials
+    report results at multiple BUDGETS (HyperBand rung milestones), and the
+    density model is fit only on results from the LARGEST budget that has
+    enough observations — low-budget scores guide early, high-budget scores
+    take over as they accumulate. A ``random_fraction`` of suggestions stays
+    uniform so the model never starves the space. Pair with
+    ``HyperBandForBOHB`` so intermediate results arrive per rung via
+    ``on_trial_result``."""
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: str = "max",
+        n_startup_trials: int = 10,
+        gamma: float = 0.25,
+        n_candidates: int = 24,
+        random_fraction: float = 1.0 / 3.0,
+        time_attr: str = "training_iteration",
+        seed: Optional[int] = None,
+    ):
+        super().__init__(
+            metric=metric, mode=mode, n_startup_trials=n_startup_trials,
+            gamma=gamma, n_candidates=n_candidates, seed=seed,
+        )
+        self._random_fraction = random_fraction
+        self._time_attr = time_attr
+        # budget -> list of (config, score): rewritten per trial as larger
+        # budgets report, so each budget keeps one (latest) entry per trial
+        self._by_budget: Dict[int, Dict[str, Tuple[Dict[str, Any], float]]] = {}
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        config = self._live.get(trial_id)
+        value = (result or {}).get(self.metric)
+        if config is None or value is None:
+            return
+        budget = int(result.get(self._time_attr, 0) or 0)
+        score = float(value) if self.mode == "max" else -float(value)
+        self._by_budget.setdefault(budget, {})[trial_id] = (config, score)
+
+    def on_trial_complete(self, trial_id, result=None):
+        self.on_trial_result(trial_id, result or {})
+        self._live.pop(trial_id, None)
+
+    def _model_history(self) -> List[Tuple[Dict[str, Any], float]]:
+        """Observations from the largest budget with >= n_startup entries
+        (BOHB's model-selection rule); empty if no budget qualifies yet."""
+        for budget in sorted(self._by_budget, reverse=True):
+            entries = self._by_budget[budget]
+            if len(entries) >= self._n_startup:
+                return list(entries.values())
+        return []
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        self._history = self._model_history()
+        if (
+            not self._history
+            or self._rng.random() < self._random_fraction
+        ):
+            config = _sample_config(self.param_space, self._rng)
+        else:
+            config = self._tpe_sample()
+        self._live[trial_id] = config
+        return config
+
+
+class _GatedExternalSearcher(Searcher):
+    """Stand-in for searchers wrapping libraries not present in this
+    environment; constructing one raises with the native alternative."""
+
+    _lib = ""
+    _alternative = ""
+
+    def __init__(self, *a, **kw):
+        raise ImportError(
+            f"{type(self).__name__} wraps '{self._lib}', which is not "
+            f"installed in this environment. Use the dependency-free native "
+            f"equivalent instead: {self._alternative}"
+        )
+
+
+class OptunaSearch(_GatedExternalSearcher):
+    """Reference: tune/search/optuna/optuna_search.py (optuna's sampler is
+    TPE — the native TPESearcher implements the same algorithm)."""
+
+    _lib = "optuna"
+    _alternative = "ray_tpu.tune.TPESearcher"
+
+
+class HyperOptSearch(_GatedExternalSearcher):
+    """Reference: tune/search/hyperopt/hyperopt_search.py."""
+
+    _lib = "hyperopt"
+    _alternative = "ray_tpu.tune.TPESearcher"

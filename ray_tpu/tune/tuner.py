@@ -374,6 +374,10 @@ class Tuner:
                 for report in update["reports"]:
                     trial.iterations = report["training_iteration"]
                     trial.last_metrics = report
+                    if searcher is not None:
+                        # budget-aware searchers (BOHB) model per-rung
+                        # intermediate results, not just final ones
+                        searcher.on_trial_result(trial.trial_id, report)
                     decision = scheduler.on_result(trial.trial_id, report)
                     if decision == PERTURB:
                         perturb_now = True

@@ -258,3 +258,27 @@ def test_join_many_to_many(cluster):
     right = rd.from_items([{"id": 1, "b": j} for j in range(2)])
     rows = left.join(right, on="id").take_all()
     assert len(rows) == 6
+
+
+def test_read_text(cluster, tmp_path):
+    p1 = tmp_path / "a.txt"
+    p1.write_text("hello\nworld\n\nfoo\n")
+    p2 = tmp_path / "b.txt"
+    p2.write_text("bar\n")
+    ds = rd.read_text(str(tmp_path))
+    rows = ds.take_all()
+    assert sorted(r["text"] for r in rows) == ["bar", "foo", "hello", "world"]
+    # keep empty lines when asked
+    ds2 = rd.read_text(str(p1), drop_empty_lines=False)
+    assert ds2.count() == 4
+
+
+def test_read_binary_files(cluster, tmp_path):
+    (tmp_path / "x.bin").write_bytes(b"\x00\x01\x02")
+    (tmp_path / "y.bin").write_bytes(b"abc")
+    ds = rd.read_binary_files(str(tmp_path), include_paths=True)
+    rows = ds.take_all()
+    assert len(rows) == 2
+    by_path = {r["path"].rsplit("/", 1)[-1]: r["bytes"] for r in rows}
+    assert by_path["x.bin"] == b"\x00\x01\x02"
+    assert by_path["y.bin"] == b"abc"

@@ -160,6 +160,36 @@ def test_dreamer_trains_cartpole(cluster):
         algo.stop()
 
 
+def test_dreamer_continuous_actions(cluster):
+    """Pendulum (Box actions): tanh-gaussian actor trains and the deployed
+    action is rescaled into the env's bounds like the rollout runners do."""
+    from ray_tpu.rllib.dreamer import DreamerV3Config
+
+    cfg = (
+        DreamerV3Config()
+        .environment("Pendulum-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=1,
+                     rollout_fragment_length=24)
+        .training(
+            deter_dim=32, stoch_groups=4, stoch_classes=4, hidden_units=32,
+            n_bins=21, seq_len=8, batch_size=2, horizon=4,
+            learning_starts=24, buffer_capacity=1024,
+        )
+        .debugging(seed=3)
+    )
+    algo = cfg.build()
+    try:
+        result = None
+        for _ in range(3):
+            result = algo.train()
+        assert "wm_loss" in result and np.isfinite(result["wm_loss"])
+        a = algo.compute_single_action(np.zeros(3, np.float32))
+        assert a.shape == (1,)
+        assert -2.0 <= float(a[0]) <= 2.0  # Pendulum torque bounds
+    finally:
+        algo.stop()
+
+
 def test_dreamer_checkpoint_roundtrip(cluster, tmp_path):
     import jax
 

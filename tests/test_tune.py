@@ -283,3 +283,59 @@ def test_tpe_nested_param_space():
         assert isinstance(cfg["opt"]["lr"], float), cfg
         assert cfg["k"] == 5
         searcher.on_trial_complete(tid, {"loss": (cfg["opt"]["lr"] - 0.3) ** 2})
+
+
+def test_bohb_searcher_models_largest_qualified_budget():
+    """BOHB fits its density model on the largest budget with enough
+    observations: results at budget 9 (good trials clustered at x=2) must
+    override a misleading cluster reported at budget 1."""
+    searcher = tune.BOHBSearcher(
+        metric="loss", mode="min", n_startup_trials=4,
+        random_fraction=0.0, seed=0,
+    )
+    searcher.set_search_properties("loss", "min", {"x": tune.uniform(-10, 10)})
+    for i in range(12):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        x = cfg["x"]
+        # budget-1 report: misleading metric favoring x near -8
+        searcher.on_trial_result(
+            tid, {"training_iteration": 1, "loss": (x + 8.0) ** 2}
+        )
+        # budget-9 report: true objective favoring x near 2
+        searcher.on_trial_complete(
+            tid, {"training_iteration": 9, "loss": (x - 2.0) ** 2}
+        )
+    late = [searcher.suggest(f"probe{i}") for i in range(10)]
+    xs = [c["x"] for c in late]
+    assert sum(abs(x - 2.0) < 4.0 for x in xs) >= 6, xs
+
+
+def test_bohb_with_hyperband_tuner(cluster):
+    def objective(config):
+        for i in range(6):
+            tune.report({"loss": (config["x"] - 1.0) ** 2 + 1.0 / (i + 1)})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(-5, 5)},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=12,
+            search_alg=tune.BOHBSearcher(
+                n_startup_trials=5, random_fraction=0.34, seed=2
+            ),
+            scheduler=tune.HyperBandForBOHB(max_t=6, reduction_factor=3),
+            max_concurrent_trials=2,
+        ),
+    )
+    results = tuner.fit()
+    assert len(results) == 12
+    # integration coverage (intermediate results reach the searcher, the
+    # scheduler pairing runs): any sane search beats the worst-case corner
+    assert results.get_best_result().metrics["loss"] < 16.0
+
+
+def test_external_searcher_wrappers_are_gated():
+    for cls in (tune.OptunaSearch, tune.HyperOptSearch):
+        with pytest.raises(ImportError, match="TPESearcher"):
+            cls()
