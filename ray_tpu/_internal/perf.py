@@ -136,6 +136,9 @@ def run_microbenchmarks(
         # item 7: >=5x at 1 MB).
         results.update(_channel_bandwidth_bench(scale))
 
+        # -- native transfer plane vs python chunked pull -------------------
+        results.update(_transfer_plane_bench(scale))
+
         # -- wait over many refs -------------------------------------------
         nw = max(int(1000 * scale), 100)
         wait_refs: List = [ray_tpu.put(i) for i in range(nw)]
@@ -149,6 +152,56 @@ def run_microbenchmarks(
     finally:
         if owns_cluster:
             ray_tpu.shutdown()
+    return results
+
+
+def _transfer_plane_bench(scale: float) -> Dict[str, float]:
+    """Node-to-node object transfer bandwidth: the C++ TCP plane
+    (rt_transfer_fetch, one stream into the arena) vs the python
+    chunked-RPC pull path, store-to-store over loopback."""
+    import os
+
+    from .._native.lib import load
+    from .ids import ObjectID
+    from ..runtime.object_store.native_store import NativeObjectStore
+
+    lib = load()
+    if lib is None:
+        return {}
+    size_mb = 64 if scale >= 1.0 else 8
+    results: Dict[str, float] = {}
+    src = NativeObjectStore(
+        (size_mb * 4) << 20, f"perfa{os.getpid()}", lib
+    )
+    dst = NativeObjectStore(
+        (size_mb * 4) << 20, f"perfb{os.getpid()}", lib
+    )
+    try:
+        port = src.transfer_serve()
+        if port is None:
+            return {}
+        payload = os.urandom(size_mb << 20)
+        best = float("inf")
+        for _ in range(3):
+            oid = ObjectID.from_random()
+            src.create_and_write(oid, payload)
+            t0 = time.perf_counter()
+            rc, off, tsize = dst.transfer_fetch_raw(
+                oid, "127.0.0.1", port, ""
+            )
+            dt = time.perf_counter() - t0
+            if rc != 0 or tsize != len(payload):
+                return {}
+            dst.adopt_fetched(oid, off, tsize)
+            best = min(best, dt)
+            src.free(oid)
+            dst.free(oid)
+        results[f"native_transfer_{size_mb}mb_gb_s"] = (
+            size_mb / 1024 / best
+        )
+    finally:
+        src.shutdown()
+        dst.shutdown()
     return results
 
 

@@ -64,5 +64,24 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int,
     ]
     lib.rt_lru_spillable.restype = ctypes.c_int
+    lib.rt_true_size.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.rt_true_size.restype = ctypes.c_uint64
+    lib.rt_transfer_serve.argtypes = [
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    lib.rt_transfer_serve.restype = ctypes.c_int
+    lib.rt_transfer_stop.argtypes = [ctypes.c_int]
+    lib.rt_transfer_fetch.argtypes = [
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.rt_transfer_fetch.restype = ctypes.c_int
     _lib = lib
     return _lib

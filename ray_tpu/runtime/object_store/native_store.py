@@ -72,7 +72,9 @@ class NativeObjectStore:
         self._sync_evicted()
         if object_id in self._offsets:
             return self._segment_ref(self._offsets[object_id])
-        off = self._lib.rt_create(self._h, self._key(object_id), max(size, 1))
+        # pass the TRUE size: rt_create pads the allocation itself and
+        # records true_size for the transfer plane's payload header
+        off = self._lib.rt_create(self._h, self._key(object_id), size)
         if off == -2:  # raced: already created
             off = self._offsets.get(object_id)
             if off is None:
@@ -166,6 +168,46 @@ class NativeObjectStore:
             return None
         return memoryview(self._mm)[off : off + size]
 
+    # -- C++ transfer plane (reference role: ObjectManager push/pull) --------
+
+    def transfer_serve(self, token: str = "") -> Optional[int]:
+        """Start the native TCP transfer server over this arena; returns the
+        bound port (None on failure)."""
+        port = self._lib.rt_transfer_serve(self._h, token.encode(), 0)
+        if port <= 0:
+            return None
+        self._transfer_port = port
+        return port
+
+    def transfer_fetch_raw(
+        self, object_id: ObjectID, host: str, port: int, token: str = ""
+    ):
+        """Pull ``object_id`` from a peer's transfer server straight into
+        this arena (blocking — run in a thread). Returns (rc, off, size);
+        rc 0 means the bytes are in the arena but NOT yet sealed — call
+        ``adopt_fetched`` from the event-loop thread (seal notifies
+        asyncio waiters, which is not thread-safe from here)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rt_transfer_fetch(
+            self._h, host.encode(), port, self._key(object_id),
+            token.encode(), ctypes.byref(off), ctypes.byref(size),
+        )
+        return rc, off.value, size.value
+
+    def adopt_fetched(self, object_id: ObjectID, off: int, size: int):
+        """Record mirrors + seal for an object rt_transfer_fetch landed."""
+        self._offsets[object_id] = off
+        self._sizes[object_id] = size
+        self._sealed[object_id] = False
+        self.seal(object_id)
+
+    def transfer_stop(self):
+        port = getattr(self, "_transfer_port", None)
+        if port is not None:
+            self._lib.rt_transfer_stop(port)
+            self._transfer_port = None
+
     def lru_spillable(self) -> Optional[ObjectID]:
         """Least-recently-used primary copy eligible for spilling."""
         buf = ctypes.create_string_buffer(64)
@@ -186,6 +228,9 @@ class NativeObjectStore:
         }
 
     def shutdown(self):
+        # stop the transfer server BEFORE unmapping: a handler thread
+        # streaming from the arena must not outlive the mapping
+        self.transfer_stop()
         try:
             self._mm.close()
         except (BufferError, ValueError):

@@ -130,6 +130,12 @@ class Raylet:
         )
         self._oom_kills = 0
         self._last_oom_kill_ts = 0.0
+        # native transfer plane counters (observability + tests)
+        self._native_pulls = 0
+        self._transfer_port: Optional[int] = None
+        # peer address -> (port or None, probe-expiry timestamp)
+        self._peer_transfer_ports: Dict[tuple, tuple] = {}
+        self._pull_locks: Dict[ObjectID, asyncio.Lock] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -138,6 +144,12 @@ class Raylet:
         self.server.on_connection_lost(self._on_connection_lost)
         bound = await self.server.start(host, port)
         self.address = (host, bound)
+        # native transfer plane: serve this arena over TCP so peers pull
+        # bulk bytes via the C++ path instead of chunked python RPC
+        if hasattr(self.store, "transfer_serve"):
+            self._transfer_port = self.store.transfer_serve(
+                self.config.cluster_auth_token
+            )
         # the auth token ships to workers via env, NOT the --config argv JSON
         # (argv is world-readable through /proc/<pid>/cmdline). The key is
         # OMITTED — an empty value would overwrite the env-provided token in
@@ -878,9 +890,79 @@ class Raylet:
         chunk = bytes(view[offset : offset + length])
         return {"total": total, "data": chunk}
 
+    async def handle_transfer_info(self):
+        """Advertise the native transfer-plane port (None = python path)."""
+        return {"port": self._transfer_port}
+
+    async def _native_pull(self, object_id: ObjectID, node_address) -> bool:
+        """Try the C++ transfer plane: one TCP stream straight into the
+        local arena. False = not attempted / failed (caller falls back to
+        the chunked-RPC pull)."""
+        if self._transfer_port is None or not hasattr(
+            self.store, "transfer_fetch_raw"
+        ):
+            return False
+        key = tuple(node_address)
+        cached = self._peer_transfer_ports.get(key)
+        # a failed probe is retried after a grace period (the peer may have
+        # just been starting up), not cached forever
+        if cached is not None and (
+            cached[0] is not None or time.time() < cached[1]
+        ):
+            port = cached[0]
+        else:
+            try:
+                peer = self.client_pool.get(*node_address)
+                info = await peer.call("transfer_info", timeout=5.0)
+                port = (info or {}).get("port")
+            except Exception:
+                port = None
+            self._peer_transfer_ports[key] = (port, time.time() + 30.0)
+        if port is None:
+            return False
+        rc, off, size = await asyncio.to_thread(
+            self.store.transfer_fetch_raw,
+            object_id, node_address[0], port,
+            self.config.cluster_auth_token,
+        )
+        if rc == 0:
+            self.store.adopt_fetched(object_id, off, size)
+            self._native_pulls += 1
+            return True
+        if rc == -4:  # already present (raced with another pull)
+            return self.store.contains(object_id)
+        if rc in (-1, -5):
+            # connect/protocol/auth failure: the peer may have restarted on
+            # a new port (or with a new token) — drop the cache entry so the
+            # next pull re-probes instead of paying this again
+            self._peer_transfer_ports.pop(key, None)
+        return False
+
     async def _pull_object(self, object_id: ObjectID, owner_address) -> bool:
-        """Ask the owner where the object lives, then pull it chunk-by-chunk
-        from that node's raylet."""
+        """Ask the owner where the object lives, then pull it — C++
+        transfer plane first, chunked RPC as the fallback (reference:
+        PullManager + ObjectManager::Push).
+
+        Serialized per object: the native fetch creates the C++ arena entry
+        before the python mirrors exist, so a concurrent pull of the SAME
+        object would see an inconsistent half-created state (the chunked
+        path's mirror-first ordering tolerated this; the native path does
+        not)."""
+        lock = self._pull_locks.setdefault(object_id, asyncio.Lock())
+        try:
+            async with lock:
+                if self.store.contains(object_id):
+                    return True  # a concurrent pull already landed it
+                return await self._pull_object_locked(
+                    object_id, owner_address
+                )
+        finally:
+            if not lock.locked() and self._pull_locks.get(object_id) is lock:
+                del self._pull_locks[object_id]
+
+    async def _pull_object_locked(
+        self, object_id: ObjectID, owner_address
+    ) -> bool:
         try:
             owner = self.client_pool.get(*owner_address)
             loc = await owner.call("get_object_locations", object_id)
@@ -892,6 +974,21 @@ class Raylet:
         for node_address in loc:
             if tuple(node_address) == tuple(self.address):
                 continue
+            try:
+                if await self._native_pull(object_id, node_address):
+                    try:
+                        owner = self.client_pool.get(*owner_address)
+                        await owner.call_oneway(
+                            "add_object_location", object_id, self.address
+                        )
+                    except Exception:
+                        pass
+                    return True
+            except Exception as e:
+                logger.debug(
+                    "native pull of %s failed: %s (falling back)",
+                    object_id, e,
+                )
             try:
                 peer = self.client_pool.get(*node_address)
                 chunk_size = self.config.object_transfer_chunk_size
@@ -944,6 +1041,8 @@ class Raylet:
             "resources_available": self.resources.available_float(),
             "labels": dict(self.resources.labels),
             "store": self.store.stats(),
+            "transfer_port": self._transfer_port,
+            "native_pulls": self._native_pulls,
             "num_workers": self.worker_pool.num_total if self.worker_pool else 0,
         }
 
