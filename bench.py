@@ -216,17 +216,21 @@ def _measure(cfg, batch, steps, _log):
     opt_state = optimizer.init(lora)
     _log("params initialized (base frozen, lora in optimizer)")
 
-    def loss_fn(lora_p, tokens):
-        return next_token_loss(cfg, None, merge_lora(base, lora_p), tokens)
-
-    def one_step(carry, tokens):
-        lp, s = carry
-        loss, grads = jax.value_and_grad(loss_fn)(lp, tokens)
-        updates, s2 = optimizer.update(grads, s, lp)
-        return (optax.apply_updates(lp, updates), s2), loss
+    # `base` is an explicit jit ARGUMENT, not a closure capture: captured
+    # trees are lowered as constants, and 13.5GB of bf16 constants blows the
+    # compile payload through the remote-dispatch tunnel (observed: >20min
+    # lowering). As an argument it stays a resident device buffer.
+    def loss_fn(lora_p, base_p, tokens):
+        return next_token_loss(cfg, None, merge_lora(base_p, lora_p), tokens)
 
     @jax.jit
-    def run(lp, s, data):
+    def run(base_p, lp, s, data):
+        def one_step(carry, tokens):
+            lp_c, s_c = carry
+            loss, grads = jax.value_and_grad(loss_fn)(lp_c, base_p, tokens)
+            updates, s2 = optimizer.update(grads, s_c, lp_c)
+            return (optax.apply_updates(lp_c, updates), s2), loss
+
         (lp2, s2), losses = jax.lax.scan(one_step, (lp, s), data)
         return lp2, s2, losses
 
@@ -243,14 +247,14 @@ def _measure(cfg, batch, steps, _log):
     def timed(n_steps, seed):
         _log(f"compile+warm n_steps={n_steps}")
         tc0 = time.perf_counter()
-        _, _, losses = run(lora, opt_state, make_data(n_steps, seed + 1000))
+        _, _, losses = run(base, lora, opt_state, make_data(n_steps, seed + 1000))
         float(losses[-1])  # compile + warm
         compile_s = time.perf_counter() - tc0
         _log(f"warm done n_steps={n_steps} ({compile_s:.1f}s); timing")
         # time with DIFFERENT data: the tunnel may serve repeated identical
         # dispatches from cache
         t0 = time.perf_counter()
-        _, _, losses = run(lora, opt_state, make_data(n_steps, seed))
+        _, _, losses = run(base, lora, opt_state, make_data(n_steps, seed))
         float(losses[-1])
         dt = time.perf_counter() - t0
         _log(f"n_steps={n_steps} dt={dt:.3f}s")

@@ -70,6 +70,7 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int,
         ctypes.c_char_p,
         ctypes.c_int,
+        ctypes.c_char_p,
     ]
     lib.rt_transfer_serve.restype = ctypes.c_int
     lib.rt_transfer_stop.argtypes = [ctypes.c_int]

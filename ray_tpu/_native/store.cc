@@ -394,6 +394,12 @@ void set_io_timeout(int fd, int seconds) {
 }
 
 void handle_conn(int fd, TransferServer* s) {
+  // accept_loop incremented `active` before spawning us; every exit path
+  // must decrement it or rt_transfer_stop spins its full drain backoff
+  struct ActiveGuard {
+    TransferServer* srv;
+    ~ActiveGuard() { srv->active.fetch_sub(1); }
+  } guard{s};
   const int arena_handle = s->arena_handle;
   const std::string& token = s->token;
   set_io_timeout(fd, 60);
@@ -441,7 +447,6 @@ void handle_conn(int fd, TransferServer* s) {
   }
   rt_release(arena_handle, key.c_str());
   ::close(fd);
-  s->active.fetch_sub(1);
 }
 
 void accept_loop(TransferServer* s) {
@@ -465,9 +470,12 @@ void accept_loop(TransferServer* s) {
 
 extern "C" {
 
-// Start a transfer server for an open arena. port 0 = ephemeral. Returns
-// the bound port (> 0) or -1.
-int rt_transfer_serve(int h, const char* token, int port) {
+// Start a transfer server for an open arena. port 0 = ephemeral. Binds the
+// given host (the address the raylet itself serves on) — NOT INADDR_ANY:
+// the payload plane must never be reachable on interfaces the control
+// plane isn't. Null/empty/unparseable host falls back to loopback.
+// Returns the bound port (> 0) or -1.
+int rt_transfer_serve(int h, const char* token, int port, const char* host) {
   if (arena(h) == nullptr) return -1;
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
@@ -475,7 +483,10 @@ int rt_transfer_serve(int h, const char* token, int port) {
   ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (host == nullptr || host[0] == '\0' ||
+      ::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  }
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
       ::listen(fd, 64) != 0) {

@@ -139,9 +139,26 @@ def init(
 
         node.dashboard = DashboardServer(gcs_address, port=dashboard_port)
         node.dashboard.start()
+    if log_to_driver and config.log_to_driver:
+        loop_thread.run(
+            worker.subscribe_worker_logs(_print_worker_logs), timeout=30
+        )
     _worker_api.set_core_worker(worker, config, loop_thread=loop_thread, node=node)
     atexit.register(_atexit_shutdown)
     return node
+
+
+def _print_worker_logs(record: dict):
+    """Driver-side echo of worker output (reference: the driver's log
+    streaming with ``(pid=..., ip=...)`` prefixes)."""
+    import sys
+
+    prefix = f"(pid={record.get('pid')}, ip={record.get('ip')})"
+    if sys.stderr.isatty():
+        prefix = f"\x1b[36m{prefix}\x1b[0m"
+    out = "".join(f"{prefix} {line}\n" for line in record.get("lines", ()))
+    sys.stderr.write(out)
+    sys.stderr.flush()
 
 
 def _find_raylet(loop_thread, gcs_address):

@@ -25,6 +25,12 @@ from ...exceptions import ObjectStoreFullError
 logger = logging.getLogger(__name__)
 
 
+class FetchInFlightError(ObjectStoreFullError):
+    """A native transfer-plane fetch of this object is mid-stream: the C++
+    arena entry exists but the python mirrors don't yet. Transient — the
+    caller should back off briefly and retry rather than spill."""
+
+
 class NativeObjectStore:
     def __init__(self, capacity_bytes: int, session_id: str, lib):
         self.capacity = capacity_bytes
@@ -42,6 +48,9 @@ class NativeObjectStore:
         self._offsets: Dict[ObjectID, int] = {}
         self._sealed: Dict[ObjectID, bool] = {}
         self._waiters: Dict[ObjectID, List[asyncio.Event]] = {}
+        # objects whose bytes rt_transfer_fetch is streaming into the arena
+        # right now (C++ entry exists, python mirrors pending adopt_fetched)
+        self._fetching: set = set()
 
     # -- helpers -------------------------------------------------------------
 
@@ -78,6 +87,12 @@ class NativeObjectStore:
         if off == -2:  # raced: already created
             off = self._offsets.get(object_id)
             if off is None:
+                if object_id in self._fetching:
+                    # a native pull is streaming the same object in; its
+                    # mirrors land via adopt_fetched on this event loop
+                    raise FetchInFlightError(
+                        f"native fetch of {object_id} in flight"
+                    )
                 raise KeyError(f"create race lost for {object_id}")
             return self._segment_ref(off)
         if off < 0:
@@ -170,10 +185,14 @@ class NativeObjectStore:
 
     # -- C++ transfer plane (reference role: ObjectManager push/pull) --------
 
-    def transfer_serve(self, token: str = "") -> Optional[int]:
+    def transfer_serve(self, token: str = "", host: str = "") -> Optional[int]:
         """Start the native TCP transfer server over this arena; returns the
-        bound port (None on failure)."""
-        port = self._lib.rt_transfer_serve(self._h, token.encode(), 0)
+        bound port (None on failure). ``host`` should be the address the
+        raylet control plane serves on (empty = loopback) so the payload
+        plane is never reachable more widely than the RPC plane."""
+        port = self._lib.rt_transfer_serve(
+            self._h, token.encode(), 0, host.encode()
+        )
         if port <= 0:
             return None
         self._transfer_port = port
@@ -194,6 +213,12 @@ class NativeObjectStore:
             token.encode(), ctypes.byref(off), ctypes.byref(size),
         )
         return rc, off.value, size.value
+
+    def begin_fetch(self, object_id: ObjectID):
+        self._fetching.add(object_id)
+
+    def end_fetch(self, object_id: ObjectID):
+        self._fetching.discard(object_id)
 
     def adopt_fetched(self, object_id: ObjectID, off: int, size: int):
         """Record mirrors + seal for an object rt_transfer_fetch landed."""

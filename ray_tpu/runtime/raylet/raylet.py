@@ -136,6 +136,7 @@ class Raylet:
         # peer address -> (port or None, probe-expiry timestamp)
         self._peer_transfer_ports: Dict[tuple, tuple] = {}
         self._pull_locks: Dict[ObjectID, asyncio.Lock] = {}
+        self._pull_lock_holds: Dict[ObjectID, int] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -144,11 +145,20 @@ class Raylet:
         self.server.on_connection_lost(self._on_connection_lost)
         bound = await self.server.start(host, port)
         self.address = (host, bound)
+        self._loop = asyncio.get_event_loop()
+        # session log dir (reference: per-session /tmp/ray/session_*/logs)
+        import tempfile
+
+        self.log_dir = os.path.join(
+            tempfile.gettempdir(), "ray_tpu",
+            f"session_{self.session_id}", "logs",
+        )
+        os.makedirs(self.log_dir, exist_ok=True)
         # native transfer plane: serve this arena over TCP so peers pull
         # bulk bytes via the C++ path instead of chunked python RPC
         if hasattr(self.store, "transfer_serve"):
             self._transfer_port = self.store.transfer_serve(
-                self.config.cluster_auth_token
+                self.config.cluster_auth_token, host=host
             )
         # the auth token ships to workers via env, NOT the --config argv JSON
         # (argv is world-readable through /proc/<pid>/cmdline). The key is
@@ -166,6 +176,8 @@ class Raylet:
             self.config.max_workers_per_node,
             _json.dumps(cfg_dict),
             auth_token=self.config.cluster_auth_token,
+            log_dir=self.log_dir,
+            log_sink=self._worker_log_sink,
         )
         gcs = self.client_pool.get(*self.gcs_address)
         info = self._node_info()
@@ -700,10 +712,20 @@ class Raylet:
                 f"object of {size} bytes exceeds store capacity "
                 f"{self.store.capacity}"
             )
+        from ..object_store.native_store import FetchInFlightError
+
         tried: set = set()
+        deadline = time.time() + 30.0
         while True:
             try:
                 return self.store.create(object_id, size)
+            except FetchInFlightError:
+                # transient: a native pull of the same object is mid-stream;
+                # once it adopts, create() dedups onto the landed copy.
+                # Spilling could never help here.
+                if time.time() > deadline:
+                    raise
+                await asyncio.sleep(0.02)
             except ObjectStoreFullError:
                 victim = self.store.lru_spillable()
                 if victim is None or victim == object_id or victim in tried:
@@ -920,15 +942,19 @@ class Raylet:
             self._peer_transfer_ports[key] = (port, time.time() + 30.0)
         if port is None:
             return False
-        rc, off, size = await asyncio.to_thread(
-            self.store.transfer_fetch_raw,
-            object_id, node_address[0], port,
-            self.config.cluster_auth_token,
-        )
-        if rc == 0:
-            self.store.adopt_fetched(object_id, off, size)
-            self._native_pulls += 1
-            return True
+        self.store.begin_fetch(object_id)
+        try:
+            rc, off, size = await asyncio.to_thread(
+                self.store.transfer_fetch_raw,
+                object_id, node_address[0], port,
+                self.config.cluster_auth_token,
+            )
+            if rc == 0:
+                self.store.adopt_fetched(object_id, off, size)
+                self._native_pulls += 1
+                return True
+        finally:
+            self.store.end_fetch(object_id)
         if rc == -4:  # already present (raced with another pull)
             return self.store.contains(object_id)
         if rc in (-1, -5):
@@ -949,6 +975,13 @@ class Raylet:
         path's mirror-first ordering tolerated this; the native path does
         not)."""
         lock = self._pull_locks.setdefault(object_id, asyncio.Lock())
+        # hold-counted cleanup: Lock.locked() is False the instant release()
+        # runs even with waiters still queued, so a holder's `finally` could
+        # delete the entry out from under them and a third pull would mint a
+        # fresh lock — two pulls of the same object running "locked"
+        self._pull_lock_holds[object_id] = (
+            self._pull_lock_holds.get(object_id, 0) + 1
+        )
         try:
             async with lock:
                 if self.store.contains(object_id):
@@ -957,8 +990,13 @@ class Raylet:
                     object_id, owner_address
                 )
         finally:
-            if not lock.locked() and self._pull_locks.get(object_id) is lock:
-                del self._pull_locks[object_id]
+            holds = self._pull_lock_holds[object_id] - 1
+            if holds:
+                self._pull_lock_holds[object_id] = holds
+            else:
+                del self._pull_lock_holds[object_id]
+                if self._pull_locks.get(object_id) is lock:
+                    del self._pull_locks[object_id]
 
     async def _pull_object_locked(
         self, object_id: ObjectID, owner_address
@@ -1027,6 +1065,47 @@ class Raylet:
             except Exception as e:
                 logger.debug("pull of %s from %s failed: %s", object_id, node_address, e)
         return False
+
+    # -- worker logs (reference: log_monitor.py + `ray logs`) --------------
+
+    def _worker_log_sink(self, record: dict):
+        """Called from log-pump threads: ship a batch of worker output lines
+        to the GCS "logs" pubsub channel for driver echo."""
+        if self._stopped:
+            return
+        record = dict(
+            record, ip=self.address[0], node_id=self.node_id.hex()
+        )
+        asyncio.run_coroutine_threadsafe(self._publish_logs(record), self._loop)
+
+    async def _publish_logs(self, record: dict):
+        try:
+            gcs = self.client_pool.get(*self.gcs_address)
+            await gcs.call_oneway("publish", "logs", record)
+        except Exception:
+            pass  # log echo is best-effort; never destabilize the raylet
+
+    async def handle_list_logs(self) -> List[str]:
+        """List log files in this node's session log dir (`ray logs`)."""
+        try:
+            return sorted(os.listdir(self.log_dir))
+        except OSError:
+            return []
+
+    async def handle_read_log(self, name: str, tail: int = 1000) -> str:
+        """Return the last ``tail`` lines of one session log file. The name
+        is basename-sanitized — this RPC must not become a file-read oracle."""
+        path = os.path.join(self.log_dir, os.path.basename(name))
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 4 * 1024 * 1024))
+                data = f.read()
+        except OSError:
+            return ""
+        lines = data.decode("utf-8", errors="replace").splitlines()
+        return "\n".join(lines[-tail:])
 
     # -- misc --------------------------------------------------------------
 

@@ -4,6 +4,12 @@ Role-equivalent of the reference's WorkerPool (src/ray/raylet/worker_pool.h:276)
 the raylet spawns language workers as subprocesses, workers dial back and
 register, idle workers are popped to satisfy leases and pushed back on lease
 return. Idle workers above the prestart floor are reaped after a timeout.
+
+Worker stdout/stderr is captured raylet-side (reference: the per-node log
+monitor, _private/log_monitor.py): each worker's output is pumped by a reader
+thread into a per-worker file under the session log dir and, batched, into a
+``log_sink`` callable that the raylet wires to the GCS "logs" pubsub channel
+so drivers can echo worker output (ray.init(log_to_driver=True) semantics).
 """
 
 from __future__ import annotations
@@ -13,9 +19,10 @@ import logging
 import os
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..._internal.ids import NodeID, WorkerID
 
@@ -43,6 +50,8 @@ class WorkerPool:
         max_workers: int,
         config_json: str,
         auth_token: str = "",
+        log_dir: Optional[str] = None,
+        log_sink: Optional[Callable[[dict], None]] = None,
     ):
         self._node_id = node_id
         self._raylet_port_getter = raylet_port_getter
@@ -51,6 +60,8 @@ class WorkerPool:
         self._max_workers = max_workers
         self._config_json = config_json
         self._auth_token = auth_token
+        self._log_dir = log_dir
+        self._log_sink = log_sink
         self._idle: List[WorkerHandle] = []
         self._registered: Dict[WorkerID, WorkerHandle] = {}
         self._spawned_procs: Dict[int, subprocess.Popen] = {}  # pid -> proc
@@ -118,16 +129,82 @@ class WorkerPool:
             "--session", self._session_id,
             "--config", self._config_json,
         ]
-        proc = subprocess.Popen(
-            cmd,
-            env=env,
-            stdout=subprocess.DEVNULL if env.get("RAY_TPU_WORKER_QUIET") else None,
-            stderr=None,
-        )
+        if self._log_dir is not None:
+            # capture into the session log dir + publish to the driver.
+            # Unbuffered: piped stdout would otherwise block-buffer prints
+            # and delay the driver echo by kilobytes.
+            env["PYTHONUNBUFFERED"] = "1"
+            proc = subprocess.Popen(
+                cmd, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            )
+            threading.Thread(
+                target=self._pump_logs, args=(proc, bool(env.get("RAY_TPU_WORKER_QUIET"))),
+                name=f"log-pump-{proc.pid}", daemon=True,
+            ).start()
+        else:
+            proc = subprocess.Popen(
+                cmd,
+                env=env,
+                stdout=subprocess.DEVNULL if env.get("RAY_TPU_WORKER_QUIET") else None,
+                stderr=None,
+            )
         self._spawned_procs[proc.pid] = proc
         self._pending_spawns[proc.pid] = env_key
         logger.debug("spawned worker pid=%s", proc.pid)
         return proc
+
+    def _pump_logs(self, proc: subprocess.Popen, quiet: bool):
+        """Reader thread: tee one worker's merged stdout/stderr into its
+        session log file and batch lines to the log sink (→ GCS "logs"
+        channel). select() with a short timeout bounds both batch size and
+        batch age, so a lone final line still reaches the driver promptly
+        while chatty workers don't hammer the control plane per line."""
+        import select
+
+        path = os.path.join(self._log_dir, f"worker-{proc.pid}.log")
+        fd = proc.stdout.fileno()
+        batch: List[str] = []
+        partial = b""
+
+        def flush():
+            nonlocal batch
+            if batch and self._log_sink is not None and not quiet:
+                try:
+                    self._log_sink({"pid": proc.pid, "lines": batch})
+                except Exception:
+                    pass  # sink failures must not kill the pump
+            batch = []
+
+        try:
+            with open(path, "ab", buffering=0) as f:
+                while True:
+                    readable, _, _ = select.select([fd], [], [], 0.2)
+                    if not readable:
+                        flush()
+                        continue
+                    chunk = os.read(fd, 65536)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+                    lines = (partial + chunk).split(b"\n")
+                    partial = lines.pop()
+                    batch.extend(
+                        ln.decode("utf-8", errors="replace") for ln in lines
+                    )
+                    if len(batch) >= 200:
+                        flush()
+                if partial:
+                    f.write(b"\n")
+                    batch.append(partial.decode("utf-8", errors="replace"))
+        except (OSError, ValueError):
+            pass
+        finally:
+            flush()
+            try:
+                proc.stdout.close()
+            except Exception:
+                pass
 
     def on_worker_registered(self, worker_id: WorkerID, address: tuple, pid: int,
                              env_key: str = ""):

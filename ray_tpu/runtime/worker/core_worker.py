@@ -25,6 +25,7 @@ import concurrent.futures
 import enum
 import logging
 import os
+import sys
 import threading
 import time
 from collections import defaultdict, deque
@@ -233,6 +234,15 @@ class CoreWorker:
         )
         self._event_flush_task = asyncio.ensure_future(self._flush_task_events())
         return self.address
+
+    async def subscribe_worker_logs(self, callback):
+        """Echo worker output to this process (reference:
+        ray.init(log_to_driver=True) — the driver subscribes to the log
+        channel and prints lines the per-node log monitors publish).
+        ``callback`` receives {"pid", "ip", "node_id", "lines": [...]}."""
+        await self._subscriber.subscribe(
+            "logs", lambda _channel, record: callback(record)
+        )
 
     # -- task events (reference: TaskEventBuffer, task_event_buffer.h:297) --
 
@@ -1605,9 +1615,21 @@ class CoreWorker:
     async def _run_user_code(self, fn, args, kwargs, spec: TaskSpec):
         if asyncio.iscoroutinefunction(fn):
             return await fn(*args, **kwargs)
-        return await self.loop.run_in_executor(
-            self._executor_pool, lambda: fn(*args, **kwargs)
-        )
+
+        def _call():
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                # opt-in post-mortem debugger (reference: RAY_DEBUG_POST_MORTEM).
+                # Runs here in the executor thread so the blocking accept()
+                # never stalls the worker's event loop.
+                from ...util import debug
+
+                if debug.post_mortem_enabled():
+                    debug.post_mortem(sys.exc_info()[2])
+                raise
+
+        return await self.loop.run_in_executor(self._executor_pool, _call)
 
     def _error_reply(self, spec: TaskSpec, exc: Exception) -> TaskReply:
         err = TaskError.from_exception(spec.function.qualname, exc)

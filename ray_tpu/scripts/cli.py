@@ -121,6 +121,39 @@ def cmd_list(args):
     return 0
 
 
+def cmd_logs(args):
+    _connected(args)
+    from ..util import state
+
+    if args.filename:
+        print(state.get_log(args.filename, node_id=args.node_id, tail=args.tail))
+    else:
+        print(json.dumps(state.list_logs(node_id=args.node_id), indent=2))
+    return 0
+
+
+def cmd_debug(args):
+    _connected(args)
+    from ..util import debug
+
+    if not args.session:
+        sessions = debug.list_sessions()
+        if not sessions:
+            print("no active debug sessions")
+        else:
+            for sid, info in sessions.items():
+                print(
+                    f"{sid}  pid={info.get('pid')}  {info.get('host')}:"
+                    f"{info.get('port')}  {info.get('reason')}  "
+                    f"task={info.get('task_id')}"
+                )
+        return 0
+    if not debug.attach(args.session):
+        print(f"unknown debug session: {args.session}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_summary(args):
     _connected(args)
     from ..util import state
@@ -218,6 +251,22 @@ def main(argv=None):
         p = sub.add_parser(name)
         p.add_argument("--address", required=True, help="head host:port")
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser(
+        "logs", help="list or tail session log files (reference: ray logs)"
+    )
+    p.add_argument("filename", nargs="?", help="log file name; omit to list")
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument("--node-id", default=None, help="node id hex prefix filter")
+    p.add_argument("--tail", type=int, default=1000)
+    p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser(
+        "debug", help="list or attach to remote pdb sessions (ray debug)"
+    )
+    p.add_argument("session", nargs="?", help="session id prefix; omit to list")
+    p.add_argument("--address", required=True, help="head host:port")
+    p.set_defaults(fn=cmd_debug)
 
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument(

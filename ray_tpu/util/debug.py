@@ -1,0 +1,264 @@
+"""Remote debugger for tasks and actors.
+
+Role-equivalent of the reference's distributed debugger (ray.util.rpdb /
+util/debugpy.py + the `ray debug` CLI): ``set_trace()`` inside remote code
+opens a TCP pdb server on the worker's node, advertises the session in the
+GCS KV under the ``debug:`` prefix, and blocks until a client attaches;
+``ray_tpu debug`` lists advertised sessions and bridges the local terminal
+to one. Post-mortem entry on task failure is gated by the
+``RAY_TPU_POSTMORTEM=1`` env var (reference: RAY_DEBUG_POST_MORTEM).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pdb
+import socket
+import sys
+import time
+import uuid
+from typing import Optional
+
+from .. import _worker_api
+
+def _accept_timeout_s() -> float:
+    return float(os.environ.get("RAY_TPU_DEBUGGER_TIMEOUT_S", "600"))
+
+
+class _SocketIO:
+    """File-like adapter pdb can use for stdin/stdout over a TCP socket."""
+
+    def __init__(self, conn: socket.socket):
+        self._conn = conn
+        self._rfile = conn.makefile("r", encoding="utf-8", errors="replace")
+
+    def readline(self):
+        return self._rfile.readline()
+
+    def write(self, data: str):
+        try:
+            self._conn.sendall(data.encode("utf-8", errors="replace"))
+        except OSError:
+            pass
+        return len(data)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        try:
+            self._rfile.close()
+        except Exception:
+            pass
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+
+class _RemotePdb(pdb.Pdb):
+    """pdb over a socket. With ``close_on_detach`` the socket is torn down
+    when the session ends (continue/quit) — needed for breakpoint sessions,
+    where the interaction happens after set_trace() has already returned
+    into user code and no enclosing scope can close the socket."""
+
+    def __init__(self, io: _SocketIO, close_on_detach: bool = False):
+        super().__init__(stdin=io, stdout=io, nosigint=True)
+        self.prompt = "(ray_tpu-pdb) "
+        self._io = io
+        self._close_on_detach = close_on_detach
+
+    def set_continue(self):
+        super().set_continue()
+        if self._close_on_detach:
+            self._io.close()
+
+    def set_quit(self):
+        super().set_quit()
+        if self._close_on_detach:
+            self._io.close()
+
+
+def _kv_call(method: str, *args) -> Optional[object]:
+    """Best-effort GCS KV access from wherever we are (driver, task thread).
+    Returns None when the loop is unreachable (e.g. called on the worker's
+    own event loop from an async actor) — the session still works, it is
+    just not discoverable through `ray_tpu debug`."""
+    try:
+        worker = _worker_api.get_core_worker()
+        gcs = worker.client_pool.get(*worker.gcs_address)
+        return _worker_api.run_on_worker_loop(gcs.call(method, *args), timeout=10)
+    except Exception:
+        return None
+
+
+def _session_context() -> dict:
+    ctx = {"pid": os.getpid(), "ts": time.time()}
+    try:
+        from ..runtime_context import get_runtime_context
+
+        ctx.update(get_runtime_context().get())
+    except Exception:
+        pass
+    return ctx
+
+
+def _serve_session(reason: str, run):
+    """Open the TCP server, advertise, accept one client, and hand its
+    socket IO to ``run(io)``."""
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("0.0.0.0", 0))
+    server.listen(1)
+    port = server.getsockname()[1]
+    host = socket.gethostbyname(socket.gethostname())
+    session_id = uuid.uuid4().hex[:12]
+    info = {**_session_context(), "host": host, "port": port, "reason": reason}
+    key = f"debug:{session_id}"
+    _kv_call("kv_put", key, json.dumps(info).encode(), True)
+    print(
+        f"RAY_TPU DEBUGGER: {reason} — waiting for a client at "
+        f"{host}:{port} (session {session_id}); attach with: "
+        f"ray_tpu debug --address <head> {session_id}",
+        flush=True,
+    )
+    timeout_s = _accept_timeout_s()
+    server.settimeout(timeout_s)
+    try:
+        conn, _addr = server.accept()
+    except socket.timeout:
+        print(
+            f"RAY_TPU DEBUGGER: no client within {timeout_s:.0f}s; continuing",
+            flush=True,
+        )
+        return
+    finally:
+        _kv_call("kv_del", key)
+        server.close()
+    # run() owns the io lifetime: post-mortem closes it on return; a
+    # breakpoint session hands it to the debugger, which closes it when the
+    # user continues/quits (the interaction outlives this call).
+    run(_SocketIO(conn))
+
+
+def set_trace(frame=None):
+    """Breakpoint. In a driver on a TTY this is plain pdb; in remote code it
+    opens a remote-attach session (reference: ray.util.rpdb.set_trace)."""
+    frame = frame or sys._getframe().f_back
+    worker = _worker_api.maybe_get_core_worker()
+    is_driver = worker is not None and getattr(worker, "mode", None) is not None \
+        and getattr(worker.mode, "name", "") == "DRIVER"
+    if (worker is None or is_driver) and sys.stdin is not None and sys.stdin.isatty():
+        debugger = pdb.Pdb(nosigint=True)
+        debugger.set_trace(frame)
+        return
+
+    def run(io: _SocketIO):
+        debugger = _RemotePdb(io, close_on_detach=True)
+        # Bdb.set_trace()-equivalent, except the stop target is pinned to the
+        # USER frame: plain set_step() would halt at the very next 'call'
+        # event, which is this module's own socket/cleanup machinery.
+        debugger.reset()
+        f = frame
+        while f:
+            f.f_trace = debugger.trace_dispatch
+            debugger.botframe = f
+            f = f.f_back
+        try:
+            debugger._set_stopinfo(frame, None)
+        except TypeError:  # future signature drift: degrade to plain stepping
+            debugger.set_step()
+        sys.settrace(debugger.trace_dispatch)
+
+    _serve_session("breakpoint", run)
+
+
+def post_mortem(traceback=None):
+    """Debug an exception's traceback remotely (reference: post-mortem mode
+    of the distributed debugger)."""
+    if traceback is None:
+        traceback = sys.exc_info()[2]
+    if traceback is None:
+        raise ValueError("no traceback to debug")
+
+    def run(io: _SocketIO):
+        try:
+            debugger = _RemotePdb(io)
+            debugger.reset()
+            debugger.interaction(None, traceback)
+        finally:
+            io.close()
+
+    _serve_session("post-mortem", run)
+
+
+def post_mortem_enabled() -> bool:
+    return os.environ.get("RAY_TPU_POSTMORTEM") == "1"
+
+
+def list_sessions() -> dict:
+    """Advertised debug sessions: session id -> info dict."""
+    keys = _kv_call("kv_keys", "debug:") or []
+    out = {}
+    for key in keys:
+        raw = _kv_call("kv_get", key)
+        if raw:
+            try:
+                out[key.split(":", 1)[1]] = json.loads(bytes(raw).decode())
+            except (ValueError, TypeError):
+                pass
+    return out
+
+
+def attach(session_id: str, stdin=None, stdout=None) -> bool:
+    """Bridge the local terminal to a remote pdb session. Returns False if
+    the session is unknown."""
+    import threading
+
+    stdin = stdin if stdin is not None else sys.stdin
+    stdout = stdout if stdout is not None else sys.stdout
+    sessions = list_sessions()
+    matches = [sid for sid in sessions if sid.startswith(session_id)]
+    if not matches:
+        return False
+    info = sessions[matches[0]]
+    conn = socket.create_connection((info["host"], info["port"]), timeout=10)
+
+    done = threading.Event()
+
+    def pump_remote_to_local():
+        try:
+            while True:
+                data = conn.recv(4096)
+                if not data:
+                    break
+                stdout.write(data.decode("utf-8", errors="replace"))
+                stdout.flush()
+        except OSError:
+            pass
+        finally:
+            done.set()
+
+    thread = threading.Thread(target=pump_remote_to_local, daemon=True)
+    thread.start()
+    try:
+        while not done.is_set():
+            line = stdin.readline()
+            if not line:
+                # local EOF: the remote side may still be streaming replies
+                # to commands already sent — wait for it to hang up before
+                # closing, or the tail of the session output is lost
+                done.wait(timeout=60)
+                break
+            try:
+                conn.sendall(line.encode("utf-8"))
+            except OSError:
+                break
+    finally:
+        done.set()
+        try:
+            conn.close()
+        except OSError:
+            pass
+    return True

@@ -105,6 +105,46 @@ def list_objects() -> List[Dict[str, Any]]:
     ]
 
 
+def _raylet_call(address, method: str, *args, **kwargs):
+    worker = _worker_api.get_core_worker()
+    return _worker_api.run_on_worker_loop(
+        worker.client_pool.get(*tuple(address)).call(method, *args, **kwargs)
+    )
+
+
+def list_logs(node_id: Optional[str] = None) -> Dict[str, List[str]]:
+    """Per-node listing of session log files (reference: `ray logs` backed
+    by the per-node log dirs). ``node_id`` may be a hex prefix."""
+    out: Dict[str, List[str]] = {}
+    for n in _gcs_call("get_all_nodes"):
+        nid = n.node_id.hex()
+        if not n.alive or (node_id and not nid.startswith(node_id)):
+            continue
+        try:
+            out[nid] = _raylet_call(n.address, "list_logs")
+        except Exception:
+            out[nid] = []
+    return out
+
+
+def get_log(
+    filename: str, node_id: Optional[str] = None, tail: int = 1000
+) -> str:
+    """Fetch the tail of one log file, searching nodes (hex-prefix filtered)
+    until a node that has it responds."""
+    for n in _gcs_call("get_all_nodes"):
+        nid = n.node_id.hex()
+        if not n.alive or (node_id and not nid.startswith(node_id)):
+            continue
+        try:
+            text = _raylet_call(n.address, "read_log", filename, tail)
+        except Exception:
+            continue
+        if text:
+            return text
+    return ""
+
+
 def cluster_summary() -> Dict[str, Any]:
     nodes = list_nodes()
     return {

@@ -1,0 +1,97 @@
+"""Remote debugger: set_trace in a task, session discovery, attach bridge,
+post-mortem on failure.
+
+Reference behavior: ray.util.rpdb / `ray debug` — a breakpoint in remote code
+advertises a TCP pdb server that the CLI attaches to; post-mortem entry is
+env-gated (RAY_DEBUG_POST_MORTEM).
+"""
+
+import io
+import time
+
+import pytest
+
+
+@pytest.fixture
+def cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _wait_for_session(debug, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        sessions = debug.list_sessions()
+        if sessions:
+            return sessions
+        time.sleep(0.2)
+    return {}
+
+
+def test_set_trace_attach_inspect_continue(cluster):
+    ray_tpu = cluster
+
+    @ray_tpu.remote
+    def buggy():
+        x = 41  # noqa: F841 — inspected through the debugger
+        from ray_tpu.util import debug
+
+        debug.set_trace()
+        return x + 1
+
+    ref = buggy.remote()
+    from ray_tpu.util import debug
+
+    sessions = _wait_for_session(debug)
+    assert sessions, "debug session never advertised in GCS KV"
+    (sid,) = sessions
+    assert sessions[sid]["reason"] == "breakpoint"
+
+    out = io.StringIO()
+    assert debug.attach(sid, stdin=io.StringIO("p x\nc\n"), stdout=out)
+    assert ray_tpu.get(ref, timeout=60) == 42
+    assert "41" in out.getvalue()
+    # the session key is cleaned up after the client attaches
+    assert _wait_for_nothing(debug)
+
+
+def _wait_for_nothing(debug, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if not debug.list_sessions():
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def test_post_mortem_env_gated(cluster):
+    ray_tpu = cluster
+
+    @ray_tpu.remote(
+        runtime_env={
+            "env_vars": {
+                "RAY_TPU_POSTMORTEM": "1",
+                "RAY_TPU_DEBUGGER_TIMEOUT_S": "60",
+            }
+        }
+    )
+    def exploder():
+        secret = 1234  # noqa: F841
+        raise ValueError("boom-for-postmortem")
+
+    ref = exploder.remote()
+    from ray_tpu.util import debug
+
+    sessions = _wait_for_session(debug)
+    assert sessions, "post-mortem session never advertised"
+    (sid,) = sessions
+    assert sessions[sid]["reason"] == "post-mortem"
+
+    out = io.StringIO()
+    assert debug.attach(sid, stdin=io.StringIO("p secret\nq\n"), stdout=out)
+    with pytest.raises(Exception, match="boom-for-postmortem"):
+        ray_tpu.get(ref, timeout=60)
+    assert "1234" in out.getvalue()

@@ -1,0 +1,96 @@
+"""Worker log capture: session log files, driver echo, logs state API.
+
+Reference behavior: the per-node log monitor (_private/log_monitor.py) tails
+worker stdout/stderr into /tmp/ray/session_*/logs and streams lines to the
+driver when ray.init(log_to_driver=True); `ray logs` lists/fetches files.
+"""
+
+import time
+
+import pytest
+
+
+@pytest.fixture
+def cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _wait(pred, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def test_worker_prints_reach_log_files_and_driver(cluster, capfd):
+    ray_tpu = cluster
+
+    @ray_tpu.remote
+    def shout(msg):
+        print(msg)
+        return msg
+
+    marker = "log-capture-marker-12345"
+    assert ray_tpu.get(shout.remote(marker)) == marker
+
+    from ray_tpu.util import state
+
+    # file side: the worker's session log file contains the line
+    def in_files():
+        logs = state.list_logs()
+        for node_id, files in logs.items():
+            for name in files:
+                if marker in state.get_log(name, node_id=node_id):
+                    return True
+        return False
+
+    assert _wait(in_files), "marker never appeared in session log files"
+
+    # driver side: the pubsub echo printed it to stderr with a pid prefix
+    def echoed():
+        captured = capfd.readouterr()
+        echoed.buf += captured.err
+        return marker in echoed.buf and "(pid=" in echoed.buf
+
+    echoed.buf = ""
+    assert _wait(echoed), "marker was not echoed to the driver"
+
+
+def test_list_logs_filters_by_node_prefix(cluster):
+    ray_tpu = cluster
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    assert ray_tpu.get(noop.remote()) == 1
+    from ray_tpu.util import state
+
+    logs = state.list_logs()
+    assert len(logs) == 1
+    (node_id,) = logs
+    assert state.list_logs(node_id=node_id[:8]) == logs
+    # wrong prefix yields nothing
+    other = "0" * 8 if not node_id.startswith("0" * 8) else "f" * 8
+    assert state.list_logs(node_id=other) == {}
+
+
+def test_read_log_is_sandboxed_to_log_dir(cluster):
+    """read_log must not serve arbitrary paths."""
+    ray_tpu = cluster
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get(noop.remote())
+    from ray_tpu.util import state
+
+    assert state.get_log("../../../etc/passwd") == ""
+    assert state.get_log("/etc/passwd") == ""
