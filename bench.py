@@ -72,8 +72,17 @@ def _probe_tpu_alive(timeout_s: float = 120.0) -> bool:
 
 
 def _is_oom(exc: BaseException) -> bool:
-    s = str(exc)
-    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "OOM" in s
+    import re
+
+    s = str(exc).lower()
+    # "Ran out of memory in memory space hbm" (XLA:TPU compile),
+    # RESOURCE_EXHAUSTED (runtime allocator). \boom\b, not a bare substring:
+    # "room"/"bloom" in an unrelated error must not trigger the ladder.
+    return (
+        "resource_exhausted" in s
+        or "out of memory" in s
+        or re.search(r"\boom\b", s) is not None
+    )
 
 
 def main():
@@ -114,6 +123,7 @@ def main():
             vocab_size=32000, dim=4096, n_layers=n_layers, n_heads=32,
             n_kv_heads=32, intermediate=11008, max_seq_len=2048,
             param_dtype=jnp.bfloat16, remat=True, lora_rank=16,
+            scan_layers=True,  # one layer's working set at a time (see config)
         )
 
     if on_tpu:
@@ -201,29 +211,41 @@ def result_params_count(cfg) -> int:
 
 def _measure(cfg, batch, steps, _log):
     import jax
+    import jax.numpy as jnp
     import optax
+    from flax import linen as nn
+    from jax.experimental.layout import Format, Layout
 
-    from ray_tpu.models.llama import init_params, next_token_loss
-    from ray_tpu.parallel.sharding import unbox_params
+    from ray_tpu.models.llama import Llama, next_token_loss
     from ray_tpu.train.lora import merge_lora, split_lora
 
     seq = cfg.max_seq_len
-    _log(f"init n_layers={cfg.n_layers} batch={batch} seq={seq}")
-    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
-    base, lora = split_lora(params)
-    del params
-    optimizer = optax.adamw(1e-4)
-    opt_state = optimizer.init(lora)
-    _log("params initialized (base frozen, lora in optimizer)")
+    _log(f"abstract init n_layers={cfg.n_layers} batch={batch} seq={seq}")
 
-    # `base` is an explicit jit ARGUMENT, not a closure capture: captured
-    # trees are lowered as constants, and 13.5GB of bf16 constants blows the
-    # compile payload through the remote-dispatch tunnel (observed: >20min
-    # lowering). As an argument it stays a resident device buffer.
+    # Shapes only — no arrays yet. Params are generated AFTER compiling with
+    # AUTO input layouts, each leaf directly into the layout XLA chose:
+    # (a) naive model.init materializes whole-leaf f32 init temps next to
+    #     13.5GB of resident params (a stacked w_gate leaf alone is a 5.4GiB
+    #     f32 temp) and OOMs the 16GB chip during INIT;
+    # (b) default (row-major) argument layouts make XLA insert whole-array
+    #     relayout copies of the stacked wq/wk/wv kernels inside the train
+    #     program (3x 1GiB of HLO temps — the difference between 7B fitting
+    #     and OOMing at seq 2048). Layout.AUTO lets the compiler pick
+    #     argument layouts so the copies never exist.
+    model = Llama(cfg, None)
+    shapes = nn.meta.unbox(
+        jax.eval_shape(
+            lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32)),
+            jax.random.PRNGKey(0),
+        )["params"]
+    )
+    base_s, lora_s = split_lora(shapes)  # FLAT dicts keyed by tuple paths
+    optimizer = optax.adamw(1e-4)
+    opt_s = jax.eval_shape(optimizer.init, lora_s)
+
     def loss_fn(lora_p, base_p, tokens):
         return next_token_loss(cfg, None, merge_lora(base_p, lora_p), tokens)
 
-    @jax.jit
     def run(base_p, lp, s, data):
         def one_step(carry, tokens):
             lp_c, s_c = carry
@@ -234,9 +256,80 @@ def _measure(cfg, batch, steps, _log):
         (lp2, s2), losses = jax.lax.scan(one_step, (lp, s), data)
         return lp2, s2, losses
 
+    def compile_run(n_steps, formats=None):
+        # formats pins a later compile (the 2K refinement) to the layouts
+        # the params were already generated in; AUTO there could legally
+        # pick different ones and reject the existing buffers
+        data_s = jax.ShapeDtypeStruct((n_steps, batch, seq), jnp.int32)
+        jitted = jax.jit(
+            run, in_shardings=formats or Format(Layout.AUTO)
+        )
+        return jitted.lower(base_s, lora_s, opt_s, data_s).compile()
+
+    tc0 = time.perf_counter()
+    compiled = compile_run(steps)
+    base_fmt, lora_fmt, opt_fmt, data_fmt = compiled.input_formats[0]
+    _log(f"train step compiled with AUTO layouts ({time.perf_counter() - tc0:.1f}s)")
+
+    def gen_into(fmt_tree, shape_tree, seed, what):
+        """Generate each param leaf straight into its compiled layout — ONE
+        jit dispatch per leaf. Stacked leaves build inside lax.map (a scan),
+        so the f32 init temp is one layer-slice, never the whole leaf."""
+        out = {}
+        key = jax.random.PRNGKey(seed)
+        for i, (path, leaf) in enumerate(sorted(shape_tree.items())):
+            if _remaining() < 60:
+                raise TimeoutError(
+                    f"budget exhausted while generating {what} params "
+                    f"({i}/{len(shape_tree)} leaves)"
+                )
+            fmt, name = fmt_tree[path], path[-1]
+            k = jax.random.fold_in(key, i)
+            if name in ("attn_norm", "mlp_norm", "final_norm"):
+                out[path] = jax.jit(
+                    lambda s=leaf.shape, d=leaf.dtype: jnp.ones(s, d),
+                    out_shardings=fmt,
+                )()
+            elif name == "lora_b":
+                out[path] = jax.jit(
+                    lambda s=leaf.shape, d=leaf.dtype: jnp.zeros(s, d),
+                    out_shardings=fmt,
+                )()
+            elif len(leaf.shape) >= 3 and leaf.shape[0] == cfg.n_layers:
+
+                def gen_stacked(kk, s=leaf.shape, d=leaf.dtype):
+                    keys = jax.random.split(kk, s[0])
+                    return jax.lax.map(
+                        lambda kj: (
+                            0.02 * jax.random.normal(kj, s[1:], jnp.float32)
+                        ).astype(d),
+                        keys,
+                    )
+
+                out[path] = jax.jit(gen_stacked, out_shardings=fmt)(k)
+            else:
+                out[path] = jax.jit(
+                    lambda kk, s=leaf.shape, d=leaf.dtype: (
+                        0.02 * jax.random.normal(kk, s, jnp.float32)
+                    ).astype(d),
+                    out_shardings=fmt,
+                )(k)
+        _log(f"{what}: {len(out)} leaves generated")
+        return out
+
+    base = gen_into(base_fmt, base_s, 0, "base")
+    jax.block_until_ready(base)
+    lora = gen_into(lora_fmt, lora_s, 1, "lora")
+    opt_state = jax.jit(optimizer.init, out_shardings=opt_fmt)(lora)
+    jax.block_until_ready((lora, opt_state))
+    _log("params generated into compiled layouts (base frozen, lora in optimizer)")
+
     def make_data(n_steps, s):
-        return jax.random.randint(
-            jax.random.PRNGKey(s), (n_steps, batch, seq), 0, cfg.vocab_size
+        return jax.device_put(
+            jax.random.randint(
+                jax.random.PRNGKey(s), (n_steps, batch, seq), 0, cfg.vocab_size
+            ),
+            data_fmt,
         )
 
     # Timing through the remote-execution tunnel: block_until_ready does not
@@ -244,23 +337,26 @@ def _measure(cfg, batch, steps, _log):
     # counts and use the slope (dt(2K) - dt(K)) / K to cancel the fixed
     # per-dispatch overhead — but only if the wall-clock budget allows the
     # second compile; otherwise report the conservative single measurement.
-    def timed(n_steps, seed):
+    def timed(n_steps, seed, exe=None):
         _log(f"compile+warm n_steps={n_steps}")
         tc0 = time.perf_counter()
-        _, _, losses = run(base, lora, opt_state, make_data(n_steps, seed + 1000))
+        exe = exe or compile_run(
+            n_steps, formats=(base_fmt, lora_fmt, opt_fmt, data_fmt)
+        )
+        _, _, losses = exe(base, lora, opt_state, make_data(n_steps, seed + 1000))
         float(losses[-1])  # compile + warm
         compile_s = time.perf_counter() - tc0
         _log(f"warm done n_steps={n_steps} ({compile_s:.1f}s); timing")
         # time with DIFFERENT data: the tunnel may serve repeated identical
         # dispatches from cache
         t0 = time.perf_counter()
-        _, _, losses = run(base, lora, opt_state, make_data(n_steps, seed))
+        _, _, losses = exe(base, lora, opt_state, make_data(n_steps, seed))
         float(losses[-1])
         dt = time.perf_counter() - t0
         _log(f"n_steps={n_steps} dt={dt:.3f}s")
         return dt, compile_s
 
-    t_short, compile_short = timed(steps, seed=1)
+    t_short, compile_short = timed(steps, seed=1, exe=compiled)
     # second (2K) measurement needs one more compile of similar cost to the
     # first plus ~2*t_short of run time; bail to the K-only estimate (which
     # conservatively includes dispatch overhead) if the budget is shy

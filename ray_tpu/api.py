@@ -140,8 +140,17 @@ def init(
         node.dashboard = DashboardServer(gcs_address, port=dashboard_port)
         node.dashboard.start()
     if log_to_driver and config.log_to_driver:
+        my_job = worker.job_id.hex()
+
+        def _filtered_echo(record: dict, _job=my_job):
+            # echo only this driver's job (records carry the leasing job's
+            # id; un-attributed output — prestart/setup chatter — is shown)
+            if record.get("job_id") and record["job_id"] != _job:
+                return
+            _print_worker_logs(record)
+
         loop_thread.run(
-            worker.subscribe_worker_logs(_print_worker_logs), timeout=30
+            worker.subscribe_worker_logs(_filtered_echo), timeout=30
         )
     _worker_api.set_core_worker(worker, config, loop_thread=loop_thread, node=node)
     atexit.register(_atexit_shutdown)

@@ -48,6 +48,12 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # Stack the layers and run them with nn.scan (train path only). One
+    # layer's buffers are live at a time — the python loop form lets XLA's
+    # latency-hiding scheduler keep many layers' remat recomputations
+    # resident at once (~7 GB of HLO temps at 7B/seq-2048, which OOMs a
+    # 16 GB v5e next to 13.5 GB of bf16 params). Also ~L× faster compiles.
+    scan_layers: bool = False
     lora_rank: int = 0
     lora_alpha: float = 16.0
 
@@ -291,6 +297,20 @@ class Block(nn.Module):
         )
 
 
+class BlockStep(nn.Module):
+    """One scanned layer: Block adapted to the (carry, xs) -> (carry, ys)
+    signature nn.scan requires; rope tables ride along as broadcast xs."""
+
+    config: LlamaConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, cos_sin):
+        cos, sin = cos_sin
+        x = Block(self.config, self.mesh, False, name="block")(x, cos, sin)
+        return x, None
+
+
 class Llama(nn.Module):
     config: LlamaConfig
     mesh: Optional[Mesh] = None
@@ -309,15 +329,36 @@ class Llama(nn.Module):
         )
         x = embed.astype(cfg.dtype)[tokens]
         cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
-        block = Block
-        if cfg.remat:
-            block = nn.remat(
-                Block,
-                policy=jax.checkpoint_policies.save_only_these_names(),
-                prevent_cse=False,
-            )
-        for i in range(cfg.n_layers):
-            x = block(cfg, self.mesh, self.decode, name=f"layer_{i}")(x, cos, sin)
+        if cfg.scan_layers and not self.decode:
+            # stacked layers under lax.scan: sequential structure the
+            # scheduler can't flatten, one layer's working set at a time
+            step = BlockStep
+            if cfg.remat:
+                step = nn.remat(
+                    BlockStep,
+                    policy=jax.checkpoint_policies.save_only_these_names(),
+                    prevent_cse=False,
+                )
+            x, _ = nn.scan(
+                step,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,
+                length=cfg.n_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"},
+            )(cfg, self.mesh, name="layers")(x, (cos, sin))
+        else:
+            block = Block
+            if cfg.remat:
+                block = nn.remat(
+                    Block,
+                    policy=jax.checkpoint_policies.save_only_these_names(),
+                    prevent_cse=False,
+                )
+            for i in range(cfg.n_layers):
+                x = block(cfg, self.mesh, self.decode, name=f"layer_{i}")(
+                    x, cos, sin
+                )
         final_norm_w = self.param(
             "final_norm",
             nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),

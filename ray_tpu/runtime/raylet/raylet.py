@@ -137,6 +137,8 @@ class Raylet:
         self._peer_transfer_ports: Dict[tuple, tuple] = {}
         self._pull_locks: Dict[ObjectID, asyncio.Lock] = {}
         self._pull_lock_holds: Dict[ObjectID, int] = {}
+        # worker pid -> hex job id of its most recent lease (log attribution)
+        self._worker_job: Dict[int, str] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -576,6 +578,11 @@ class Raylet:
             return {"granted": False, "reason": "no worker available"}
         lease_id = UniqueID.from_random()
         self._leases[lease_id] = Lease(lease_id, worker, allocation, spec)
+        # job attribution for the log plane: output from this worker belongs
+        # to the leasing job from here on (reference: per-job workers)
+        job = getattr(spec, "job_id", None)
+        if job is not None:
+            self._worker_job[worker.pid] = job.hex()
         return {
             "granted": True,
             "lease_id": lease_id,
@@ -1074,7 +1081,8 @@ class Raylet:
         if self._stopped:
             return
         record = dict(
-            record, ip=self.address[0], node_id=self.node_id.hex()
+            record, ip=self.address[0], node_id=self.node_id.hex(),
+            job_id=self._worker_job.get(record.get("pid"), ""),
         )
         asyncio.run_coroutine_threadsafe(self._publish_logs(record), self._loop)
 

@@ -81,6 +81,33 @@ def cmd_start(args):
         time.sleep(3600)
 
 
+def cmd_stop(args):
+    """Stop all local ray_tpu processes (reference: `ray stop` — scans for
+    ray process cmdlines and terminates them)."""
+    me = os.getpid()
+    needles = ("ray_tpu.scripts.cli start", "ray_tpu.runtime.worker.worker_main")
+    # two-space join matches how argv renders in /proc cmdline after replace
+    killed = []
+    for pid_dir in os.listdir("/proc"):
+        if not pid_dir.isdigit() or int(pid_dir) == me:
+            continue
+        try:
+            with open(f"/proc/{pid_dir}/cmdline", "rb") as f:
+                cmdline = f.read().replace(b"\0", b" ").decode(errors="replace")
+        except OSError:
+            continue
+        if any(n in cmdline for n in needles) or (
+            "-m ray_tpu" in cmdline and " start " in cmdline
+        ):
+            try:
+                os.kill(int(pid_dir), signal.SIGTERM)
+                killed.append(int(pid_dir))
+            except OSError:
+                pass
+    print(f"stopped {len(killed)} process(es): {killed}")
+    return 0
+
+
 def _connected(args):
     import ray_tpu
 
@@ -232,6 +259,11 @@ def main(argv=None):
         help="bind host for ray:// clients; 0.0.0.0 accepts remote machines",
     )
     p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser(
+        "stop", help="stop all local ray_tpu processes (reference: ray stop)"
+    )
+    p.set_defaults(fn=cmd_stop)
 
     p = sub.add_parser("job", help="submit and manage jobs")
     p.add_argument(

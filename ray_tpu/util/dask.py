@@ -1,0 +1,126 @@
+"""Dask-on-ray_tpu: execute dask task graphs as ray_tpu tasks.
+
+Role-equivalent of the reference's ``ray.util.dask`` (the ``ray_dask_get``
+scheduler): a dask *scheduler function* receives a plain graph dict
+(`key -> literal | (callable, arg...) | alias-key | [nested...]`) and the
+requested output keys, and must return results in the same nested shape.
+Each graph task becomes one ray_tpu task whose dependencies are passed as
+ObjectRefs, so independent subgraphs run in parallel across the cluster and
+intermediate results live in the object store.
+
+The core scheduler deliberately avoids importing dask — the graph protocol
+is plain data — so it is usable (and testable) without dask installed.
+``enable_dask_on_ray`` registers it as dask's default get when dask IS
+available.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, List
+
+from .. import api as _api
+from ..api import remote as _remote
+
+
+def _istask(x) -> bool:
+    return isinstance(x, tuple) and len(x) > 0 and callable(x[0])
+
+
+def _find_deps(expr, dsk, out: set):
+    """Collect graph keys referenced by ``expr`` (dask semantics: any
+    hashable leaf that is a key of the graph is a dependency)."""
+    if _istask(expr):
+        for arg in expr[1:]:
+            _find_deps(arg, dsk, out)
+    elif isinstance(expr, list):
+        for item in expr:
+            _find_deps(item, dsk, out)
+    else:
+        try:
+            if expr in dsk:
+                out.add(expr)
+        except TypeError:
+            pass  # unhashable literal
+    return out
+
+
+def _rebuild(expr, lookup: Dict[Hashable, Any]):
+    """Evaluate a dask expression with dependency keys already materialized."""
+    if _istask(expr):
+        func = expr[0]
+        args = [_rebuild(a, lookup) for a in expr[1:]]
+        return func(*args)
+    if isinstance(expr, list):
+        return [_rebuild(item, lookup) for item in expr]
+    try:
+        if expr in lookup:
+            return lookup[expr]
+    except TypeError:
+        pass
+    return expr
+
+
+@_remote
+def _exec_dask_task(expr, dep_keys: List[Hashable], *dep_values):
+    return _rebuild(expr, dict(zip(dep_keys, dep_values)))
+
+
+def _toposort(dsk) -> List[Hashable]:
+    order: List[Hashable] = []
+    state: Dict[Hashable, int] = {}  # 1 = visiting, 2 = done
+
+    def visit(key, stack):
+        if state.get(key) == 2:
+            return
+        if state.get(key) == 1:
+            raise ValueError(f"cycle in dask graph at {key!r}")
+        state[key] = 1
+        for dep in sorted(
+            _find_deps(dsk[key], dsk, set()), key=repr
+        ):
+            if dep != key:
+                visit(dep, stack)
+        state[key] = 2
+        order.append(key)
+
+    for key in dsk:
+        visit(key, [])
+    return order
+
+
+def ray_dask_get(dsk: Dict, keys, ray_remote_args: Dict | None = None, **_kw):
+    """Dask scheduler: one ray_tpu task per graph entry, dependencies as
+    ObjectRefs (reference: ray.util.dask.ray_dask_get). ``keys`` may be a
+    single key or arbitrarily nested lists of keys; the return value has
+    the same shape."""
+    refs: Dict[Hashable, Any] = {}
+    submit = (
+        _exec_dask_task.options(**ray_remote_args)
+        if ray_remote_args
+        else _exec_dask_task
+    )
+    for key in _toposort(dsk):
+        expr = dsk[key]
+        deps = sorted(
+            (d for d in _find_deps(expr, dsk, set()) if d != key), key=repr
+        )
+        refs[key] = submit.remote(expr, deps, *[refs[d] for d in deps])
+
+    def materialize(k):
+        if isinstance(k, list):
+            return [materialize(i) for i in k]
+        return _api.get(refs[k])
+
+    return materialize(keys)
+
+
+def enable_dask_on_ray():
+    """Set ray_dask_get as dask's default scheduler (requires dask)."""
+    try:
+        import dask
+    except ImportError as e:
+        raise ImportError(
+            "dask is not installed; ray_dask_get still works directly on "
+            "plain graph dicts: ray_dask_get(dsk, keys)"
+        ) from e
+    return dask.config.set(scheduler=ray_dask_get)

@@ -104,15 +104,42 @@ def _session_context() -> dict:
     return ctx
 
 
+def _auth_token() -> str:
+    cfg = _worker_api.get_config()
+    return getattr(cfg, "cluster_auth_token", "") or "" if cfg else ""
+
+
+def _bind_host() -> str:
+    """Bind where the cluster control plane is reachable — never wider.
+    Same rule as the native transfer plane (store.cc rt_transfer_serve):
+    a debugger socket is arbitrary code execution, so it must not listen
+    on interfaces the RPC plane doesn't."""
+    try:
+        worker = _worker_api.get_core_worker()
+        host = worker.gcs_address[0]
+        if host not in ("127.0.0.1", "localhost", ""):
+            # cluster spans hosts: listen on the interface that routes there
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                probe.connect((host, 1))
+                return probe.getsockname()[0]
+            finally:
+                probe.close()
+    except Exception:
+        pass
+    return "127.0.0.1"
+
+
 def _serve_session(reason: str, run):
     """Open the TCP server, advertise, accept one client, and hand its
-    socket IO to ``run(io)``."""
+    socket IO to ``run(io)``. When the cluster has an auth token, the
+    client must send it as the first line before getting a prompt."""
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    server.bind(("0.0.0.0", 0))
+    host = _bind_host()
+    server.bind((host, 0))
     server.listen(1)
     port = server.getsockname()[1]
-    host = socket.gethostbyname(socket.gethostname())
     session_id = uuid.uuid4().hex[:12]
     info = {**_session_context(), "host": host, "port": port, "reason": reason}
     key = f"debug:{session_id}"
@@ -136,10 +163,24 @@ def _serve_session(reason: str, run):
     finally:
         _kv_call("kv_del", key)
         server.close()
+    io = _SocketIO(conn)
+    token = _auth_token()
+    if token:
+        conn.settimeout(30)
+        try:
+            presented = io.readline().rstrip("\n")
+        except OSError:  # includes socket.timeout
+            presented = None
+        conn.settimeout(None)
+        if presented != token:
+            io.write("authentication failed\n")
+            io.close()
+            print("RAY_TPU DEBUGGER: client auth failed; continuing", flush=True)
+            return
     # run() owns the io lifetime: post-mortem closes it on return; a
     # breakpoint session hands it to the debugger, which closes it when the
     # user continues/quits (the interaction outlives this call).
-    run(_SocketIO(conn))
+    run(io)
 
 
 def set_trace(frame=None):
@@ -224,39 +265,36 @@ def attach(session_id: str, stdin=None, stdout=None) -> bool:
         return False
     info = sessions[matches[0]]
     conn = socket.create_connection((info["host"], info["port"]), timeout=10)
+    token = _auth_token()
+    if token:
+        conn.sendall(f"{token}\n".encode())
 
-    done = threading.Event()
-
-    def pump_remote_to_local():
+    # stdin pumps in a daemon thread; the MAIN thread drains the remote so
+    # attach() returns the moment the debuggee continues/quits — a blocking
+    # stdin.readline() in the main thread would otherwise hold the CLI
+    # hostage until one extra Enter after the session already ended.
+    def pump_local_to_remote():
         try:
             while True:
-                data = conn.recv(4096)
-                if not data:
+                line = stdin.readline()
+                if not line:
                     break
-                stdout.write(data.decode("utf-8", errors="replace"))
-                stdout.flush()
+                conn.sendall(line.encode("utf-8"))
         except OSError:
             pass
-        finally:
-            done.set()
 
-    thread = threading.Thread(target=pump_remote_to_local, daemon=True)
+    thread = threading.Thread(target=pump_local_to_remote, daemon=True)
     thread.start()
     try:
-        while not done.is_set():
-            line = stdin.readline()
-            if not line:
-                # local EOF: the remote side may still be streaming replies
-                # to commands already sent — wait for it to hang up before
-                # closing, or the tail of the session output is lost
-                done.wait(timeout=60)
+        while True:
+            data = conn.recv(4096)
+            if not data:
                 break
-            try:
-                conn.sendall(line.encode("utf-8"))
-            except OSError:
-                break
+            stdout.write(data.decode("utf-8", errors="replace"))
+            stdout.flush()
+    except OSError:
+        pass
     finally:
-        done.set()
         try:
             conn.close()
         except OSError:

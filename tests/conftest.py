@@ -41,3 +41,16 @@ def shutdown_only():
 
     yield
     ray_tpu.shutdown()
+
+
+@pytest.fixture
+def shutdown_only_with_token():
+    """Cluster with RPC auth on; clears the process-global token after."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, _system_config={"cluster_auth_token": "tok-dbg"})
+    yield ray_tpu
+    ray_tpu.shutdown()
+    from ray_tpu._internal.rpc import set_auth_token
+
+    set_auth_token(None)

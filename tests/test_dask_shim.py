@@ -1,0 +1,53 @@
+"""ray_dask_get: dask graph-protocol scheduler over ray_tpu tasks.
+
+Reference behavior: ray.util.dask.ray_dask_get — executes a dask graph dict
+as distributed tasks; works on plain graphs without dask installed.
+"""
+
+from operator import add
+
+import pytest
+
+
+@pytest.fixture
+def cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_graph_with_deps_and_nested_keys(cluster):
+    from ray_tpu.util.dask import ray_dask_get
+
+    def total(xs):
+        return sum(xs)
+
+    dsk = {
+        "a": 1,
+        "b": (add, "a", 2),        # 3
+        "c": (add, "b", "b"),      # 6
+        "d": (total, ["a", "b", "c"]),  # 10
+        "alias": "d",
+    }
+    assert ray_dask_get(dsk, "d") == 10
+    assert ray_dask_get(dsk, ["a", ["b", "c"], "alias"]) == [1, [3, 6], 10]
+
+
+def test_cycle_detection(cluster):
+    from ray_tpu.util.dask import ray_dask_get
+
+    dsk = {"x": (add, "y", 1), "y": (add, "x", 1)}
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get(dsk, "x")
+
+
+def test_literals_pass_through(cluster):
+    from ray_tpu.util.dask import ray_dask_get
+
+    def cat(a, b):
+        return f"{a}{b}"
+
+    dsk = {"s": (cat, "not-a-key", "a"), "a": "!"}
+    assert ray_dask_get(dsk, "s") == "not-a-key!"

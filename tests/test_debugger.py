@@ -95,3 +95,34 @@ def test_post_mortem_env_gated(cluster):
     with pytest.raises(Exception, match="boom-for-postmortem"):
         ray_tpu.get(ref, timeout=60)
     assert "1234" in out.getvalue()
+
+
+def test_debugger_rejects_wrong_token(shutdown_only_with_token):
+    """With cluster auth on, the pdb socket requires the token as a first
+    line; a wrong token gets 'authentication failed' and the breakpoint is
+    skipped (the task completes)."""
+    import socket
+
+    ray_tpu = shutdown_only_with_token
+
+    @ray_tpu.remote
+    def guarded():
+        from ray_tpu.util import debug
+
+        debug.set_trace()
+        return "survived"
+
+    ref = guarded.remote()
+    from ray_tpu.util import debug
+
+    sessions = _wait_for_session(debug)
+    assert sessions
+    (sid,) = sessions
+    info = sessions[sid]
+    conn = socket.create_connection((info["host"], info["port"]), timeout=10)
+    conn.sendall(b"wrong-token\n")
+    reply = conn.recv(4096)
+    conn.close()
+    assert b"authentication failed" in reply
+    # the worker refused the client and moved on without a pdb session
+    assert ray_tpu.get(ref, timeout=60) == "survived"

@@ -1,0 +1,77 @@
+"""Scanned (stacked-layer) Llama path: param structure, loss parity with
+the python-loop form, LoRA split compatibility."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _cfg(**kw):
+    from ray_tpu.models.llama import LlamaConfig
+
+    return LlamaConfig.tiny(lora_rank=4, **kw)
+
+
+def test_scan_layers_params_stacked_and_loss_runs():
+    from ray_tpu.models.llama import init_params, next_token_loss
+    from ray_tpu.parallel.sharding import unbox_params
+
+    cfg = _cfg(scan_layers=True, remat=True)
+    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
+    # stacked: one "layers" subtree with a leading n_layers axis
+    kernel = params["layers"]["block"]["attn"]["wq"]["base"]["kernel"]
+    assert kernel.shape[0] == cfg.n_layers
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab_size)
+    loss = next_token_loss(cfg, None, params, tokens)
+    assert jnp.isfinite(loss)
+
+
+def test_scan_layers_grads_flow_and_lora_split():
+    from ray_tpu.models.llama import init_params, next_token_loss
+    from ray_tpu.parallel.sharding import unbox_params
+    from ray_tpu.train.lora import merge_lora, split_lora
+
+    cfg = _cfg(scan_layers=True)
+    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
+    base, lora = split_lora(params)
+    assert lora, "stacked tree must still expose lora_a/lora_b leaves"
+    assert all(k[-1] in ("lora_a", "lora_b") for k in lora)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+
+    def loss_fn(lora_p):
+        return next_token_loss(cfg, None, merge_lora(base, lora_p), tokens)
+
+    grads = jax.grad(loss_fn)(lora)
+    flat = jax.tree_util.tree_leaves(grads)
+    assert flat and all(jnp.all(jnp.isfinite(g)) for g in flat)
+    # lora_b initializes to zero, so d(loss)/d(lora_a) is zero at init but
+    # d(loss)/d(lora_b) must be nonzero (signal actually flows)
+    b_grads = [v for k, v in grads.items() if k[-1] == "lora_b"]
+    assert any(float(jnp.abs(g).max()) > 0 for g in b_grads)
+
+
+def test_scan_and_loop_agree_with_same_params():
+    """Restacking the loop form's per-layer params must reproduce the scan
+    form's logits exactly — same math, different program structure."""
+    from ray_tpu.models.llama import Llama, init_params
+    from ray_tpu.parallel.sharding import unbox_params
+
+    cfg_loop = _cfg(scan_layers=False)
+    cfg_scan = _cfg(scan_layers=True)
+    params = unbox_params(init_params(cfg_loop, jax.random.PRNGKey(0)))
+    # restack layer_i subtrees into the scan layout
+    layer_trees = [params[f"layer_{i}"] for i in range(cfg_loop.n_layers)]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *layer_trees
+    )
+    scan_params = {
+        "embed": params["embed"],
+        "final_norm": params["final_norm"],
+        "lm_head": params["lm_head"],
+        "layers": {"block": stacked},
+    }
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 16), 0, cfg_loop.vocab_size)
+    out_loop = Llama(cfg_loop).apply({"params": params}, tokens)
+    out_scan = Llama(cfg_scan).apply({"params": scan_params}, tokens)
+    assert jnp.allclose(out_loop, out_scan, atol=1e-5), (
+        float(jnp.abs(out_loop - out_scan).max())
+    )
