@@ -127,7 +127,13 @@ def main():
         )
 
     if on_tpu:
-        ladder = [(make_cfg(32), 1), (make_cfg(24), 1), (make_cfg(16), 1)]
+        # batch 2 first: bwd temps roughly double but ~2GB still fits next
+        # to the 12.6GiB of params, and the larger batch lifts MFU; the
+        # ladder falls back to batch 1 then shallower stacks on OOM
+        ladder = [
+            (make_cfg(32), 2), (make_cfg(32), 1),
+            (make_cfg(24), 1), (make_cfg(16), 1),
+        ]
         steps = 4
         peak = 197e12  # v5e bf16 peak
     else:  # smoke fallback for dev boxes
@@ -269,7 +275,8 @@ def _measure(cfg, batch, steps, _log):
     tc0 = time.perf_counter()
     compiled = compile_run(steps)
     base_fmt, lora_fmt, opt_fmt, data_fmt = compiled.input_formats[0]
-    _log(f"train step compiled with AUTO layouts ({time.perf_counter() - tc0:.1f}s)")
+    first_compile_s = time.perf_counter() - tc0
+    _log(f"train step compiled with AUTO layouts ({first_compile_s:.1f}s)")
 
     def gen_into(fmt_tree, shape_tree, seed, what):
         """Generate each param leaf straight into its compiled layout — ONE
@@ -356,11 +363,12 @@ def _measure(cfg, batch, steps, _log):
         _log(f"n_steps={n_steps} dt={dt:.3f}s")
         return dt, compile_s
 
-    t_short, compile_short = timed(steps, seed=1, exe=compiled)
-    # second (2K) measurement needs one more compile of similar cost to the
-    # first plus ~2*t_short of run time; bail to the K-only estimate (which
-    # conservatively includes dispatch overhead) if the budget is shy
-    if _remaining() > compile_short + 3 * t_short + 20:
+    t_short, _warm_s = timed(steps, seed=1, exe=compiled)
+    # second (2K) measurement needs one more compile — estimated from the
+    # MEASURED first compile (with exe=compiled, timed()'s own compile_s is
+    # just a warm run and would wildly understate it) — plus ~2*t_short of
+    # run time; bail to the K-only estimate if the budget is shy
+    if _remaining() > first_compile_s + 3 * t_short + 20:
         try:
             t_long, _ = timed(2 * steps, seed=2)
             dt = max(t_long - t_short, 1e-9)

@@ -166,15 +166,17 @@ class WorkerPool:
         fd = proc.stdout.fileno()
         batch: List[str] = []
         partial = b""
+        last_flush = time.monotonic()
 
         def flush():
-            nonlocal batch
+            nonlocal batch, last_flush
             if batch and self._log_sink is not None and not quiet:
                 try:
                     self._log_sink({"pid": proc.pid, "lines": batch})
                 except Exception:
                     pass  # sink failures must not kill the pump
             batch = []
+            last_flush = time.monotonic()
 
         try:
             with open(path, "ab", buffering=0) as f:
@@ -192,7 +194,9 @@ class WorkerPool:
                     batch.extend(
                         ln.decode("utf-8", errors="replace") for ln in lines
                     )
-                    if len(batch) >= 200:
+                    # size OR age: steady sub-0.2s output would otherwise
+                    # keep select() readable and starve the idle flush
+                    if len(batch) >= 200 or time.monotonic() - last_flush > 0.5:
                         flush()
                 if partial:
                     f.write(b"\n")

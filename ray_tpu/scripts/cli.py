@@ -85,20 +85,31 @@ def cmd_stop(args):
     """Stop all local ray_tpu processes (reference: `ray stop` — scans for
     ray process cmdlines and terminates them)."""
     me = os.getpid()
-    needles = ("ray_tpu.scripts.cli start", "ray_tpu.runtime.worker.worker_main")
-    # two-space join matches how argv renders in /proc cmdline after replace
+    # exact argv-token matching (NUL-split), not substring over the joined
+    # line: `grep worker_main ...` or an editor on that path must survive
+    ray_modules = {
+        "ray_tpu.scripts.cli", "ray_tpu.runtime.worker.worker_main",
+    }
     killed = []
     for pid_dir in os.listdir("/proc"):
         if not pid_dir.isdigit() or int(pid_dir) == me:
             continue
         try:
             with open(f"/proc/{pid_dir}/cmdline", "rb") as f:
-                cmdline = f.read().replace(b"\0", b" ").decode(errors="replace")
+                argv = [
+                    a.decode(errors="replace")
+                    for a in f.read().split(b"\0") if a
+                ]
         except OSError:
             continue
-        if any(n in cmdline for n in needles) or (
-            "-m ray_tpu" in cmdline and " start " in cmdline
-        ):
+        is_ours = False
+        for i, tok in enumerate(argv):
+            if tok == "-m" and i + 1 < len(argv) and argv[i + 1] in ray_modules:
+                # `cli` only counts when it is a `start` invocation
+                if argv[i + 1].endswith("worker_main") or "start" in argv[i + 2 : i + 3]:
+                    is_ours = True
+                break
+        if is_ours:
             try:
                 os.kill(int(pid_dir), signal.SIGTERM)
                 killed.append(int(pid_dir))

@@ -1,0 +1,1 @@
+"""Runnable Train examples for the BASELINE.json reference configs."""

@@ -66,25 +66,35 @@ def _exec_dask_task(expr, dep_keys: List[Hashable], *dep_values):
 
 
 def _toposort(dsk) -> List[Hashable]:
+    """Iterative DFS (deep linear chains are routine in dask graphs; a
+    recursive visit would hit the interpreter recursion limit ~1000)."""
     order: List[Hashable] = []
     state: Dict[Hashable, int] = {}  # 1 = visiting, 2 = done
 
-    def visit(key, stack):
-        if state.get(key) == 2:
-            return
-        if state.get(key) == 1:
-            raise ValueError(f"cycle in dask graph at {key!r}")
-        state[key] = 1
-        for dep in sorted(
-            _find_deps(dsk[key], dsk, set()), key=repr
-        ):
-            if dep != key:
-                visit(dep, stack)
-        state[key] = 2
-        order.append(key)
-
-    for key in dsk:
-        visit(key, [])
+    for root in dsk:
+        if state.get(root) == 2:
+            continue
+        stack: List[tuple] = [(root, False)]
+        while stack:
+            key, children_done = stack.pop()
+            if children_done:
+                state[key] = 2
+                order.append(key)
+                continue
+            if state.get(key) == 2:
+                continue
+            if state.get(key) == 1:
+                raise ValueError(f"cycle in dask graph at {key!r}")
+            state[key] = 1
+            stack.append((key, True))
+            for dep in sorted(_find_deps(dsk[key], dsk, set()), key=repr):
+                if dep == key:
+                    continue
+                if state.get(dep) == 1:
+                    raise ValueError(f"cycle in dask graph at {dep!r}")
+                if state.get(dep) != 2:
+                    stack.append((dep, False))
+        # stack unwound: everything reachable from root is done
     return order
 
 
@@ -94,6 +104,7 @@ def ray_dask_get(dsk: Dict, keys, ray_remote_args: Dict | None = None, **_kw):
     single key or arbitrarily nested lists of keys; the return value has
     the same shape."""
     refs: Dict[Hashable, Any] = {}
+    literals: Dict[Hashable, Any] = {}
     submit = (
         _exec_dask_task.options(**ray_remote_args)
         if ray_remote_args
@@ -104,11 +115,26 @@ def ray_dask_get(dsk: Dict, keys, ray_remote_args: Dict | None = None, **_kw):
         deps = sorted(
             (d for d in _find_deps(expr, dsk, set()) if d != key), key=repr
         )
-        refs[key] = submit.remote(expr, deps, *[refs[d] for d in deps])
+        if not _istask(expr) and not isinstance(expr, list):
+            if deps:  # alias: reuse the target's ref/literal directly
+                target = deps[0]
+                if target in refs:
+                    refs[key] = refs[target]
+                else:
+                    literals[key] = literals[target]
+            else:  # plain literal: no scheduler round-trip for a no-op
+                literals[key] = expr
+            continue
+        args = [
+            refs[d] if d in refs else literals[d] for d in deps
+        ]
+        refs[key] = submit.remote(expr, deps, *args)
 
     def materialize(k):
         if isinstance(k, list):
             return [materialize(i) for i in k]
+        if k in literals:
+            return literals[k]
         return _api.get(refs[k])
 
     return materialize(keys)

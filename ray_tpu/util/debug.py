@@ -151,32 +151,47 @@ def _serve_session(reason: str, run):
         flush=True,
     )
     timeout_s = _accept_timeout_s()
-    server.settimeout(timeout_s)
+    token = _auth_token()
+    deadline = time.time() + timeout_s
+    io = None
     try:
-        conn, _addr = server.accept()
-    except socket.timeout:
+        # accept until an AUTHENTICATED client arrives or the deadline
+        # passes: a port scanner or wrong-token client must not consume the
+        # one-shot session and silently skip the developer's breakpoint
+        while time.time() < deadline:
+            server.settimeout(max(deadline - time.time(), 0.1))
+            try:
+                conn, _addr = server.accept()
+            except socket.timeout:
+                break
+            candidate = _SocketIO(conn)
+            if token:
+                conn.settimeout(30)
+                try:
+                    presented = candidate.readline().rstrip("\n")
+                except OSError:  # includes socket.timeout
+                    presented = None
+                conn.settimeout(None)
+                if presented != token:
+                    candidate.write("authentication failed\n")
+                    candidate.close()
+                    print(
+                        "RAY_TPU DEBUGGER: rejected unauthenticated client; "
+                        "still waiting",
+                        flush=True,
+                    )
+                    continue
+            io = candidate
+            break
+    finally:
+        _kv_call("kv_del", key)
+        server.close()
+    if io is None:
         print(
             f"RAY_TPU DEBUGGER: no client within {timeout_s:.0f}s; continuing",
             flush=True,
         )
         return
-    finally:
-        _kv_call("kv_del", key)
-        server.close()
-    io = _SocketIO(conn)
-    token = _auth_token()
-    if token:
-        conn.settimeout(30)
-        try:
-            presented = io.readline().rstrip("\n")
-        except OSError:  # includes socket.timeout
-            presented = None
-        conn.settimeout(None)
-        if presented != token:
-            io.write("authentication failed\n")
-            io.close()
-            print("RAY_TPU DEBUGGER: client auth failed; continuing", flush=True)
-            return
     # run() owns the io lifetime: post-mortem closes it on return; a
     # breakpoint session hands it to the debugger, which closes it when the
     # user continues/quits (the interaction outlives this call).

@@ -97,16 +97,21 @@ def test_post_mortem_env_gated(cluster):
     assert "1234" in out.getvalue()
 
 
-def test_debugger_rejects_wrong_token(shutdown_only_with_token):
+def test_debugger_rejects_wrong_token_without_losing_session(
+    shutdown_only_with_token,
+):
     """With cluster auth on, the pdb socket requires the token as a first
-    line; a wrong token gets 'authentication failed' and the breakpoint is
-    skipped (the task completes)."""
+    line. A wrong-token client is rejected WITHOUT consuming the one-shot
+    session — the worker keeps listening, and a legitimate attach (which
+    sends the token automatically) still gets the breakpoint."""
+    import io
     import socket
 
     ray_tpu = shutdown_only_with_token
 
     @ray_tpu.remote
     def guarded():
+        x = 55  # noqa: F841
         from ray_tpu.util import debug
 
         debug.set_trace()
@@ -124,5 +129,8 @@ def test_debugger_rejects_wrong_token(shutdown_only_with_token):
     reply = conn.recv(4096)
     conn.close()
     assert b"authentication failed" in reply
-    # the worker refused the client and moved on without a pdb session
+    # the session survives the intruder: a real attach still works
+    out = io.StringIO()
+    assert debug.attach(sid, stdin=io.StringIO("p x\nc\n"), stdout=out)
     assert ray_tpu.get(ref, timeout=60) == "survived"
+    assert "55" in out.getvalue()
