@@ -25,6 +25,12 @@ class LLMConfig:
     sequence_parallel_size: int = 1
     # serving
     num_replicas: int = 1
+    # queue-depth replica autoscaling (BASELINE configs[4]: "Llama-2-7B
+    # serving with TPU replica autoscaling"); dict mirroring
+    # serve.AutoscalingConfig fields (min_replicas/max_replicas/
+    # target_ongoing_requests/...). When set, num_replicas is ignored and
+    # the serve controller scales TPU replicas with request pressure.
+    autoscaling_config: Optional[Dict[str, Any]] = None
     resources_per_replica: Dict[str, float] = field(
         default_factory=lambda: {"TPU": 0.0, "CPU": 1.0}
     )

@@ -144,10 +144,15 @@ def build_llm_deployment(
 ):
     """Return a bound serve Application for this LLM (reference:
     build_llm_deployment, llm/_internal/serve/builders)."""
-    dep = serve.deployment(
-        _LLMReplica,
+    options = dict(
         name=name or llm_config.model_id,
-        num_replicas=llm_config.num_replicas,
         ray_actor_options=dict(llm_config.resources_per_replica),
     )
+    if llm_config.autoscaling_config:
+        # TPU replica autoscaling: the serve controller adds/removes engine
+        # replicas from queue depth (serve/_private autoscaling policy)
+        options["autoscaling_config"] = dict(llm_config.autoscaling_config)
+    else:
+        options["num_replicas"] = llm_config.num_replicas
+    dep = serve.deployment(_LLMReplica, **options)
     return dep.bind(llm_config, params_blob, tokenizer_name)

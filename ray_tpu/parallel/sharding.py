@@ -84,3 +84,28 @@ def unbox_params(params: Any):
     import flax.linen as nn
 
     return nn.meta.unbox(params)
+
+
+def process_local_batch(mesh: Mesh, local, batch_axes=("dcn", "dp", "fsdp")):
+    """Assemble a GLOBAL batch array from this process's local shard — the
+    canonical SPMD data-feeding step under jax.distributed (each host loads
+    its slice of the batch; the result is one global jax.Array sharded over
+    the mesh's data axes). Single-process meshes take the same path, so
+    example/training code is identical on a laptop and a pod.
+
+    ``local`` is (per_process_batch, ...); the global batch is
+    per_process_batch * process_count. Feeding a rank-local array straight
+    into a jit over a multi-host mesh is an error (non-addressable
+    shardings) — this is the supported route.
+    """
+    import numpy as np
+
+    axes = tuple(a for a in batch_axes if a in mesh.shape)
+    spec = P(axes, *([None] * (local.ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    global_shape = (
+        local.shape[0] * jax.process_count(), *local.shape[1:]
+    )
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(local), global_shape
+    )

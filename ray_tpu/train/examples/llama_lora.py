@@ -33,6 +33,8 @@ def train_loop_per_worker(config: dict):
     from ...parallel.sharding import param_shardings, unbox_params
     from ...train.lora import merge_lora, split_lora
 
+    from ...parallel.sharding import process_local_batch
+
     ctx = rt_train.get_context()
     n_dev = len(jax.devices())
 
@@ -81,30 +83,48 @@ def train_loop_per_worker(config: dict):
         updates, s2 = optimizer.update(grads, s, lp)
         return optax.apply_updates(lp, updates), s2, loss
 
+    # per-PROCESS batch: the global batch (batch * process_count) must be a
+    # multiple of the mesh's data extent, so each process's share rounds to
+    # a multiple of its local slice of that extent
+    local_shards = max(data_shards // jax.process_count(), 1)
     batch = config.get("batch_per_worker", 2)
-    batch = max(batch, data_shards)
-    batch -= batch % data_shards  # round to a shardable size
+    batch = max(batch, local_shards)
+    batch -= batch % local_shards
     seq = cfg.max_seq_len
     steps = config.get("steps_per_epoch", 4)
     rank = ctx.get_world_rank()
     loss = None
     for epoch in range(config.get("epochs", 2)):
         for step in range(steps):
-            tokens = jax.random.randint(
-                jax.random.PRNGKey(epoch * 10_000 + step * 100 + rank),
+            # each process contributes ITS shard of the global batch —
+            # process_local_batch assembles the global sharded jax.Array
+            # (feeding a rank-local array into a jit over a multi-host mesh
+            # is an error); seeded by process index so hosts differ
+            local = jax.random.randint(
+                jax.random.PRNGKey(
+                    epoch * 10_000 + step * 100 + jax.process_index()
+                ),
                 (batch, seq), 0, cfg.vocab_size,
             )
+            tokens = process_local_batch(mesh, local)
             lora, opt_state, loss = train_step(base, lora, opt_state, tokens)
         checkpoint = None
         if rank == 0:
-            # LoRA-only checkpoint: adapters are the entire trainable state
+            # LoRA-only checkpoint: adapters are the entire trainable state.
+            # One reused directory per run (epochs overwrite) — a fresh
+            # mkdtemp per epoch would accumulate a full adapter pickle per
+            # epoch in the worker's /tmp. Real runs point RunConfig at
+            # shared storage; this example keeps node-local files.
             import os
             import pickle
             import tempfile
 
             from ...train.checkpoint import Checkpoint
 
-            ckpt_dir = tempfile.mkdtemp(prefix="lora_ckpt_")
+            ckpt_dir = getattr(train_loop_per_worker, "_ckpt_dir", None)
+            if ckpt_dir is None:
+                ckpt_dir = tempfile.mkdtemp(prefix="lora_ckpt_")
+                train_loop_per_worker._ckpt_dir = ckpt_dir
             with open(os.path.join(ckpt_dir, "lora.pkl"), "wb") as f:
                 pickle.dump(
                     {"lora": jax.device_get(lora), "epoch": epoch}, f

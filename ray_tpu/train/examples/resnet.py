@@ -1,0 +1,138 @@
+"""ResNet via DataParallelTrainer — BASELINE.json configs[1].
+
+Reference config: "ResNet-50 ImageNet via DataParallelTrainer (XLA
+collective backend)". Each ranked worker runs the same jitted SGD step on
+its shard of the batch; with the batch axis sharded over the mesh's data
+axes XLA inserts the gradient all-reduce (the role NCCL-DDP plays in the
+reference) and the plain-jnp BatchNorm reductions become sync-BN.
+
+``train_config`` keys: model ("tiny" | "50"), image_size, epochs,
+steps_per_epoch, batch_per_worker, lr. Data is synthetic (the data plane
+is exercised by ray_tpu.data tests; this example isolates the trainer).
+"""
+
+from __future__ import annotations
+
+
+def train_loop_per_worker(config: dict):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ... import train as rt_train
+    from ...models.resnet import (
+        ResNetConfig,
+        apply_train,
+        cross_entropy,
+        init_train_state,
+    )
+    from ...parallel.mesh import make_mesh
+    from ...parallel.sharding import process_local_batch
+
+    ctx = rt_train.get_context()
+    rank = ctx.get_world_rank()
+    if config.get("model") == "50":
+        cfg = ResNetConfig.resnet50()
+        image_size = config.get("image_size", 224)
+    else:
+        cfg = ResNetConfig.tiny()
+        image_size = config.get("image_size", 32)
+
+    # data-parallel mesh over every device jax.distributed exposes: params
+    # replicate, the batch axis shards over dp — XLA inserts the gradient
+    # all-reduce (NCCL-DDP's role in the reference) and the BN batch-mean
+    # reductions become sync-BN
+    n_dev = len(jax.devices())
+    mesh = make_mesh(num_devices=n_dev, dp=n_dev)
+    replicated = NamedSharding(mesh, P())
+
+    params, batch_stats = init_train_state(
+        cfg, jax.random.PRNGKey(0), image_size=image_size
+    )
+    params = jax.device_put(params, replicated)
+    batch_stats = jax.device_put(batch_stats, replicated)
+    optimizer = optax.sgd(config.get("lr", 0.1), momentum=0.9)
+    opt_state = jax.device_put(optimizer.init(params), replicated)
+
+    def loss_fn(p, stats, images, labels):
+        logits, new_stats = apply_train(cfg, p, stats, images)
+        return cross_entropy(logits, labels), new_stats
+
+    @jax.jit
+    def train_step(p, stats, s, images, labels):
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, stats, images, labels
+        )
+        updates, s2 = optimizer.update(grads, s, p)
+        return optax.apply_updates(p, updates), new_stats, s2, loss
+
+    # per-process batch, rounded so the global batch divides over dp
+    local_shards = max(n_dev // jax.process_count(), 1)
+    batch = config.get("batch_per_worker", 8)
+    batch = max(batch, local_shards)
+    batch -= batch % local_shards
+    steps = config.get("steps_per_epoch", 4)
+    loss = None
+    for epoch in range(config.get("epochs", 2)):
+        for step in range(steps):
+            key = jax.random.PRNGKey(
+                epoch * 10_000 + step * 100 + jax.process_index()
+            )
+            images = process_local_batch(
+                mesh,
+                jax.random.normal(
+                    key, (batch, image_size, image_size, 3), jnp.float32
+                ),
+            )
+            labels = process_local_batch(
+                mesh,
+                jax.random.randint(
+                    jax.random.fold_in(key, 1), (batch,), 0, cfg.num_classes
+                ),
+            )
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, images, labels
+            )
+        rt_train.report({"epoch": epoch, "loss": float(loss), "rank": rank})
+
+
+def make_trainer(
+    num_workers: int = 1,
+    use_tpu: bool = False,
+    topology: str = "",
+    train_config: dict | None = None,
+):
+    from ... import train as rt_train
+
+    return rt_train.DataParallelTrainer(
+        train_loop_per_worker,
+        train_loop_config=dict(train_config or {}),
+        scaling_config=rt_train.ScalingConfig(
+            num_workers=num_workers, use_tpu=use_tpu,
+            topology=topology or None,
+        ),
+        run_config=rt_train.RunConfig(name="resnet"),
+        backend_config=rt_train.JaxConfig(use_tpu=use_tpu),
+    )
+
+
+if __name__ == "__main__":
+    import argparse
+
+    import ray_tpu
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="tiny", choices=["tiny", "50"])
+    parser.add_argument("--num-workers", type=int, default=1)
+    parser.add_argument("--epochs", type=int, default=2)
+    args = parser.parse_args()
+
+    ray_tpu.init(ignore_reinit_error=True)
+    result = make_trainer(
+        num_workers=args.num_workers,
+        train_config={"model": args.model, "epochs": args.epochs},
+    ).fit()
+    if result.error is not None:
+        raise SystemExit(f"training failed: {result.error}")
+    print({"final": result.metrics})

@@ -311,3 +311,45 @@ def test_llm_serve_token_streaming_e2e(ray_start_regular):
         assert first_latency < 60
     finally:
         serve.shutdown()
+
+
+def test_llm_deployment_with_replica_autoscaling(ray_start_regular):
+    """BASELINE configs[4]: LLM serving with replica autoscaling — the
+    builder wires LLMConfig.autoscaling_config into the serve deployment
+    and the controller scales engine replicas under request pressure."""
+    import time
+
+    from ray_tpu import serve
+
+    llm_config = LLMConfig(
+        model_id="llama-tiny",
+        max_seq_len=64,
+        max_new_tokens=8,
+        resources_per_replica={"CPU": 0.5},
+        autoscaling_config=dict(
+            min_replicas=2,
+            max_replicas=3,
+            target_ongoing_requests=2,
+        ),
+    )
+    app = build_llm_deployment(llm_config, name="llm-auto")
+    serve.start(proxy=False)
+    handle = serve.run(app, name="llm-auto-app", route_prefix=None, _proxy=False)
+    try:
+        def n_running():
+            st = serve.status()["llm-auto-app"].deployments["llm-auto"]
+            return sum(1 for r in st.replicas if r.state == "RUNNING")
+
+        # the controller owns the replica count now: it must bring the
+        # deployment up to the autoscaling floor (2 engine replicas), not
+        # LLMConfig.num_replicas (1) — proves the config reached serve
+        deadline = time.time() + 60
+        while time.time() < deadline and n_running() < 2:
+            time.sleep(0.5)
+        assert n_running() >= 2, "autoscaler never reached min_replicas=2"
+        out = handle.remote(
+            {"token_ids": [1, 2, 3], "max_new_tokens": 8}
+        ).result(timeout_s=120)
+        assert out["finished_reason"] in ("length", "eos")
+    finally:
+        serve.shutdown()
