@@ -25,7 +25,26 @@ from .session import (
     report,
 )
 from .trainer import DataParallelTrainer, JaxTrainer, TorchTrainer
+from .integrations import (
+    LightGBMTrainer,
+    LightningTrainer,
+    TensorflowTrainer,
+    XGBoostTrainer,
+)
 from .worker_group import WorkerGroup
+
+
+def __getattr__(name):
+    # PEP 562 lazy submodule (same pattern as ray_tpu/__init__.py): the
+    # transformers import behind train.huggingface costs seconds and must
+    # not tax every worker bootstrap that only needs Jax/Torch trainers
+    if name == "huggingface":
+        import importlib
+
+        module = importlib.import_module(".huggingface", __name__)
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "save_sharded",
@@ -50,6 +69,11 @@ __all__ = [
     "JaxTrainer",
     "TorchTrainer",
     "WorkerGroup",
+    "LightningTrainer",
+    "TensorflowTrainer",
+    "XGBoostTrainer",
+    "LightGBMTrainer",
+    "huggingface",
     "get_context",
     "get_checkpoint",
     "get_dataset_shard",

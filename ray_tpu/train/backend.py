@@ -231,3 +231,53 @@ class _TorchBackend(Backend):
             worker_group.execute(_torch_shutdown)
         except Exception:
             pass
+
+
+# -- TensorFlow --------------------------------------------------------------
+
+
+class TensorflowConfig(BackendConfig):
+    """TF_CONFIG cluster bootstrap (reference: train/tensorflow/config.py —
+    each ranked worker gets the full worker address list + its own index so
+    tf.distribute.MultiWorkerMirroredStrategy forms the collective ring)."""
+
+    def backend(self) -> "Backend":
+        return _TensorflowBackend()
+
+
+def _tf_advertise():
+    return f"{_host_ip()}:{_free_port()}"
+
+
+def _tf_worker_setup(cluster, rank):
+    import json
+    import os
+
+    os.environ["TF_CONFIG"] = json.dumps(
+        {
+            "cluster": {"worker": list(cluster)},
+            "task": {"type": "worker", "index": rank},
+        }
+    )
+    return True
+
+
+class _TensorflowBackend(Backend):
+    def on_start(self, worker_group):
+        from .. import api as ray_api
+
+        # every worker advertises its own host:port — multi-host correct,
+        # unlike deriving all addresses on rank 0; gathered concurrently
+        # (workers are rank-ordered, so the list index IS the task index)
+        cluster = ray_api.get(
+            [
+                w.actor.execute.remote(_tf_advertise)
+                for w in worker_group.workers
+            ]
+        )
+        ray_api.get(
+            [
+                w.actor.execute.remote(_tf_worker_setup, cluster, w.world_rank)
+                for w in worker_group.workers
+            ]
+        )

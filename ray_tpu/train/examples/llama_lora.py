@@ -91,6 +91,8 @@ def train_loop_per_worker(config: dict):
     batch = max(batch, local_shards)
     batch -= batch % local_shards
     seq = cfg.max_seq_len
+    # one checkpoint dir per run, epochs overwrite (no per-epoch /tmp leak)
+    ckpt_dir = None
     steps = config.get("steps_per_epoch", 4)
     rank = ctx.get_world_rank()
     loss = None
@@ -99,11 +101,12 @@ def train_loop_per_worker(config: dict):
             # each process contributes ITS shard of the global batch —
             # process_local_batch assembles the global sharded jax.Array
             # (feeding a rank-local array into a jit over a multi-host mesh
-            # is an error); seeded by process index so hosts differ
+            # is an error). Seeded by WORLD RANK: under jax.distributed
+            # rank == process_index, and in the non-distributed multi-worker
+            # mode (independent single-process JAX per worker) every
+            # process_index is 0 while ranks still differ.
             local = jax.random.randint(
-                jax.random.PRNGKey(
-                    epoch * 10_000 + step * 100 + jax.process_index()
-                ),
+                jax.random.PRNGKey(epoch * 10_000 + step * 100 + rank),
                 (batch, seq), 0, cfg.vocab_size,
             )
             tokens = process_local_batch(mesh, local)
@@ -111,20 +114,16 @@ def train_loop_per_worker(config: dict):
         checkpoint = None
         if rank == 0:
             # LoRA-only checkpoint: adapters are the entire trainable state.
-            # One reused directory per run (epochs overwrite) — a fresh
-            # mkdtemp per epoch would accumulate a full adapter pickle per
-            # epoch in the worker's /tmp. Real runs point RunConfig at
-            # shared storage; this example keeps node-local files.
+            # Real runs point RunConfig at shared storage; this example
+            # keeps one reused node-local directory for the whole run.
             import os
             import pickle
             import tempfile
 
             from ...train.checkpoint import Checkpoint
 
-            ckpt_dir = getattr(train_loop_per_worker, "_ckpt_dir", None)
             if ckpt_dir is None:
                 ckpt_dir = tempfile.mkdtemp(prefix="lora_ckpt_")
-                train_loop_per_worker._ckpt_dir = ckpt_dir
             with open(os.path.join(ckpt_dir, "lora.pkl"), "wb") as f:
                 pickle.dump(
                     {"lora": jax.device_get(lora), "epoch": epoch}, f

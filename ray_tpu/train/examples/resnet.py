@@ -76,9 +76,9 @@ def train_loop_per_worker(config: dict):
     loss = None
     for epoch in range(config.get("epochs", 2)):
         for step in range(steps):
-            key = jax.random.PRNGKey(
-                epoch * 10_000 + step * 100 + jax.process_index()
-            )
+            # world rank, not process_index: in the non-distributed
+            # multi-worker mode every process_index is 0 (see llama_lora)
+            key = jax.random.PRNGKey(epoch * 10_000 + step * 100 + rank)
             images = process_local_batch(
                 mesh,
                 jax.random.normal(

@@ -1,0 +1,77 @@
+"""transformers (Hugging Face) integration.
+
+Role-equivalent of the reference's ``ray.train.huggingface.transformers``
+(prepare_trainer + RayTrainReportCallback): run a ``transformers.Trainer``
+inside a ray_tpu Train worker loop, bridging its logging/checkpoint events
+into ``ray_tpu.train.report`` so the controller sees metrics and the
+CheckpointManager tracks HF checkpoints. Typical use:
+
+    def train_loop(config):
+        trainer = transformers.Trainer(model=..., args=..., ...)
+        trainer = ray_tpu.train.huggingface.prepare_trainer(trainer)
+        trainer.train()
+
+    TorchTrainer(train_loop, scaling_config=ScalingConfig(num_workers=N))
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+try:
+    import transformers
+    from transformers.trainer_callback import TrainerCallback
+except ImportError as _e:  # pragma: no cover — transformers is in the image
+    transformers = None
+
+    class TrainerCallback:  # type: ignore[no-redef]
+        pass
+
+
+class RayTrainReportCallback(TrainerCallback):
+    """Bridge transformers Trainer events to ray_tpu.train.report
+    (reference: huggingface/transformers/_transformers_utils.py
+    RayTrainReportCallback — report on log, attach checkpoint on save)."""
+
+    def on_log(self, args, state, control, logs=None, **kwargs):
+        from . import session
+
+        if not session.in_session() or not logs:
+            return
+        metrics = {
+            k: v for k, v in logs.items() if isinstance(v, (int, float))
+        }
+        metrics["step"] = state.global_step
+        metrics["epoch"] = float(state.epoch or 0.0)
+        session.report(metrics)
+
+    def on_save(self, args, state, control, **kwargs):
+        from . import session
+        from .checkpoint import Checkpoint
+
+        if not session.in_session():
+            return
+        ckpt_dir = transformers.trainer_utils.get_last_checkpoint(
+            args.output_dir
+        )
+        if ckpt_dir:
+            session.report(
+                {"step": state.global_step, "checkpoint_saved": True},
+                checkpoint=Checkpoint.from_directory(ckpt_dir),
+            )
+
+
+def prepare_trainer(trainer):
+    """Attach the report bridge exactly once (reference: prepare_trainer).
+    Returns the same Trainer for chaining."""
+    if transformers is None:
+        raise ImportError(
+            "transformers is not installed; TorchTrainer/JaxTrainer work "
+            "without it — prepare_trainer only wraps transformers.Trainer"
+        )
+    if not any(
+        isinstance(cb, RayTrainReportCallback)
+        for cb in trainer.callback_handler.callbacks
+    ):
+        trainer.add_callback(RayTrainReportCallback())
+    return trainer

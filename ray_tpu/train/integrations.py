@@ -1,0 +1,62 @@
+"""Framework trainers beyond the flagship Jax/Torch pair.
+
+Role-equivalent of the reference's LightningTrainer / TensorflowTrainer /
+XGBoostTrainer / LightGBMTrainer entry points (train/lightning, tensorflow,
+xgboost, lightgbm). TensorflowTrainer is fully functional (TF is in the
+image; the TF_CONFIG backend forms the MultiWorkerMirroredStrategy
+cluster). lightning/xgboost/lightgbm are not installed, so those
+constructors are import-gated: they keep the reference's API shape and fail
+at construction with an actionable message rather than at a confusing point
+mid-fit; when the library IS present they delegate to DataParallelTrainer
+with the torch backend (those frameworks drive their own training loops).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .backend import TensorflowConfig, TorchConfig
+from .trainer import DataParallelTrainer
+
+
+class TensorflowTrainer(DataParallelTrainer):
+    """TF trainer (reference: train/tensorflow/tensorflow_trainer.py): the
+    TF_CONFIG backend wires the ranked workers into one
+    MultiWorkerMirroredStrategy cluster; the user loop builds the strategy
+    and trains under its scope."""
+
+    def __init__(self, train_loop_per_worker: Callable, **kwargs):
+        try:
+            import tensorflow  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "tensorflow is not installed in this image; use JaxTrainer "
+                "(the TPU-native path) or TorchTrainer"
+            ) from e
+        kwargs.setdefault("backend_config", TensorflowConfig())
+        super().__init__(train_loop_per_worker, **kwargs)
+
+
+def _gated_trainer(import_name: str, display: str):
+    class _FrameworkTrainer(DataParallelTrainer):
+        def __init__(self, train_loop_per_worker: Callable, **kwargs):
+            try:
+                __import__(import_name)
+            except ImportError as e:
+                raise ImportError(
+                    f"{display} is not installed in this image; "
+                    f"{display}Trainer needs it inside the worker loop. "
+                    "Use JaxTrainer (the TPU-native path) or TorchTrainer, "
+                    f"or bake {import_name} into the runtime image."
+                ) from e
+            kwargs.setdefault("backend_config", TorchConfig())
+            super().__init__(train_loop_per_worker, **kwargs)
+
+    _FrameworkTrainer.__name__ = f"{display}Trainer"
+    _FrameworkTrainer.__qualname__ = _FrameworkTrainer.__name__
+    return _FrameworkTrainer
+
+
+LightningTrainer = _gated_trainer("lightning", "Lightning")
+XGBoostTrainer = _gated_trainer("xgboost", "XGBoost")
+LightGBMTrainer = _gated_trainer("lightgbm", "LightGBM")

@@ -54,3 +54,13 @@ def shutdown_only_with_token():
     from ray_tpu._internal.rpc import set_auth_token
 
     set_auth_token(None)
+
+
+@pytest.fixture
+def cluster():
+    """Default 2-CPU local cluster; yields the ray_tpu module."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+    yield ray_tpu
+    ray_tpu.shutdown()

@@ -41,15 +41,6 @@ def test_resnet50_param_count():
     assert 25_400_000 < n < 25_800_000, n
 
 
-@pytest.fixture
-def cluster():
-    import ray_tpu
-
-    ray_tpu.init(num_cpus=2)
-    yield ray_tpu
-    ray_tpu.shutdown()
-
-
 def test_resnet_data_parallel_trainer(cluster):
     from ray_tpu.train.examples.resnet import make_trainer
 

@@ -8,15 +8,6 @@ import pickle
 import pytest
 
 
-@pytest.fixture
-def cluster():
-    import ray_tpu
-
-    ray_tpu.init(num_cpus=2)
-    yield ray_tpu
-    ray_tpu.shutdown()
-
-
 def test_llama_lora_jaxtrainer_end_to_end(cluster):
     from ray_tpu.train.examples.llama_lora import make_trainer
 
