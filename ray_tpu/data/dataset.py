@@ -249,6 +249,9 @@ class Dataset:
     def iter_torch_batches(self, **kwargs):
         return self.iterator().iter_torch_batches(**kwargs)
 
+    def iter_tf_batches(self, **kwargs):
+        return self.iterator().iter_tf_batches(**kwargs)
+
     def take(self, n: int = 20) -> List[Any]:
         out: List[Any] = []
         for row in self.limit(n).iter_rows():

@@ -122,6 +122,14 @@ class DataIterator:
         for batch in self.iter_batches(**kwargs):
             yield {k: torch.as_tensor(v) for k, v in batch.items()}
 
+    def iter_tf_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        """Batches as TF tensors (reference: DataIterator.iter_tf_batches)."""
+        kwargs.setdefault("batch_format", "numpy")
+        import tensorflow as tf
+
+        for batch in self.iter_batches(**kwargs):
+            yield {k: tf.convert_to_tensor(v) for k, v in batch.items()}
+
     # -- internals -----------------------------------------------------------
 
     def _iter_blocks(self, prefetch_blocks: int = 2) -> Iterator[Any]:

@@ -282,3 +282,16 @@ def test_read_binary_files(cluster, tmp_path):
     by_path = {r["path"].rsplit("/", 1)[-1]: r["bytes"] for r in rows}
     assert by_path["x.bin"] == b"\x00\x01\x02"
     assert by_path["y.bin"] == b"abc"
+
+
+def test_iter_tf_batches(cluster):
+    import ray_tpu.data as rd
+
+    ds = rd.from_items([{"x": float(i), "y": i * 2} for i in range(10)])
+    batches = list(ds.iter_tf_batches(batch_size=4))
+    import tensorflow as tf
+
+    assert len(batches) == 3
+    assert all(isinstance(b["x"], tf.Tensor) for b in batches)
+    total = sum(int(tf.reduce_sum(b["y"])) for b in batches)
+    assert total == sum(i * 2 for i in range(10))
