@@ -37,7 +37,7 @@ from .object_ref import ObjectRef, ObjectRefGenerator
 from .runtime_context import get_runtime_context
 from . import exceptions
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 
 def get_tpu_ids():
