@@ -77,6 +77,20 @@ class Config:
     # "gs://bucket/cluster") sends spilled primary copies to that store.
     spill_storage_uri: str = ""
 
+    # --- worker-lease reuse (reference: worker_lease_timeout_milliseconds +
+    # lease reuse in normal_task_submitter.h) ---
+    # Owners keep a granted worker lease warm per scheduling class and push
+    # subsequent same-shape tasks straight to the leased worker (1 RPC/task
+    # instead of 3). False restores the request/push/return-per-task path.
+    lease_reuse_enabled: bool = True
+    # How long an owner's cached lease may sit idle before the owner returns
+    # the worker to its raylet.
+    worker_lease_idle_ttl_s: float = 1.0
+    # Raylet-side backstop: a reusable lease older than this is probed with a
+    # revoke_lease RPC to its owner (an owner actively reusing it answers
+    # "busy", which renews the clock; a crashed/leaky owner loses the lease).
+    lease_ttl_s: float = 60.0
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 120.0

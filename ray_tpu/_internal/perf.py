@@ -22,10 +22,13 @@ def _rate(n_ops: int, fn: Callable[[], None]) -> float:
 
 
 def metric_unit(metric: str) -> str:
-    """Unit per metric: ops/s by default; *_gb_s rates are GB/s and
-    *_refs_s entries are durations in seconds (lower is better)."""
+    """Unit per metric: ops/s by default; *_gb_s rates are GB/s,
+    *_refs_s entries are durations in seconds (lower is better), and
+    *_per_task* entries are dimensionless ratios (lower is better)."""
     if "gb_s" in metric:
         return "GB/s"
+    if "per_task" in metric:
+        return "rpcs/task"
     if metric.endswith("_s"):
         return "s"
     return "ops/s"
@@ -90,15 +93,33 @@ def run_microbenchmarks(
         def noop(x=None):
             return x
 
-        ray_tpu.get(noop.remote())  # warm worker pool
+        ray_tpu.get(noop.remote())  # warm worker pool (and the lease cache)
 
         nt = max(int(200 * scale), 20)
+
+        from ray_tpu.util import metrics as _metrics
+
+        rpc_before = _metrics.rpc_calls_by_method()
+        tasks_before = _metrics.tasks_submitted_total()
 
         def tasks_sync():
             for _ in range(nt):
                 ray_tpu.get(noop.remote())
 
         results["single_client_tasks_sync"] = _rate(nt, tasks_sync)
+
+        # control-plane amortization proof: RPCs issued per task over the
+        # warm same-class stream (lease reuse target: 1 push_task, ~0 lease
+        # RPCs). Driver-side background RPCs (heartbeats) add sub-0.1 noise.
+        rpc_after = _metrics.rpc_calls_by_method()
+        tasks_delta = _metrics.tasks_submitted_total() - tasks_before
+        if tasks_delta > 0:
+            total_delta = sum(rpc_after.values()) - sum(rpc_before.values())
+            results["rpcs_per_task_sync"] = total_delta / tasks_delta
+            results["lease_rpcs_per_task_sync"] = (
+                rpc_after.get("request_worker_lease", 0.0)
+                - rpc_before.get("request_worker_lease", 0.0)
+            ) / tasks_delta
 
         def tasks_async():
             ray_tpu.get([noop.remote() for _ in range(nt)])
@@ -271,13 +292,38 @@ def print_results(results: Dict[str, float]) -> None:
         print(f"{metric}: {value:.2f} {metric_unit(metric)}")
 
 
+def json_results(results: Dict[str, float]) -> str:
+    """One machine-readable JSON line for BENCH_LOG.md appends: every metric
+    with its unit, plus the per-method RPC latency histograms recorded by
+    the run (the lease-reuse / v2-framing proof layer)."""
+    import json
+
+    from ray_tpu.util import metrics as _metrics
+
+    return json.dumps({
+        "metrics": {
+            name: {"value": value, "unit": metric_unit(name)}
+            for name, value in results.items()
+        },
+        "rpc_latency_ms": _metrics.rpc_latency_summary(),
+    })
+
+
 def main():
     import argparse
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--small", action="store_true")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit one machine-readable JSON line instead of text",
+    )
     args = parser.parse_args()
-    print_results(run_microbenchmarks(small=args.small))
+    results = run_microbenchmarks(small=args.small)
+    if args.json:
+        print(json_results(results))
+    else:
+        print_results(results)
 
 
 if __name__ == "__main__":

@@ -6,10 +6,20 @@ length-prefixed pickle protocol over asyncio TCP keeps the control plane in
 one dependency-free file, and every server in this framework (GCS, raylet,
 worker) is an ``RpcServer`` with async handler methods.
 
-Frame format:  [u32 length][pickle payload]
+Frame format (v2): [u32 length][0xF2][u32 meta_len][u16 nbuf][u64 buf_len]*
+                   [meta pickle][buffer bytes ...]
 Request:   (request_id:int, method:str, args:tuple, kwargs:dict)
 Response:  (request_id:int, ok:bool, value_or_exc)
 One-way:   request_id == -1 (no response expected)
+
+The meta section is a protocol-5 pickle with out-of-band buffers: large
+contiguous payloads (numpy arrays and other PickleBuffer producers) travel
+after the meta as raw wire segments, written with ``writelines`` so no
+header+body concatenation copy ever happens, and reconstructed on the read
+side as memoryviews over the received body (zero-copy). Payloads are pickled
+with plain ``pickle`` (C fast path); ``cloudpickle`` is only the fallback for
+closures. A body whose first byte is a pickle PROTO opcode (0x80) is a legacy
+v1 frame and is loaded directly, so v2 peers interoperate with v1 senders.
 
 Includes deterministic chaos injection keyed by method name, the equivalent of
 the reference's RAY_testing_rpc_failure / rpc_chaos.h.
@@ -23,7 +33,8 @@ import logging
 import pickle
 import random
 import struct
-from typing import Any, Callable, Dict, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
 
@@ -34,23 +45,209 @@ logger = logging.getLogger(__name__)
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 1 << 31
 
+# v2 framing: first body byte. Pickle protocol >= 2 streams always start with
+# the PROTO opcode 0x80, so 0xF2 unambiguously marks a v2 frame.
+_V2_TAG = 0xF2
+_V2_HDR = struct.Struct("<BIH")  # tag, meta_len, nbuf
+_V2_BUFLEN = struct.Struct("<Q")
+# Buffers below this stay inline in the meta pickle; splitting tiny buffers
+# out-of-band costs more than it saves (mirrors serialization._OOB_THRESHOLD).
+_RPC_OOB_THRESHOLD = 1 * 1024
+
+# Wire/framing counters for tests and the microbenchmark proof layer.
+_frame_stats = {
+    "frames_sent": 0,
+    "frames_received": 0,
+    "oob_buffers_sent": 0,
+    "oob_buffers_received": 0,
+    "fallback_cloudpickle": 0,
+}
+
+
+def frame_stats() -> Dict[str, int]:
+    return dict(_frame_stats)
+
+
+# Per-method client-call latency recording lives in util.metrics; imported
+# lazily (and cached) so this dependency-free module stays import-light.
+_record_rpc = None
+
+
+def _recorder():
+    global _record_rpc
+    if _record_rpc is None:
+        try:
+            from ..util.metrics import record_rpc as _record_rpc
+        except Exception:  # pragma: no cover — metrics must never break RPC
+            def _record_rpc(method, latency_s):
+                pass
+    return _record_rpc
+
+
+def _encode_frame(payload: Any) -> List[Any]:
+    """Serialize ``payload`` into a list of wire parts (header + meta +
+    out-of-band buffers) suitable for ``writer.writelines`` — the multi-MB
+    body is never concatenated into one bytes object."""
+    buffers: List[memoryview] = []
+
+    def cb(pb: pickle.PickleBuffer):
+        try:
+            raw = pb.raw()
+        except BufferError:
+            return True  # non-contiguous: keep inline
+        if raw.nbytes >= _RPC_OOB_THRESHOLD:
+            buffers.append(raw)
+            return False  # out-of-band
+        return True  # keep inline
+
+    try:
+        meta = pickle.dumps(payload, protocol=5, buffer_callback=cb)
+    except Exception:
+        # closures / locally-defined classes: cloudpickle by value
+        buffers.clear()
+        _frame_stats["fallback_cloudpickle"] += 1
+        meta = cloudpickle.dumps(payload, protocol=5, buffer_callback=cb)
+    total = _V2_HDR.size + len(meta) + len(buffers) * _V2_BUFLEN.size + sum(
+        b.nbytes for b in buffers
+    )
+    if total > _MAX_FRAME:
+        raise RpcError(f"frame too large: {total} bytes")
+    header = bytearray(4 + _V2_HDR.size + len(buffers) * _V2_BUFLEN.size)
+    _LEN.pack_into(header, 0, total)
+    _V2_HDR.pack_into(header, 4, _V2_TAG, len(meta), len(buffers))
+    off = 4 + _V2_HDR.size
+    for b in buffers:
+        _V2_BUFLEN.pack_into(header, off, b.nbytes)
+        off += _V2_BUFLEN.size
+    _frame_stats["frames_sent"] += 1
+    _frame_stats["oob_buffers_sent"] += len(buffers)
+    return [bytes(header), meta, *buffers]
+
+
+def _encode_frame_v1(payload: Any) -> List[Any]:
+    """Legacy v1 frame (raw cloudpickle body): used only to answer peers
+    that themselves speak v1 (e.g. the C++ xlang client's minimal pickle
+    reader, which predates the v2 header)."""
+    body = cloudpickle.dumps(payload)
+    if len(body) > _MAX_FRAME:
+        raise RpcError(f"frame too large: {len(body)} bytes")
+    _frame_stats["frames_sent"] += 1
+    return [_LEN.pack(len(body)), body]
+
+
+def _decode_body(body) -> Any:
+    payload, _is_v1 = _decode_body_ex(body)
+    return payload
+
+
+def _decode_body_ex(body) -> Tuple[Any, bool]:
+    """Decode one frame body, reporting whether it was a legacy v1 frame.
+    v2 bodies reconstruct out-of-band buffers as memoryview slices of
+    ``body`` — zero-copy; anything else is a v1 raw-pickle body."""
+    _frame_stats["frames_received"] += 1
+    mv = memoryview(body)
+    if mv[0] == _V2_TAG:
+        tag, meta_len, nbuf = _V2_HDR.unpack_from(mv, 0)
+        off = _V2_HDR.size
+        sizes = []
+        for _ in range(nbuf):
+            (n,) = _V2_BUFLEN.unpack_from(mv, off)
+            sizes.append(n)
+            off += _V2_BUFLEN.size
+        meta = mv[off : off + meta_len]
+        off += meta_len
+        bufs = []
+        for n in sizes:
+            bufs.append(mv[off : off + n])
+            off += n
+        _frame_stats["oob_buffers_received"] += nbuf
+        return pickle.loads(meta, buffers=bufs), False
+    return pickle.loads(mv), True
+
 
 async def _read_frame(
     reader: asyncio.StreamReader, preread_header: Optional[bytes] = None
 ) -> Any:
+    payload, _is_v1 = await _read_frame_ex(reader, preread_header)
+    return payload
+
+
+async def _read_frame_ex(
+    reader: asyncio.StreamReader, preread_header: Optional[bytes] = None
+) -> Tuple[Any, bool]:
     header = preread_header or await reader.readexactly(4)
     (length,) = _LEN.unpack(header)
     if length > _MAX_FRAME:
         raise RpcError(f"frame too large: {length}")
     body = await reader.readexactly(length)
-    return pickle.loads(body)
+    return _decode_body_ex(body)
 
 
 def _write_frame(writer: asyncio.StreamWriter, payload: Any):
-    body = cloudpickle.dumps(payload)
-    if len(body) > _MAX_FRAME:
-        raise RpcError(f"frame too large: {len(body)} bytes")
-    writer.write(_LEN.pack(len(body)) + body)
+    writer.writelines(_encode_frame(payload))
+
+
+class _FrameBatcher:
+    """Per-connection outgoing write coalescing, self-clocked: a frame
+    enqueued while the connection is quiet is written immediately (no added
+    latency on the sync ping-pong path — the transport's own buffer absorbs
+    same-tick bursts into one send), while frames enqueued while a drain is
+    already pending are batched and flushed with a single ``writelines`` and
+    one shared ``drain`` when it completes (reference role: gRPC's batched
+    completion-queue writes)."""
+
+    __slots__ = ("_writer", "_parts", "_drain_fut", "_done_fut")
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self._writer = writer
+        self._parts: List[Any] = []
+        self._drain_fut: Optional[asyncio.Future] = None
+        self._done_fut: Optional[asyncio.Future] = None
+
+    def enqueue(self, parts: List[Any]) -> asyncio.Future:
+        """Send one encoded frame; returns a future resolving once the
+        write (and its coalesced drain, when one is needed) completed."""
+        if self._drain_fut is None:
+            # quiet connection: write now
+            loop = asyncio.get_event_loop()
+            try:
+                self._writer.writelines(parts)
+            except Exception as e:
+                fut = loop.create_future()
+                fut.set_exception(e)
+                return fut
+            if self._writer.transport.get_write_buffer_size() == 0:
+                # the socket took everything: no flow control needed, no
+                # drain task — the ping-pong fast path costs zero tasks
+                fut = self._done_fut
+                if fut is None:
+                    fut = self._done_fut = loop.create_future()
+                    fut.set_result(None)
+                return fut
+            fut = loop.create_future()
+            self._drain_fut = fut
+            asyncio.ensure_future(self._drain(fut))
+            return fut
+        # a drain is in flight: coalesce — this batch flushes (one
+        # writelines, one drain) when it resolves
+        self._parts.extend(parts)
+        return self._drain_fut
+
+    async def _drain(self, fut: asyncio.Future):
+        try:
+            await self._writer.drain()
+            while self._parts:
+                parts, self._parts = self._parts, []
+                self._writer.writelines(parts)
+                await self._writer.drain()
+        except Exception as e:
+            self._drain_fut = None
+            if not fut.done():
+                fut.set_exception(e)
+            return
+        self._drain_fut = None
+        if not fut.done():
+            fut.set_result(None)
 
 
 # ---------------------------------------------------------------------------
@@ -182,6 +379,7 @@ class RpcServer:
         peer_meta: Dict[str, Any] = {}
         tasks: set[asyncio.Task] = set()
         self._conns.add(writer)
+        batcher = _FrameBatcher(writer)
         try:
             # First 4 bytes are either the auth-preamble magic or the first
             # frame's length header. Auth is decided BEFORE the frame loop:
@@ -209,7 +407,7 @@ class RpcServer:
                 preread = first
             while True:
                 try:
-                    frame = await _read_frame(reader, preread)
+                    frame, peer_v1 = await _read_frame_ex(reader, preread)
                     preread = None
                 except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
                     break
@@ -238,10 +436,12 @@ class RpcServer:
                         )
                         if req_id != -1:
                             try:
-                                _write_frame(
-                                    writer,
-                                    (req_id, False,
-                                     RpcError("authentication failed")),
+                                writer.writelines(
+                                    (_encode_frame_v1 if peer_v1
+                                     else _encode_frame)(
+                                        (req_id, False,
+                                         RpcError("authentication failed"))
+                                    )
                                 )
                                 await writer.drain()
                             except Exception:
@@ -254,7 +454,11 @@ class RpcServer:
                         except Exception:
                             logger.exception("connection-registered callback failed")
                     if req_id != -1:
-                        _write_frame(writer, (req_id, True, None))
+                        writer.writelines(
+                            (_encode_frame_v1 if peer_v1 else _encode_frame)(
+                                (req_id, True, None)
+                            )
+                        )
                     continue
                 if _auth_token is not None and peer_meta.get("auth_token") != _auth_token:
                     logger.warning(
@@ -263,16 +467,21 @@ class RpcServer:
                     )
                     if req_id != -1:
                         try:
-                            _write_frame(
-                                writer,
-                                (req_id, False, RpcError("authentication failed")),
+                            writer.writelines(
+                                (_encode_frame_v1 if peer_v1
+                                 else _encode_frame)(
+                                    (req_id, False,
+                                     RpcError("authentication failed"))
+                                )
                             )
                             await writer.drain()
                         except Exception:
                             pass
                     break
                 t = asyncio.ensure_future(
-                    self._dispatch(writer, req_id, method, args, kwargs)
+                    self._dispatch(
+                        batcher, req_id, method, args, kwargs, peer_v1
+                    )
                 )
                 tasks.add(t)
                 t.add_done_callback(tasks.discard)
@@ -289,7 +498,8 @@ class RpcServer:
                     logger.exception("connection-lost callback failed")
             writer.close()
 
-    async def _dispatch(self, writer, req_id, method, args, kwargs):
+    async def _dispatch(self, batcher, req_id, method, args, kwargs,
+                        peer_v1: bool = False):
         try:
             _maybe_inject_failure(method)
             handler = self._handlers.get(method)
@@ -303,15 +513,18 @@ class RpcServer:
             value, ok = e, False
         if req_id == -1:
             return
+        # a v1 request gets a v1 reply: legacy peers (the C++ xlang client's
+        # minimal pickle reader) never see the v2 header
+        encode = _encode_frame_v1 if peer_v1 else _encode_frame
         try:
             try:
-                _write_frame(writer, (req_id, ok, value))
+                parts = encode((req_id, ok, value))
             except Exception as e:
                 # Response unserializable or oversized: still answer the
                 # caller so its future resolves instead of hanging.
-                _write_frame(writer, (req_id, False, RpcError(f"bad response: {e}")))
-            await writer.drain()
-        except (ConnectionResetError, BrokenPipeError, RuntimeError):
+                parts = encode((req_id, False, RpcError(f"bad response: {e}")))
+            await batcher.enqueue(parts)
+        except (ConnectionResetError, BrokenPipeError, RuntimeError, OSError):
             pass
 
 
@@ -339,6 +552,7 @@ class RpcClient:
         self._connect_timeout = connect_timeout
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
+        self._batcher: Optional[_FrameBatcher] = None
         self._pending: Dict[int, asyncio.Future] = {}
         self._req_ids = itertools.count(1)
         self._recv_task: Optional[asyncio.Task] = None
@@ -381,6 +595,7 @@ class RpcClient:
                 meta["auth_token"] = _auth_token
             if meta:
                 _write_frame(self._writer, (-1, "__register__", (), meta))
+            self._batcher = _FrameBatcher(self._writer)
             self._recv_task = asyncio.ensure_future(self._recv_loop())
 
     async def _recv_loop(self):
@@ -394,6 +609,13 @@ class RpcClient:
                 if ok:
                     fut.set_result(value)
                 else:
+                    if not isinstance(value, BaseException):
+                        # a malformed/hostile server can send any payload as
+                        # the error; set_exception would raise TypeError and
+                        # kill this recv loop — wrap instead
+                        value = RpcError(
+                            f"remote error (non-exception payload): {value!r}"
+                        )
                     fut.set_exception(value)
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError, EOFError):
             pass
@@ -414,9 +636,11 @@ class RpcClient:
         req_id = next(self._req_ids)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
+        t0 = time.perf_counter()
         try:
-            _write_frame(self._writer, (req_id, method, args, kwargs))
-            await self._writer.drain()
+            await self._batcher.enqueue(
+                _encode_frame((req_id, method, args, kwargs))
+            )
             if timeout is None:
                 return await fut
             return await asyncio.wait_for(fut, timeout)
@@ -425,11 +649,14 @@ class RpcClient:
             # so a long-lived connection doesn't accumulate dead futures
             self._pending.pop(req_id, None)
             raise
+        finally:
+            _recorder()(method, time.perf_counter() - t0)
 
     async def call_oneway(self, method: str, *args, **kwargs):
         await self._ensure_connected()
-        _write_frame(self._writer, (-1, method, args, kwargs))
-        await self._writer.drain()
+        t0 = time.perf_counter()
+        await self._batcher.enqueue(_encode_frame((-1, method, args, kwargs)))
+        _recorder()(method, time.perf_counter() - t0)
 
     async def close(self):
         self._closed = True

@@ -55,14 +55,23 @@ logger = logging.getLogger(__name__)
 
 
 class Lease:
-    __slots__ = ("lease_id", "worker", "allocation", "spec", "granted_at")
+    __slots__ = (
+        "lease_id", "worker", "allocation", "spec", "granted_at",
+        "reusable", "renewed_at",
+    )
 
-    def __init__(self, lease_id, worker: WorkerHandle, allocation: Allocation, spec):
+    def __init__(self, lease_id, worker: WorkerHandle, allocation: Allocation,
+                 spec, reusable: bool = False):
         self.lease_id = lease_id
         self.worker = worker
         self.allocation = allocation
         self.spec = spec
         self.granted_at = time.time()
+        # owner may cache this lease and reuse it across tasks; the raylet
+        # can recall it with a revoke_lease RPC to the owner (TTL accounting
+        # below; reference: worker lease reuse + lease reclamation)
+        self.reusable = reusable
+        self.renewed_at = self.granted_at
 
 
 class Raylet:
@@ -139,6 +148,8 @@ class Raylet:
         self._pull_lock_holds: Dict[ObjectID, int] = {}
         # worker pid -> hex job id of its most recent lease (log attribution)
         self._worker_job: Dict[int, str] = {}
+        # lease ids with a revoke_lease RPC in flight to their owner
+        self._revoking: set = set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -197,6 +208,10 @@ class Raylet:
             max(self.config.health_check_period_s / 2, 0.1), self._report_resources
         )
         self._runner.run_every(5.0, self._reap_idle_workers)
+        if self.config.lease_ttl_s > 0:
+            self._runner.run_every(
+                max(self.config.lease_ttl_s / 2, 1.0), self._check_lease_ttls
+            )
         if self.config.memory_monitor_refresh_s > 0:
             self._runner.run_every(
                 self.config.memory_monitor_refresh_s, self._check_memory
@@ -348,7 +363,7 @@ class Raylet:
             entry["count"] += 1
 
         for queue in self._queues.values():
-            for spec, fut in queue:
+            for spec, fut, _reusable in queue:
                 if not fut.done():
                     add(spec.resources, spec.label_selector)
         now = time.time()
@@ -424,6 +439,16 @@ class Raylet:
             if lease.worker.worker_id == victim.worker_id:
                 self.resources.release(lease.allocation)
                 del self._leases[lease_id]
+                if lease.reusable:
+                    # tell the owner its cached lease is gone so the cache
+                    # drops it now instead of on the next failed push
+                    try:
+                        owner = self.client_pool.get(*lease.spec.owner_address)
+                        self._bg.spawn(
+                            owner.call_oneway("revoke_lease", lease_id)
+                        )
+                    except Exception:
+                        pass
         self._dispatch_wakeup.set()
         if handle is not None:
             try:
@@ -473,6 +498,16 @@ class Raylet:
             if lease.worker.worker_id == worker_id:
                 self.resources.release(lease.allocation)
                 del self._leases[lease_id]
+                if lease.reusable:
+                    # drop the owner's cached copy promptly (it would also
+                    # self-heal on the next failed push)
+                    try:
+                        owner = self.client_pool.get(*lease.spec.owner_address)
+                        self._bg.spawn(
+                            owner.call_oneway("revoke_lease", lease_id)
+                        )
+                    except Exception:
+                        pass
         self._dispatch_wakeup.set()
         try:
             gcs = self.client_pool.get(*self.gcs_address)
@@ -482,10 +517,13 @@ class Raylet:
 
     # -- lease protocol ----------------------------------------------------
 
-    async def handle_request_worker_lease(self, spec: TaskSpec):
-        """Grant a worker locally, queue, or spill to another node."""
+    async def handle_request_worker_lease(self, spec: TaskSpec,
+                                          reusable: bool = False):
+        """Grant a worker locally, queue, or spill to another node.
+        ``reusable`` marks the grant as cacheable by the owner (lease reuse);
+        the raylet may recall it later via revoke_lease."""
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._queues[spec.scheduling_class()].append((spec, fut))
+        self._queues[spec.scheduling_class()].append((spec, fut, reusable))
         self._dispatch_wakeup.set()
         return await fut
 
@@ -498,6 +536,68 @@ class Raylet:
             self.worker_pool.push(lease.worker)
         self._dispatch_wakeup.set()
         return True
+
+    # -- lease revocation (the raylet side of lease reuse: TTL accounting +
+    # recall of owner-cached leases under resource pressure) ---------------
+
+    def _maybe_revoke_idle_lease(self, lease: Optional[Lease] = None):
+        """Fire one revoke_lease RPC at the owner of a reusable lease
+        (oldest first when unspecified). The owner releases the lease if it
+        is idle in its cache — its return_worker then frees the resources
+        and wakes dispatch — or answers False (in use), which renews the
+        lease's TTL clock."""
+        if lease is None:
+            candidates = [
+                l for l in self._leases.values()
+                if l.reusable and l.lease_id not in self._revoking
+            ]
+            if not candidates:
+                return
+            lease = min(candidates, key=lambda l: l.renewed_at)
+        elif lease.lease_id in self._revoking:
+            return
+        self._revoking.add(lease.lease_id)
+        self._bg.spawn(self._revoke_lease(lease))
+
+    async def _revoke_lease(self, lease: Lease):
+        try:
+            owner = self.client_pool.get(*lease.spec.owner_address)
+            released = await owner.call(
+                "revoke_lease", lease.lease_id, timeout=5.0
+            )
+            if released:
+                return  # owner's return_worker does the cleanup
+            # in use: the owner is actively reusing it — renew the clock
+            live = self._leases.get(lease.lease_id)
+            if live is not None:
+                live.renewed_at = time.time()
+        except Exception:
+            # owner unreachable (crashed / shut down): force-reclaim so a
+            # dead owner can never pin a worker and its resources forever
+            live = self._leases.pop(lease.lease_id, None)
+            if live is not None:
+                logger.warning(
+                    "force-reclaiming lease %s from unreachable owner %s",
+                    live.lease_id, live.spec.owner_address,
+                )
+                self.resources.release(live.allocation)
+                self.worker_pool.push(live.worker)
+                self._dispatch_wakeup.set()
+        finally:
+            self._revoking.discard(lease.lease_id)
+
+    async def _check_lease_ttls(self):
+        """Periodic TTL backstop: probe reusable leases older than
+        lease_ttl_s. Owners actively reusing a lease answer the probe with
+        "busy", which renews it; leaked leases (crashed or wedged owners)
+        get reclaimed."""
+        ttl = self.config.lease_ttl_s
+        if ttl <= 0:
+            return
+        now = time.time()
+        for lease in list(self._leases.values()):
+            if lease.reusable and now - lease.renewed_at > ttl:
+                self._maybe_revoke_idle_lease(lease)
 
     async def _dispatch_loop(self):
         """Single dispatch loop draining per-class FIFO queues (reference:
@@ -512,12 +612,12 @@ class Raylet:
                     if not queue:
                         del self._queues[cls]
                         continue
-                    spec, fut = queue[0]
+                    spec, fut, reusable = queue[0]
                     if fut.done():
                         queue.popleft()
                         progress = True
                         continue
-                    decision = await self._try_dispatch(spec)
+                    decision = await self._try_dispatch(spec, reusable)
                     if decision is None:
                         continue  # head-of-line waits; other classes proceed
                     queue.popleft()
@@ -525,7 +625,8 @@ class Raylet:
                         fut.set_result(decision)
                     progress = True
 
-    async def _try_dispatch(self, spec: TaskSpec) -> Optional[dict]:
+    async def _try_dispatch(self, spec: TaskSpec,
+                            reusable: bool = False) -> Optional[dict]:
         """Returns a reply dict, or None to keep the request queued."""
         strategy = spec.scheduling_strategy
         bundle = None
@@ -557,10 +658,13 @@ class Raylet:
                     return {"granted": False, "spillback": target}
             if not self.resources.pool.can_allocate(spec.resources):
                 # feasible but busy: hybrid policy — spill if a remote node
-                # has free capacity now, else queue locally
+                # has free capacity now, else queue locally. Before queuing,
+                # try to recall an owner-cached idle lease: its resources
+                # may be all that stands between this request and a grant.
                 target = self._pick_remote_with_capacity(spec)
                 if target is not None:
                     return {"granted": False, "spillback": target}
+                self._maybe_revoke_idle_lease()
                 return None
 
         allocation = self.resources.allocate(spec.resources, bundle=bundle)
@@ -577,7 +681,9 @@ class Raylet:
             self.resources.release(allocation)
             return {"granted": False, "reason": "no worker available"}
         lease_id = UniqueID.from_random()
-        self._leases[lease_id] = Lease(lease_id, worker, allocation, spec)
+        self._leases[lease_id] = Lease(
+            lease_id, worker, allocation, spec, reusable=reusable
+        )
         # job attribution for the log plane: output from this worker belongs
         # to the leasing job from here on (reference: per-job workers)
         job = getattr(spec, "job_id", None)
@@ -666,7 +772,13 @@ class Raylet:
     async def handle_prepare_bundle(
         self, pg_id: PlacementGroupID, index: int, resources: Dict[str, float]
     ) -> bool:
-        return self.resources.prepare_bundle(pg_id, index, resources)
+        ok = self.resources.prepare_bundle(pg_id, index, resources)
+        if not ok:
+            # an owner-cached idle lease may be holding exactly the capacity
+            # this bundle needs: recall one so the GCS's scheduling retry
+            # (backoff loop in placement_groups.py) can succeed
+            self._maybe_revoke_idle_lease()
+        return ok
 
     async def handle_commit_bundle(self, pg_id: PlacementGroupID, index: int) -> bool:
         ok = self.resources.commit_bundle(pg_id, index)

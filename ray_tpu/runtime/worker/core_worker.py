@@ -45,6 +45,7 @@ from ..._internal.ids import (
 from ..._internal.protocol import (
     ActorInfo,
     ActorState,
+    DefaultSchedulingStrategy,
     FunctionDescriptor,
     PlacementGroupSchedulingStrategy,
     ReturnObject,
@@ -179,6 +180,16 @@ class CoreWorker:
         self._task_event_buffer: List[dict] = []
         self._event_flush_task: Optional[asyncio.Task] = None
 
+        # worker-lease reuse (reference: lease caching per SchedulingKey in
+        # normal_task_submitter.h): scheduling-class key -> idle granted
+        # leases kept warm for worker_lease_idle_ttl_s. _lease_waiters counts
+        # in-flight request_worker_lease calls per key so a finishing task
+        # returns its worker to the raylet (which holds the queued requests)
+        # instead of parking it locally where no one would take it.
+        self._lease_cache: Dict[tuple, List[dict]] = {}
+        self._lease_waiters: Dict[tuple, int] = defaultdict(int)
+        self._lease_reaper_task: Optional[asyncio.Task] = None
+
         # actor submission state
         self._actors: Dict[ActorID, _ActorClientState] = {}
         self._subscriber: Optional[SubscriberClient] = None
@@ -286,6 +297,9 @@ class CoreWorker:
         s.register("actor_task", self._handle_actor_task)
         s.register("exit_worker", self._handle_exit_worker)
         s.register("ping", self._handle_ping)
+        # raylet-initiated recall of a cached worker lease (resource
+        # pressure / TTL backstop)
+        s.register("revoke_lease", self._handle_revoke_lease)
         # device objects (reference: RDT / GPU object manager, P13)
         from ...experimental import device_objects
 
@@ -314,6 +328,10 @@ class CoreWorker:
                 await gcs.call("finish_job", self.job_id, timeout=5.0)
             except Exception:
                 pass
+        try:
+            await asyncio.wait_for(self._flush_lease_cache(), timeout=5.0)
+        except Exception:
+            pass
         if self._event_flush_task:
             self._event_flush_task.cancel()
         for task in list(self._reconciler_tasks):
@@ -857,6 +875,9 @@ class CoreWorker:
             self._streams[spec.task_id] = _StreamState()
         self._pending_tasks[spec.task_id] = spec
         arg_ids = self._pin_task_args(spec)
+        from ...util.metrics import note_task_submitted
+
+        note_task_submitted()
         self.record_task_event(
             spec.task_id,
             state="PENDING",
@@ -914,26 +935,46 @@ class CoreWorker:
 
     async def _submit_once(self, spec: TaskSpec, attempt: int) -> bool:
         """One lease + push attempt. Returns True when the task reached a
-        terminal state (success or non-retriable failure)."""
-        grant = await self._acquire_lease(spec)
-        raylet_addr = grant["raylet_address"]
-        lease_id = grant["lease_id"]
-        worker_addr = grant["worker_address"]
-        worker_failed = False
-        try:
-            worker = self.client_pool.get(*worker_addr)
-            reply: TaskReply = await worker.call(
-                "push_task", spec, attempt, timeout=None
+        terminal state (success or non-retriable failure).
+
+        With lease reuse on, the lease comes from the per-scheduling-class
+        cache when a warm one exists (zero lease RPCs), and on success goes
+        back into the cache instead of being returned — the steady-state
+        cost of a same-shape task stream is one push_task RPC per task."""
+        cache_key = self._lease_cache_key(spec)
+        grant = self._take_cached_lease(cache_key)
+        from_cache = grant is not None
+        if grant is None:
+            grant = await self._acquire_lease(
+                spec, reusable=cache_key is not None
             )
-        except RpcError as e:
-            worker_failed = True
-            raise WorkerCrashedError(str(e)) from None
-        finally:
+        while True:
             try:
-                raylet = self.client_pool.get(*raylet_addr)
-                await raylet.call("return_worker", lease_id, worker_failed)
-            except Exception:
-                pass
+                worker = self.client_pool.get(*grant["worker_address"])
+                reply: TaskReply = await worker.call(
+                    "push_task", spec, attempt, timeout=None
+                )
+                break
+            except RpcError as e:
+                self._bg.spawn(self._return_lease(grant, worker_failed=True))
+                if from_cache:
+                    # stale cached lease (worker died or was revoked under
+                    # us): not the task's fault — re-acquire fresh without
+                    # burning a retry attempt
+                    from_cache = False
+                    grant = await self._acquire_lease(
+                        spec, reusable=cache_key is not None
+                    )
+                    continue
+                raise WorkerCrashedError(str(e)) from None
+        # the worker is idle again (push_task replies after execution): park
+        # the lease for the next same-class task unless peers of this class
+        # are already queued at the raylet — then hand the worker back so the
+        # raylet's FIFO (which may include other owners) gets it now
+        if cache_key is not None and not self._lease_waiters.get(cache_key):
+            self._park_lease(cache_key, grant)
+        else:
+            self._bg.spawn(self._return_lease(grant, worker_failed=False))
         if reply.error is not None:
             # the failed executor may still have stashed an arg ref — even
             # one that will be retried elsewhere keeps its borrow
@@ -950,7 +991,93 @@ class CoreWorker:
         self._process_reply(spec, reply, attempt=attempt)
         return True
 
-    async def _acquire_lease(self, spec: TaskSpec) -> dict:
+    # -- lease cache (reference: per-SchedulingKey worker lease reuse in
+    # normal_task_submitter.h; the owner side of the lease TTL protocol) ----
+
+    def _lease_cache_key(self, spec: TaskSpec) -> Optional[tuple]:
+        """Cache key for reusable leases, or None when this spec's lease
+        must not be reused (strategy pins placement decisions per task)."""
+        if not self.config.lease_reuse_enabled:
+            return None
+        if type(spec.scheduling_strategy) is not DefaultSchedulingStrategy:
+            return None
+        from ..._internal.runtime_env import env_key
+
+        return (spec.scheduling_class(), env_key(spec.runtime_env))
+
+    def _take_cached_lease(self, cache_key: Optional[tuple]) -> Optional[dict]:
+        if cache_key is None:
+            return None
+        grants = self._lease_cache.get(cache_key)
+        if not grants:
+            return None
+        grant = grants.pop()  # LIFO: warmest worker first
+        if not grants:
+            del self._lease_cache[cache_key]
+        return grant
+
+    def _park_lease(self, cache_key: tuple, grant: dict):
+        grant["parked_at"] = time.monotonic()
+        self._lease_cache.setdefault(cache_key, []).append(grant)
+        if self._lease_reaper_task is None or self._lease_reaper_task.done():
+            self._lease_reaper_task = asyncio.ensure_future(
+                self._reap_idle_leases()
+            )
+
+    async def _reap_idle_leases(self):
+        """Return cached leases that sat idle past worker_lease_idle_ttl_s;
+        exits when the cache drains (restarted on the next park)."""
+        ttl = max(self.config.worker_lease_idle_ttl_s, 0.02)
+        while self._lease_cache:
+            await asyncio.sleep(ttl / 2)
+            now = time.monotonic()
+            for key, grants in list(self._lease_cache.items()):
+                keep = [g for g in grants if now - g["parked_at"] < ttl]
+                for g in grants:
+                    if now - g["parked_at"] >= ttl:
+                        self._bg.spawn(self._return_lease(g, False))
+                if keep:
+                    self._lease_cache[key] = keep
+                else:
+                    self._lease_cache.pop(key, None)
+
+    async def _return_lease(self, grant: dict, worker_failed: bool):
+        try:
+            raylet = self.client_pool.get(*grant["raylet_address"])
+            await raylet.call(
+                "return_worker", grant["lease_id"], worker_failed,
+                timeout=self.config.rpc_call_timeout_s,
+            )
+        except Exception:
+            pass
+
+    async def _handle_revoke_lease(self, lease_id) -> bool:
+        """Raylet recalls a lease (resource pressure / TTL backstop): release
+        it if it is sitting idle in the cache; answer False when it is in
+        use (or already gone) — the raylet treats that as a renewal."""
+        for key, grants in list(self._lease_cache.items()):
+            for g in grants:
+                if g["lease_id"] == lease_id:
+                    grants.remove(g)
+                    if not grants:
+                        self._lease_cache.pop(key, None)
+                    await self._return_lease(g, False)
+                    return True
+        return False
+
+    async def _flush_lease_cache(self):
+        """Shutdown path: hand every cached lease back to its raylet."""
+        if self._lease_reaper_task is not None:
+            self._lease_reaper_task.cancel()
+        grants = [g for gs in self._lease_cache.values() for g in gs]
+        self._lease_cache.clear()
+        if grants:
+            await asyncio.gather(
+                *[self._return_lease(g, False) for g in grants],
+                return_exceptions=True,
+            )
+
+    async def _acquire_lease(self, spec: TaskSpec, reusable: bool = False) -> dict:
         """Request a worker lease, following spillback redirects (reference:
         RequestNewWorkerIfNeeded + spillback handling)."""
         target = self.raylet_address
@@ -960,9 +1087,27 @@ class CoreWorker:
                 target = bundle_node
         spillbacks = 0
         infeasible_warned = False
+        cache_key = self._lease_cache_key(spec) if reusable else None
+        if cache_key is not None:
+            self._lease_waiters[cache_key] += 1
+        try:
+            return await self._acquire_lease_loop(
+                spec, target, spillbacks, infeasible_warned, reusable
+            )
+        finally:
+            if cache_key is not None:
+                self._lease_waiters[cache_key] -= 1
+                if self._lease_waiters[cache_key] <= 0:
+                    self._lease_waiters.pop(cache_key, None)
+
+    async def _acquire_lease_loop(
+        self, spec: TaskSpec, target, spillbacks, infeasible_warned, reusable
+    ) -> dict:
         while True:
             raylet = self.client_pool.get(*target)
-            reply = await raylet.call("request_worker_lease", spec, timeout=None)
+            reply = await raylet.call(
+                "request_worker_lease", spec, reusable, timeout=None
+            )
             if reply.get("granted"):
                 reply["raylet_address"] = target
                 return reply

@@ -102,6 +102,102 @@ class Histogram(Metric):
         return snap
 
 
+# ---------------------------------------------------------------------------
+# Control-plane RPC metrics (the lease-reuse / v2-framing proof layer):
+# per-method client-call latency histograms plus an RPCs-per-task counter
+# pair, recorded from _internal/rpc.py on every client call and surfaced by
+# the microbenchmark CLI and the lease-reuse regression tests.
+# ---------------------------------------------------------------------------
+
+_RPC_LATENCY_BOUNDARIES_MS = [
+    0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000,
+]
+
+_rpc_latency: Optional["Histogram"] = None
+_rpc_calls: Optional["Counter"] = None
+_tasks_submitted: Optional["Counter"] = None
+_rpc_init_lock = threading.Lock()
+
+
+def _ensure_rpc_metrics():
+    global _rpc_latency, _rpc_calls, _tasks_submitted
+    if _rpc_latency is None:
+        with _rpc_init_lock:
+            if _rpc_latency is None:
+                _rpc_calls = Counter(
+                    "rpc_client_calls_total",
+                    "Client RPCs issued by this process, by method",
+                    tag_keys=("method",),
+                )
+                _tasks_submitted = Counter(
+                    "tasks_submitted_total",
+                    "Normal tasks submitted by this process",
+                )
+                # assigned last: its non-None-ness gates the fast path, so
+                # the other two must already exist when readers see it
+                _rpc_latency = Histogram(
+                    "rpc_client_latency_ms",
+                    "Client RPC round-trip latency by method (ms)",
+                    boundaries=_RPC_LATENCY_BOUNDARIES_MS,
+                    tag_keys=("method",),
+                )
+    return _rpc_latency, _rpc_calls, _tasks_submitted
+
+
+def record_rpc(method: str, latency_s: float):
+    """Called from RpcClient.call / call_oneway (hot path — keep cheap)."""
+    latency, calls, _ = _ensure_rpc_metrics()
+    tags = {"method": method}
+    latency.observe(latency_s * 1000.0, tags)
+    calls.inc(1.0, tags)
+
+
+def note_task_submitted(n: float = 1.0):
+    """Called from CoreWorker._launch_task; pairs with rpc_call counts to
+    derive RPCs-per-task."""
+    _, _, tasks = _ensure_rpc_metrics()
+    tasks.inc(n)
+
+
+def rpc_calls_by_method() -> Dict[str, float]:
+    """Process-local snapshot: method -> client calls issued."""
+    _, calls, _ = _ensure_rpc_metrics()
+    with calls._lock:
+        return {k[0]: v for k, v in calls._values.items()}
+
+
+def tasks_submitted_total() -> float:
+    _, _, tasks = _ensure_rpc_metrics()
+    with tasks._lock:
+        return sum(tasks._values.values())
+
+
+def rpc_latency_summary() -> Dict[str, dict]:
+    """Process-local per-method latency summary: count, mean ms, and the
+    cumulative histogram buckets ({le: count}) — the machine-readable shape
+    the microbenchmark CLI emits for BENCH_LOG.md."""
+    latency, _, _ = _ensure_rpc_metrics()
+    out: Dict[str, dict] = {}
+    with latency._lock:
+        for key, counts in latency._counts.items():
+            method = key[0]
+            total = sum(counts)
+            if not total:
+                continue
+            cum = 0
+            buckets = {}
+            for bound, c in zip(latency._boundaries, counts):
+                cum += c
+                buckets[str(bound)] = cum
+            buckets["+Inf"] = total
+            out[method] = {
+                "count": total,
+                "mean_ms": latency._sums.get(key, 0.0) / total,
+                "buckets": buckets,
+            }
+    return out
+
+
 def _ensure_pusher():
     """Background thread pushing this process's metrics to the GCS KV."""
     global _pusher_started

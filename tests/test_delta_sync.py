@@ -50,7 +50,9 @@ def test_steady_state_reports_are_empty_deltas(cluster):
         return 1
 
     assert ray_tpu.get(touch.remote(), timeout=60) == 1
-    time.sleep(1.0)  # let post-task availability changes settle
+    # let post-task availability settle: the cached worker lease returns
+    # after the idle TTL (lease reuse), and that return is itself a delta
+    time.sleep(2.5)
 
     captured = _spy_reports(cluster.head_node.gcs)
     time.sleep(2.0)  # several report periods
@@ -70,17 +72,19 @@ def test_change_ships_only_touched_keys_and_bumps_version(cluster):
         return 0
 
     ray_tpu.get(warm.remote(), timeout=60)
-    time.sleep(1.0)
+    time.sleep(2.5)  # warm's cached lease expires back -> availability settles
     captured = _spy_reports(cluster.head_node.gcs)
 
-    @ray_tpu.remote(num_cpus=1)
+    # a different scheduling class than warm's (CPU:2), so this acquisition
+    # cannot ride warm's cached lease and must show up as a resource delta
+    @ray_tpu.remote(num_cpus=2)
     def hold():
         time.sleep(1.5)
         return 2
 
     ref = hold.remote()
     assert ray_tpu.get(ref, timeout=60) == 2
-    time.sleep(1.0)
+    time.sleep(2.5)  # hold's lease expires back -> view converges to idle
 
     deltas = [r for r in captured if r["changed"] is not None]
     assert deltas, "a CPU acquisition produced no delta"
@@ -108,7 +112,7 @@ def test_gcs_resync_after_version_mismatch(cluster):
         return 0
 
     ray_tpu.get(warm.remote(), timeout=60)
-    time.sleep(1.0)
+    time.sleep(2.5)  # settle: cached lease returned, reports gone quiet
 
     gcs = cluster.head_node.gcs
     node_id = cluster.head_node.node_id

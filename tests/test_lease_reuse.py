@@ -1,0 +1,121 @@
+"""Worker-lease reuse: warm same-class task streams amortize the lease
+protocol down to one push RPC per task, idle leases expire back to the
+raylet, and failed pushes invalidate the cache (reference: per-SchedulingKey
+lease caching in normal_task_submitter.h + lease reclamation)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import _worker_api
+from ray_tpu.util import metrics
+
+
+def _lease_rpcs():
+    return metrics.rpc_calls_by_method().get("request_worker_lease", 0.0)
+
+
+def test_same_class_tasks_reuse_one_lease(shutdown_only):
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    assert ray_tpu.get(noop.remote(0)) == 0  # warm: acquires + caches
+    before = _lease_rpcs()
+    n = 30
+    for i in range(n):
+        assert ray_tpu.get(noop.remote(i)) == i
+    # the whole warm stream reuses the one cached lease: at most one
+    # re-acquire total (idle-TTL edge), never one per task
+    assert _lease_rpcs() - before <= 1
+    worker = _worker_api.get_core_worker()
+    assert worker._lease_cache, "lease should be parked between tasks"
+
+
+def test_distinct_scheduling_classes_get_distinct_leases(shutdown_only):
+    ray_tpu.init(num_cpus=2, resources={"A": 1.0})
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get(noop.remote())
+    before = _lease_rpcs()
+    # different resource shape -> different scheduling class -> new lease
+    ray_tpu.get(noop.options(resources={"A": 1.0}).remote())
+    assert _lease_rpcs() - before >= 1
+
+
+def test_idle_ttl_expiry_returns_worker(shutdown_only):
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={"worker_lease_idle_ttl_s": 0.2},
+    )
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    assert ray_tpu.get(noop.remote()) == 1
+    worker = _worker_api.get_core_worker()
+    assert worker._lease_cache  # parked right after the task
+    raylet = _worker_api.get_node().raylet
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if not worker._lease_cache and not raylet._leases:
+            break
+        time.sleep(0.05)
+    assert not worker._lease_cache, "idle lease should expire after the TTL"
+    assert not raylet._leases, "raylet should get the worker back on expiry"
+
+
+def test_pressure_revokes_cached_lease(shutdown_only):
+    """A queued request of a different scheduling class recalls an idle
+    cached lease holding the capacity it needs, well before the idle TTL."""
+    ray_tpu.init(
+        num_cpus=1,
+        _system_config={"worker_lease_idle_ttl_s": 30.0},
+    )
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    assert ray_tpu.get(noop.remote()) == 1  # CPU:1 lease now cached
+    worker = _worker_api.get_core_worker()
+    assert worker._lease_cache
+    # different class (CPU:0.5): needs the CPU the cached lease holds
+    t0 = time.time()
+    assert ray_tpu.get(noop.options(num_cpus=0.5).remote(), timeout=60) == 1
+    assert time.time() - t0 < 25, "revocation should beat the 30s idle TTL"
+
+
+def test_chaos_on_push_task_invalidates_cached_lease(shutdown_only):
+    """Injected push_task failures in the workers: the owner must drop the
+    cached lease, re-acquire, and still run every task to completion."""
+    import json
+
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "testing_rpc_failure": json.dumps({"push_task": 0.3}),
+        },
+    )
+
+    @ray_tpu.remote(max_retries=5)
+    def noop(i):
+        return i
+
+    before = _lease_rpcs()
+    n = 12
+    out = ray_tpu.get([noop.remote(i) for i in range(n)], timeout=300)
+    assert out == list(range(n))
+    # sequential warm stream with failures mixed in
+    for i in range(n):
+        assert ray_tpu.get(noop.remote(i), timeout=300) == i
+    # at least one re-acquire happened (a failed push returned the lease
+    # as failed and the task took a fresh one)
+    assert _lease_rpcs() - before >= 2

@@ -16,11 +16,62 @@ def test_microbenchmarks_produce_all_metrics(shutdown_only):
         "one_to_one_actor_calls_sync",
         "one_to_one_actor_calls_async",
         "single_client_wait_100_refs_s",
+        "rpcs_per_task_sync",
+        "lease_rpcs_per_task_sync",
     }
     assert expected <= set(results)
     for metric, value in results.items():
-        assert value > 0, (metric, value)
+        if "per_task" in metric:
+            # ratios where 0 is the optimum (warm lease cache -> 0 lease
+            # RPCs); the push itself keeps rpcs_per_task >= 1
+            assert value >= 0, (metric, value)
+        else:
+            assert value > 0, (metric, value)
+    assert results["rpcs_per_task_sync"] >= 1
     assert not ray_tpu.is_initialized()  # the suite cleans up after itself
+
+
+def test_microbenchmark_json_output(shutdown_only):
+    """The CLI's machine-readable mode (BENCH_LOG.md appends): every metric
+    carries a unit, and the per-method RPC latency histograms ride along."""
+    import json
+
+    from ray_tpu._internal.perf import json_results, metric_unit
+
+    results = run_microbenchmarks(small=True)
+    doc = json.loads(json_results(results))
+    assert set(doc["metrics"]) == set(results)
+    for name, entry in doc["metrics"].items():
+        assert entry["unit"] == metric_unit(name)
+    lat = doc["rpc_latency_ms"]
+    assert "push_task" in lat and lat["push_task"]["count"] > 0
+    assert "buckets" in lat["push_task"]
+
+
+def test_warm_stream_lease_rpcs_regression_guard(shutdown_only):
+    """Regression guard for lease reuse (counter-based, stable on a 1-core
+    box): a warm same-class task stream must issue at most one lease RPC
+    total — NOT one per task."""
+    from ray_tpu.util import metrics
+
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    ray_tpu.get(noop.remote(0))  # warm: acquire + cache the lease
+    before = metrics.rpc_calls_by_method()
+    n = 25
+    for i in range(n):
+        assert ray_tpu.get(noop.remote(i)) == i
+    after = metrics.rpc_calls_by_method()
+    lease_delta = after.get("request_worker_lease", 0.0) - before.get(
+        "request_worker_lease", 0.0
+    )
+    push_delta = after.get("push_task", 0.0) - before.get("push_task", 0.0)
+    assert lease_delta <= 1, f"{lease_delta} lease RPCs for {n} warm tasks"
+    assert push_delta == n
 
 
 def test_scale_smoke_queued_tasks(shutdown_only):
