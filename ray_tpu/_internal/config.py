@@ -34,6 +34,21 @@ class Config:
     object_store_memory: int = 512 * 1024 * 1024
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_size: int = 4 * 1024 * 1024
+    # Native (C++ TCP) transfer plane for node-to-node pulls. False forces
+    # the python chunked-RPC path (deterministic transfer accounting; the
+    # weight-plane broadcast tests rely on it).
+    object_transfer_native_enabled: bool = True
+
+    # --- weight plane (ray_tpu.weights) ---
+    # Target size of one broadcast chunk: a published pytree's leaves are
+    # greedily grouped into store objects of at most this many bytes (one
+    # oversized leaf still becomes a single chunk — arrays never split).
+    weights_chunk_size: int = 8 * 1024 * 1024
+    # How long a subscriber waits for its broadcast-tree parent to hold a
+    # chunk before falling back to pulling from any holder. The fallback
+    # preserves liveness when a parent node dies mid-broadcast at the cost
+    # of the O(1)-publisher-upload property for that chunk.
+    weights_prefer_wait_s: float = 10.0
 
     # --- scheduling ---
     # Hybrid policy: prefer local node until utilization exceeds this, then

@@ -27,6 +27,8 @@ def metric_unit(metric: str) -> str:
     *_per_task* entries are dimensionless ratios (lower is better)."""
     if "gb_s" in metric:
         return "GB/s"
+    if "mb_s" in metric:
+        return "MB/s"
     if "per_task" in metric:
         return "rpcs/task"
     if metric.endswith("_s"):
@@ -160,6 +162,9 @@ def run_microbenchmarks(
         # -- native transfer plane vs python chunked pull -------------------
         results.update(_transfer_plane_bench(scale))
 
+        # -- weight plane: publish + subscribe bandwidth --------------------
+        results.update(_weights_broadcast_bench(scale))
+
         # -- wait over many refs -------------------------------------------
         nw = max(int(1000 * scale), 100)
         wait_refs: List = [ray_tpu.put(i) for i in range(nw)]
@@ -223,6 +228,57 @@ def _transfer_plane_bench(scale: float) -> Dict[str, float]:
     finally:
         src.shutdown()
         dst.shutdown()
+    return results
+
+
+def _weights_broadcast_bench(scale: float) -> Dict[str, float]:
+    """Weight-plane end-to-end rates: publish (chunk + store + register) and
+    subscribe (resolve + pull + pin + assemble) of an ``size_mb`` pytree,
+    one subscriber per measured fan-out level. Same-node numbers here — the
+    O(1)-in-subscribers publisher upload is asserted by the multi-node test
+    (tests/test_weights_broadcast.py); MB/s vs subscriber count on a real
+    cluster lands in BENCH_LOG.md."""
+    import numpy as np
+
+    from ray_tpu import weights
+    from ray_tpu.util import metrics as _metrics  # noqa: F401 (gauge init)
+    from ray_tpu.weights.subscriber import WeightSubscriber
+
+    size_mb = 16 if scale >= 1.0 else 4
+    n_leaves = 8
+    leaf = np.random.default_rng(0).integers(
+        0, 255, (size_mb << 20) // (4 * n_leaves), dtype=np.int32
+    )
+    pytree = {f"layer{i}": leaf + i for i in range(n_leaves)}
+    name = "perf/weights_broadcast"
+    pub = weights.WeightPublisher(name)
+    results: Dict[str, float] = {}
+    # publish: best of 3 (first run pays jit-free path warmup + registry)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        pub.publish(pytree)
+        best = min(best, time.perf_counter() - t0)
+    results["weights_publish_mb_s"] = size_mb / best
+    # subscribe fan-out: per-subscriber fetch rate at 1 and 2 subscribers on
+    # this node — the second subscriber dedupes through the node store, so
+    # its rate reflects cache-hit assembly, not another transfer
+    for fanout in (1, 2):
+        subs = [
+            WeightSubscriber(name, reader_id=f"perf-{fanout}-{i}")
+            for i in range(fanout)
+        ]
+        t0 = time.perf_counter()
+        for sub in subs:
+            sub.get()
+        dt = time.perf_counter() - t0
+        results[f"weights_subscribe_x{fanout}_mb_s"] = (
+            size_mb * fanout / dt if dt > 0 else float("inf")
+        )
+        for sub in subs:
+            sub.release()
+    pub.collect()
+    pub.close()
     return results
 
 

@@ -211,6 +211,14 @@ def shutdown():
             loop_thread.stop()
         except Exception:
             pass
+    # process-cached weight-plane publishers/subscribers hold refs + pins
+    # bound to the dying cluster; drop them so the next init() starts clean
+    try:
+        from .weights import _reset_for_shutdown
+
+        _reset_for_shutdown()
+    except Exception:
+        pass
     # injected RPC chaos is process-global; it must not outlive the cluster
     # that configured it (later init()s in the same process would inherit it)
     from ._internal.rpc import set_rpc_chaos

@@ -71,6 +71,12 @@ class _DecodeModelBase:
         )
         return logits[:, -1, :], vars_out["cache"]
 
+    def swap_params(self, params):
+        """Hot weight reload: the jitted prefill/decode programs close over
+        shapes only (params are traced arguments), so swapping the pytree
+        retunes nothing — the next prefill simply reads the new weights."""
+        self._params = params
+
     @staticmethod
     def _sample_tokens(logits, temps: np.ndarray, key) -> np.ndarray:
         """Greedy where temps==0, temperature-categorical elsewhere — the

@@ -27,7 +27,8 @@ class _LLMReplica:
     """The replica callable (reference role: VLLMDeployment)."""
 
     def __init__(self, llm_config: LLMConfig, params_blob: Optional[bytes] = None,
-                 tokenizer_name: Optional[str] = None):
+                 tokenizer_name: Optional[str] = None,
+                 weights_name: Optional[str] = None):
         import jax
 
         from ..parallel.mesh import make_mesh
@@ -43,7 +44,21 @@ class _LLMReplica:
                 fsdp=1,
                 dp=-1,
             )
-        if params_blob is not None:
+        self._mesh = mesh
+        self._weights_name = weights_name
+        self._weights_sub = None
+        self._weights_version = None
+        if weights_name is not None:
+            # hot-reloadable weights from the weight plane: the replica
+            # subscribes to the named model and serves its head version;
+            # reload_weights()/reconfigure swap in fresh versions in place
+            from ..weights import WeightSubscriber
+
+            self._weights_sub = WeightSubscriber(weights_name)
+            self._weights_version, params = self._weights_sub.get(
+                timeout=60.0
+            )
+        elif params_blob is not None:
             from .._internal import serialization
 
             params = serialization.loads(params_blob)
@@ -62,6 +77,46 @@ class _LLMReplica:
             from transformers import AutoTokenizer
 
             self._tokenizer = AutoTokenizer.from_pretrained(tokenizer_name)
+
+    # -- hot weight reload (weight plane) ------------------------------------
+
+    def reload_weights(self, version: Optional[int] = None) -> Dict[str, Any]:
+        """Swap in a weight-plane version (head when None). Routed through
+        the replica handle (or serve's reconfigure) — in-flight requests
+        finish on the old pytree; the next prefill reads the new one."""
+        if self._weights_sub is None:
+            raise ValueError(
+                "replica was not deployed with weights_name; hot reload "
+                "needs the weight plane"
+            )
+        new_version, params = self._weights_sub.get(version, timeout=60.0)
+        if new_version != self._weights_version:
+            self._engine.swap_params(params)
+            self._weights_version = new_version
+        return {
+            "version": self._weights_version,
+            "staleness": self._weights_sub.staleness(),
+        }
+
+    def reconfigure(self, user_config):
+        """serve reconfigure hook: ``{"weights_version": v}`` (or
+        ``{"weights_version": None}`` for head) hot-reloads without
+        restarting the replica."""
+        if isinstance(user_config, dict) and (
+            "weights_version" in user_config
+        ) and self._weights_sub is not None:
+            self.reload_weights(user_config["weights_version"])
+
+    def weights_info(self) -> Dict[str, Any]:
+        return {
+            "weights_name": self._weights_name,
+            "version": self._weights_version,
+            "staleness": (
+                self._weights_sub.staleness()
+                if self._weights_sub is not None
+                else None
+            ),
+        }
 
     def _parse_request(self, request: Dict[str, Any]) -> GenerationRequest:
         token_ids = request.get("token_ids")
@@ -141,6 +196,7 @@ def build_llm_deployment(
     params_blob: Optional[bytes] = None,
     tokenizer_name: Optional[str] = None,
     name: Optional[str] = None,
+    weights_name: Optional[str] = None,
 ):
     """Return a bound serve Application for this LLM (reference:
     build_llm_deployment, llm/_internal/serve/builders)."""
@@ -155,4 +211,4 @@ def build_llm_deployment(
     else:
         options["num_replicas"] = llm_config.num_replicas
     dep = serve.deployment(_LLMReplica, **options)
-    return dep.bind(llm_config, params_blob, tokenizer_name)
+    return dep.bind(llm_config, params_blob, tokenizer_name, weights_name)

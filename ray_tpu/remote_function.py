@@ -82,6 +82,9 @@ def prepare_args(worker, args: tuple, kwargs: dict) -> List[TaskArg]:
     structure, extracted = arglib.flatten(args, kwargs)
     with serialization.collect_refs() as nested:
         packed = serialization.pack(structure)
+    from .util import metrics
+
+    metrics.record_object_serialization("task_arg", len(packed))
     task_args = [TaskArg(value=packed)]
     for ref in extracted:
         owner = ref.owner_address or worker.address

@@ -104,6 +104,9 @@ class PPO:
             max_grad_norm=config.max_grad_norm,
             seed=config.seed,
         )
+        from .weight_sync import broadcaster_for
+
+        self._broadcaster = broadcaster_for(config)
         Runner = api.remote(num_cpus=config.num_cpus_per_runner)(EnvRunner)
         self.runners = [
             Runner.remote(
@@ -140,9 +143,11 @@ class PPO:
         """One iteration: parallel rollouts -> GAE -> learner update
         (reference: Algorithm.step / training_step)."""
         t0 = time.time()
-        params = self.learner.get_params()
+        # params travel once per iteration (ObjectRef or weight-plane
+        # version), never inline per runner — see rllib/weight_sync.py
+        params_handle = self._broadcaster.handle(self.learner.get_params())
         rollouts = api.get(
-            [r.sample.remote(params) for r in self.runners]
+            [r.sample.remote(params_handle) for r in self.runners]
         )
         batch, ep_returns, ep_lengths = self._postprocess(rollouts)
         stats = self.learner.update(batch)

@@ -28,6 +28,12 @@ class AlgorithmConfig:
         self.num_tpus_for_learner = 0.0
         self.env_to_module_connector = None
         self.module_to_env_connector = None
+        # learner→env-runner weight sync (see rllib/weight_sync.py): False =
+        # api.put once per iteration + ObjectRef task args; True = publish
+        # through ray_tpu.weights and hand runners a WeightHandle (binomial
+        # broadcast tree, per-node chunk dedup, versioned registry)
+        self.use_weight_plane = False
+        self.weight_plane_name: Optional[str] = None
 
     def environment(self, env, env_config: Optional[dict] = None):
         self.env_spec = env
@@ -76,6 +82,18 @@ class AlgorithmConfig:
 
     def resources(self, num_tpus_for_learner: float = 0):
         self.num_tpus_for_learner = num_tpus_for_learner
+        return self
+
+    def weight_sync(
+        self,
+        use_weight_plane: Optional[bool] = None,
+        weight_plane_name: Optional[str] = None,
+    ):
+        """Configure how fresh params reach env-runners each iteration."""
+        if use_weight_plane is not None:
+            self.use_weight_plane = use_weight_plane
+        if weight_plane_name is not None:
+            self.weight_plane_name = weight_plane_name
         return self
 
     def debugging(self, seed: Optional[int] = None):

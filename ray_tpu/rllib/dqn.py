@@ -99,6 +99,9 @@ class DQNRunner:
     def sample(self, params, epsilon: float) -> Dict[str, Any]:
         import jax.numpy as jnp
 
+        from .weight_sync import resolve_params
+
+        params = resolve_params(params)
         if self._model is None:
             obs_dim, act_dim, _ = space_dims(
                 self._obs_space, self._env.envs[0].action_space
@@ -194,6 +197,9 @@ class DQN:
 
         Buffer = api.remote(num_cpus=0)(ReplayBuffer)
         self.buffer = Buffer.remote(config.buffer_capacity, obs_dim)
+        from .weight_sync import broadcaster_for
+
+        self._broadcaster = broadcaster_for(config)
         Runner = api.remote(num_cpus=config.num_cpus_per_runner)(DQNRunner)
         self.runners = [
             Runner.remote(
@@ -252,8 +258,9 @@ class DQN:
         t0 = time.time()
         cfg = self.config
         eps = self._epsilon()
+        params_handle = self._broadcaster.handle(self.params)
         rollouts = api.get(
-            [r.sample.remote(self.params, eps) for r in self.runners]
+            [r.sample.remote(params_handle, eps) for r in self.runners]
         )
         adds = []
         ep_returns, ep_lengths = [], []

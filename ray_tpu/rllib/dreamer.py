@@ -449,6 +449,9 @@ class DreamerRunner:
         arrival record per lane; episode ends append a second record for
         the terminal arrival (the pre-auto-reset observation), so lane
         sequence lengths differ."""
+        from .weight_sync import resolve_params
+
+        params = resolve_params(params)
         T, n = self._rollout_len, self._vec.num_envs
         lanes: List[Dict[str, List]] = [
             {k: [] for k in ("obs", "action", "reward", "is_first",
@@ -596,6 +599,9 @@ class DreamerV3:
         self.buffer = Buffer.remote(
             config.buffer_capacity, total_slots, obs_dim, act_dim
         )
+        from .weight_sync import broadcaster_for
+
+        self._broadcaster = broadcaster_for(config)
         Runner = api.remote(num_cpus=config.num_cpus_per_runner)(
             DreamerRunner
         )
@@ -794,8 +800,9 @@ class DreamerV3:
             np.asarray,
             {"wm": self.params["wm"], "actor": self.params["actor"]},
         )
+        params_handle = self._broadcaster.handle(host_params)
         rollouts = api.get(
-            [r.sample.remote(host_params) for r in self.runners]
+            [r.sample.remote(params_handle) for r in self.runners]
         )
         adds, ep_returns = [], []
         for i, ro in enumerate(rollouts):

@@ -76,9 +76,14 @@ class EnvRunner:
 
     def sample(self, params) -> Dict[str, Any]:
         """Roll ``rollout_len`` steps; returns [T, N] arrays + last values
-        for bootstrap + episode stats."""
+        for bootstrap + episode stats. ``params`` may be the pytree itself
+        (inline or via ObjectRef) or a weight-plane WeightHandle — resolved
+        here so the learner chooses the sync transport, not the runner."""
         import jax
 
+        from .weight_sync import resolve_params
+
+        params = resolve_params(params)
         T, N = self._rollout_len, self._vec.num_envs
         obs_buf = np.zeros((T, N) + self._obs.shape[1:], np.float32)
         act_buf = None

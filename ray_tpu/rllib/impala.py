@@ -58,6 +58,9 @@ class ImpalaRunner:
         )
 
     def sample(self, params) -> Dict[str, Any]:
+        from .weight_sync import resolve_params
+
+        params = resolve_params(params)
         T, N = self._rollout_len, self._vec.num_envs
         obs_buf = np.zeros((T, N) + self._obs.shape[1:], np.float32)
         act_buf = None
@@ -164,6 +167,9 @@ class IMPALA:
         self.opt_state = self.tx.init(self.params)
         self._update = jax.jit(self._update_impl)
 
+        from .weight_sync import broadcaster_for
+
+        self._broadcaster = broadcaster_for(config)
         Runner = api.remote(num_cpus=config.num_cpus_per_runner)(ImpalaRunner)
         self.runners = [
             Runner.remote(
@@ -174,9 +180,11 @@ class IMPALA:
             for i in range(config.num_env_runners)
         ]
         api.get([r.ping.remote() for r in self.runners])
-        # async pipeline: one in-flight sample per runner
+        # async pipeline: one in-flight sample per runner (params broadcast
+        # once — every runner's first rollout shares the same handle)
+        params_handle = self._broadcaster.handle(self.params)
         self._inflight: Dict[Any, Any] = {
-            r.sample.remote(self.params): r for r in self.runners
+            r.sample.remote(params_handle): r for r in self.runners
         }
         self._ep_return_window: List[float] = []
 
@@ -291,8 +299,12 @@ class IMPALA:
             ep_returns.extend(rollout["episode_returns"])
             steps += rollout["rewards"].size
             # resubmit with fresh params — the runner's next rollout is at
-            # most one update stale (reference: broadcast interval)
-            self._inflight[runner.sample.remote(self.params)] = runner
+            # most one update stale (reference: broadcast interval). The
+            # broadcaster keys on params identity, so each update broadcasts
+            # once even when several runners resubmit between updates.
+            self._inflight[
+                runner.sample.remote(self._broadcaster.handle(self.params))
+            ] = runner
 
         self.iteration += 1
         self._ep_return_window.extend(ep_returns)

@@ -134,6 +134,10 @@ class MultiAgentEnvRunner:
         over all agents, the cooperative objective)."""
         import jax
 
+        from .weight_sync import resolve_params
+
+        params_by_policy = resolve_params(params_by_policy)
+
         T = self._rollout_len
         buffers: Dict[str, Dict[str, list]] = {
             pid: {k: [] for k in ("obs", "actions", "logp", "values", "rewards", "dones")}
@@ -260,6 +264,9 @@ class MultiAgentPPO:
             probe.close()
         except Exception:
             pass
+        from .weight_sync import broadcaster_for
+
+        self._broadcaster = broadcaster_for(config)
         Runner = api.remote(num_cpus=config.num_cpus_per_runner)(
             MultiAgentEnvRunner
         )
@@ -282,7 +289,10 @@ class MultiAgentPPO:
     def train(self) -> Dict[str, Any]:
         t0 = time.time()
         params = {pid: l.get_params() for pid, l in self.learners.items()}
-        rollouts = api.get([r.sample.remote(params) for r in self.runners])
+        params_handle = self._broadcaster.handle(params)
+        rollouts = api.get(
+            [r.sample.remote(params_handle) for r in self.runners]
+        )
         stats: Dict[str, Any] = {}
         steps = 0
         ep_returns: List[float] = []

@@ -60,6 +60,9 @@ class SACRunner:
         self._sample_fn = jax.jit(_sample)
 
     def sample(self, params, random_actions: bool = False) -> Dict[str, Any]:
+        from .weight_sync import resolve_params
+
+        params = resolve_params(params)
         out: Dict[str, List] = {
             "obs": [], "actions": [], "rewards": [], "next_obs": [],
             "dones": [],
@@ -186,6 +189,9 @@ class SAC:
         self.buffer = Buffer.remote(
             config.buffer_capacity, obs_dim, (act_dim,), np.float32
         )
+        from .weight_sync import broadcaster_for
+
+        self._broadcaster = broadcaster_for(config)
         Runner = api.remote(num_cpus=config.num_cpus_per_runner)(SACRunner)
         self.runners = [
             Runner.remote(
@@ -305,11 +311,9 @@ class SAC:
         t0 = time.time()
         cfg = self.config
         warmup = api.get(self.buffer.size.remote()) < cfg.learning_starts
+        actor_handle = self._broadcaster.handle(self.state["actor"])
         rollouts = api.get(
-            [
-                r.sample.remote(self.state["actor"], warmup)
-                for r in self.runners
-            ]
+            [r.sample.remote(actor_handle, warmup) for r in self.runners]
         )
         adds, ep_returns = [], []
         for ro in rollouts:

@@ -1,0 +1,76 @@
+"""Learner→env-runner weight sync.
+
+Every algorithm's train loop used to pass the raw params pytree inline to
+``runner.sample.remote(params)`` — re-serializing the full model once PER
+RUNNER per iteration, so publisher-side work scaled O(runners × model
+size). ``ParamsBroadcaster`` collapses that to once per iteration:
+
+- default mode: ``api.put`` the params once and hand every runner the
+  ObjectRef (executors resolve top-level refs through the object plane, so
+  runner code is unchanged);
+- weight-plane mode (``config.use_weight_plane``): publish one version via
+  ``ray_tpu.weights`` and hand runners a tiny ``WeightHandle`` — runners
+  fetch over the binomial broadcast tree (publisher upload O(1) in
+  subscriber-node count) with per-node chunk dedup; ``resolve_params`` at
+  the top of each runner's ``sample`` turns the handle back into the tree.
+
+The cache key is object identity: learners produce a fresh params object
+per update (jit outputs), so an unchanged policy between iterations reuses
+the previous ref/version and a changed one re-broadcasts exactly once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class ParamsBroadcaster:
+    def __init__(
+        self, use_weight_plane: bool = False, name: Optional[str] = None
+    ):
+        self._use_weight_plane = use_weight_plane
+        self._name = name or "rllib/params"
+        self._cached: Any = None
+        self._handle: Any = None
+
+    def handle(self, params: Any):
+        """The task-arg stand-in for ``params``: ObjectRef or WeightHandle,
+        minted at most once per distinct params object."""
+        if params is self._cached and self._handle is not None:
+            return self._handle
+        if self._use_weight_plane:
+            from .. import weights
+
+            self._handle = weights.publish(self._name, params)
+        else:
+            from .. import api
+
+            self._handle = api.put(params)
+        self._cached = params
+        return self._handle
+
+    def invalidate(self):
+        """Forget the cache (e.g. params mutated in place)."""
+        self._cached = None
+        self._handle = None
+
+
+def broadcaster_for(config) -> ParamsBroadcaster:
+    """Build from an AlgorithmConfig's weight-sync fields."""
+    return ParamsBroadcaster(
+        use_weight_plane=getattr(config, "use_weight_plane", False),
+        name=getattr(config, "weight_plane_name", None)
+        or f"rllib/{type(config).__name__.removesuffix('Config').lower()}",
+    )
+
+
+def resolve_params(params: Any) -> Any:
+    """Runner-side inverse of ``ParamsBroadcaster.handle`` for the
+    weight-plane mode: a WeightHandle fetches its pinned version over the
+    broadcast tree; anything else (resolved ObjectRef values arrive as the
+    plain pytree) passes through."""
+    from ..weights import WeightHandle, resolve
+
+    if isinstance(params, WeightHandle):
+        return resolve(params)
+    return params

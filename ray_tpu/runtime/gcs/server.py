@@ -40,6 +40,7 @@ from .actor_manager import GcsActorManager
 from .placement_groups import GcsPlacementGroupManager
 from .pubsub import Publisher
 from .store import StoreClient, make_store
+from .weight_registry import GcsWeightRegistry
 
 logger = logging.getLogger(__name__)
 
@@ -53,6 +54,7 @@ class GcsServer:
         self.storage = storage or make_store(config.gcs_storage_path)
         self.actor_manager = GcsActorManager(self)
         self.pg_manager = GcsPlacementGroupManager(self)
+        self.weight_registry = GcsWeightRegistry(self)
 
         self._nodes: Dict[NodeID, NodeInfo] = {}
         self._node_available: Dict[NodeID, Dict[str, float]] = {}
@@ -130,6 +132,7 @@ class GcsServer:
         restored_nodes = set()
         restored_nodes |= self.actor_manager.restore_from(self.storage)
         restored_nodes |= self.pg_manager.restore_from(self.storage)
+        self.weight_registry.restore_from(self.storage)
         if restored_nodes:
             deadline = time.time() + self.config.health_check_timeout_s
             self._restored_nodes_pending = {
@@ -571,6 +574,34 @@ class GcsServer:
     async def handle_kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         await self.actor_manager.kill_actor(actor_id, no_restart)
         return True
+
+    # -- weight plane (ray_tpu.weights registry) ---------------------------
+
+    async def handle_weights_publish(
+        self, name: str, manifest_blob: bytes, meta: Optional[dict] = None
+    ):
+        return self.weight_registry.publish(name, manifest_blob, meta)
+
+    async def handle_weights_get(self, name: str, version: Optional[int] = None):
+        return self.weight_registry.get(name, version)
+
+    async def handle_weights_head(self, name: str):
+        return self.weight_registry.head(name)
+
+    async def handle_weights_pin(self, name: str, version: int, reader_id: str):
+        return self.weight_registry.pin(name, version, reader_id)
+
+    async def handle_weights_unpin(self, name: str, version: int, reader_id: str):
+        return self.weight_registry.unpin(name, version, reader_id)
+
+    async def handle_weights_collect(self, name: str):
+        return self.weight_registry.collect(name)
+
+    async def handle_weights_plan(self, name: str, node_address):
+        return self.weight_registry.plan(name, node_address)
+
+    async def handle_weights_list(self):
+        return self.weight_registry.list_models()
 
     # -- placement groups --------------------------------------------------
 

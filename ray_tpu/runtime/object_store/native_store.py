@@ -51,6 +51,8 @@ class NativeObjectStore:
         # objects whose bytes rt_transfer_fetch is streaming into the arena
         # right now (C++ entry exists, python mirrors pending adopt_fetched)
         self._fetching: set = set()
+        # weight-plane pins held as C++ reader pins (see pin_weight)
+        self._weight_pins: Dict[ObjectID, int] = {}
 
     # -- helpers -------------------------------------------------------------
 
@@ -156,6 +158,32 @@ class NativeObjectStore:
 
     def pin_primary(self, object_id: ObjectID):
         self._lib.rt_pin_primary(self._h, self._key(object_id))
+
+    def pin_weight(self, object_id: ObjectID) -> bool:
+        """Weight-plane pin over the C++ core: implemented as a held reader
+        pin (rt_get bumps the pin count the C++ eviction and lru_spillable
+        paths already respect), released by unpin_weight."""
+        if not self.contains(object_id):
+            return False
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rt_get(
+            self._h, self._key(object_id), ctypes.byref(off), ctypes.byref(size)
+        )
+        if rc != 0:
+            return False
+        self._weight_pins[object_id] = self._weight_pins.get(object_id, 0) + 1
+        return True
+
+    def unpin_weight(self, object_id: ObjectID):
+        held = self._weight_pins.get(object_id, 0)
+        if held <= 0:
+            return
+        if held == 1:
+            self._weight_pins.pop(object_id, None)
+        else:
+            self._weight_pins[object_id] = held - 1
+        self._lib.rt_release(self._h, self._key(object_id))
 
     def free(self, object_id: ObjectID):
         self._lib.rt_free(self._h, self._key(object_id))

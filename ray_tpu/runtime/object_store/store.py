@@ -74,6 +74,11 @@ class _Entry:
     seal_waiters: List[asyncio.Event] = field(default_factory=list)
     # objects pinned as primary copies (owned here) are never evicted until freed
     primary: bool = False
+    # weight-plane pins (refcounted): chunks of a pinned model version are
+    # exempt from LRU eviction AND from spill selection while any subscriber
+    # holds the version — a reader-side guarantee that survives between the
+    # fetch that landed the chunk and the get that maps it
+    weight_pins: int = 0
 
 
 class ObjectStore:
@@ -157,6 +162,20 @@ class ObjectStore:
         if entry is not None:
             entry.primary = True
 
+    def pin_weight(self, object_id: ObjectID) -> bool:
+        """Refcounted weight-plane pin: exempts the object from eviction and
+        from spill selection until the matching unpin_weight."""
+        entry = self._entries.get(object_id)
+        if entry is None:
+            return False
+        entry.weight_pins += 1
+        return True
+
+    def unpin_weight(self, object_id: ObjectID):
+        entry = self._entries.get(object_id)
+        if entry is not None and entry.weight_pins > 0:
+            entry.weight_pins -= 1
+
     def free(self, object_id: ObjectID):
         entry = self._entries.pop(object_id, None)
         if entry is not None:
@@ -179,7 +198,7 @@ class ObjectStore:
         entry = self._entries.get(object_id)
         if entry is None:
             return None
-        if entry.pin_count > 0:
+        if entry.pin_count > 0 or entry.weight_pins > 0:
             return False
         self.free(object_id)
         return True
@@ -206,7 +225,10 @@ class ObjectStore:
             (
                 e
                 for e in self._entries.values()
-                if e.sealed and e.pin_count == 0 and not e.primary
+                if e.sealed
+                and e.pin_count == 0
+                and not e.primary
+                and e.weight_pins == 0
             ),
             key=lambda e: e.last_access,
         )
@@ -223,11 +245,16 @@ class ObjectStore:
 
     def lru_spillable(self) -> Optional[ObjectID]:
         """Least-recently-used primary copy eligible for spilling
-        (sealed, unpinned; primaries are exempt from plain eviction)."""
+        (sealed, unpinned; primaries are exempt from plain eviction).
+        Weight-pinned chunks are NOT spillable: an in-flight subscribe
+        reading them zero-copy must never race a spill-then-free."""
         victims = [
             e
             for e in self._entries.values()
-            if e.sealed and e.pin_count == 0 and e.primary
+            if e.sealed
+            and e.pin_count == 0
+            and e.primary
+            and e.weight_pins == 0
         ]
         if not victims:
             return None

@@ -141,6 +141,12 @@ class Raylet:
         self._last_oom_kill_ts = 0.0
         # native transfer plane counters (observability + tests)
         self._native_pulls = 0
+        # chunk-serve accounting for the weight-plane broadcast proofs:
+        # object -> number of complete python-path transfers served FROM this
+        # node (counted at offset 0), plus total payload bytes out. The O(1)
+        # publisher-upload test reads these via the transfer_stats RPC.
+        self._fetch_serves: Dict[ObjectID, int] = {}
+        self._fetch_bytes_out = 0
         self._transfer_port: Optional[int] = None
         # peer address -> (port or None, probe-expiry timestamp)
         self._peer_transfer_ports: Dict[tuple, tuple] = {}
@@ -942,9 +948,12 @@ class Raylet:
         object_id: ObjectID,
         owner_address: Optional[Tuple[str, int]] = None,
         timeout: Optional[float] = None,
+        prefer_source: Optional[Tuple[str, int]] = None,
     ):
         """Local get; pulls from a remote node when the object isn't here
-        (reference: PullManager)."""
+        (reference: PullManager). ``prefer_source`` names the peer to pull
+        from first — the weight plane routes each node at its broadcast-tree
+        parent so a shard leaves the publisher once, not once per node."""
         if self.store.contains(object_id):
             result = await self.store.get(object_id, timeout=0.1)
             if result is not None:
@@ -970,7 +979,9 @@ class Raylet:
                     except (OSError, spill_storage.SpillStorageError):
                         pass  # raced with restore, or transient backend error
         if owner_address is not None:
-            pulled = await self._pull_object(object_id, owner_address)
+            pulled = await self._pull_object(
+                object_id, owner_address, prefer_source
+            )
             if pulled:
                 result = await self.store.get(object_id, timeout=1.0)
                 if result is not None:
@@ -1018,6 +1029,7 @@ class Raylet:
                     total, chunk = await asyncio.to_thread(
                         spill_storage.read_range, path, offset, length
                     )
+                    self._note_fetch_served(object_id, offset, len(chunk))
                     return {"total": total, "data": chunk}
                 except (OSError, spill_storage.SpillStorageError):
                     pass  # spill copy raced with restore/free, or transient
@@ -1029,7 +1041,40 @@ class Raylet:
                 return None
         total = len(view)
         chunk = bytes(view[offset : offset + length])
+        self._note_fetch_served(object_id, offset, len(chunk))
         return {"total": total, "data": chunk}
+
+    def _note_fetch_served(self, object_id: ObjectID, offset: int, nbytes: int):
+        if offset == 0:
+            self._fetch_serves[object_id] = (
+                self._fetch_serves.get(object_id, 0) + 1
+            )
+        self._fetch_bytes_out += nbytes
+
+    async def handle_transfer_stats(self):
+        """Per-node transfer accounting: python-path serves per object,
+        payload bytes out, and native-plane pull count. The weight-plane
+        multi-node test asserts each chunk is served from the publisher node
+        at most once regardless of subscriber count."""
+        return {
+            "fetch_serves": {
+                oid.hex(): n for oid, n in self._fetch_serves.items()
+            },
+            "fetch_bytes_out": self._fetch_bytes_out,
+            "native_pulls": self._native_pulls,
+        }
+
+    async def handle_store_pin_weight(self, object_id: ObjectID) -> bool:
+        """Weight-plane pin (refcounted): exempts a local chunk copy from
+        eviction and spill selection until the matching unpin."""
+        pin = getattr(self.store, "pin_weight", None)
+        return bool(pin(object_id)) if pin is not None else False
+
+    async def handle_store_unpin_weight(self, object_id: ObjectID) -> bool:
+        unpin = getattr(self.store, "unpin_weight", None)
+        if unpin is not None:
+            unpin(object_id)
+        return True
 
     async def handle_transfer_info(self):
         """Advertise the native transfer-plane port (None = python path)."""
@@ -1039,6 +1084,8 @@ class Raylet:
         """Try the C++ transfer plane: one TCP stream straight into the
         local arena. False = not attempted / failed (caller falls back to
         the chunked-RPC pull)."""
+        if not self.config.object_transfer_native_enabled:
+            return False
         if self._transfer_port is None or not hasattr(
             self.store, "transfer_fetch_raw"
         ):
@@ -1083,7 +1130,9 @@ class Raylet:
             self._peer_transfer_ports.pop(key, None)
         return False
 
-    async def _pull_object(self, object_id: ObjectID, owner_address) -> bool:
+    async def _pull_object(
+        self, object_id: ObjectID, owner_address, prefer_source=None
+    ) -> bool:
         """Ask the owner where the object lives, then pull it — C++
         transfer plane first, chunked RPC as the fallback (reference:
         PullManager + ObjectManager::Push).
@@ -1106,7 +1155,7 @@ class Raylet:
                 if self.store.contains(object_id):
                     return True  # a concurrent pull already landed it
                 return await self._pull_object_locked(
-                    object_id, owner_address
+                    object_id, owner_address, prefer_source
                 )
         finally:
             holds = self._pull_lock_holds[object_id] - 1
@@ -1118,7 +1167,7 @@ class Raylet:
                     del self._pull_locks[object_id]
 
     async def _pull_object_locked(
-        self, object_id: ObjectID, owner_address
+        self, object_id: ObjectID, owner_address, prefer_source=None
     ) -> bool:
         try:
             owner = self.client_pool.get(*owner_address)
@@ -1126,6 +1175,16 @@ class Raylet:
         except Exception as e:
             logger.debug("pull: owner lookup failed for %s: %s", object_id, e)
             return False
+        if prefer_source is not None:
+            # topology-aware pull (weight plane): try the named peer first
+            # even if the owner's location table hasn't caught up with it yet
+            # (the caller verified the peer holds the object; registration
+            # with the owner is asynchronous). Other holders stay as
+            # fallbacks so a dead parent cannot wedge the pull.
+            prefer = tuple(prefer_source)
+            loc = [prefer] + [
+                n for n in (loc or ()) if tuple(n) != prefer
+            ]
         if not loc:
             return False
         for node_address in loc:

@@ -473,17 +473,36 @@ class CoreWorker:
         return ObjectID.for_put(self._current_task_id, self._put_index)
 
     async def put(self, value: Any, object_id: Optional[ObjectID] = None) -> ObjectID:
-        object_id = object_id or self.next_put_id()
         meta, bufs = serialization.serialize(value)
+        object_id, _ = await self.put_serialized(meta, bufs, object_id)
+        return object_id
+
+    async def put_serialized(
+        self,
+        meta: bytes,
+        bufs,
+        object_id: Optional[ObjectID] = None,
+        force_plasma: bool = False,
+    ):
+        """Put an already-serialized value; returns (object_id, packed size).
+        Split out of put() so the weight plane can serialize once, learn the
+        exact chunk size for its manifest, and store without re-serializing.
+        ``force_plasma`` routes even small values through the shared store —
+        weight chunks must be node-shareable (and peer-pullable) regardless
+        of size."""
+        from ...util import metrics
+
+        object_id = object_id or self.next_put_id()
         size = serialization.packed_size(meta, bufs)
+        metrics.record_object_serialization("put", size)
         self._owned.add(object_id)
-        if size <= self.config.max_direct_call_object_size:
+        if not force_plasma and size <= self.config.max_direct_call_object_size:
             packed = bytearray(size)
             serialization.pack_into(meta, bufs, memoryview(packed))
             self.memory_store.put_value(object_id, bytes(packed))
         else:
             await self._put_plasma(object_id, meta, bufs, size, primary=True)
-        return object_id
+        return object_id, size
 
     async def _put_plasma(self, object_id, meta, bufs, size, primary: bool):
         raylet = self.client_pool.get(*self.raylet_address)
@@ -595,7 +614,7 @@ class CoreWorker:
             return await self._read_plasma(ref, entry.size)
         raise ObjectLostError(ref.id, "entry empty")
 
-    async def _read_plasma(self, ref: ObjectRef, size: int):
+    async def _read_plasma(self, ref: ObjectRef, size: int, prefer_source=None):
         raylet = self.client_pool.get(*self.raylet_address)
         owner_addr = ref.owner_address if not self._is_self(ref.owner_address) else (
             self.address
@@ -603,7 +622,7 @@ class CoreWorker:
         attempts = 0
         while True:
             reply = await raylet.call(
-                "store_get", ref.id, owner_addr,
+                "store_get", ref.id, owner_addr, None, prefer_source,
                 timeout=self.config.rpc_call_timeout_s,
             )
             if reply["ok"]:

@@ -127,9 +127,17 @@ def _connected(args):
 
 
 def cmd_microbenchmark(args):
-    from .._internal.perf import print_results, run_microbenchmarks
+    from .._internal.perf import (
+        json_results,
+        print_results,
+        run_microbenchmarks,
+    )
 
-    print_results(run_microbenchmarks(small=args.small))
+    results = run_microbenchmarks(small=args.small)
+    if getattr(args, "json", False):
+        print(json_results(results))
+    else:
+        print_results(results)
     return 0
 
 
@@ -153,6 +161,7 @@ def cmd_list(args):
         "jobs": state.list_jobs,
         "placement-groups": state.list_placement_groups,
         "objects": state.list_objects,
+        "weights": state.list_weights,
     }[args.what]
     rows = fn()
     print(json.dumps(rows, indent=2, default=str))
@@ -314,7 +323,10 @@ def main(argv=None):
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument(
         "what",
-        choices=["nodes", "actors", "tasks", "jobs", "placement-groups", "objects"],
+        choices=[
+            "nodes", "actors", "tasks", "jobs", "placement-groups",
+            "objects", "weights",
+        ],
     )
     p.add_argument("--address", required=True, help="head host:port")
     p.set_defaults(fn=cmd_list)
@@ -324,6 +336,10 @@ def main(argv=None):
         "(reference: release/microbenchmark)",
     )
     p.add_argument("--small", action="store_true")
+    p.add_argument(
+        "--json", action="store_true",
+        help="emit one machine-readable JSON line (BENCH_LOG.md appends)",
+    )
     p.set_defaults(fn=cmd_microbenchmark)
 
     args = parser.parse_args(argv)

@@ -8,7 +8,11 @@ loop compiles to pjit/GSPMD with collectives over ICI.
 
 from . import collective
 from .backend import BackendConfig, JaxConfig, TorchConfig
-from .callbacks import TPUReservationCallback, TrainCallback
+from .callbacks import (
+    TPUReservationCallback,
+    TrainCallback,
+    WeightPublishCallback,
+)
 from .checkpoint import Checkpoint, CheckpointManager, load_latest_checkpoint
 from .sharded_checkpoint import (
     ShardedCheckpointWriter,

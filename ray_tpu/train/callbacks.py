@@ -37,6 +37,80 @@ class TrainCallback:
         pass
 
 
+class WeightPublishCallback(TrainCallback):
+    """Publish every reported checkpoint's state to the weight plane
+    (reference role: the learner-side weight broadcast RLlib/Serve consume).
+
+    Each checkpoint the train loop reports becomes one version of the named
+    model: downstream subscribers — serve replicas hot-reloading a
+    fine-tune, RL env-runners, evaluation jobs — pull it over the broadcast
+    tree instead of re-reading checkpoint storage per consumer.
+
+    ``load_fn(checkpoint) -> pytree`` extracts the publishable state; the
+    default understands ``state.pkl`` files (what the examples write) and
+    falls back to the sharded-checkpoint reader.
+    """
+
+    def __init__(self, name: str, load_fn=None):
+        self._name = name
+        self._load_fn = load_fn or _default_checkpoint_load
+        self._last_published_index = None
+
+    def on_report(self, report) -> None:
+        if report.checkpoint is None or report.world_rank != 0:
+            return
+        if report.index == self._last_published_index:
+            return
+        try:
+            state = self._load_fn(report.checkpoint)
+        except Exception:
+            logger.exception(
+                "weight publish: could not load state from checkpoint %s",
+                report.checkpoint,
+            )
+            return
+        if state is None:
+            return
+        from .. import weights
+
+        handle = weights.publish(
+            self._name, state, meta={"checkpoint_index": report.index}
+        )
+        self._last_published_index = report.index
+        logger.info(
+            "published checkpoint %d as weights %s v%s",
+            report.index, self._name, handle.version,
+        )
+
+    def after_run(self, result) -> None:
+        # reclaim superseded versions' chunks before the driver moves on
+        from ..weights import _publisher
+
+        try:
+            _publisher(self._name).collect()
+        except Exception:
+            pass
+
+
+def _default_checkpoint_load(checkpoint):
+    """Best-effort state extraction: a ``state.pkl`` in the checkpoint dir,
+    else an orbax sharded checkpoint, else None."""
+    import os
+    import pickle
+
+    with checkpoint.as_directory() as path:
+        pkl = os.path.join(path, "state.pkl")
+        if os.path.exists(pkl):
+            with open(pkl, "rb") as f:
+                return pickle.load(f)
+        try:
+            from .sharded_checkpoint import restore_sharded
+
+            return restore_sharded(path)
+        except Exception:
+            return None
+
+
 class TPUReservationCallback(TrainCallback):
     """Reserve one slice per run (reference flow: reserve_tpu_slice →
     bundle_label_selector, tpu_reservation_callback.py:12)."""

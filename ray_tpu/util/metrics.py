@@ -198,6 +198,144 @@ def rpc_latency_summary() -> Dict[str, dict]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Object-serialization accounting: how many times (and how many bytes) this
+# process serialized values into the object plane, by context — "put"
+# (api.put / CoreWorker.put) vs "task_arg" (inline task-argument packing).
+# The rllib put-once regression guard asserts train() serializes the params
+# pytree at most once per iteration instead of once per env-runner.
+# ---------------------------------------------------------------------------
+
+_ser_count: Optional["Counter"] = None
+_ser_bytes: Optional["Counter"] = None
+_ser_init_lock = threading.Lock()
+
+
+def _ensure_serialization_metrics():
+    global _ser_count, _ser_bytes
+    if _ser_bytes is None:
+        with _ser_init_lock:
+            if _ser_bytes is None:
+                _ser_count = Counter(
+                    "object_serializations_total",
+                    "Object-plane serializations by context (put | task_arg)",
+                    tag_keys=("context",),
+                )
+                # assigned last: gates the fast path (see _ensure_rpc_metrics)
+                _ser_bytes = Counter(
+                    "object_serialization_bytes_total",
+                    "Bytes serialized into the object plane by context",
+                    tag_keys=("context",),
+                )
+    return _ser_count, _ser_bytes
+
+
+def record_object_serialization(context: str, nbytes: int):
+    """Called from CoreWorker.put and prepare_args (hot path — keep cheap)."""
+    count, total = _ensure_serialization_metrics()
+    tags = {"context": context}
+    count.inc(1.0, tags)
+    total.inc(float(nbytes), tags)
+
+
+def object_serializations() -> Dict[str, Dict[str, float]]:
+    """Process-local snapshot: context -> {count, bytes}."""
+    count, total = _ensure_serialization_metrics()
+    out: Dict[str, Dict[str, float]] = {}
+    with count._lock:
+        for key, v in count._values.items():
+            out.setdefault(key[0], {"count": 0.0, "bytes": 0.0})["count"] = v
+    with total._lock:
+        for key, v in total._values.items():
+            out.setdefault(key[0], {"count": 0.0, "bytes": 0.0})["bytes"] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Weight-plane metrics (ray_tpu.weights): publish latency, broadcast volume,
+# tree depth, and subscriber staleness, tagged by model name. Surfaced via
+# the GCS pusher / prometheus_text like every other metric, and snapshotted
+# process-locally by the weights microbenchmark + tests.
+# ---------------------------------------------------------------------------
+
+_WEIGHTS_LATENCY_BOUNDARIES_MS = [
+    1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
+]
+
+_weights_metrics: Optional[dict] = None
+_weights_init_lock = threading.Lock()
+
+
+def _ensure_weights_metrics() -> dict:
+    global _weights_metrics
+    if _weights_metrics is None:
+        with _weights_init_lock:
+            if _weights_metrics is None:
+                _weights_metrics = {
+                    "publish_latency": Histogram(
+                        "weights_publish_latency_ms",
+                        "WeightPublisher.publish wall time by model (ms)",
+                        boundaries=_WEIGHTS_LATENCY_BOUNDARIES_MS,
+                        tag_keys=("model",),
+                    ),
+                    "fetch_latency": Histogram(
+                        "weights_fetch_latency_ms",
+                        "WeightSubscriber full-version fetch wall time (ms)",
+                        boundaries=_WEIGHTS_LATENCY_BOUNDARIES_MS,
+                        tag_keys=("model",),
+                    ),
+                    "broadcast_bytes": Counter(
+                        "weights_broadcast_bytes_total",
+                        "Weight bytes moved by direction (publish | fetch)",
+                        tag_keys=("model", "direction"),
+                    ),
+                    "tree_depth": Gauge(
+                        "weights_broadcast_tree_depth",
+                        "Depth of the binomial broadcast tree by model",
+                        tag_keys=("model",),
+                    ),
+                    "staleness": Gauge(
+                        "weights_staleness_versions",
+                        "Versions behind head for this subscriber, by model",
+                        tag_keys=("model",),
+                    ),
+                }
+    return _weights_metrics
+
+
+def record_weights_publish(model: str, latency_s: float, nbytes: int):
+    m = _ensure_weights_metrics()
+    m["publish_latency"].observe(latency_s * 1000.0, {"model": model})
+    m["broadcast_bytes"].inc(
+        float(nbytes), {"model": model, "direction": "publish"}
+    )
+
+
+def record_weights_fetch(model: str, latency_s: float, nbytes: int):
+    m = _ensure_weights_metrics()
+    m["fetch_latency"].observe(latency_s * 1000.0, {"model": model})
+    m["broadcast_bytes"].inc(
+        float(nbytes), {"model": model, "direction": "fetch"}
+    )
+
+
+def set_weights_tree_depth(model: str, depth: int):
+    _ensure_weights_metrics()["tree_depth"].set(float(depth), {"model": model})
+
+
+def set_weights_staleness(model: str, versions_behind: int):
+    _ensure_weights_metrics()["staleness"].set(
+        float(versions_behind), {"model": model}
+    )
+
+
+def weights_staleness(model: str) -> Optional[float]:
+    """Process-local staleness gauge readback (tests + state CLI)."""
+    gauge = _ensure_weights_metrics()["staleness"]
+    with gauge._lock:
+        return gauge._values.get(gauge._tag_tuple({"model": model}))
+
+
 def _ensure_pusher():
     """Background thread pushing this process's metrics to the GCS KV."""
     global _pusher_started

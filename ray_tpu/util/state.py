@@ -105,6 +105,14 @@ def list_objects() -> List[Dict[str, Any]]:
     ]
 
 
+def list_weights() -> List[Dict[str, Any]]:
+    """Weight-plane registry rows: every published model with its head
+    version, resident/pinned versions, tombstone count, and broadcast-tree
+    shape (reference analogue: `ray list objects` for the model-state
+    subsystem)."""
+    return _gcs_call("weights_list")
+
+
 def _raylet_call(address, method: str, *args, **kwargs):
     worker = _worker_api.get_core_worker()
     return _worker_api.run_on_worker_loop(

@@ -1,0 +1,127 @@
+"""ray_tpu.weights — the cluster weight plane.
+
+A GCS-backed registry of named models with monotonic versions plus a
+topology-aware zero-copy broadcast path: publishers chunk host weight
+shards into the object store once, subscriber nodes relay chunks to each
+other along a binomial tree (publisher upload is O(1) in subscriber-node
+count), co-located subscribers dedupe through their node's store, and
+superseded versions are tombstoned and freed only after the last pinned
+reader releases.
+
+    pub = weights.WeightPublisher("policy/ppo")
+    v = pub.publish(params)                      # one upload, any fan-out
+
+    sub = weights.WeightSubscriber("policy/ppo")
+    version, params = sub.get()                  # pinned until next get()
+    sub.staleness()                              # versions behind head
+
+Module-level helpers cache one publisher/subscriber per model per process:
+``publish(name, pytree)``, ``fetch(name)``, and ``resolve(obj)`` (the
+env-runner-side hook that turns a ``WeightHandle`` task argument back into
+the pytree, pulling over the broadcast tree instead of the task RPC).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from .manifest import ChunkInfo, Manifest, assemble_pytree, chunk_pytree, reshard
+from .publisher import WeightPublisher
+from .subscriber import WeightSubscriber
+
+__all__ = [
+    "ChunkInfo",
+    "Manifest",
+    "WeightHandle",
+    "WeightPublisher",
+    "WeightSubscriber",
+    "assemble_pytree",
+    "chunk_pytree",
+    "fetch",
+    "list_models",
+    "publish",
+    "reshard",
+    "resolve",
+]
+
+_lock = threading.Lock()
+_publishers: Dict[str, WeightPublisher] = {}
+_subscribers: Dict[str, WeightSubscriber] = {}
+
+
+@dataclass(frozen=True)
+class WeightHandle:
+    """A by-name pointer to one published version — small enough to ride in
+    any task argument or config; consumers resolve it through the broadcast
+    tree with ``weights.resolve``."""
+
+    name: str
+    version: Optional[int] = None  # None = head at resolve time
+
+
+def _publisher(name: str) -> WeightPublisher:
+    with _lock:
+        pub = _publishers.get(name)
+        if pub is None:
+            pub = _publishers[name] = WeightPublisher(name)
+        return pub
+
+
+def _subscriber(name: str) -> WeightSubscriber:
+    with _lock:
+        sub = _subscribers.get(name)
+        if sub is None:
+            sub = _subscribers[name] = WeightSubscriber(name)
+        return sub
+
+
+def publish(name: str, pytree: Any, meta: Optional[dict] = None) -> WeightHandle:
+    """Publish one version through this process's cached publisher; returns
+    a handle pinned to the assigned version."""
+    version = _publisher(name).publish(pytree, meta)
+    return WeightHandle(name, version)
+
+
+def fetch(
+    name: str,
+    version: Optional[int] = None,
+    sharding: Any = None,
+    timeout: Optional[float] = None,
+) -> Tuple[int, Any]:
+    """(version, pytree) through this process's cached subscriber — the
+    per-process manifest/value cache on top of the per-node chunk cache."""
+    return _subscriber(name).get(version, sharding=sharding, timeout=timeout)
+
+
+def resolve(obj: Any, sharding: Any = None) -> Any:
+    """Identity for plain values; a WeightHandle fetches its version over
+    the weight plane. Lets sample(params)-style APIs accept either."""
+    if isinstance(obj, WeightHandle):
+        _, value = fetch(obj.name, obj.version, sharding=sharding, timeout=30.0)
+        return value
+    return obj
+
+
+def list_models():
+    """Registry rows for every published model (state API passthrough)."""
+    from ..util.state import list_weights
+
+    return list_weights()
+
+
+def _reset_for_shutdown():
+    """Drop process-cached publishers/subscribers (api.shutdown hook).
+    Purely local — no RPCs: registry pins and store pins die with the
+    cluster, and issuing unpin calls during teardown would race the loop
+    thread stopping. Cached instances must not leak into the next init()."""
+    with _lock:
+        for sub in _subscribers.values():
+            sub._current = None
+            sub._prefetched.clear()
+        _subscribers.clear()
+        for pub in _publishers.values():
+            pub._held.clear()
+            pub._held_ids.clear()
+        _publishers.clear()

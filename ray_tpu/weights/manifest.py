@@ -1,0 +1,116 @@
+"""Weight-plane manifests: how a pytree becomes broadcastable chunks.
+
+A published model version is described by a ``Manifest``: the pytree's
+structure (treedef, pickled once) plus an ordered list of ``ChunkInfo``
+entries. Each chunk is one object-store object holding a contiguous run of
+host-side leaf arrays — leaves are greedily packed into chunks of at most
+``weights_chunk_size`` bytes (an oversized leaf becomes its own chunk;
+arrays are never split, so every leaf deserializes zero-copy from exactly
+one store segment). Assembly is the inverse: concatenate the per-chunk leaf
+lists in order and unflatten with the treedef, optionally ``jax.device_put``
+-ing each leaf onto a consumer-supplied sharding (publisher and subscriber
+meshes need not match).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+from .._internal import serialization
+from .._internal.ids import ObjectID
+
+
+@dataclass(frozen=True)
+class ChunkInfo:
+    object_id: ObjectID
+    owner_address: Tuple[str, int]
+    size: int          # packed (wire) size in the store
+    num_leaves: int    # leaves carried by this chunk, in flatten order
+
+
+@dataclass
+class Manifest:
+    name: str
+    version: Optional[int]          # assigned by the registry at publish
+    treedef_blob: bytes
+    chunks: List[ChunkInfo] = field(default_factory=list)
+    total_bytes: int = 0            # sum of raw leaf bytes (pre-framing)
+    publisher_node: Optional[Tuple[str, int]] = None  # raylet address
+    created_at: float = 0.0
+
+    def to_blob(self) -> bytes:
+        return serialization.dumps(self)
+
+    @staticmethod
+    def from_blob(blob: bytes) -> "Manifest":
+        return serialization.loads(blob)
+
+
+def chunk_pytree(pytree: Any, chunk_size: int):
+    """Flatten to host arrays and group into chunk-sized leaf runs.
+
+    Returns (treedef_blob, chunk_values, total_bytes) where each element of
+    ``chunk_values`` is the list of numpy arrays for one chunk. Leaves are
+    materialized on host (``np.asarray``) — a publish moves device weights
+    to host exactly once, and every downstream copy is store-to-store.
+    """
+    import jax
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(pytree)
+    host_leaves = [np.asarray(leaf) for leaf in leaves]
+    chunk_values: List[list] = []
+    current: list = []
+    current_bytes = 0
+    total = 0
+    for arr in host_leaves:
+        nbytes = arr.nbytes
+        total += nbytes
+        if current and current_bytes + nbytes > chunk_size:
+            chunk_values.append(current)
+            current, current_bytes = [], 0
+        current.append(arr)
+        current_bytes += nbytes
+    if current or not chunk_values:
+        chunk_values.append(current)
+    return serialization.dumps(treedef), chunk_values, total
+
+
+def assemble_pytree(
+    treedef_blob: bytes, chunk_values: List[list], sharding: Any = None
+):
+    """Unflatten fetched chunk leaf-lists back into the pytree. With a
+    ``sharding`` (a single sharding, or a pytree of shardings matching the
+    value), each leaf is ``jax.device_put`` onto it — the consumer-side
+    reshard for subscriber meshes that differ from the publisher's."""
+    import jax
+
+    treedef = serialization.loads(treedef_blob)
+    leaves: list = []
+    for chunk in chunk_values:
+        leaves.extend(chunk)
+    value = jax.tree_util.tree_unflatten(treedef, leaves)
+    return reshard(value, sharding)
+
+
+def reshard(value: Any, sharding: Any):
+    """``jax.device_put`` every leaf onto ``sharding`` — one sharding for
+    the whole tree, or a matching pytree of per-leaf shardings. None is a
+    no-op (host arrays pass through)."""
+    if sharding is None:
+        return value
+    import jax
+
+    is_sharding = lambda s: hasattr(s, "device_set") or hasattr(s, "devices")
+    try:
+        shardings_flat = jax.tree_util.tree_leaves(sharding, is_leaf=is_sharding)
+    except Exception:
+        shardings_flat = [sharding]
+    if len(shardings_flat) == 1:
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, shardings_flat[0]), value
+        )
+    return jax.tree_util.tree_map(
+        lambda leaf, s: jax.device_put(leaf, s), value, sharding
+    )

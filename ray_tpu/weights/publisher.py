@@ -1,0 +1,151 @@
+"""WeightPublisher: push a model's state into the weight plane.
+
+``publish(pytree)`` chunks the host weights into the local object store
+(serialize once, zero-copy out-of-band buffers, one plasma object per
+chunk), registers a versioned manifest with the GCS registry, and holds the
+chunk ObjectRefs until the registry reports the version collectible —
+dropping them cascades into cluster-wide frees through the ownership layer.
+Publisher upload volume is O(model size): subscriber nodes relay chunks to
+each other along the broadcast tree, so each chunk leaves this node once no
+matter how many nodes subscribe.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import _worker_api
+from .._internal import serialization
+from ..object_ref import ObjectRef
+from ..util import metrics
+from .manifest import ChunkInfo, Manifest, chunk_pytree
+
+logger = logging.getLogger(__name__)
+
+
+class WeightPublisher:
+    def __init__(self, name: str, chunk_size: Optional[int] = None):
+        self.name = name
+        worker = _worker_api.get_core_worker()
+        self._chunk_size = chunk_size or worker.config.weights_chunk_size
+        # version -> chunk refs held until the registry releases the version
+        self._held: Dict[int, List[ObjectRef]] = {}
+        self._held_ids: Dict[int, list] = {}
+
+    # -- publish -----------------------------------------------------------
+
+    def publish(self, pytree: Any, meta: Optional[dict] = None) -> int:
+        """Store + register one new version; returns the assigned version."""
+        worker = _worker_api.get_core_worker()
+        t0 = time.perf_counter()
+        treedef_blob, chunk_values, total_bytes = chunk_pytree(
+            pytree, self._chunk_size
+        )
+
+        async def _store():
+            raylet = worker.client_pool.get(*worker.raylet_address)
+            infos, refs = [], []
+            for value in chunk_values:
+                meta_b, bufs = serialization.serialize(value)
+                oid, size = await worker.put_serialized(
+                    meta_b, bufs, force_plasma=True
+                )
+                # spill/evict exemption while the version is live: a chunk
+                # mid-broadcast must stay resident at its source
+                try:
+                    await raylet.call("store_pin_weight", oid)
+                except Exception:
+                    pass
+                refs.append(ObjectRef(oid, worker.address))
+                infos.append(
+                    ChunkInfo(
+                        object_id=oid,
+                        owner_address=tuple(worker.address),
+                        size=size,
+                        num_leaves=len(value),
+                    )
+                )
+            return infos, refs
+
+        infos, refs = _worker_api.run_on_worker_loop(_store())
+        manifest = Manifest(
+            name=self.name,
+            version=None,
+            treedef_blob=treedef_blob,
+            chunks=infos,
+            total_bytes=total_bytes,
+            publisher_node=tuple(worker.raylet_address),
+            created_at=time.time(),
+        )
+        reply = _worker_api.run_on_worker_loop(
+            worker.client_pool.get(*worker.gcs_address).call(
+                "weights_publish",
+                self.name,
+                manifest.to_blob(),
+                {
+                    "total_bytes": total_bytes,
+                    "num_chunks": len(infos),
+                    **(meta or {}),
+                },
+            )
+        )
+        version = reply["version"]
+        self._held[version] = refs
+        self._held_ids[version] = [c.object_id for c in infos]
+        self._release(reply.get("released", ()))
+        metrics.record_weights_publish(
+            self.name, time.perf_counter() - t0, total_bytes
+        )
+        return version
+
+    # -- GC ----------------------------------------------------------------
+
+    def collect(self):
+        """Drop chunk refs for every version the registry has tombstoned
+        (also reconciles against the registry's live set, which covers
+        released-lists lost to a GCS restart)."""
+        worker = _worker_api.get_core_worker()
+        reply = _worker_api.run_on_worker_loop(
+            worker.client_pool.get(*worker.gcs_address).call(
+                "weights_collect", self.name
+            )
+        )
+        live = set(reply.get("live", ()))
+        stale = [v for v in self._held if v not in live]
+        self._release(set(reply.get("released", ())) | set(stale))
+
+    def _release(self, versions):
+        if not versions:
+            return
+        worker = _worker_api.maybe_get_core_worker()
+        for version in versions:
+            refs = self._held.pop(version, None)
+            oids = self._held_ids.pop(version, None)
+            if refs:
+                logger.debug(
+                    "weights %s: releasing version %s (%d chunks)",
+                    self.name, version, len(refs),
+                )
+            if oids and worker is not None:
+                async def _unpin(ids=oids):
+                    raylet = worker.client_pool.get(*worker.raylet_address)
+                    for oid in ids:
+                        try:
+                            await raylet.call_oneway("store_unpin_weight", oid)
+                        except Exception:
+                            pass
+                try:
+                    _worker_api.run_on_worker_loop(_unpin())
+                except Exception:
+                    pass
+            # dropping the refs is the actual free: the ownership layer
+            # broadcasts free_objects to every node holding a copy once no
+            # borrower (subscriber) still holds the chunk
+
+    def close(self):
+        """Release every held version (the registry may still list them;
+        resolving a version whose publisher exited fails at fetch time, the
+        same lifetime contract as any owner-died object)."""
+        self._release(list(self._held))

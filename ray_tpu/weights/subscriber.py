@@ -1,0 +1,246 @@
+"""WeightSubscriber: pinned, prefetchable reads from the weight plane.
+
+A subscriber resolves a model version (head by default), pins it in the
+registry BEFORE fetching (pins block GC, so a version can't tombstone under
+an in-flight subscribe), pulls the chunks along its broadcast-tree position,
+weight-pins the local copies (eviction/spill exemption), assembles the
+pytree, and reports a staleness gauge (versions behind head). ``prefetch``
+starts pulling the next head in the background so a learner's publish
+overlaps the env-runners' previous rollout.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+from .. import _worker_api
+from ..util import metrics
+from . import broadcast
+from .manifest import Manifest, assemble_pytree
+
+logger = logging.getLogger(__name__)
+
+
+class _PinnedVersion:
+    __slots__ = ("version", "value", "manifest", "local_pins")
+
+    def __init__(self, version, value, manifest, local_pins):
+        self.version = version
+        self.value = value
+        self.manifest = manifest
+        self.local_pins = local_pins
+
+
+class WeightSubscriber:
+    def __init__(
+        self,
+        name: str,
+        reader_id: Optional[str] = None,
+        prefer_wait_s: Optional[float] = None,
+    ):
+        self.name = name
+        worker = _worker_api.get_core_worker()
+        self.reader_id = reader_id or (
+            f"{worker.worker_id.hex()[:8]}-{uuid.uuid4().hex[:6]}"
+        )
+        self._prefer_wait_s = (
+            prefer_wait_s
+            if prefer_wait_s is not None
+            else worker.config.weights_prefer_wait_s
+        )
+        self._current: Optional[_PinnedVersion] = None
+        # version -> prefetched (pinned, assembled) result awaiting adoption
+        self._prefetched: Dict[int, _PinnedVersion] = {}
+        self._prefetch_future = None
+
+    # -- resolution --------------------------------------------------------
+
+    def _gcs_call(self, method: str, *args):
+        worker = _worker_api.get_core_worker()
+        return _worker_api.run_on_worker_loop(
+            worker.client_pool.get(*worker.gcs_address).call(method, *args)
+        )
+
+    def head(self) -> Optional[int]:
+        return self._gcs_call("weights_head", self.name)
+
+    def staleness(self) -> Optional[int]:
+        """Versions behind head (0 = current); also refreshes the gauge."""
+        head = self.head()
+        if head is None:
+            return None
+        behind = head - (self._current.version if self._current else 0)
+        metrics.set_weights_staleness(self.name, behind)
+        return behind
+
+    @property
+    def version(self) -> Optional[int]:
+        return self._current.version if self._current else None
+
+    # -- fetch -------------------------------------------------------------
+
+    def get(
+        self,
+        version: Optional[int] = None,
+        sharding: Any = None,
+        timeout: Optional[float] = None,
+    ):
+        """Return (version, pytree) for ``version`` (head when None). The
+        returned version stays pinned — registry GC and local eviction both
+        exclude it — until the next get() adopts a newer one or release().
+        ``sharding`` reshard-places leaves for this consumer's mesh."""
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        while True:
+            resolved = self._gcs_call("weights_get", self.name, version)
+            if resolved is not None:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"weights {self.name!r}"
+                    + (f" v{version}" if version else "")
+                    + " not resolvable"
+                )
+            if deadline is None:
+                raise KeyError(
+                    f"weights {self.name!r}"
+                    + (f" v{version}" if version else "")
+                    + " not found"
+                )
+            time.sleep(0.05)
+        v = resolved["version"]
+        head = resolved.get("head", v)
+        if self._current is not None and self._current.version == v:
+            metrics.set_weights_staleness(self.name, head - v)
+            return v, self._maybe_reshard(self._current.value, sharding)
+        pinned = self._prefetched.pop(v, None)
+        if pinned is None:
+            pinned = self._fetch_version(v, resolved["manifest"])
+        self._adopt(pinned)
+        metrics.set_weights_staleness(self.name, head - v)
+        return v, self._maybe_reshard(pinned.value, sharding)
+
+    def _fetch_version(self, version: int, manifest_blob: bytes) -> _PinnedVersion:
+        worker = _worker_api.get_core_worker()
+        t0 = time.perf_counter()
+        # pin FIRST: a pinned version cannot tombstone mid-fetch
+        if not self._gcs_call("weights_pin", self.name, version, self.reader_id):
+            raise KeyError(
+                f"weights {self.name!r} v{version} was garbage-collected"
+            )
+        try:
+            manifest = Manifest.from_blob(manifest_blob)
+            plan = self._gcs_call(
+                "weights_plan", self.name, tuple(worker.raylet_address)
+            )
+            metrics.set_weights_tree_depth(self.name, plan["depth"])
+            # parent None = seed position: pull straight from the publisher
+            # node via the owner's location table (no preference needed)
+            parent = plan["parent"]
+            chunk_values = _worker_api.run_on_worker_loop(
+                broadcast.fetch_version_chunks(
+                    worker, manifest.chunks, parent, self._prefer_wait_s
+                ),
+                timeout=None,
+            )
+            local_pins = _worker_api.run_on_worker_loop(
+                broadcast.pin_local_chunks(worker, manifest.chunks)
+            )
+            value = assemble_pytree(manifest.treedef_blob, chunk_values)
+            metrics.record_weights_fetch(
+                self.name, time.perf_counter() - t0, manifest.total_bytes
+            )
+            return _PinnedVersion(version, value, manifest, local_pins)
+        except Exception:
+            # never leak a registry pin on a failed fetch
+            try:
+                self._gcs_call(
+                    "weights_unpin", self.name, version, self.reader_id
+                )
+            except Exception:
+                pass
+            raise
+
+    @staticmethod
+    def _maybe_reshard(value, sharding):
+        from .manifest import reshard
+
+        return reshard(value, sharding)
+
+    # -- prefetch ----------------------------------------------------------
+
+    def prefetch(self, block: bool = True) -> Optional[int]:
+        """Pull the current head into the local store (pinned + assembled)
+        without adopting it: the next get() returns it instantly. Returns
+        the prefetched version, or None if already current. ``block=False``
+        runs the fetch on a background thread."""
+        resolved = self._gcs_call("weights_get", self.name, None)
+        if resolved is None:
+            return None
+        v = resolved["version"]
+        if (
+            (self._current is not None and self._current.version >= v)
+            or v in self._prefetched
+        ):
+            return None
+        if block:
+            self._prefetched[v] = self._fetch_version(v, resolved["manifest"])
+            return v
+        import threading
+
+        def _bg():
+            try:
+                self._prefetched[v] = self._fetch_version(
+                    v, resolved["manifest"]
+                )
+            except Exception:
+                logger.exception(
+                    "weights %s: prefetch of v%d failed", self.name, v
+                )
+
+        t = threading.Thread(target=_bg, daemon=True, name="weights-prefetch")
+        t.start()
+        self._prefetch_future = t
+        return v
+
+    # -- pin lifecycle -----------------------------------------------------
+
+    def _adopt(self, pinned: _PinnedVersion):
+        prev, self._current = self._current, pinned
+        if prev is not None:
+            self._release_pinned(prev)
+        # drop prefetched versions now superseded by the adopted one
+        for v in [v for v in self._prefetched if v <= pinned.version]:
+            self._release_pinned(self._prefetched.pop(v))
+
+    def _release_pinned(self, pinned: _PinnedVersion):
+        try:
+            self._gcs_call(
+                "weights_unpin", self.name, pinned.version, self.reader_id
+            )
+        except Exception:
+            pass
+        worker = _worker_api.maybe_get_core_worker()
+        if worker is not None and pinned.local_pins:
+            try:
+                _worker_api.run_on_worker_loop(
+                    broadcast.unpin_local_chunks(worker, pinned.local_pins)
+                )
+            except Exception:
+                pass
+
+    def release(self):
+        """Unpin everything this subscriber holds (registry + local store)."""
+        if self._current is not None:
+            self._release_pinned(self._current)
+            self._current = None
+        for v in list(self._prefetched):
+            self._release_pinned(self._prefetched.pop(v))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()

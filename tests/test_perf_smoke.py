@@ -18,6 +18,9 @@ def test_microbenchmarks_produce_all_metrics(shutdown_only):
         "single_client_wait_100_refs_s",
         "rpcs_per_task_sync",
         "lease_rpcs_per_task_sync",
+        "weights_publish_mb_s",
+        "weights_subscribe_x1_mb_s",
+        "weights_subscribe_x2_mb_s",
     }
     assert expected <= set(results)
     for metric, value in results.items():
