@@ -49,6 +49,12 @@ class Config:
     # preserves liveness when a parent node dies mid-broadcast at the cost
     # of the O(1)-publisher-upload property for that chunk.
     weights_prefer_wait_s: float = 10.0
+    # Registry pin-lease lifetime: a version pin not refreshed within this
+    # window is reaped during GC, so a crashed/restarted reader (which pins
+    # again under a fresh reader_id) cannot block tombstoning forever.
+    # Subscribers heartbeat their pins at half this interval on get()/
+    # staleness(); 0 disables expiry.
+    weights_pin_lease_s: float = 600.0
 
     # --- scheduling ---
     # Hybrid policy: prefer local node until utilization exceeds this, then

@@ -389,6 +389,7 @@ class GcsServer:
         self._node_sync_versions.pop(node_id, None)
         logger.warning("node %s dead: %s", node_id, reason)
         self.publisher.publish("node", ("dead", node))
+        self.weight_registry.on_node_death(node.address)
         await self.actor_manager.on_node_death(node_id)
         await self.pg_manager.on_node_death(node_id)
 
@@ -599,6 +600,10 @@ class GcsServer:
 
     async def handle_weights_plan(self, name: str, node_address):
         return self.weight_registry.plan(name, node_address)
+
+    async def handle_weights_report_fallback(self, name: str, node_address):
+        self.weight_registry.report_fallback(name, node_address)
+        return True
 
     async def handle_weights_list(self):
         return self.weight_registry.list_models()

@@ -10,11 +10,20 @@ pulls fan out node-to-node instead of hammering the publisher.
 GC mirrors the actor-tombstone compaction pattern (actor_manager.py
 _mark_dead): a superseded version with no pinned readers is compacted to a
 tombstone — manifest deleted from storage, a tiny marker written instead —
-and queued on a per-model ``released`` list the publisher drains to drop
-its chunk ObjectRefs (which cascades into cluster-wide object frees).
-Head versions are never GC'd. Pins are NOT persisted: after a GCS restart
-superseded versions survive until the next publish/unpin cycle re-judges
-them, so readers that re-pin promptly keep their version.
+and queued on a per-model ``released`` list. Only the PUBLISHER drains
+``released`` (through its publish reply or an explicit weights_collect):
+subscriber unpins trigger GC but never consume the queue, so a release
+produced by a late unpin is delivered on the publisher's next
+publish/collect instead of vanishing into a reply nobody reads.
+Head versions are never GC'd.
+
+Pins are leases, not permanent marks: a pin older than
+``weights_pin_lease_s`` is reaped during GC, so a crashed reader (whose
+restart pins under a fresh reader_id) cannot block tombstoning forever.
+Live subscribers refresh their pins as a heartbeat (weights_pin is
+idempotent and re-timestamps). Pins are NOT persisted: after a GCS restart
+superseded versions survive until the next publish/unpin/collect cycle
+re-judges them, so readers that re-pin promptly keep their version.
 """
 
 from __future__ import annotations
@@ -33,7 +42,7 @@ logger = logging.getLogger(__name__)
 class _Model:
     __slots__ = (
         "name", "head", "versions", "meta", "pins", "released",
-        "tombstones", "subscriber_nodes",
+        "tombstones", "subscriber_nodes", "fallback_reports",
     )
 
     def __init__(self, name: str):
@@ -44,15 +53,19 @@ class _Model:
         self.versions: Dict[int, bytes] = {}
         # version -> {"total_bytes": int, "num_chunks": int, "ts": float}
         self.meta: Dict[int, dict] = {}
-        # version -> reader_id -> pin timestamp
+        # version -> reader_id -> pin timestamp (a lease: reaped when older
+        # than weights_pin_lease_s; re-pinning refreshes it)
         self.pins: Dict[int, Dict[str, float]] = {}
         # tombstoned versions whose chunks the publisher may free, drained
-        # by weights_collect
+        # ONLY by the publisher (publish reply / weights_collect)
         self.released: List[int] = []
         self.tombstones: Set[int] = set()
         # broadcast-tree membership: raylet addresses in first-subscribe
-        # order; a node's index is its stable tree position
+        # order; a node's index is its stable tree position. Pruned on node
+        # death and on repeated child fallback reports.
         self.subscriber_nodes: List[Tuple[str, int]] = []
+        # node -> count of children that gave up waiting on it as a parent
+        self.fallback_reports: Dict[Tuple[str, int], int] = {}
 
 
 def _tree_parent(position: int) -> Optional[int]:
@@ -134,8 +147,10 @@ class GcsWeightRegistry:
     def publish(
         self, name: str, manifest_blob: bytes, meta: Optional[dict] = None
     ) -> dict:
-        """Register a new version; returns the assigned version plus every
-        version whose chunks the publisher may now free."""
+        """Register a new version; returns the assigned version, every
+        version whose chunks the publisher may now free, and the live set
+        (so the publisher can reconcile refs held for versions the registry
+        no longer lists — e.g. released-lists lost with a GCS restart)."""
         model = self._models.setdefault(name, _Model(name))
         model.head += 1
         version = model.head
@@ -144,7 +159,11 @@ class GcsWeightRegistry:
         self._persist_version(model, version)
         self._gc_superseded(model)
         self._gcs.publisher.publish("weights", ("published", name, version))
-        return {"version": version, "released": self._drain_released(model)}
+        return {
+            "version": version,
+            "released": self._drain_released(model),
+            "live": sorted(model.versions),
+        }
 
     def get(self, name: str, version: Optional[int] = None) -> Optional[dict]:
         model = self._models.get(name)
@@ -169,32 +188,61 @@ class GcsWeightRegistry:
         model.pins.setdefault(version, {})[reader_id] = time.time()
         return True
 
-    def unpin(self, name: str, version: int, reader_id: str) -> dict:
+    def unpin(self, name: str, version: int, reader_id: str) -> bool:
+        """Drop one reader's pin and re-judge GC. Deliberately does NOT
+        drain ``released``: the caller is a subscriber, and a release
+        drained into a reply the subscriber ignores is lost forever — the
+        publisher would hold the version's chunk refs (and their store
+        weight-pins) for the rest of the run. Tombstoned versions stay
+        queued for the publisher's next publish/collect drain."""
         model = self._models.get(name)
         if model is None:
-            return {"released": []}
+            return False
         readers = model.pins.get(version)
         if readers is not None:
             readers.pop(reader_id, None)
             if not readers:
                 model.pins.pop(version, None)
         self._gc_superseded(model)
-        return {"released": self._drain_released(model)}
+        return True
 
     def collect(self, name: str) -> dict:
         """Publisher-side GC poll: versions safe to free now, plus the set
         still live (a publisher also drops refs for anything it holds that
         the registry no longer lists — covers released-lists lost with a
-        GCS restart)."""
+        GCS restart). Runs a GC pass first so expired pin leases are reaped
+        even when no publish/unpin has happened since they lapsed."""
         model = self._models.get(name)
         if model is None:
             return {"released": [], "live": []}
+        self._gc_superseded(model)
         return {
             "released": self._drain_released(model),
             "live": sorted(model.versions),
         }
 
+    def _reap_expired_pins(self, model: _Model):
+        """Expire pin leases older than weights_pin_lease_s: a crashed or
+        partitioned reader must not block tombstoning forever (its restart
+        pins under a fresh reader_id, so its old pin is unreachable). Live
+        readers refresh their leases via pin() heartbeats."""
+        lease = getattr(self._gcs.config, "weights_pin_lease_s", 0.0)
+        if not lease or lease <= 0:
+            return
+        now = time.time()
+        for version, readers in list(model.pins.items()):
+            expired = [r for r, ts in readers.items() if now - ts > lease]
+            for reader_id in expired:
+                readers.pop(reader_id, None)
+                logger.warning(
+                    "weights %s v%d: reaping expired pin lease of reader %s",
+                    model.name, version, reader_id,
+                )
+            if not readers:
+                model.pins.pop(version, None)
+
     def _gc_superseded(self, model: _Model):
+        self._reap_expired_pins(model)
         for version in sorted(model.versions):
             if version >= model.head:
                 continue  # head is never GC'd
@@ -247,6 +295,44 @@ class GcsWeightRegistry:
             "num_nodes": len(model.subscriber_nodes),
             "depth": depth,
         }
+
+    def on_node_death(self, node_address) -> None:
+        """Drop a dead node from every model's broadcast tree so children
+        stop burning weights_prefer_wait_s per chunk on an unreachable
+        parent, and subscriber_nodes stays bounded under autoscaling churn.
+        Positions are recomputed from list order on each plan() call, so
+        removal reparents affected children on their next fetch."""
+        node = tuple(node_address)
+        for model in self._models.values():
+            self._prune_node(model, node)
+
+    def report_fallback(self, name: str, node_address) -> None:
+        """A child reports that it gave up waiting on ``node_address`` as
+        its broadcast parent (unreachable, or never produced a chunk within
+        the wait budget). Health checks catch dead *nodes*; this catches
+        hung-but-connectable ones. Two independent reports prune the node —
+        a live node that was merely slow simply re-subscribes and is
+        re-appended at a fresh position on its next plan()."""
+        model = self._models.get(name)
+        if model is None:
+            return
+        node = tuple(node_address)
+        if node not in model.subscriber_nodes:
+            return
+        count = model.fallback_reports.get(node, 0) + 1
+        if count >= 2:
+            self._prune_node(model, node)
+        else:
+            model.fallback_reports[node] = count
+
+    def _prune_node(self, model: _Model, node: Tuple[str, int]):
+        if node in model.subscriber_nodes:
+            model.subscriber_nodes.remove(node)
+            logger.info(
+                "weights %s: pruned node %s from broadcast tree (%d left)",
+                model.name, node, len(model.subscriber_nodes),
+            )
+        model.fallback_reports.pop(node, None)
 
     # -- introspection (state API / CLI) -----------------------------------
 

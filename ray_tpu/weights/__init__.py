@@ -89,17 +89,33 @@ def fetch(
     version: Optional[int] = None,
     sharding: Any = None,
     timeout: Optional[float] = None,
+    fallback_to_head: bool = False,
 ) -> Tuple[int, Any]:
     """(version, pytree) through this process's cached subscriber — the
     per-process manifest/value cache on top of the per-node chunk cache."""
-    return _subscriber(name).get(version, sharding=sharding, timeout=timeout)
+    return _subscriber(name).get(
+        version,
+        sharding=sharding,
+        timeout=timeout,
+        fallback_to_head=fallback_to_head,
+    )
 
 
 def resolve(obj: Any, sharding: Any = None) -> Any:
     """Identity for plain values; a WeightHandle fetches its version over
-    the weight plane. Lets sample(params)-style APIs accept either."""
+    the weight plane. Lets sample(params)-style APIs accept either. A
+    handle whose exact version was GC'd (every other reader already moved
+    on) resolves head instead — the handle holds no registry pin, and for
+    the sync flows that mint handles (rllib, train) one version of
+    staleness beats failing the task."""
     if isinstance(obj, WeightHandle):
-        _, value = fetch(obj.name, obj.version, sharding=sharding, timeout=30.0)
+        _, value = fetch(
+            obj.name,
+            obj.version,
+            sharding=sharding,
+            timeout=30.0,
+            fallback_to_head=True,
+        )
         return value
     return obj
 

@@ -13,7 +13,10 @@ pulling, then pulls with ``prefer_source`` pointing at the parent, so:
   second subscriber on a node finds every chunk already local and moves
   zero bytes;
 - a dead parent degrades to a plain owner-directed pull after the wait,
-  trading the O(1) property for liveness.
+  trading the O(1) property for liveness — and the child reports the
+  fallback to the registry (weights_report_fallback), which prunes a
+  repeatedly-reported parent from the tree so later waves stop paying the
+  wait on a hung node.
 """
 
 from __future__ import annotations
@@ -31,9 +34,11 @@ async def fetch_chunk_value(
     chunk: ChunkInfo,
     parent: Optional[Tuple[str, int]],
     prefer_wait_s: float,
+    fellback: Optional[list] = None,
 ):
     """Fetch one chunk into the local store (along the tree) and return its
-    deserialized value. Runs on the worker's event loop."""
+    deserialized value. Runs on the worker's event loop. ``fellback`` is a
+    one-element flag list set True when the parent wait was abandoned."""
     raylet = worker.client_pool.get(*worker.raylet_address)
     ref = ObjectRef(chunk.object_id, tuple(chunk.owner_address))
     prefer = None
@@ -41,6 +46,8 @@ async def fetch_chunk_value(
     if not local:
         if parent is not None and tuple(parent) != tuple(worker.raylet_address):
             prefer = await _wait_for_parent(worker, chunk, parent, prefer_wait_s)
+            if prefer is None and fellback is not None:
+                fellback[0] = True
         elif parent is None and not _is_local_owner(worker, chunk):
             # seed position: the publisher node is the designated source
             prefer = _owner_node_hint(chunk)
@@ -81,20 +88,32 @@ async def _wait_for_parent(
 
 async def fetch_version_chunks(
     worker,
+    name: str,
     chunks: List[ChunkInfo],
     parent: Optional[Tuple[str, int]],
     prefer_wait_s: float,
 ) -> List:
     """Fetch every chunk of a version concurrently (the raylet serializes
-    same-object pulls; distinct chunks stream in parallel down the tree)."""
-    return list(
+    same-object pulls; distinct chunks stream in parallel down the tree).
+    One fallback report per version fetch when the parent never delivered —
+    the registry prunes the parent after repeated reports."""
+    fellback = [False]
+    values = list(
         await asyncio.gather(
             *[
-                fetch_chunk_value(worker, chunk, parent, prefer_wait_s)
+                fetch_chunk_value(worker, chunk, parent, prefer_wait_s, fellback)
                 for chunk in chunks
             ]
         )
     )
+    if fellback[0] and parent is not None:
+        try:
+            await worker.client_pool.get(*worker.gcs_address).call_oneway(
+                "weights_report_fallback", name, tuple(parent)
+            )
+        except Exception:
+            pass
+    return values
 
 
 async def pin_local_chunks(worker, chunks: List[ChunkInfo]) -> List:

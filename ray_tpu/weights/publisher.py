@@ -94,7 +94,11 @@ class WeightPublisher:
         version = reply["version"]
         self._held[version] = refs
         self._held_ids[version] = [c.object_id for c in infos]
-        self._release(reply.get("released", ()))
+        # Subscriber unpins queue releases but never consume them — every
+        # publish drains the queue AND reconciles against the registry's
+        # live set, so superseded versions tombstoned between publishes are
+        # freed here instead of accreting for the whole training run.
+        self._reconcile(reply)
         metrics.record_weights_publish(
             self.name, time.perf_counter() - t0, total_bytes
         )
@@ -112,9 +116,18 @@ class WeightPublisher:
                 "weights_collect", self.name
             )
         )
-        live = set(reply.get("live", ()))
-        stale = [v for v in self._held if v not in live]
-        self._release(set(reply.get("released", ())) | set(stale))
+        self._reconcile(reply)
+
+    def _reconcile(self, reply: dict):
+        """Free everything the registry released, plus any held version the
+        registry no longer lists as live (covers released-lists lost to a
+        GCS restart)."""
+        released = set(reply.get("released", ()))
+        live = reply.get("live")
+        if live is not None:
+            live_set = set(live)
+            released |= {v for v in self._held if v not in live_set}
+        self._release(released)
 
     def _release(self, versions):
         if not versions:

@@ -7,11 +7,19 @@ weight-pins the local copies (eviction/spill exemption), assembles the
 pytree, and reports a staleness gauge (versions behind head). ``prefetch``
 starts pulling the next head in the background so a learner's publish
 overlaps the env-runners' previous rollout.
+
+Registry pins are leases (``weights_pin_lease_s``): every get()/staleness()
+re-pins held versions once half the lease has elapsed, so a live-but-idle
+reader keeps its version while a crashed one stops blocking GC. All pin
+state (``_current`` / ``_prefetched``) is guarded by ``_lock`` — prefetch
+completes on a background thread, and a completion that lost the race to a
+newer adoption must release its pins instead of parking them forever.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 import time
 import uuid
 from typing import Any, Dict, Optional, Tuple
@@ -25,13 +33,14 @@ logger = logging.getLogger(__name__)
 
 
 class _PinnedVersion:
-    __slots__ = ("version", "value", "manifest", "local_pins")
+    __slots__ = ("version", "value", "manifest", "local_pins", "pinned_at")
 
     def __init__(self, version, value, manifest, local_pins):
         self.version = version
         self.value = value
         self.manifest = manifest
         self.local_pins = local_pins
+        self.pinned_at = time.time()
 
 
 class WeightSubscriber:
@@ -51,6 +60,10 @@ class WeightSubscriber:
             if prefer_wait_s is not None
             else worker.config.weights_prefer_wait_s
         )
+        self._pin_lease_s = getattr(worker.config, "weights_pin_lease_s", 600.0)
+        # guards _current/_prefetched: get()/release() on the caller thread
+        # race prefetch(block=False) completing on its daemon thread
+        self._lock = threading.Lock()
         self._current: Optional[_PinnedVersion] = None
         # version -> prefetched (pinned, assembled) result awaiting adoption
         self._prefetched: Dict[int, _PinnedVersion] = {}
@@ -68,10 +81,12 @@ class WeightSubscriber:
         return self._gcs_call("weights_head", self.name)
 
     def staleness(self) -> Optional[int]:
-        """Versions behind head (0 = current); also refreshes the gauge."""
+        """Versions behind head (0 = current); also refreshes the gauge and
+        heartbeats this reader's pin leases."""
         head = self.head()
         if head is None:
             return None
+        self._heartbeat_pins()
         behind = head - (self._current.version if self._current else 0)
         metrics.set_weights_staleness(self.name, behind)
         return behind
@@ -87,16 +102,41 @@ class WeightSubscriber:
         version: Optional[int] = None,
         sharding: Any = None,
         timeout: Optional[float] = None,
+        fallback_to_head: bool = False,
     ):
         """Return (version, pytree) for ``version`` (head when None). The
         returned version stays pinned — registry GC and local eviction both
         exclude it — until the next get() adopts a newer one or release().
-        ``sharding`` reshard-places leaves for this consumer's mesh."""
+        ``sharding`` reshard-places leaves for this consumer's mesh.
+        ``fallback_to_head`` resolves head instead when the requested
+        version is gone (GC'd after every other reader moved on): handles
+        minted at publish time hold no pin, so staleness-by-one beats
+        crashing the consumer."""
         deadline = time.monotonic() + timeout if timeout is not None else None
         while True:
             resolved = self._gcs_call("weights_get", self.name, version)
             if resolved is not None:
                 break
+            if version is not None:
+                # An explicit version that the registry no longer lists
+                # while some head exists is gone for good (tombstoned, or
+                # renumbered past a GCS restart) — waiting cannot bring it
+                # back, so fall back or fail now instead of spinning out
+                # the full timeout.
+                head = self._gcs_call("weights_get", self.name, None)
+                if head is not None:
+                    if fallback_to_head:
+                        logger.warning(
+                            "weights %s: v%d no longer resolvable; "
+                            "falling back to head v%d",
+                            self.name, version, head["version"],
+                        )
+                        resolved = head
+                        break
+                    raise KeyError(
+                        f"weights {self.name!r} v{version} was "
+                        "garbage-collected"
+                    )
             if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"weights {self.name!r}"
@@ -111,15 +151,21 @@ class WeightSubscriber:
                 )
             time.sleep(0.05)
         v = resolved["version"]
-        head = resolved.get("head", v)
-        if self._current is not None and self._current.version == v:
-            metrics.set_weights_staleness(self.name, head - v)
-            return v, self._maybe_reshard(self._current.value, sharding)
-        pinned = self._prefetched.pop(v, None)
+        head_version = resolved.get("head", v)
+        with self._lock:
+            current = self._current
+            if current is not None and current.version == v:
+                pinned = current
+            else:
+                pinned = self._prefetched.pop(v, None)
+        if pinned is current and current is not None:
+            self._heartbeat_pins()
+            metrics.set_weights_staleness(self.name, head_version - v)
+            return v, self._maybe_reshard(current.value, sharding)
         if pinned is None:
             pinned = self._fetch_version(v, resolved["manifest"])
         self._adopt(pinned)
-        metrics.set_weights_staleness(self.name, head - v)
+        metrics.set_weights_staleness(self.name, head_version - v)
         return v, self._maybe_reshard(pinned.value, sharding)
 
     def _fetch_version(self, version: int, manifest_blob: bytes) -> _PinnedVersion:
@@ -141,7 +187,8 @@ class WeightSubscriber:
             parent = plan["parent"]
             chunk_values = _worker_api.run_on_worker_loop(
                 broadcast.fetch_version_chunks(
-                    worker, manifest.chunks, parent, self._prefer_wait_s
+                    worker, self.name, manifest.chunks, parent,
+                    self._prefer_wait_s,
                 ),
                 timeout=None,
             )
@@ -180,48 +227,95 @@ class WeightSubscriber:
         if resolved is None:
             return None
         v = resolved["version"]
-        if (
-            (self._current is not None and self._current.version >= v)
-            or v in self._prefetched
-        ):
-            return None
+        with self._lock:
+            if (
+                (self._current is not None and self._current.version >= v)
+                or v in self._prefetched
+            ):
+                return None
         if block:
-            self._prefetched[v] = self._fetch_version(v, resolved["manifest"])
+            self._offer_prefetched(v, self._fetch_version(v, resolved["manifest"]))
             return v
-        import threading
 
         def _bg():
             try:
-                self._prefetched[v] = self._fetch_version(
-                    v, resolved["manifest"]
-                )
+                result = self._fetch_version(v, resolved["manifest"])
             except Exception:
                 logger.exception(
                     "weights %s: prefetch of v%d failed", self.name, v
                 )
+                return
+            self._offer_prefetched(v, result)
 
         t = threading.Thread(target=_bg, daemon=True, name="weights-prefetch")
         t.start()
         self._prefetch_future = t
         return v
 
+    def _offer_prefetched(self, version: int, pinned: _PinnedVersion) -> bool:
+        """Park a fetched version for the next get() — unless an adoption
+        won the race (get() already moved to this version or newer, or a
+        duplicate prefetch landed first), in which case the result is
+        released immediately: an orphan entry would hold registry and store
+        pins that nothing ever pops."""
+        with self._lock:
+            stale = (
+                (self._current is not None and self._current.version >= version)
+                or version in self._prefetched
+            )
+            if not stale:
+                self._prefetched[version] = pinned
+        if stale:
+            self._release_pinned(pinned)
+            return False
+        return True
+
     # -- pin lifecycle -----------------------------------------------------
 
-    def _adopt(self, pinned: _PinnedVersion):
-        prev, self._current = self._current, pinned
-        if prev is not None:
-            self._release_pinned(prev)
-        # drop prefetched versions now superseded by the adopted one
-        for v in [v for v in self._prefetched if v <= pinned.version]:
-            self._release_pinned(self._prefetched.pop(v))
+    def _heartbeat_pins(self):
+        """Re-pin held versions once half the lease has elapsed, so the
+        registry's lease reaper only fires on readers that actually died."""
+        if not self._pin_lease_s or self._pin_lease_s <= 0:
+            return
+        now = time.time()
+        with self._lock:
+            due = [
+                p
+                for p in [self._current, *self._prefetched.values()]
+                if p is not None and now - p.pinned_at > self._pin_lease_s / 2
+            ]
+        for pinned in due:
+            try:
+                if self._gcs_call(
+                    "weights_pin", self.name, pinned.version, self.reader_id
+                ):
+                    pinned.pinned_at = now
+            except Exception:
+                pass
 
-    def _release_pinned(self, pinned: _PinnedVersion):
-        try:
-            self._gcs_call(
-                "weights_unpin", self.name, pinned.version, self.reader_id
+    def _adopt(self, pinned: _PinnedVersion):
+        with self._lock:
+            prev, self._current = self._current, pinned
+            # drop prefetched versions now superseded by the adopted one
+            superseded = [
+                self._prefetched.pop(v)
+                for v in [v for v in self._prefetched if v <= pinned.version]
+            ]
+        for old in ([prev] if prev is not None else []) + superseded:
+            # two threads adopting the same version share one registry pin
+            # (keyed by reader_id): releasing the loser's must not strip it
+            self._release_pinned(
+                old, skip_registry=old.version == pinned.version
             )
-        except Exception:
-            pass
+
+    def _release_pinned(self, pinned: _PinnedVersion, skip_registry=False):
+        if not skip_registry:
+            try:
+                self._gcs_call(
+                    "weights_unpin", self.name, pinned.version, self.reader_id
+                )
+            except Exception:
+                pass
         worker = _worker_api.maybe_get_core_worker()
         if worker is not None and pinned.local_pins:
             try:
@@ -233,11 +327,15 @@ class WeightSubscriber:
 
     def release(self):
         """Unpin everything this subscriber holds (registry + local store)."""
-        if self._current is not None:
-            self._release_pinned(self._current)
-            self._current = None
-        for v in list(self._prefetched):
-            self._release_pinned(self._prefetched.pop(v))
+        with self._lock:
+            to_release = []
+            if self._current is not None:
+                to_release.append(self._current)
+                self._current = None
+            for v in list(self._prefetched):
+                to_release.append(self._prefetched.pop(v))
+        for pinned in to_release:
+            self._release_pinned(pinned)
 
     def __enter__(self):
         return self

@@ -130,6 +130,122 @@ def test_prefetch_adopts_instantly(cluster):
     sub.release()
 
 
+# -- registry unit: release queue, pin leases, tree repair -------------------
+
+
+class _FakeGcs:
+    """Storage/publisher/config stand-in so GcsWeightRegistry runs without a
+    server (the registry only touches these three attributes)."""
+
+    class _Storage:
+        def __init__(self):
+            self.tables = {}
+
+        def put(self, table, key, value):
+            self.tables.setdefault(table, {})[key] = value
+
+        def delete(self, table, key):
+            self.tables.get(table, {}).pop(key, None)
+
+        def get_all(self, table):
+            return dict(self.tables.get(table, {}))
+
+    class _Publisher:
+        def __init__(self):
+            self.events = []
+
+        def publish(self, channel, msg):
+            self.events.append((channel, msg))
+
+    def __init__(self, **config_overrides):
+        from ray_tpu._internal.config import Config
+
+        self.storage = self._Storage()
+        self.publisher = self._Publisher()
+        self.config = Config()
+        for key, value in config_overrides.items():
+            setattr(self.config, key, value)
+
+
+def _registry(**config_overrides):
+    from ray_tpu.runtime.gcs.weight_registry import GcsWeightRegistry
+
+    return GcsWeightRegistry(_FakeGcs(**config_overrides))
+
+
+def test_registry_unpin_never_consumes_release_queue():
+    """A release triggered by a subscriber unpin must stay queued for the
+    publisher: draining it into the (ignored) unpin reply would leak the
+    version's chunks for the rest of the run."""
+    reg = _registry()
+    r1 = reg.publish("m", b"m1")
+    assert r1["version"] == 1 and r1["released"] == [] and r1["live"] == [1]
+    reg.pin("m", 1, "reader-a")
+    r2 = reg.publish("m", b"m2")
+    assert r2["released"] == []  # v1 pinned: survives the supersede
+    reg.unpin("m", 1, "reader-a")  # tombstones v1 ...
+    assert reg.get("m", 1) is None
+    collected = reg.collect("m")  # ... queued until the publisher drains
+    assert collected["released"] == [1] and collected["live"] == [2]
+    assert reg.collect("m")["released"] == []  # drained exactly once
+
+
+def test_registry_publish_reply_delivers_queued_releases():
+    """The steady-state rllib flow: version N is still pinned when N+1
+    publishes, so its release happens at a later subscriber unpin — the
+    NEXT publish reply must deliver it (no explicit collect needed)."""
+    reg = _registry()
+    reg.publish("m", b"m1")
+    reg.pin("m", 1, "r")
+    reg.publish("m", b"m2")
+    reg.unpin("m", 1, "r")  # queued, not delivered
+    r3 = reg.publish("m", b"m3")
+    assert set(r3["released"]) == {1, 2} and r3["live"] == [3]
+
+
+def test_registry_pin_lease_expiry_reaps_dead_reader():
+    """A pin not refreshed within weights_pin_lease_s stops blocking GC: a
+    crashed env-runner re-pins under a fresh reader_id, so its old pin would
+    otherwise leak forever."""
+    import time as _time
+
+    reg = _registry(weights_pin_lease_s=0.05)
+    reg.publish("m", b"m1")
+    reg.pin("m", 1, "dead-reader")
+    reg.publish("m", b"m2")
+    assert reg.get("m", 1) is not None  # lease still fresh: pin holds
+    _time.sleep(0.06)
+    collected = reg.collect("m")  # GC pass reaps the lapsed lease
+    assert collected["released"] == [1]
+    assert reg.get("m", 1) is None
+
+
+def test_registry_tree_prunes_dead_and_hung_parents():
+    """Node death drops a node from the tree immediately; two fallback
+    reports prune a hung-but-connectable parent. Surviving children
+    reparent via recomputed positions on their next plan()."""
+    reg = _registry()
+    reg.publish("m", b"m1")
+    a, b, c = ("n1", 1), ("n2", 1), ("n3", 1)
+    assert reg.plan("m", a)["position"] == 0
+    assert reg.plan("m", b)["position"] == 1
+    plan_c = reg.plan("m", c)
+    assert plan_c["position"] == 2 and tuple(plan_c["parent"]) == a
+
+    reg.on_node_death(a)
+    plan_b = reg.plan("m", b)
+    assert plan_b["position"] == 0 and plan_b["parent"] is None
+    plan_c = reg.plan("m", c)
+    assert plan_c["position"] == 1 and tuple(plan_c["parent"]) == b
+    assert plan_c["num_nodes"] == 2
+
+    reg.report_fallback("m", b)  # one report: benefit of the doubt
+    assert tuple(reg.plan("m", c)["parent"]) == b
+    reg.report_fallback("m", b)  # second report prunes the hung parent
+    plan_c = reg.plan("m", c)
+    assert plan_c["position"] == 0 and plan_c["parent"] is None
+
+
 # -- GC: tombstones gated on pinned readers ---------------------------------
 
 
@@ -193,6 +309,92 @@ def test_registry_gc_survives_gcs_restart(shutdown_only, tmp_path):
     assert _gcs_call("weights_get", "t/ft", 1) is None  # tombstone survived
     rows = {r["name"]: r for r in _gcs_call("weights_list")}
     assert rows["t/ft"]["head"] == 2
+    sub.release()
+
+
+def test_publish_drains_subscriber_unpinned_versions(cluster):
+    """Versions released by subscriber unpins are freed on the publisher's
+    next publish — no explicit collect() required (the unpin reply is
+    ignored by subscribers, so the release must ride the publish path)."""
+    pub = WeightPublisher("t/drain")
+    pub.publish(_params(1.0))
+    sub = WeightSubscriber("t/drain")
+    sub.get()
+    pub.publish(_params(2.0))  # v1 still pinned by the subscriber
+    assert 1 in pub._held
+    sub.get()  # adopt v2 -> unpin v1 -> tombstone queued in the registry
+    pub.publish(_params(3.0))  # publish reply delivers the queued release
+    assert 1 not in pub._held
+    assert 2 in pub._held and 3 in pub._held  # v2 pinned, v3 head
+    sub.release()
+
+
+def test_resolve_falls_back_to_head_after_gc(cluster):
+    """A WeightHandle holds no registry pin, so its exact version can
+    tombstone before resolve; resolve() must serve head (one version of
+    staleness) instead of spinning out the timeout and crashing the task."""
+    import time as _time
+
+    handle1 = weights.publish("t/fb", _params(1.0))
+    weights.publish("t/fb", _params(2.0))  # no pins: v1 tombstones now
+    t0 = _time.monotonic()
+    value = weights.resolve(handle1)
+    assert _time.monotonic() - t0 < 10.0  # no full-timeout spin
+    np.testing.assert_array_equal(value["bias"], np.full(16, 2.0, np.float32))
+
+    # an explicit pinned get without fallback fails fast with KeyError
+    # (the version is gone for good — waiting cannot bring it back)
+    sub = WeightSubscriber("t/fb")
+    with pytest.raises(KeyError):
+        sub.get(1, timeout=30.0)
+    sub.release()
+
+
+def test_prefetch_result_losing_race_is_released(cluster):
+    """A background prefetch completing after get() adopted the same (or a
+    newer) version must release its pins instead of parking an orphan
+    _PinnedVersion that nothing ever pops."""
+    from ray_tpu.weights.subscriber import _PinnedVersion
+
+    pub = WeightPublisher("t/race")
+    pub.publish(_params(1.0))
+    sub = WeightSubscriber("t/race")
+    sub.get()
+    pub.publish(_params(2.0))
+    sub.get()  # current = v2
+    stale = _PinnedVersion(1, {"w": 0}, None, [])
+    assert sub._offer_prefetched(1, stale) is False  # raced: released
+    assert sub._prefetched == {}
+    fresh = _PinnedVersion(3, {"w": 1}, None, [])
+    assert sub._offer_prefetched(3, fresh) is True  # newer: parked
+    assert 3 in sub._prefetched
+    sub.release()
+
+
+def test_pin_lease_heartbeat_keeps_idle_reader_alive(shutdown_only):
+    """staleness()/get() re-pin held versions at half-lease, so only readers
+    that actually died lose their pins to the registry's lease reaper."""
+    import time as _time
+
+    node = ray_tpu.init(num_cpus=2)
+    pub = WeightPublisher("t/lease")
+    pub.publish(_params(1.0))
+    sub = WeightSubscriber("t/lease")
+    sub.get()
+    pub.publish(_params(2.0))  # v1 superseded but pinned
+
+    registry = node.gcs.weight_registry
+    model = registry._models["t/lease"]
+    # age both the registry lease and the subscriber's local stamp far past
+    # the window: without a heartbeat the next GC pass would reap the pin
+    model.pins[1][sub.reader_id] = _time.time() - 100 * 600
+    sub._current.pinned_at = 0.0
+    assert sub.staleness() == 1  # heartbeats the v1 pin
+    assert model.pins[1][sub.reader_id] > _time.time() - 60
+    pub.collect()  # GC pass: v1 must survive, its lease is fresh again
+    from ray_tpu.util.state import _gcs_call
+
+    assert _gcs_call("weights_get", "t/lease", 1) is not None
     sub.release()
 
 
