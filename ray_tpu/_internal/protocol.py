@@ -148,6 +148,11 @@ class TaskSpec:
     # streaming generator support
     is_streaming_generator: bool = False
     runtime_env: Optional[Dict[str, Any]] = None
+    # distributed tracing: the submitter's active span context
+    # ({trace_id, span_id}), restored around execution so driver->task->
+    # nested-task span chains link across processes (reference: the
+    # OpenTelemetry context injected into task metadata by tracing_helper)
+    trace_context: Optional[Dict[str, str]] = None
 
     def scheduling_class(self) -> tuple:
         """Tasks with identical resource shapes share a FIFO dispatch queue

@@ -247,6 +247,9 @@ class ActorHandle:
             max_task_retries=self._max_task_retries,
             is_streaming_generator=streaming,
         )
+        from .util import tracing
+
+        spec.trace_context = tracing.inject_context()
         return_ids = _worker_api.run_on_worker_loop(worker.submit_actor_task(spec))
         if streaming:
             from .object_ref import ObjectRefGenerator

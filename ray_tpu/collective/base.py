@@ -10,6 +10,7 @@ tensors lowering to jax.lax collectives — the NCCL role).
 from __future__ import annotations
 
 import enum
+import time
 from abc import ABC, abstractmethod
 from typing import Any, List
 
@@ -21,11 +22,45 @@ class ReduceOp(enum.Enum):
     MAX = "max"
 
 
+def tensor_nbytes(tensor) -> int:
+    """Payload size of a collective operand: numpy/jax arrays expose
+    nbytes; arbitrary control-plane objects (cpu allgather) fall back to a
+    cheap estimate rather than a serialization pass."""
+    nbytes = getattr(tensor, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    if isinstance(tensor, (bytes, bytearray, memoryview)):
+        return len(tensor)
+    if isinstance(tensor, (int, float, bool, complex)):
+        return 8
+    try:
+        import numpy as np
+
+        return int(np.asarray(tensor).nbytes)
+    except Exception:
+        return 0
+
+
 class BaseGroup(ABC):
+    #: backend tag on every recorded metric ("xla" = ICI fast path,
+    #: "gcs_store" = host/control-plane fallback)
+    backend = "base"
+
     def __init__(self, world_size: int, rank: int, group_name: str):
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
+
+    def _record_op(self, op: str, nbytes: int, start: float):
+        """Record one finished op into the collective bytes/latency/
+        bandwidth metrics (util/metrics); ``start`` is the perf_counter
+        taken before the op."""
+        from ..util import metrics
+
+        metrics.record_collective(
+            op, self.backend, self.group_name, nbytes,
+            time.perf_counter() - start,
+        )
 
     @abstractmethod
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):

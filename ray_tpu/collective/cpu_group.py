@@ -21,7 +21,7 @@ import numpy as np
 
 from .. import _worker_api
 from .._internal import serialization
-from .base import BaseGroup, ReduceOp
+from .base import BaseGroup, ReduceOp, tensor_nbytes
 
 _REDUCERS = {
     ReduceOp.SUM: lambda arrs: np.sum(arrs, axis=0),
@@ -38,6 +38,8 @@ def _kv_call(method, *args):
 
 
 class GcsStoreGroup(BaseGroup):
+    backend = "gcs_store"
+
     def __init__(self, world_size: int, rank: int, group_name: str):
         super().__init__(world_size, rank, group_name)
         self._seq = 0
@@ -84,23 +86,36 @@ class GcsStoreGroup(BaseGroup):
 
     # -- ops ---------------------------------------------------------------
 
-    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+    def _allreduce_impl(self, tensor, op: ReduceOp):
         seq = self._next_seq()
         arr = np.asarray(tensor)
         self._put(seq, "d", arr)
         return _REDUCERS[op](self._gather_all(seq, "d"))
 
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        start = time.perf_counter()
+        out = self._allreduce_impl(tensor, op)
+        self._record_op("allreduce", tensor_nbytes(out), start)
+        return out
+
     def allgather(self, tensor) -> List[Any]:
         # arbitrary python objects allowed (control-plane data), not just
         # tensors — objects round-trip unchanged
+        start = time.perf_counter()
         seq = self._next_seq()
         self._put(seq, "d", tensor)
-        return self._gather_all(seq, "d")
+        out = self._gather_all(seq, "d")
+        self._record_op("allgather", tensor_nbytes(tensor), start)
+        return out
 
     def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
-        reduced = self.allreduce(tensor, op)
+        start = time.perf_counter()
+        # inner impl, not allreduce(): one op records one metric sample
+        reduced = self._allreduce_impl(tensor, op)
         shards = np.array_split(reduced, self.world_size, axis=0)
-        return shards[self.rank]
+        out = shards[self.rank]
+        self._record_op("reducescatter", tensor_nbytes(reduced), start)
+        return out
 
     def broadcast(self, tensor, src_rank: int = 0):
         # The src must not return until every receiver has read the payload:
@@ -108,6 +123,7 @@ class GcsStoreGroup(BaseGroup):
         # gather-style ops guarantee but a fire-and-forget broadcast would
         # not — a racing src could let cleanup delete a payload a slow rank
         # never read. The ack phase makes broadcast synchronizing.
+        start = time.perf_counter()
         seq = self._next_seq()
         if self.rank == src_rank:
             self._put(seq, "d", tensor)
@@ -116,6 +132,7 @@ class GcsStoreGroup(BaseGroup):
             out = self._get_blocking(seq, "d", src_rank)
         self._put(seq, "s", 1)
         self._gather_all(seq, "s")
+        self._record_op("broadcast", tensor_nbytes(out), start)
         return out
 
     def _p2p_key(self, src: int, dst: int) -> tuple:
@@ -124,11 +141,14 @@ class GcsStoreGroup(BaseGroup):
         return n
 
     def send(self, tensor, dst_rank: int):
+        start = time.perf_counter()
         n = self._p2p_key(self.rank, dst_rank)
         key = f"col:{self.group_name}:p2p:{self.rank}:{dst_rank}:{n}"
         _kv_call("kv_put", key, serialization.pack(tensor), True)
+        self._record_op("send", tensor_nbytes(tensor), start)
 
     def recv(self, src_rank: int):
+        start = time.perf_counter()
         n = self._p2p_key(src_rank, self.rank)
         key = f"col:{self.group_name}:p2p:{src_rank}:{self.rank}:{n}"
         deadline = time.time() + 120.0
@@ -137,7 +157,9 @@ class GcsStoreGroup(BaseGroup):
             raw = _kv_call("kv_get", key)
             if raw is not None:
                 _kv_call("kv_del", key)
-                return serialization.unpack(raw)
+                out = serialization.unpack(raw)
+                self._record_op("recv", len(raw), start)
+                return out
             time.sleep(delay)
             delay = min(delay * 1.5, 0.1)
         raise TimeoutError(
@@ -145,9 +167,11 @@ class GcsStoreGroup(BaseGroup):
         )
 
     def barrier(self):
+        start = time.perf_counter()
         seq = self._next_seq()
         self._put(seq, "s", 1)
         self._gather_all(seq, "s")
+        self._record_op("barrier", 0, start)
 
     def destroy(self):
         for seq in range(max(0, self._seq - 2), self._seq):

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .base import BaseGroup, ReduceOp
+from .base import BaseGroup, ReduceOp, tensor_nbytes
 
 _LAX_REDUCERS = {
     ReduceOp.SUM: jax.lax.psum,
@@ -146,6 +146,18 @@ class XlaGroup(BaseGroup):
         """Shard a host array over the group axis (leading dim)."""
         return jax.device_put(tensor, NamedSharding(self.mesh, P("g")))
 
+    backend = "xla"
+
+    def _timed(self, op_name: str, tensor, fn):
+        """Run an eager collective under the bytes/latency instrumentation;
+        block_until_ready so the recorded latency covers the ICI transfer,
+        not just the async dispatch (the eager surface is synchronizing
+        anyway — in-graph lax collectives stay untouched)."""
+        start = time.perf_counter()
+        out = jax.block_until_ready(fn())
+        self._record_op(op_name, tensor_nbytes(tensor), start)
+        return out
+
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
         # each device's shard is summed: for the eager API the input is the
         # per-rank contribution replicated per device slot
@@ -154,17 +166,19 @@ class XlaGroup(BaseGroup):
                 "PRODUCT has no XLA collective; use the cpu backend"
             )
         x = self._device_shard(tensor)
-        return self._reduce(x, op.value)
+        return self._timed("allreduce", x, lambda: self._reduce(x, op.value))
 
     def allgather(self, tensor) -> Any:
-        return self._allgather(self._device_shard(tensor))
+        x = self._device_shard(tensor)
+        return self._timed("allgather", x, lambda: self._allgather(x))
 
     def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
         if op != ReduceOp.SUM:
             raise NotImplementedError(
                 "XLA psum_scatter only reduces with SUM; use the cpu backend"
             )
-        return self._reducescatter(jnp.asarray(tensor))
+        x = jnp.asarray(tensor)
+        return self._timed("reducescatter", x, lambda: self._reducescatter(x))
 
     def _host_group(self):
         # host-side control ops (broadcast/send/recv across processes)
@@ -181,8 +195,11 @@ class XlaGroup(BaseGroup):
     def broadcast(self, tensor, src_rank: int = 0):
         if self.world_size == 1:
             return jax.device_put(tensor, NamedSharding(self.mesh, P()))
+        start = time.perf_counter()
         value = self._host_group().broadcast(tensor, src_rank)
-        return jax.device_put(value, NamedSharding(self.mesh, P()))
+        out = jax.device_put(value, NamedSharding(self.mesh, P()))
+        self._record_op("broadcast", tensor_nbytes(out), start)
+        return out
 
     def send(self, tensor, dst_rank: int):
         if self.world_size == 1:
@@ -195,8 +212,10 @@ class XlaGroup(BaseGroup):
         return self._host_group().recv(src_rank)
 
     def barrier(self):
+        start = time.perf_counter()
         x = jnp.zeros((len(self.devices),), jnp.int32)
         jax.block_until_ready(self._reduce(self._device_shard(x), "sum"))
+        self._record_op("barrier", 0, start)
 
     # -- in-graph surface (use inside shard_map/jit) ------------------------
 

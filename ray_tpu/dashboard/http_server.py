@@ -185,6 +185,8 @@ class DashboardServer:
             # (role of `ray timeline` + the React timeline view)
             ("GET", "/api/timeline"): self._timeline,
             ("GET", "/api/timeline/full"): self._timeline_full,
+            # per-device HBM telemetry aggregated from pushed metrics
+            ("GET", "/api/devices"): self._devices,
             ("GET", "/metrics"): self._metrics,
             # browser UI (role of the React frontend, dashboard/client/ —
             # here a dependency-free single page over the same REST API)
@@ -204,21 +206,39 @@ class DashboardServer:
         )
         return 200, {"submission_id": submission_id}, None
 
-    def _timeline(self, body, limit: int = 250):
+    def _timeline(self, body, limit: int = 250, span_limit: int = 250):
         """UI refresh payload: recent events only — the browser renders the
-        last 80 bars; /api/timeline/full is the whole-trace download."""
-        from ..util.tracing import build_chrome_trace
+        last 80 bars; /api/timeline/full is the whole-trace download. Both
+        merge GCS task-state events with the cluster span store, so the
+        chrome trace carries every traced node's driver AND worker spans."""
+        from ..util.tracing import build_chrome_trace, merge_span_events
 
         events = self._gcs("list_task_events", None, limit)
-        return 200, {"traceEvents": build_chrome_trace(events)}, None
+        trace = build_chrome_trace(events)
+        try:
+            spans = self._gcs("list_spans", span_limit)
+        except Exception:
+            spans = []
+        merge_span_events(trace, spans)
+        return 200, {"traceEvents": trace}, None
 
     def _timeline_full(self, body):
-        return self._timeline(body, limit=100000)
+        return self._timeline(body, limit=100000, span_limit=100000)
+
+    def _metric_payloads(self):
+        from ..util.metrics import fetch_metric_payloads
+
+        return fetch_metric_payloads(self._gcs)
+
+    def _devices(self, body):
+        from ..util.metrics import device_rows
+
+        return 200, device_rows(self._metric_payloads()), None
 
     def _metrics(self, body):
-        from ..util.metrics import prometheus_text
+        from ..util.metrics import render_prometheus
 
-        return 200, prometheus_text(), "text/plain"
+        return 200, render_prometheus(self._metric_payloads()), "text/plain"
 
 
 _INDEX_HTML = """<!doctype html>
@@ -253,6 +273,7 @@ _INDEX_HTML = """<!doctype html>
 <h2>Cluster resources</h2><div id="resources">loading…</div>
 <h2>Utilization</h2><div id="sparklines"></div>
 <h2>Nodes</h2><table id="nodes"></table>
+<h2>Devices (HBM)</h2><table id="devices"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Placement groups</h2><table id="pgs"></table>
 <h2>Jobs</h2><table id="jobs"></table>
@@ -353,6 +374,12 @@ async function refresh() {
       head: n.is_head ? "head" : "",
       resources: JSON.stringify(n.resources_total || {}),
     })), ["id", "address", "alive", "head", "resources"]);
+    const devices = await j("/api/devices");
+    fill("devices", devices.map(d => ({
+      node: (d.node || "").slice(0, 12), device: d.device, kind: d.kind,
+      hbm_used_mb: (d.used / 1048576).toFixed(1),
+      hbm_limit_mb: (d.limit / 1048576).toFixed(1),
+    })), ["node", "device", "kind", "hbm_used_mb", "hbm_limit_mb"]);
     const actors = await j("/api/actors");
     fill("actors", actors.map(a => ({
       id: (a.actor_id || "").slice(0, 12),

@@ -197,6 +197,9 @@ class RemoteFunction:
             is_streaming_generator=streaming,
             runtime_env=_normalize_runtime_env(options.get("runtime_env"), worker),
         )
+        from .util import tracing
+
+        spec.trace_context = tracing.inject_context()
         return_ids = _worker_api.run_on_worker_loop(worker.submit_task(spec))
         if streaming:
             from .object_ref import ObjectRefGenerator

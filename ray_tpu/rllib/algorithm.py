@@ -136,6 +136,11 @@ class PPO:
             config.env_to_module_connector() if config.env_to_module_connector
             else default_env_to_module()
         )
+        # per-iteration compute/collective/idle telemetry feeding the
+        # scaling-efficiency gauge (util/metrics)
+        from ..util.metrics import StepBreakdown
+
+        self._step_breakdown = StepBreakdown(role="rllib")
 
     # -- training -----------------------------------------------------------
 
@@ -143,14 +148,15 @@ class PPO:
         """One iteration: parallel rollouts -> GAE -> learner update
         (reference: Algorithm.step / training_step)."""
         t0 = time.time()
-        # params travel once per iteration (ObjectRef or weight-plane
-        # version), never inline per runner — see rllib/weight_sync.py
-        params_handle = self._broadcaster.handle(self.learner.get_params())
-        rollouts = api.get(
-            [r.sample.remote(params_handle) for r in self.runners]
-        )
-        batch, ep_returns, ep_lengths = self._postprocess(rollouts)
-        stats = self.learner.update(batch)
+        with self._step_breakdown.step():
+            # params travel once per iteration (ObjectRef or weight-plane
+            # version), never inline per runner — see rllib/weight_sync.py
+            params_handle = self._broadcaster.handle(self.learner.get_params())
+            rollouts = api.get(
+                [r.sample.remote(params_handle) for r in self.runners]
+            )
+            batch, ep_returns, ep_lengths = self._postprocess(rollouts)
+            stats = self.learner.update(batch)
         self.iteration += 1
         self._ep_return_window.extend(ep_returns)
         self._ep_return_window = self._ep_return_window[-100:]

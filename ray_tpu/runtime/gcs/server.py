@@ -17,6 +17,7 @@ NotifyGCSRestart, node_manager.proto:426).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import pickle
 import time
@@ -71,6 +72,11 @@ class GcsServer:
         self._task_events: Dict[str, dict] = {}
         self._task_events_order: List[str] = []
         self._task_events_cap = 10000
+        # span store: finished spans streamed from every traced process so
+        # worker spans outlive their process and join the cluster timeline
+        # (capped like task events; tracing off -> nothing ever arrives)
+        self._spans: List[dict] = []
+        self._spans_cap = 50000
         # autoscaler state (reference: GcsAutoscalerStateManager)
         self._node_demands: Dict[NodeID, list] = {}
         self._autoscaling_state: Optional[dict] = None
@@ -388,6 +394,7 @@ class GcsServer:
         # partial view forever — a popped version forces a resync/snapshot
         self._node_sync_versions.pop(node_id, None)
         logger.warning("node %s dead: %s", node_id, reason)
+        self._reap_node_metrics(node_id)
         self.publisher.publish("node", ("dead", node))
         self.weight_registry.on_node_death(node.address)
         await self.actor_manager.on_node_death(node_id)
@@ -397,7 +404,30 @@ class GcsServer:
 
     async def handle_report_worker_death(self, worker_id: WorkerID, reason: str):
         await self.actor_manager.on_worker_death(worker_id, reason)
+        # reap the dead worker's pushed metrics snapshot, or its series
+        # would live in every /metrics scrape forever
+        self._drop_metrics_key(f"metrics:{worker_id.hex()}")
         return True
+
+    def _drop_metrics_key(self, key: str):
+        if self._kv.pop(key, None) is not None:
+            try:
+                self.storage.delete("kv", key)
+            except Exception:
+                pass
+
+    def _reap_node_metrics(self, node_id: NodeID):
+        """Drop metrics snapshots pushed by workers of a dead node: every
+        push is tagged with the pusher's node identity (util/metrics), so a
+        node death reaps all of its workers' series at once."""
+        want = node_id.hex()
+        for key in [k for k in self._kv if k.startswith("metrics:")]:
+            try:
+                payload = json.loads(self._kv[key])
+            except Exception:
+                continue
+            if isinstance(payload, dict) and payload.get("node_id") == want:
+                self._drop_metrics_key(key)
 
     # -- internal KV (reference: GcsInternalKVManager) ---------------------
 
@@ -536,6 +566,17 @@ class GcsServer:
             if len(out) >= limit:
                 break
         return out
+
+    # -- span store (cluster-wide tracing; see util/tracing.py) ------------
+
+    async def handle_report_spans(self, spans: List[dict]):
+        self._spans.extend(spans)
+        if len(self._spans) > self._spans_cap:
+            del self._spans[: len(self._spans) - self._spans_cap]
+        return True
+
+    async def handle_list_spans(self, limit: int = 100000):
+        return self._spans[-limit:]
 
     async def handle_register_job(self, metadata: dict) -> JobID:
         job_id = JobID.from_int(self._next_job)

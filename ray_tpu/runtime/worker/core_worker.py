@@ -1657,6 +1657,8 @@ class CoreWorker:
 
     async def _handle_push_task(self, spec: TaskSpec, attempt: int = 0) -> TaskReply:
         """Execute a normal task and reply with its returns."""
+        from ...util import tracing
+
         prev_task = self._current_task_id
         self._current_task_id = spec.task_id
         self.record_task_event(
@@ -1664,6 +1666,17 @@ class CoreWorker:
             node_id=self.node_id.hex() if self.node_id else "",
             worker_pid=os.getpid(),
         )
+        with tracing.task_execution_span(
+            f"execute:{spec.function.qualname}",
+            getattr(spec, "trace_context", None),
+            task_id=spec.task_id.hex(),
+            node_id=self.node_id.hex() if self.node_id else "",
+        ):
+            return await self._handle_push_task_traced(spec, attempt, prev_task)
+
+    async def _handle_push_task_traced(
+        self, spec: TaskSpec, attempt: int, prev_task: TaskID
+    ) -> TaskReply:
         try:
             fn = await self._load_function(spec.function)
             args, kwargs = await self._unflatten(spec)
@@ -1993,6 +2006,18 @@ class CoreWorker:
                 fut.exception()
 
     async def _execute_actor_task(self, spec: TaskSpec) -> TaskReply:
+        from ...util import tracing
+
+        with tracing.task_execution_span(
+            f"execute:{spec.function.qualname}",
+            getattr(spec, "trace_context", None),
+            task_id=spec.task_id.hex(),
+            actor_id=spec.actor_id.hex() if spec.actor_id else "",
+            node_id=self.node_id.hex() if self.node_id else "",
+        ):
+            return await self._execute_actor_task_traced(spec)
+
+    async def _execute_actor_task_traced(self, spec: TaskSpec) -> TaskReply:
         if self._actor_instance is None:
             return self._error_reply(spec, RuntimeError("actor not initialized"))
         if spec.function.qualname in ("__ray_dag_init__", "__ray_dag_teardown__"):

@@ -211,9 +211,29 @@ def cmd_summary(args):
 
 def cmd_metrics(args):
     _connected(args)
+    if getattr(args, "summary", False):
+        from ..util import state
+
+        print(json.dumps(state.metrics_summary(), indent=2, default=str))
+        return 0
     from ..util.metrics import prometheus_text
 
     print(prometheus_text())
+    return 0
+
+
+def cmd_timeline(args):
+    """`ray_tpu timeline`: export the cluster-wide chrome trace — GCS
+    task-state bars merged with every traced node's spans (reference:
+    `ray timeline` writing chrome://tracing JSON)."""
+    _connected(args)
+    from ..util import tracing
+
+    events = tracing.timeline(args.output)
+    print(
+        f"wrote {len(events)} trace events to {args.output} "
+        f"(open in chrome://tracing or https://ui.perfetto.dev)"
+    )
     return 0
 
 
@@ -298,11 +318,30 @@ def main(argv=None):
     for name, fn in (
         ("status", cmd_status),
         ("summary", cmd_summary),
-        ("metrics", cmd_metrics),
     ):
         p = sub.add_parser(name)
         p.add_argument("--address", required=True, help="head host:port")
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser(
+        "metrics", help="Prometheus exposition dump (or --summary JSON)"
+    )
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument(
+        "--summary", action="store_true",
+        help="aggregated collective/step/HBM JSON instead of raw exposition",
+    )
+    p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "timeline", help="export the cluster chrome trace (ray timeline)"
+    )
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument(
+        "-o", "--output", default="/tmp/ray_tpu_timeline.json",
+        help="output chrome-trace JSON path",
+    )
+    p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser(
         "logs", help="list or tail session log files (reference: ray logs)"

@@ -44,6 +44,9 @@ class TrainContext:
     _reports: list = field(default_factory=list)
     _lock: threading.Lock = field(default_factory=threading.Lock)
     _report_count: int = 0
+    # report-to-report step telemetry (compute/collective split +
+    # scaling-efficiency gauge; util/metrics.StepBreakdown)
+    _step_breakdown: Any = None
 
     # -- user-facing accessors (reference: TrainContext methods) ----------
 
@@ -84,6 +87,13 @@ class TrainContext:
         """
         index = self._report_count
         self._report_count += 1
+        # each report marks a train-step boundary: record the interval's
+        # compute/collective breakdown for the scaling-efficiency gauge
+        if self._step_breakdown is None:
+            from ..util.metrics import StepBreakdown
+
+            self._step_breakdown = StepBreakdown(role="train")
+        self._step_breakdown.mark()
         persisted: Optional[Checkpoint] = None
         if checkpoint is not None:
             dest = os.path.join(self.run_dir, f"checkpoint_{index:06d}")

@@ -105,6 +105,66 @@ def list_objects() -> List[Dict[str, Any]]:
     ]
 
 
+def metrics_summary() -> Dict[str, Any]:
+    """Cluster telemetry rollup from every worker's pushed metrics
+    snapshot: collective traffic (bytes/ops/mean latency/achieved
+    bandwidth per op), per-role step breakdowns with the
+    scaling-efficiency gauge, and per-device HBM usage (reference
+    analogue: `ray status -v` + the metrics agent's aggregation)."""
+    import json as _json
+
+    from .metrics import device_rows, fetch_metric_payloads
+
+    payloads = fetch_metric_payloads(_gcs_call)
+    collective: Dict[str, Dict[str, float]] = {}
+    steps: Dict[str, Dict[str, float]] = {}
+    efficiency: Dict[str, float] = {}
+    for payload in payloads:
+        for snap in payload.get("metrics", []):
+            name = snap["name"]
+            if name == "collective_bytes_total":
+                for tag_json, value in snap["values"].items():
+                    tags = dict(zip(snap["tag_keys"], _json.loads(tag_json)))
+                    row = collective.setdefault(
+                        tags.get("op", "?"), {"bytes": 0.0, "ops": 0.0}
+                    )
+                    row["bytes"] += value
+            elif name == "collective_op_latency_ms":
+                for tag_json, counts in snap.get("counts", {}).items():
+                    tags = dict(zip(snap["tag_keys"], _json.loads(tag_json)))
+                    row = collective.setdefault(
+                        tags.get("op", "?"), {"bytes": 0.0, "ops": 0.0}
+                    )
+                    n = float(sum(counts))
+                    row["ops"] += n
+                    if n:
+                        row["mean_ms"] = (
+                            snap["values"].get(tag_json, 0.0) / n
+                        )
+            elif name == "collective_bandwidth_gb_s":
+                for tag_json, value in snap["values"].items():
+                    tags = dict(zip(snap["tag_keys"], _json.loads(tag_json)))
+                    collective.setdefault(
+                        tags.get("op", "?"), {"bytes": 0.0, "ops": 0.0}
+                    )["bandwidth_gb_s"] = value
+            elif name == "step_time_seconds":
+                for tag_json, value in snap["values"].items():
+                    tags = dict(zip(snap["tag_keys"], _json.loads(tag_json)))
+                    steps.setdefault(tags.get("role", "?"), {})[
+                        tags.get("component", "?")
+                    ] = value
+            elif name == "scaling_efficiency_ratio":
+                for tag_json, value in snap["values"].items():
+                    tags = dict(zip(snap["tag_keys"], _json.loads(tag_json)))
+                    efficiency[tags.get("role", "?")] = value
+    return {
+        "collective": collective,
+        "step_breakdown": steps,
+        "scaling_efficiency": efficiency,
+        "devices": device_rows(payloads),
+    }
+
+
 def list_weights() -> List[Dict[str, Any]]:
     """Weight-plane registry rows: every published model with its head
     version, resident/pinned versions, tombstone count, and broadcast-tree

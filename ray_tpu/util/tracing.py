@@ -16,12 +16,30 @@ import json
 import os
 import threading
 import time
+import uuid
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 _lock = threading.Lock()
 _spans: List[dict] = []
 _enabled = os.environ.get("RAY_TPU_TRACE", "") not in ("", "0")
+
+# -- distributed trace context ----------------------------------------------
+# Every span carries (trace_id, span_id, parent_id). The ACTIVE context is a
+# per-thread stack of open spans; when a thread has no open span the
+# process-wide task context (restored from TaskSpec.trace_context around task
+# execution) is the parent — user code runs in executor threads, so a pure
+# thread-local would lose the chain between the RPC loop and the user frame.
+_tls = threading.local()
+_task_context: Optional[Dict[str, str]] = None
+
+# spans not yet streamed to the GCS span store
+_flush_cursor = 0
+_span_pusher_started = False
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
 
 
 def enable_tracing():
@@ -32,34 +50,113 @@ def enable_tracing():
 
 
 def is_tracing_enabled() -> bool:
-    return _enabled
+    """True when this process records spans — either statically (the
+    RAY_TPU_TRACE env / enable_tracing()) or dynamically because it is
+    executing a task whose submitter propagated a trace context (workers
+    need no env of their own: the trace follows the task)."""
+    return _enabled or _task_context is not None
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """The active span context: innermost open span of this thread, else
+    the restored task context."""
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        return stack[-1]
+    return _task_context
+
+
+def inject_context() -> Optional[Dict[str, str]]:
+    """Context to stamp into a TaskSpec at .remote() time; None when
+    tracing is off (zero per-task cost on the untraced hot path)."""
+    if not is_tracing_enabled():
+        return None
+    ctx = current_context()
+    if ctx is None:
+        # root of a fresh trace: submissions with no enclosing span still
+        # correlate (every task of one driver loop shares a trace)
+        return {"trace_id": _new_id(), "span_id": ""}
+    return dict(ctx)
 
 
 @contextmanager
 def trace_span(name: str, category: str = "app", **attrs):
-    """Record one span (reference: tracing_helper span context managers)."""
-    if not _enabled:
+    """Record one span (reference: tracing_helper span context managers),
+    linked to the enclosing span/task context."""
+    if not is_tracing_enabled():
         yield
         return
+    parent = current_context()
+    ctx = {
+        "trace_id": parent["trace_id"] if parent else _new_id(),
+        "span_id": _new_id(),
+    }
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(ctx)
     start = time.perf_counter()
     wall = time.time()
     try:
         yield
     finally:
         dur = time.perf_counter() - start
-        with _lock:
-            _spans.append(
-                {
-                    "name": name,
-                    "cat": category,
-                    "ph": "X",
-                    "ts": wall * 1e6,
-                    "dur": dur * 1e6,
-                    "pid": os.getpid(),
-                    "tid": threading.get_ident() % 100000,
-                    "args": attrs,
-                }
-            )
+        stack.pop()
+        _record_span(
+            name, category, wall, dur,
+            ctx["trace_id"], ctx["span_id"],
+            (parent or {}).get("span_id", ""), attrs,
+        )
+
+
+@contextmanager
+def task_execution_span(name: str, ctx: Optional[Dict[str, str]], **attrs):
+    """Restore a propagated trace context around task execution and record
+    the execute span. Installed as the process-wide task context so nested
+    ``.remote()`` submissions from user code (which runs in executor
+    threads) parent to this execution."""
+    global _task_context
+    if ctx is None and not _enabled:
+        yield
+        return
+    span_ctx = {
+        "trace_id": (ctx or {}).get("trace_id") or _new_id(),
+        "span_id": _new_id(),
+    }
+    prev = _task_context
+    _task_context = span_ctx
+    start = time.perf_counter()
+    wall = time.time()
+    try:
+        yield
+    finally:
+        _task_context = prev
+        _record_span(
+            name, "ray_tpu.execute", wall, time.perf_counter() - start,
+            span_ctx["trace_id"], span_ctx["span_id"],
+            (ctx or {}).get("span_id", ""), attrs,
+        )
+
+
+def _record_span(name, category, wall, dur_s, trace_id, span_id, parent_id,
+                 attrs):
+    span = {
+        "name": name,
+        "cat": category,
+        "ph": "X",
+        "ts": wall * 1e6,
+        "dur": dur_s * 1e6,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() % 100000,
+        "trace_id": trace_id,
+        "span_id": span_id,
+        "parent_id": parent_id,
+        "args": {**attrs, "trace_id": trace_id, "span_id": span_id,
+                 "parent_id": parent_id},
+    }
+    with _lock:
+        _spans.append(span)
+    _ensure_span_pusher()
 
 
 def get_spans() -> List[dict]:
@@ -68,8 +165,58 @@ def get_spans() -> List[dict]:
 
 
 def clear_spans():
+    global _flush_cursor
     with _lock:
         _spans.clear()
+        _flush_cursor = 0
+
+
+# -- span streaming to the GCS span store -----------------------------------
+
+
+def flush_spans():
+    """Push spans recorded since the last flush to the GCS span store.
+    Called from the background pusher; also public so a short-lived task
+    can flush deterministically before returning."""
+    global _flush_cursor
+    from .. import _worker_api
+
+    worker = _worker_api.maybe_get_core_worker()
+    if worker is None:
+        return
+    with _lock:
+        batch = _spans[_flush_cursor:]
+        cursor = len(_spans)
+    if not batch:
+        return
+    try:
+        _worker_api.run_on_worker_loop(
+            worker.client_pool.get(*worker.gcs_address).call(
+                "report_spans", batch
+            ),
+            timeout=5,
+        )
+        with _lock:
+            _flush_cursor = max(_flush_cursor, cursor)
+    except Exception:
+        pass  # spans are best-effort observability
+
+
+def _ensure_span_pusher():
+    """Background thread streaming finished spans to the GCS (reference:
+    worker-side TaskEventBuffer flushes; here for spans, so a WORKER's
+    spans outlive its process and join the cluster timeline)."""
+    global _span_pusher_started
+    if _span_pusher_started:
+        return
+    _span_pusher_started = True
+
+    def _loop():
+        while True:
+            time.sleep(1.0)
+            flush_spans()
+
+    threading.Thread(target=_loop, daemon=True, name="span-push").start()
 
 
 def export_spans(filename: str):
@@ -106,22 +253,43 @@ def build_chrome_trace(events: List[dict]) -> List[dict]:
     return trace
 
 
+def merge_span_events(trace: List[dict], *span_lists: List[dict]) -> List[dict]:
+    """Append span lists onto a chrome trace, deduplicating by span_id (a
+    driver's spans exist both locally and in the GCS store). Shared by
+    ``timeline()`` and the dashboard's /api/timeline."""
+    seen = set()
+    for spans in span_lists:
+        for span in spans:
+            sid = span.get("span_id")
+            if sid and sid in seen:
+                continue
+            if sid:
+                seen.add(sid)
+            trace.append(span)
+    return trace
+
+
 def timeline(filename: Optional[str] = None) -> List[dict]:
-    """Cluster-wide task timeline as chrome-trace events, reconstructed
-    from the GCS task-event store (reference: `ray timeline` building a
-    chrome trace from profile events). Returns the events; also writes
+    """Cluster-wide timeline as chrome-trace events: GCS task-state events
+    plus EVERY node's spans from the GCS span store, plus this process's
+    not-yet-flushed spans (reference: `ray timeline` building a chrome
+    trace from profile events). Returns the events; also writes
     ``filename`` if given."""
     from .. import _worker_api
 
     worker = _worker_api.get_core_worker()
+    gcs = worker.client_pool.get(*worker.gcs_address)
     events = _worker_api.run_on_worker_loop(
-        worker.client_pool.get(*worker.gcs_address).call(
-            "list_task_events", None, 100000
-        )
+        gcs.call("list_task_events", None, 100000)
     )
     trace = build_chrome_trace(events)
-    # driver-side spans join the same trace
-    trace.extend(get_spans())
+    try:
+        cluster_spans = _worker_api.run_on_worker_loop(
+            gcs.call("list_spans", 100000)
+        )
+    except Exception:
+        cluster_spans = []
+    merge_span_events(trace, cluster_spans, get_spans())
     if filename:
         with open(filename, "w") as f:
             json.dump({"traceEvents": trace}, f)

@@ -77,6 +77,60 @@ def test_warm_stream_lease_rpcs_regression_guard(shutdown_only):
     assert push_delta == n
 
 
+def test_tracing_disabled_overhead_guard(shutdown_only, monkeypatch):
+    """The tracing plane must never silently tax the hot path: with
+    RAY_TPU_TRACE unset, tasks_sync throughput stays within 5% of an
+    untraced baseline (driver-side tracing hooks stubbed to no-ops), and
+    zero spans are recorded anywhere."""
+    import time as _time
+
+    monkeypatch.delenv("RAY_TPU_TRACE", raising=False)
+    from ray_tpu.util import tracing
+
+    tracing._enabled = False
+    assert not tracing.is_tracing_enabled()
+    tracing.clear_spans()
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    def measure(n=150):
+        t0 = _time.perf_counter()
+        for i in range(n):
+            ray_tpu.get(noop.remote(i))
+        return n / (_time.perf_counter() - t0)
+
+    measure(40)  # warm the lease cache + code paths
+
+    real_enabled = tracing.is_tracing_enabled
+    real_inject = tracing.inject_context
+
+    def baseline_throughput():
+        tracing.is_tracing_enabled = lambda: False
+        tracing.inject_context = lambda: None
+        try:
+            return measure()
+        finally:
+            tracing.is_tracing_enabled = real_enabled
+            tracing.inject_context = real_inject
+
+    # interleave measurements; pass when any attempt is within tolerance
+    # (single-box timing noise dwarfs the one-boolean-check difference)
+    ratios = []
+    for _ in range(4):
+        base = baseline_throughput()
+        real = measure()
+        ratios.append(real / base)
+        if real >= 0.95 * base:
+            break
+    assert ratios[-1] >= 0.95, (
+        f"disabled-tracing path slower than untraced baseline: {ratios}"
+    )
+    assert tracing.get_spans() == []  # plane fully dormant when disabled
+
+
 def test_scale_smoke_queued_tasks(shutdown_only):
     """Queue-depth envelope smoke (BASELINE.md 'tasks queued on a single
     node'): hundreds of queued no-op tasks on 2 workers all complete
