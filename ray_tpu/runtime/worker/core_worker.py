@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextvars
 import enum
 import logging
 import os
@@ -1753,8 +1754,8 @@ class CoreWorker:
                     except StopAsyncIteration:
                         break
                 else:
-                    item = await self.loop.run_in_executor(
-                        self._executor_pool, next, gen, _SENTINEL
+                    item = await self._run_traced(
+                        lambda: next(gen, _SENTINEL)
                     )
                     if item is _SENTINEL:
                         break
@@ -1789,6 +1790,14 @@ class CoreWorker:
             borrowed_refs=self._held_arg_refs(spec),
         )
 
+    def _run_traced(self, fn):
+        """run_in_executor with the caller's contextvars copied across: user
+        code on the executor thread then sees the coroutine-local trace
+        context (util/tracing task context) of the task execution coroutine
+        that dispatched it, so nested .remote() calls parent correctly."""
+        ctx = contextvars.copy_context()
+        return self.loop.run_in_executor(self._executor_pool, ctx.run, fn)
+
     async def _run_user_code(self, fn, args, kwargs, spec: TaskSpec):
         if asyncio.iscoroutinefunction(fn):
             return await fn(*args, **kwargs)
@@ -1806,7 +1815,7 @@ class CoreWorker:
                     debug.post_mortem(sys.exc_info()[2])
                 raise
 
-        return await self.loop.run_in_executor(self._executor_pool, _call)
+        return await self._run_traced(_call)
 
     def _error_reply(self, spec: TaskSpec, exc: Exception) -> TaskReply:
         err = TaskError.from_exception(spec.function.qualname, exc)
@@ -2085,13 +2094,13 @@ class CoreWorker:
             if asyncio.iscoroutinefunction(method):
                 result = await method(*args, **kwargs)
             elif max_conc > 1:
-                result = await self.loop.run_in_executor(
-                    self._executor_pool, lambda: method(*args, **kwargs)
+                result = await self._run_traced(
+                    lambda: method(*args, **kwargs)
                 )
             else:
                 async with self._execution_lock:
-                    result = await self.loop.run_in_executor(
-                        self._executor_pool, lambda: method(*args, **kwargs)
+                    result = await self._run_traced(
+                        lambda: method(*args, **kwargs)
                     )
         except Exception as e:  # noqa: BLE001
             return self._error_reply(spec, e)

@@ -117,6 +117,7 @@ def metrics_summary() -> Dict[str, Any]:
 
     payloads = fetch_metric_payloads(_gcs_call)
     collective: Dict[str, Dict[str, float]] = {}
+    latency_sums: Dict[str, float] = {}
     steps: Dict[str, Dict[str, float]] = {}
     efficiency: Dict[str, float] = {}
     for payload in payloads:
@@ -132,15 +133,16 @@ def metrics_summary() -> Dict[str, Any]:
             elif name == "collective_op_latency_ms":
                 for tag_json, counts in snap.get("counts", {}).items():
                     tags = dict(zip(snap["tag_keys"], _json.loads(tag_json)))
+                    op = tags.get("op", "?")
                     row = collective.setdefault(
-                        tags.get("op", "?"), {"bytes": 0.0, "ops": 0.0}
+                        op, {"bytes": 0.0, "ops": 0.0}
                     )
-                    n = float(sum(counts))
-                    row["ops"] += n
-                    if n:
-                        row["mean_ms"] = (
-                            snap["values"].get(tag_json, 0.0) / n
-                        )
+                    # accumulate sum and count across ALL workers' payloads;
+                    # the cluster-wide mean is computed once after the loop
+                    row["ops"] += float(sum(counts))
+                    latency_sums[op] = latency_sums.get(op, 0.0) + snap[
+                        "values"
+                    ].get(tag_json, 0.0)
             elif name == "collective_bandwidth_gb_s":
                 for tag_json, value in snap["values"].items():
                     tags = dict(zip(snap["tag_keys"], _json.loads(tag_json)))
@@ -157,6 +159,9 @@ def metrics_summary() -> Dict[str, Any]:
                 for tag_json, value in snap["values"].items():
                     tags = dict(zip(snap["tag_keys"], _json.loads(tag_json)))
                     efficiency[tags.get("role", "?")] = value
+    for op, total_ms in latency_sums.items():
+        if collective[op]["ops"]:
+            collective[op]["mean_ms"] = total_ms / collective[op]["ops"]
     return {
         "collective": collective,
         "step_breakdown": steps,

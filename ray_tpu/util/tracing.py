@@ -12,6 +12,7 @@ equivalent of NVTX ranges).
 
 from __future__ import annotations
 
+import contextvars
 import json
 import os
 import threading
@@ -22,19 +23,31 @@ from typing import Any, Dict, List, Optional
 
 _lock = threading.Lock()
 _spans: List[dict] = []
+_spans_cap = 50000  # local backstop mirroring the GCS store's cap
 _enabled = os.environ.get("RAY_TPU_TRACE", "") not in ("", "0")
 
 # -- distributed trace context ----------------------------------------------
 # Every span carries (trace_id, span_id, parent_id). The ACTIVE context is a
-# per-thread stack of open spans; when a thread has no open span the
-# process-wide task context (restored from TaskSpec.trace_context around task
-# execution) is the parent — user code runs in executor threads, so a pure
-# thread-local would lose the chain between the RPC loop and the user frame.
+# per-thread stack of open spans; when a thread has no open span the task
+# context (restored from TaskSpec.trace_context around task execution) is the
+# parent. The task context is a ContextVar, NOT a module global: the worker
+# RPC server dispatches each push_task/actor_task via asyncio.ensure_future,
+# so many task-execution coroutines interleave on one event loop — a
+# ContextVar is coroutine-local under asyncio, so concurrent tasks can't
+# clobber each other's context and exits can't restore a stale one. User code
+# running in executor threads inherits it via contextvars.copy_context()
+# handoff at the run_in_executor call sites (core_worker._run_traced).
 _tls = threading.local()
-_task_context: Optional[Dict[str, str]] = None
+_task_context: contextvars.ContextVar[Optional[Dict[str, str]]] = (
+    contextvars.ContextVar("ray_tpu_task_context", default=None)
+)
+# one trace per process for submissions with no enclosing span, so all
+# root-level tasks of one driver loop correlate in the timeline
+_root_trace_id: Optional[str] = None
 
 # spans not yet streamed to the GCS span store
 _flush_cursor = 0
+_flush_lock = threading.Lock()  # serializes read-push-advance in flush_spans
 _span_pusher_started = False
 
 
@@ -54,7 +67,7 @@ def is_tracing_enabled() -> bool:
     RAY_TPU_TRACE env / enable_tracing()) or dynamically because it is
     executing a task whose submitter propagated a trace context (workers
     need no env of their own: the trace follows the task)."""
-    return _enabled or _task_context is not None
+    return _enabled or _task_context.get() is not None
 
 
 def current_context() -> Optional[Dict[str, str]]:
@@ -63,7 +76,7 @@ def current_context() -> Optional[Dict[str, str]]:
     stack = getattr(_tls, "stack", None)
     if stack:
         return stack[-1]
-    return _task_context
+    return _task_context.get()
 
 
 def inject_context() -> Optional[Dict[str, str]]:
@@ -73,10 +86,21 @@ def inject_context() -> Optional[Dict[str, str]]:
         return None
     ctx = current_context()
     if ctx is None:
-        # root of a fresh trace: submissions with no enclosing span still
-        # correlate (every task of one driver loop shares a trace)
-        return {"trace_id": _new_id(), "span_id": ""}
+        # root of the process-wide trace: submissions with no enclosing span
+        # still correlate (every task of one driver loop shares a trace)
+        return {"trace_id": _root_trace(), "span_id": ""}
     return dict(ctx)
+
+
+def _root_trace() -> str:
+    """The per-process trace_id for spans/submissions with no enclosing
+    context, created once so all root-level work of one driver correlates."""
+    global _root_trace_id
+    if _root_trace_id is None:
+        with _lock:
+            if _root_trace_id is None:
+                _root_trace_id = _new_id()
+    return _root_trace_id
 
 
 @contextmanager
@@ -88,7 +112,7 @@ def trace_span(name: str, category: str = "app", **attrs):
         return
     parent = current_context()
     ctx = {
-        "trace_id": parent["trace_id"] if parent else _new_id(),
+        "trace_id": parent["trace_id"] if parent else _root_trace(),
         "span_id": _new_id(),
     }
     stack = getattr(_tls, "stack", None)
@@ -112,25 +136,23 @@ def trace_span(name: str, category: str = "app", **attrs):
 @contextmanager
 def task_execution_span(name: str, ctx: Optional[Dict[str, str]], **attrs):
     """Restore a propagated trace context around task execution and record
-    the execute span. Installed as the process-wide task context so nested
-    ``.remote()`` submissions from user code (which runs in executor
-    threads) parent to this execution."""
-    global _task_context
+    the execute span. Installed in the coroutine-local task context so
+    nested ``.remote()`` submissions from user code parent to this
+    execution (executor threads see it via copy_context handoff)."""
     if ctx is None and not _enabled:
         yield
         return
     span_ctx = {
-        "trace_id": (ctx or {}).get("trace_id") or _new_id(),
+        "trace_id": (ctx or {}).get("trace_id") or _root_trace(),
         "span_id": _new_id(),
     }
-    prev = _task_context
-    _task_context = span_ctx
+    token = _task_context.set(span_ctx)
     start = time.perf_counter()
     wall = time.time()
     try:
         yield
     finally:
-        _task_context = prev
+        _task_context.reset(token)
         _record_span(
             name, "ray_tpu.execute", wall, time.perf_counter() - start,
             span_ctx["trace_id"], span_ctx["span_id"],
@@ -154,8 +176,15 @@ def _record_span(name, category, wall, dur_s, trace_id, span_id, parent_id,
         "args": {**attrs, "trace_id": trace_id, "span_id": span_id,
                  "parent_id": parent_id},
     }
+    global _flush_cursor
     with _lock:
         _spans.append(span)
+        if len(_spans) > _spans_cap:
+            # backstop when no pusher can drain (no core worker yet):
+            # drop the oldest spans, keeping the flush cursor aligned
+            drop = len(_spans) - _spans_cap
+            del _spans[:drop]
+            _flush_cursor = max(0, _flush_cursor - drop)
     _ensure_span_pusher()
 
 
@@ -175,7 +204,9 @@ def clear_spans():
 
 
 def flush_spans():
-    """Push spans recorded since the last flush to the GCS span store.
+    """Push spans recorded since the last flush to the GCS span store and
+    trim the flushed prefix from the local buffer (flushed spans live in
+    the GCS store; keeping them here would leak for the worker's lifetime).
     Called from the background pusher; also public so a short-lived task
     can flush deterministically before returning."""
     global _flush_cursor
@@ -184,22 +215,28 @@ def flush_spans():
     worker = _worker_api.maybe_get_core_worker()
     if worker is None:
         return
-    with _lock:
-        batch = _spans[_flush_cursor:]
-        cursor = len(_spans)
-    if not batch:
-        return
-    try:
-        _worker_api.run_on_worker_loop(
-            worker.client_pool.get(*worker.gcs_address).call(
-                "report_spans", batch
-            ),
-            timeout=5,
-        )
+    # one flusher at a time: concurrent read-push-trim would double-push
+    # the same batch (consuming the capped GCS store with duplicates)
+    with _flush_lock:
         with _lock:
-            _flush_cursor = max(_flush_cursor, cursor)
-    except Exception:
-        pass  # spans are best-effort observability
+            batch = _spans[_flush_cursor:]
+            cursor = len(_spans)
+        if not batch:
+            return
+        try:
+            _worker_api.run_on_worker_loop(
+                worker.client_pool.get(*worker.gcs_address).call(
+                    "report_spans", batch
+                ),
+                timeout=5,
+            )
+            with _lock:
+                # clear_spans may have raced the push; never trim past the
+                # current buffer
+                del _spans[: min(cursor, len(_spans))]
+                _flush_cursor = 0
+        except Exception:
+            pass  # spans are best-effort observability
 
 
 def _ensure_span_pusher():
@@ -207,9 +244,10 @@ def _ensure_span_pusher():
     worker-side TaskEventBuffer flushes; here for spans, so a WORKER's
     spans outlive its process and join the cluster timeline)."""
     global _span_pusher_started
-    if _span_pusher_started:
-        return
-    _span_pusher_started = True
+    with _lock:
+        if _span_pusher_started:
+            return
+        _span_pusher_started = True
 
     def _loop():
         while True:
