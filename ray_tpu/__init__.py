@@ -71,8 +71,8 @@ def timeline(filename=None):
 # jax-dependent libraries at top-level import time.
 _LAZY_SUBMODULES = (
     "autoscaler", "client", "collective", "dag", "data", "experimental",
-    "llm", "models", "ops", "parallel", "rllib", "serve", "testing", "train",
-    "tune", "util", "cross_language",
+    "kvcache", "llm", "models", "ops", "parallel", "rllib", "serve",
+    "testing", "train", "tune", "util", "cross_language",
 )
 
 

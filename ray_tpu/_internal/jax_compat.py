@@ -1,0 +1,26 @@
+"""Version-compatibility shims for jax APIs the repo relies on.
+
+``shard_map`` was promoted from ``jax.experimental.shard_map`` to the jax
+top level; depending on the installed jax, exactly one of the two spellings
+exists. Import it from here so every caller (library and tests) works on
+both sides of the promotion.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    @functools.wraps(_experimental_shard_map)
+    def shard_map(*args, **kwargs):
+        # the promotion also renamed check_rep -> check_vma; accept the new
+        # spelling and hand the old API its old name
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(*args, **kwargs)

@@ -32,6 +32,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .base import BaseGroup, ReduceOp, tensor_nbytes
+from .._internal.jax_compat import shard_map
 
 _LAX_REDUCERS = {
     ReduceOp.SUM: jax.lax.psum,
@@ -117,7 +118,7 @@ class XlaGroup(BaseGroup):
             fn = {
                 "sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
             }[op_name]
-            return jax.shard_map(
+            return shard_map(
                 lambda s: fn(s, "g"),
                 mesh=self.mesh, in_specs=spec, out_specs=rep, check_vma=False,
             )(x)
@@ -126,7 +127,7 @@ class XlaGroup(BaseGroup):
 
         @jax.jit
         def _allgather(x):
-            return jax.shard_map(
+            return shard_map(
                 lambda s: jax.lax.all_gather(s, "g", axis=0, tiled=True),
                 mesh=self.mesh, in_specs=spec, out_specs=rep, check_vma=False,
             )(x)
@@ -135,7 +136,7 @@ class XlaGroup(BaseGroup):
 
         @jax.jit
         def _reducescatter(x):
-            return jax.shard_map(
+            return shard_map(
                 lambda s: jax.lax.psum_scatter(s, "g", scatter_dimension=0, tiled=True),
                 mesh=self.mesh, in_specs=rep, out_specs=spec, check_vma=False,
             )(x)

@@ -187,6 +187,8 @@ class DashboardServer:
             ("GET", "/api/timeline/full"): self._timeline_full,
             # per-device HBM telemetry aggregated from pushed metrics
             ("GET", "/api/devices"): self._devices,
+            # KV-cache plane rollup (prefix hits, block pool, TTFT)
+            ("GET", "/api/kvcache"): self._kvcache,
             ("GET", "/metrics"): self._metrics,
             # browser UI (role of the React frontend, dashboard/client/ —
             # here a dependency-free single page over the same REST API)
@@ -235,6 +237,11 @@ class DashboardServer:
 
         return 200, device_rows(self._metric_payloads()), None
 
+    def _kvcache(self, body):
+        from ..util.metrics import kvcache_summary
+
+        return 200, kvcache_summary(self._metric_payloads()), None
+
     def _metrics(self, body):
         from ..util.metrics import render_prometheus
 
@@ -274,6 +281,7 @@ _INDEX_HTML = """<!doctype html>
 <h2>Utilization</h2><div id="sparklines"></div>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Devices (HBM)</h2><table id="devices"></table>
+<h2>KV cache</h2><table id="kvcache"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Placement groups</h2><table id="pgs"></table>
 <h2>Jobs</h2><table id="jobs"></table>
@@ -380,6 +388,15 @@ async function refresh() {
       hbm_used_mb: (d.used / 1048576).toFixed(1),
       hbm_limit_mb: (d.limit / 1048576).toFixed(1),
     })), ["node", "device", "kind", "hbm_used_mb", "hbm_limit_mb"]);
+    const kv = await j("/api/kvcache");
+    const ttft = kv.ttft_ms || {};
+    const fmtTtft = t => t ? (t.mean_ms ?? 0).toFixed(1) + "ms x" + t.count : "-";
+    fill("kvcache", [{
+      hit_tokens: kv.prefix_hit_tokens, computed_tokens: kv.prefill_tokens_computed,
+      blocks: kv.blocks_in_use + " / " + kv.blocks_capacity,
+      evictions: kv.evictions, blocked: kv.admission_blocked,
+      ttft_hit: fmtTtft(ttft.hit), ttft_miss: fmtTtft(ttft.miss),
+    }], ["hit_tokens", "computed_tokens", "blocks", "evictions", "blocked", "ttft_hit", "ttft_miss"]);
     const actors = await j("/api/actors");
     fill("actors", actors.map(a => ({
       id: (a.actor_id || "").slice(0, 12),

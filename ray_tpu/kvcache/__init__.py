@@ -1,0 +1,32 @@
+"""KV-cache plane: paged, prefix-reusing HBM cache for LLM serving.
+
+The dense per-engine KV pool (``num_slots x max_seq_len``) reserves HBM for
+the worst case and re-prefills identical system prompts on every request.
+This package replaces it with the subsystem the serving path was missing
+(reference analogues: vLLM's BlockSpaceManager + prefix caching, and the
+TPU-serving observation that KV capacity and prefill reuse dominate served
+throughput/TTFT):
+
+- :mod:`.block_allocator` — refcounted fixed-size block pool with
+  copy-on-write semantics and free-list accounting (pure bookkeeping; it
+  never touches device memory, so it is unit-testable without jax).
+- :mod:`.prefix_index` — token-radix tree mapping prompt prefixes (at
+  block granularity) to block chains, with LRU eviction of unreferenced
+  leaves.
+- :mod:`.manager` — :class:`KVCacheManager`, the device-facing façade: it
+  owns the pooled HBM arrays, serves longest-prefix matches, assembles
+  cached blocks into a slot row with a bounded set of jitted gather
+  programs, commits new blocks after prefill/decode, and gates admission
+  on free blocks (backpressure instead of OOM).
+"""
+
+from .block_allocator import BlockAllocator
+from .manager import KVCacheLease, KVCacheManager
+from .prefix_index import PrefixIndex
+
+__all__ = [
+    "BlockAllocator",
+    "KVCacheLease",
+    "KVCacheManager",
+    "PrefixIndex",
+]

@@ -1,0 +1,402 @@
+"""KVCacheManager: paged HBM KV pool + prefix reuse behind a lease API.
+
+The manager owns the pooled device arrays (one ``(num_blocks, ...,
+block_size, head_dim)`` array per KV leaf of the model's cache pytree) and
+wires the logical halves together: the refcounted
+:class:`~ray_tpu.kvcache.block_allocator.BlockAllocator` and the
+:class:`~ray_tpu.kvcache.prefix_index.PrefixIndex` radix tree. The engine
+talks to it through four calls:
+
+- ``acquire(token_ids)`` — longest-prefix match + admission gate. Matched
+  blocks are pinned and the blocks the prompt will need are *reserved*
+  up front (evicting LRU leaves as needed); if the pool cannot cover the
+  prompt, every ref is rolled back and ``None`` is returned so the engine
+  keeps the request pending — backpressure instead of OOM.
+- ``assemble(lease)`` — gather the matched block chain into a dense slot
+  row (jitted gather; one compiled program per block-count bucket, so XLA
+  sees a bounded program set) with the cache write position set to the
+  cached length; the engine then prefills only the uncached suffix.
+- ``commit(lease, token_ids, cache_row)`` — slice full blocks out of a
+  prefetched/decoded row into reserved pool blocks (jitted
+  ``dynamic_update_slice``; block id and token offset are traced scalars,
+  so it is ONE program) and insert them into the radix tree.
+- ``release(lease)`` — drop the request's pins; blocks whose only
+  remaining reference is the index become LRU-evictable.
+
+Blocks in the index are immutable — only *full* blocks are ever committed,
+so shared prefixes never see partial writes. ``update_block`` exposes the
+copy-on-write path (shared block -> fresh copy) for callers that do mutate
+per-request state in place.
+
+Everything here assumes the flax decode-cache layout of models/llama.py:
+KV leaves are ``(1, ..., max_seq_len, head_dim)`` with the sequence axis at
+-2, and every other cache leaf is a write-position index filled with the
+cached token count at assembly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .block_allocator import BlockAllocator
+from .prefix_index import PrefixIndex
+
+
+@dataclasses.dataclass
+class KVCacheLease:
+    """One request's claim on the pool: matched chain + reserved blocks."""
+
+    num_cached_tokens: int
+    block_ids: List[int]  # matched prefix chain, root-to-leaf order
+    reserved: List[int]  # pre-allocated for the prompt's uncached blocks
+    pinned: List[int]  # every block this lease holds a reference on
+    cacheable: bool = True  # False: prompt exceeds pool, serve hits only
+    closed: bool = False
+
+
+class KVCacheManager:
+    def __init__(self, num_blocks: int, block_size: int = 32):
+        if block_size <= 0:
+            raise ValueError(f"block_size must be positive, got {block_size}")
+        self._block_size = int(block_size)
+        self._alloc = BlockAllocator(num_blocks)
+        self._index = PrefixIndex(self._block_size, self._alloc)
+        # device state, lazily shaped from the first committed cache row
+        self._pools: Optional[List[jax.Array]] = None
+        self._treedef = None
+        self._leaf_meta: List[tuple] = []  # (is_kv, shape, dtype) per leaf
+        self._max_seq_len = 0
+        self._assemble_fns: Dict[int, Any] = {}  # block count -> jitted gather
+        self._jit_commit = None
+        self._jit_copy = None
+        self._stats: Dict[str, int] = {
+            "requests": 0,
+            "hits": 0,
+            "misses": 0,
+            "prefix_hit_tokens": 0,
+            "prefill_tokens_computed": 0,
+            "admission_blocked": 0,
+        }
+
+    # -- accounting ----------------------------------------------------------
+
+    @property
+    def block_size(self) -> int:
+        return self._block_size
+
+    @property
+    def capacity(self) -> int:
+        return self._alloc.capacity
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self._alloc.num_allocated
+
+    def stats(self) -> Dict[str, int]:
+        out = dict(self._stats)
+        out.update(
+            capacity=self._alloc.capacity,
+            block_size=self._block_size,
+            blocks_in_use=self._alloc.num_allocated,
+            blocks_free=self._alloc.num_free,
+            evictions=self._index.num_evictions,
+            index_nodes=self._index.num_nodes,
+        )
+        return out
+
+    # -- lease lifecycle -----------------------------------------------------
+
+    def acquire(self, token_ids: Sequence[int]) -> Optional[KVCacheLease]:
+        """Match + admission gate. None == not enough blocks: the caller
+        must keep the request queued and retry after a release."""
+        plen = len(token_ids)
+        # never match the whole prompt: at least one token must be
+        # prefilled to produce the first-token logits
+        max_blocks = (plen - 1) // self._block_size if plen else 0
+        matched = self._index.match(token_ids, max_blocks)
+        lease = KVCacheLease(
+            num_cached_tokens=len(matched) * self._block_size,
+            block_ids=[n.block_id for n in matched],
+            reserved=[],
+            pinned=[],
+        )
+        for node in matched:
+            self._alloc.ref(node.block_id)
+            lease.pinned.append(node.block_id)
+        needed = plen // self._block_size - len(matched)
+        if needed > self._alloc.capacity - len(matched):
+            # the prompt can never fit alongside its own matched chain:
+            # degrade to an uncacheable lease (hits still served) rather
+            # than deadlocking admission forever
+            lease.cacheable = False
+            return lease
+        for _ in range(needed):
+            bid = self._allocate_or_evict()
+            if bid is None:
+                self.release(lease)
+                self._stats["admission_blocked"] += 1
+                self._record_blocked()
+                return None
+            lease.reserved.append(bid)
+        return lease
+
+    def release(self, lease: KVCacheLease) -> None:
+        """Drop every reference the lease holds (idempotent)."""
+        if lease.closed:
+            return
+        lease.closed = True
+        for bid in lease.pinned:
+            self._alloc.release(bid)
+        for bid in lease.reserved:
+            self._alloc.release(bid)
+        lease.pinned = []
+        lease.reserved = []
+        self._update_gauges()
+
+    # -- device state --------------------------------------------------------
+
+    def initialize(self, cache_row) -> None:
+        """Shape the block pools from a solo cache row (no-op after the
+        first call). KV leaves (ndim >= 3, sequence axis -2) get a pooled
+        array; every other leaf is treated as a write-position index."""
+        if self._pools is not None:
+            return
+        leaves, treedef = jax.tree_util.tree_flatten(cache_row)
+        self._treedef = treedef
+        self._leaf_meta = [
+            (l.ndim >= 3, tuple(l.shape), l.dtype) for l in leaves
+        ]
+        seq_lens = {s[-2] for kv, s, _ in self._leaf_meta if kv}
+        if len(seq_lens) != 1:
+            raise ValueError(f"inconsistent cache sequence axes: {seq_lens}")
+        self._max_seq_len = seq_lens.pop()
+        if self._max_seq_len < self._block_size:
+            raise ValueError(
+                f"block_size {self._block_size} exceeds max_seq_len "
+                f"{self._max_seq_len}"
+            )
+        self._pools = [
+            jnp.zeros(
+                (self._alloc.capacity,)
+                + shape[1:-2]
+                + (self._block_size, shape[-1]),
+                dtype,
+            )
+            for kv, shape, dtype in self._leaf_meta
+            if kv
+        ]
+        bs = self._block_size
+
+        def commit_impl(pools, kv_row, bid, off):
+            out = []
+            for p, r in zip(pools, kv_row):
+                blk = jax.lax.dynamic_slice_in_dim(r[0], off, bs, axis=-2)
+                out.append(
+                    jax.lax.dynamic_update_index_in_dim(p, blk, bid, axis=0)
+                )
+            return out
+
+        def copy_impl(pools, src, dst):
+            return [
+                jax.lax.dynamic_update_index_in_dim(
+                    p,
+                    jax.lax.dynamic_index_in_dim(
+                        p, src, axis=0, keepdims=False
+                    ),
+                    dst,
+                    axis=0,
+                )
+                for p in pools
+            ]
+
+        # block id / token offset are traced scalars: ONE compiled program
+        # each, reused for every commit and COW copy
+        self._jit_commit = jax.jit(commit_impl, donate_argnums=(0,))
+        self._jit_copy = jax.jit(copy_impl, donate_argnums=(0,))
+
+    def assemble(self, lease: KVCacheLease):
+        """Gather the lease's matched chain into a dense (1, ..., S, d)
+        cache row whose write position is the cached token count — ready
+        for the engine to decode the uncached suffix into."""
+        if self._pools is None:
+            raise RuntimeError("assemble() before any commit")
+        n = len(lease.block_ids)
+        if n == 0:
+            raise ValueError("assemble() on a lease with no cached blocks")
+        fn = self._assemble_fns.get(n)
+        if fn is None:
+            fn = self._make_assemble(n)
+            self._assemble_fns[n] = fn
+        kv_out = list(fn(self._pools, jnp.asarray(lease.block_ids, jnp.int32)))
+        leaves = []
+        for kv, shape, dtype in self._leaf_meta:
+            if kv:
+                leaves.append(kv_out.pop(0))
+            else:
+                leaves.append(jnp.full(shape, lease.num_cached_tokens, dtype))
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def _make_assemble(self, n: int):
+        bs = self._block_size
+        seq_len = self._max_seq_len
+
+        def impl(pools, bids):
+            out = []
+            for p in pools:
+                g = jnp.take(p, bids, axis=0)  # (n, ..., bs, d)
+                g = jnp.moveaxis(g, 0, -3)  # (..., n, bs, d)
+                g = g.reshape(g.shape[:-3] + (n * bs, g.shape[-1]))
+                pad = [(0, 0)] * (g.ndim - 2) + [(0, seq_len - n * bs), (0, 0)]
+                out.append(jnp.pad(g, pad)[None])  # (1, ..., S, d)
+            return out
+
+        return jax.jit(impl)
+
+    # -- commit --------------------------------------------------------------
+
+    def commit(
+        self,
+        lease: KVCacheLease,
+        token_ids: Sequence[int],
+        cache_row,
+        pin: bool = True,
+    ) -> int:
+        """Walk/extend the radix tree with every full block of
+        ``token_ids``, copying missing blocks out of ``cache_row`` (whose
+        K/V must cover the sequence). Reserved blocks are consumed first;
+        past the reservation (decode tail at retire) allocation is
+        best-effort — on exhaustion the tail simply is not cached. With
+        ``pin``, blocks touched are pinned into the lease so they survive
+        until release. Returns the number of newly committed blocks."""
+        if lease.cacheable is False:
+            return 0
+        self.initialize(cache_row)
+        kv_row = [
+            leaf
+            for leaf, (kv, _, _) in zip(
+                jax.tree_util.tree_leaves(cache_row), self._leaf_meta
+            )
+            if kv
+        ]
+        committed = 0
+        node = self._index.root
+        for i in range(len(token_ids) // self._block_size):
+            key = tuple(
+                int(t)
+                for t in token_ids[
+                    i * self._block_size : (i + 1) * self._block_size
+                ]
+            )
+            child = self._index.child(node, key)
+            if child is None:
+                if lease.reserved:
+                    bid = lease.reserved.pop(0)
+                else:
+                    bid = self._allocate_or_evict()
+                    if bid is None:
+                        break
+                self._write_block(bid, kv_row, i * self._block_size)
+                child = self._index.insert_child(node, key, bid)
+                committed += 1
+                if pin:
+                    lease.pinned.append(bid)  # reservation ref becomes pin
+                else:
+                    self._alloc.release(bid)
+            else:
+                self._index.touch(child)
+                if pin and child.block_id not in lease.pinned:
+                    self._alloc.ref(child.block_id)
+                    lease.pinned.append(child.block_id)
+            node = child
+        self._update_gauges()
+        return committed
+
+    def update_block(self, block_id: int, cache_row, tok_offset: int):
+        """Overwrite one block from ``cache_row`` at ``tok_offset``,
+        copy-on-write when the block is shared. The caller must own a
+        reference on ``block_id``; that reference moves to the returned
+        block id. None == pool exhausted mid-COW."""
+        new_id = self._alloc.copy_on_write(block_id, copy_fn=self._copy_block)
+        if new_id is None:
+            return None
+        kv_row = [
+            leaf
+            for leaf, (kv, _, _) in zip(
+                jax.tree_util.tree_leaves(cache_row), self._leaf_meta
+            )
+            if kv
+        ]
+        self._write_block(new_id, kv_row, tok_offset)
+        return new_id
+
+    def _write_block(self, bid: int, kv_row, tok_offset: int) -> None:
+        self._pools = list(
+            self._jit_commit(
+                self._pools,
+                kv_row,
+                jnp.asarray(bid, jnp.int32),
+                jnp.asarray(tok_offset, jnp.int32),
+            )
+        )
+
+    def _copy_block(self, src: int, dst: int) -> None:
+        self._pools = list(
+            self._jit_copy(
+                self._pools,
+                jnp.asarray(src, jnp.int32),
+                jnp.asarray(dst, jnp.int32),
+            )
+        )
+
+    def _allocate_or_evict(self) -> Optional[int]:
+        bid = self._alloc.allocate()
+        while bid is None:
+            if not self._index.evict_lru(1):
+                return None
+            self._record_eviction(1)
+            bid = self._alloc.allocate()
+        return bid
+
+    # -- metrics -------------------------------------------------------------
+
+    def record_prefill(self, hit_tokens: int, computed_tokens: int) -> None:
+        """Called by the engine after each admission prefill."""
+        self._stats["requests"] += 1
+        self._stats["hits" if hit_tokens else "misses"] += 1
+        self._stats["prefix_hit_tokens"] += hit_tokens
+        self._stats["prefill_tokens_computed"] += computed_tokens
+        try:
+            from ..util.metrics import record_kvcache_prefill
+
+            record_kvcache_prefill(hit_tokens, computed_tokens)
+        except Exception:
+            pass
+        self._update_gauges()
+
+    def _record_blocked(self) -> None:
+        try:
+            from ..util.metrics import record_kvcache_blocked
+
+            record_kvcache_blocked()
+        except Exception:
+            pass
+
+    def _record_eviction(self, n: int) -> None:
+        try:
+            from ..util.metrics import record_kvcache_eviction
+
+            record_kvcache_eviction(n)
+        except Exception:
+            pass
+
+    def _update_gauges(self) -> None:
+        try:
+            from ..util.metrics import set_kvcache_blocks
+
+            set_kvcache_blocks(self._alloc.num_allocated, self._alloc.capacity)
+        except Exception:
+            pass

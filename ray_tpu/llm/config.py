@@ -37,7 +37,19 @@ class LLMConfig:
     # generation defaults
     max_new_tokens: int = 64
     temperature: float = 0.0  # 0 = greedy
-    seed: int = 0
+    # sampling seed: None (default) = fresh per replica process, so
+    # temperature>0 replicas don't emit identical streams; set an int for
+    # reproducible sampling
+    seed: Optional[int] = None
+    # paged KV cache (ray_tpu.kvcache): when kv_cache_blocks is set, each
+    # replica runs a ContinuousBatchingEngine over a block pool of that
+    # many kv_block_size-token blocks with prefix reuse and memory-gated
+    # admission; None keeps the dense grouped-batch engine
+    kv_cache_blocks: Optional[int] = None
+    kv_block_size: int = 32
+    # leading prompt tokens hashed for prefix-affinity replica routing
+    # (serve handle pow2 bias); 0 disables
+    prefix_affinity_tokens: int = 16
 
     def build_model_config(self):
         if self.model_family == "llama":

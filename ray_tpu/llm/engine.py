@@ -23,11 +23,31 @@ Greedy and temperature sampling; per-request max_new_tokens.
 from __future__ import annotations
 
 import dataclasses
+import os
+import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _resolve_seed(seed: Optional[int]) -> int:
+    """Per-process default: replicas sampling at temperature > 0 must not
+    emit identical streams, which a fixed PRNGKey(0) guarantees."""
+    if seed is not None:
+        return int(seed)
+    return int.from_bytes(os.urandom(4), "little")
+
+
+def _record_ttft(seconds: float, hit: bool) -> None:
+    try:
+        from ..util.metrics import record_kvcache_ttft
+
+        record_kvcache_ttft(seconds, hit)
+    except Exception:
+        pass
 
 
 @dataclasses.dataclass
@@ -90,9 +110,17 @@ class _DecodeModelBase:
 
 
 class LLMEngine(_DecodeModelBase):
-    def __init__(self, model_config, params, mesh=None, max_batch_size: int = 8):
+    def __init__(
+        self,
+        model_config,
+        params,
+        mesh=None,
+        max_batch_size: int = 8,
+        seed: Optional[int] = None,
+    ):
         super().__init__(model_config, params, mesh)
         self._max_batch = max_batch_size
+        self._rng = jax.random.PRNGKey(_resolve_seed(seed))
 
     # -- generation ----------------------------------------------------------
 
@@ -128,7 +156,7 @@ class LLMEngine(_DecodeModelBase):
         )  # (b, plen), no padding by construction
 
         logits, cache = self._prefill(self._params, jnp.asarray(tokens))
-        rng = jax.random.PRNGKey(0)
+        rng = self._rng
         generated: List[List[int]] = [[] for _ in range(b)]
         finished = [False] * b
         reasons = ["length"] * b
@@ -193,7 +221,7 @@ class LLMEngine(_DecodeModelBase):
             return
         tokens = np.asarray([request.token_ids], np.int32)
         logits, cache = self._prefill(self._params, jnp.asarray(tokens))
-        rng = jax.random.PRNGKey(0)
+        rng = self._rng
         generated: List[int] = []
         reason = "length"
         last = self._sample_step(logits, request, rng, 0)
@@ -231,6 +259,7 @@ class _Slot:
     request: GenerationRequest
     generated: List[int]
     last_token: int
+    lease: Any = None  # KVCacheLease when the engine runs paged
 
 
 class ContinuousBatchingEngine(_DecodeModelBase):
@@ -247,15 +276,41 @@ class ContinuousBatchingEngine(_DecodeModelBase):
     decode program + one prefill program per prompt-length bucket.
     """
 
-    def __init__(self, model_config, params, mesh=None, num_slots: int = 8):
+    def __init__(
+        self,
+        model_config,
+        params,
+        mesh=None,
+        num_slots: int = 8,
+        kv_cache=None,
+        seed: Optional[int] = None,
+    ):
         super().__init__(model_config, params, mesh)
         self._num_slots = num_slots
         self._slots: Dict[int, _Slot] = {}  # slot index -> active request
         self._pending: List[tuple] = []  # (request_id, GenerationRequest)
         self._next_id = 0
-        self._rng = jax.random.PRNGKey(0)
+        self._rng = jax.random.PRNGKey(_resolve_seed(seed))
         self._step_count = 0
         self._cache = None  # pooled cache, allocated on first prefill
+        # paged prefix cache (ray_tpu.kvcache.KVCacheManager) or None for
+        # the dense per-slot pool; with a manager, _admit serves the
+        # longest cached prefix, prefills only the suffix, and blocks
+        # admission when the pool is out of blocks (backpressure, not OOM)
+        self._kv = kv_cache
+        # serve replicas call sync methods from a thread pool: every public
+        # entry point serializes on this (reentrant: step() inside generate)
+        self._lock = threading.RLock()
+        # results finished by another thread's step() land here until the
+        # owning generate()/generate_stream() call collects them
+        self._finished_buf: Dict[int, GenerationResult] = {}
+        self._enqueue_ts: Dict[int, float] = {}  # rid -> monotonic, for TTFT
+        # slot-row readback for retire-time commits (si is traced: 1 program)
+        self._extract_row = jax.jit(
+            lambda pool, si: jax.tree.map(
+                lambda p: jax.lax.dynamic_slice_in_dim(p, si, 1, axis=0), pool
+            )
+        )
         # donated in-place row insert: one compiled program for every slot
         # (si is a traced scalar), no full-pool copy per admission
         self._insert_row = jax.jit(
@@ -274,9 +329,11 @@ class ContinuousBatchingEngine(_DecodeModelBase):
     def add_request(self, request: GenerationRequest) -> int:
         if len(request.token_ids) + request.max_new_tokens > self._cfg.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
-        rid = self._next_id
-        self._next_id += 1
-        self._pending.append((rid, request))
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._pending.append((rid, request))
+            self._enqueue_ts[rid] = time.monotonic()
         return rid
 
     @property
@@ -287,6 +344,10 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         """One engine iteration: admit pending requests into free slots
         (prefill), decode one token for every occupied slot, retire finished
         requests. Returns [(request_id, GenerationResult)] finished now."""
+        with self._lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> List[tuple]:
         finished: List[tuple] = self._admit()
         if not self._slots:
             return finished
@@ -315,8 +376,24 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                     finished_reason="eos" if done_eos else "length",
                 )
                 finished.append((slot.request_id, result))
-                del self._slots[si]  # slot is free for the next admit
+                self._retire_slot(si)
         return finished
+
+    def _retire_slot(self, si: int) -> None:
+        """Free the slot; with a KV manager, first commit the sequence's
+        full blocks (prompt + generated tail) so a follow-up request
+        sharing the prefix hits, then release the lease's pins."""
+        slot = self._slots.pop(si)
+        if self._kv is None or slot.lease is None:
+            return
+        req = slot.request
+        # K/V exists for prompt + generated[:-1]: the final sampled token
+        # was never fed back through the model
+        tokens = list(req.token_ids) + slot.generated[:-1]
+        if len(tokens) // self._kv.block_size > len(req.token_ids) // self._kv.block_size:
+            row = self._extract_row(self._cache, jnp.asarray(si, jnp.int32))
+            self._kv.commit(slot.lease, tokens, row, pin=False)
+        self._kv.release(slot.lease)
 
     def run_until_complete(self) -> Dict[int, GenerationResult]:
         """Drain every queued request; returns request_id -> result.
@@ -324,24 +401,100 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         the engine keeps NO finished-result state (a serving loop would leak
         otherwise)."""
         out: Dict[int, GenerationResult] = {}
-        while self.num_active:
-            for rid, result in self.step():
-                out[rid] = result
+        with self._lock:
+            while self.num_active:
+                for rid, result in self._step_locked():
+                    out[rid] = result
         return out
+
+    def generate(
+        self, requests: List[GenerationRequest]
+    ) -> List[GenerationResult]:
+        """Batch API matching LLMEngine.generate: enqueue every request,
+        step the shared pool until all of them finish. Safe to call from
+        several threads at once — each caller steps under the engine lock
+        and results for other callers' requests are parked in a shared
+        buffer until their owner collects them."""
+        rids = [self.add_request(r) for r in requests]
+        want = set(rids)
+        out: Dict[int, GenerationResult] = {}
+        while len(out) < len(want):
+            with self._lock:
+                for rid in want:
+                    if rid in self._finished_buf:
+                        out[rid] = self._finished_buf.pop(rid)
+                if len(out) >= len(want):
+                    break
+                for frid, res in self._step_locked():
+                    if frid in want:
+                        out[frid] = res
+                    else:
+                        self._finished_buf[frid] = res
+        return [out[rid] for rid in rids]
+
+    def generate_stream(self, request: GenerationRequest):
+        """Streaming API matching LLMEngine.generate_stream: yields each
+        token of ONE request as the shared pool produces it, then the
+        final GenerationResult. Other requests keep decoding in the same
+        steps — this is what makes replica streaming continuous-batched."""
+        rid = self.add_request(request)
+        emitted = 0
+        final: Optional[GenerationResult] = None
+        while True:
+            with self._lock:
+                if rid in self._finished_buf:
+                    final = self._finished_buf.pop(rid)
+                if final is None:
+                    for frid, res in self._step_locked():
+                        if frid == rid:
+                            final = res
+                        else:
+                            self._finished_buf[frid] = res
+                if final is None:
+                    slot = next(
+                        (
+                            s
+                            for s in self._slots.values()
+                            if s.request_id == rid
+                        ),
+                        None,
+                    )
+                    new_tokens = list(slot.generated[emitted:]) if slot else []
+                else:
+                    new_tokens = list(final.token_ids[emitted:])
+            for tok in new_tokens:  # yield outside the lock
+                yield tok
+            emitted += len(new_tokens)
+            if final is not None:
+                yield final
+                return
 
     # -- internals -----------------------------------------------------------
 
     def _admit(self) -> List[tuple]:
         """Prefill pending requests into free slots; returns the (rare)
         requests that finish AT admission (eos on the first token, or
-        max_new_tokens == 1) so step() reports every finish."""
+        max_new_tokens == 1) so step() reports every finish.
+
+        With a KV manager the admission is memory-aware: the request first
+        acquires a lease (longest cached prefix + reserved blocks for the
+        rest of the prompt). A None lease means the pool is exhausted — the
+        request goes back to the HEAD of the pending queue and admission
+        stops, preserving FIFO order, until a retiring request releases
+        blocks. Cached prefixes are gathered into the slot row and only the
+        uncached suffix is prefilled."""
         finished: List[tuple] = []
         free = [i for i in range(self._num_slots) if i not in self._slots]
         while free and self._pending:
             si = free.pop(0)
             rid, req = self._pending.pop(0)
-            tokens = jnp.asarray([req.token_ids], jnp.int32)
-            logits, solo_cache = self._prefill(self._params, tokens)
+            lease = None
+            if self._kv is not None:
+                lease = self._kv.acquire(req.token_ids)
+                if lease is None:  # backpressure: wait for a release
+                    self._pending.insert(0, (rid, req))
+                    break
+            logits, solo_cache = self._prefill_leased(req, lease)
             first = int(
                 self._sample_tokens(
                     logits,
@@ -349,6 +502,15 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                     jax.random.fold_in(self._rng, rid),
                 )[0]
             )
+            ts = self._enqueue_ts.pop(rid, None)
+            if self._kv is not None:
+                cached = lease.num_cached_tokens
+                self._kv.record_prefill(cached, len(req.token_ids) - cached)
+                if ts is not None:
+                    _record_ttft(time.monotonic() - ts, hit=cached > 0)
+                # commit the prompt's full blocks while the prefilled row
+                # is at hand; reserved blocks are consumed here
+                self._kv.commit(lease, req.token_ids, solo_cache)
             if self._cache is None:
                 self._cache = self._empty_cache(solo_cache)
             # insert the prefilled K/V row + its write position into slot si
@@ -357,7 +519,7 @@ class ContinuousBatchingEngine(_DecodeModelBase):
             )
             slot = _Slot(
                 request_id=rid, request=req, generated=[first],
-                last_token=first,
+                last_token=first, lease=lease,
             )
             req_eos = req.eos_token_id is not None and first == req.eos_token_id
             if req_eos or req.max_new_tokens <= 1:
@@ -367,10 +529,33 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                     finished_reason="eos" if req_eos else "length",
                 )
                 finished.append((rid, result))
+                if self._kv is not None:
+                    self._kv.release(lease)
                 free.insert(0, si)
                 continue
             self._slots[si] = slot
         return finished
+
+    def _prefill_leased(self, req: GenerationRequest, lease):
+        """Prefill a request, reusing the lease's cached prefix: a full
+        prefill on a miss; on a hit, gather the cached blocks into a slot
+        row and run only the uncached suffix through the decode program in
+        block-size chunks (so XLA compiles at most one program per chunk
+        length <= block_size, not one per suffix length)."""
+        tokens = req.token_ids
+        if lease is None or lease.num_cached_tokens == 0:
+            return self._prefill(
+                self._params, jnp.asarray([tokens], jnp.int32)
+            )
+        row = self._kv.assemble(lease)
+        logits = None
+        pos = lease.num_cached_tokens
+        while pos < len(tokens):
+            take = min(self._kv.block_size, len(tokens) - pos)
+            chunk = jnp.asarray([tokens[pos : pos + take]], jnp.int32)
+            logits, row = self._decode(self._params, row, chunk)
+            pos += take
+        return logits, row
 
     def _empty_cache(self, solo_cache):
         """Pooled cache with num_slots rows, shaped from a solo prefill."""

@@ -12,6 +12,14 @@ Request/response shape (token-level; bring-your-own tokenizer, or pass
   {"token_ids": [...], "max_new_tokens": 32, "temperature": 0.0}
   {"prompt": "text", ...}   (with a tokenizer configured)
 -> {"token_ids": [...], "num_prompt_tokens": N, "finished_reason": ...}
+
+With ``LLMConfig.kv_cache_blocks`` set, replicas run the paged
+prefix-reusing engine (ray_tpu.kvcache): admission is gated on free KV
+blocks and shared prompt prefixes prefill only their uncached suffix. Pair
+it with prefix-affinity routing on the caller side —
+``handle.options(prefix_affinity_tokens=cfg.prefix_affinity_tokens)`` —
+so repeated prefixes (chat sessions, shared system prompts) land on the
+replica whose pool already holds their blocks.
 """
 
 from __future__ import annotations
@@ -20,7 +28,7 @@ from typing import Any, Dict, Optional
 
 from .. import serve
 from .config import LLMConfig
-from .engine import GenerationRequest, LLMEngine
+from .engine import ContinuousBatchingEngine, GenerationRequest, LLMEngine
 
 
 class _LLMReplica:
@@ -68,10 +76,29 @@ class _LLMReplica:
             params = unbox_params(
                 init_params(model_config, jax.random.PRNGKey(0))
             )
-        self._engine = LLMEngine(
-            model_config, params, mesh,
-            max_batch_size=llm_config.max_batch_size,
-        )
+        if llm_config.kv_cache_blocks:
+            # paged prefix-reusing engine: requests stream through a slot
+            # pool over a shared KV block pool; admission is memory-gated
+            # and prompts sharing cached prefixes prefill only the suffix
+            from ..kvcache import KVCacheManager
+
+            self._kv_cache = KVCacheManager(
+                num_blocks=llm_config.kv_cache_blocks,
+                block_size=llm_config.kv_block_size,
+            )
+            self._engine = ContinuousBatchingEngine(
+                model_config, params, mesh,
+                num_slots=llm_config.max_batch_size,
+                kv_cache=self._kv_cache,
+                seed=llm_config.seed,
+            )
+        else:
+            self._kv_cache = None
+            self._engine = LLMEngine(
+                model_config, params, mesh,
+                max_batch_size=llm_config.max_batch_size,
+                seed=llm_config.seed,
+            )
         self._tokenizer = None
         if tokenizer_name:
             from transformers import AutoTokenizer
@@ -106,6 +133,13 @@ class _LLMReplica:
             "weights_version" in user_config
         ) and self._weights_sub is not None:
             self.reload_weights(user_config["weights_version"])
+
+    def kvcache_stats(self) -> Optional[Dict[str, Any]]:
+        """Replica-local KV-cache stats (None on the dense engine); routed
+        through handle.options(method_name="kvcache_stats")."""
+        if self._kv_cache is None:
+            return None
+        return self._kv_cache.stats()
 
     def weights_info(self) -> Dict[str, Any]:
         return {

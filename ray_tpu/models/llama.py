@@ -32,6 +32,7 @@ from ..ops.rmsnorm import rmsnorm
 from ..ops.rope import apply_rope, rope_table
 from ..parallel.ring_attention import ring_attention
 from ..parallel.sharding import logical_to_spec
+from .._internal.jax_compat import shard_map
 
 
 @dataclasses.dataclass(frozen=True)
@@ -227,7 +228,7 @@ class Attention(nn.Module):
             # ring attention under shard_map: batch over data axes, heads
             # over tp, sequence over sp (ICI neighbor exchanges)
             qkv_spec = P(("dcn", "dp", "fsdp"), "tp", "sp", None)
-            attn = jax.shard_map(
+            attn = shard_map(
                 partial(ring_attention, axis_name="sp"),
                 mesh=self.mesh,
                 in_specs=(qkv_spec, qkv_spec, qkv_spec),

@@ -84,11 +84,20 @@ def _rmsnorm_sharded(x2, w, eps):
     return _rmsnorm_fwd_impl(x2, w, eps, block_rows=256)
 
 
-_rmsnorm_sharded.def_partition(
-    partition=_rmsnorm_partition,
-    infer_sharding_from_operands=_rmsnorm_infer_sharding,
-    sharding_rule="i j, j -> i j",
-)
+try:
+    _rmsnorm_sharded.def_partition(
+        partition=_rmsnorm_partition,
+        infer_sharding_from_operands=_rmsnorm_infer_sharding,
+        sharding_rule="i j, j -> i j",
+    )
+except TypeError:
+    # older jax: custom_partitioning predates the Shardy sharding_rule
+    # kwarg — register the GSPMD callbacks alone rather than failing the
+    # import (which took the whole llama/llm stack down with it)
+    _rmsnorm_sharded.def_partition(
+        partition=_rmsnorm_partition,
+        infer_sharding_from_operands=_rmsnorm_infer_sharding,
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

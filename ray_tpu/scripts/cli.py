@@ -222,6 +222,18 @@ def cmd_metrics(args):
     return 0
 
 
+def cmd_kvcache(args):
+    """`ray_tpu kvcache`: cluster-wide KV-cache plane stats — prefix-hit
+    vs computed prefill tokens, block pool occupancy, evictions,
+    admission backpressure, and TTFT by hit/miss (state API rollup of the
+    `kvcache_*` metrics every paged engine pushes)."""
+    _connected(args)
+    from ..util import state
+
+    print(json.dumps(state.metrics_summary()["kvcache"], indent=2, default=str))
+    return 0
+
+
 def cmd_timeline(args):
     """`ray_tpu timeline`: export the cluster-wide chrome trace — GCS
     task-state bars merged with every traced node's spans (reference:
@@ -332,6 +344,12 @@ def main(argv=None):
         help="aggregated collective/step/HBM JSON instead of raw exposition",
     )
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "kvcache", help="KV-cache plane stats (prefix hits, blocks, TTFT)"
+    )
+    p.add_argument("--address", required=True, help="head host:port")
+    p.set_defaults(fn=cmd_kvcache)
 
     p = sub.add_parser(
         "timeline", help="export the cluster chrome trace (ray timeline)"

@@ -5,6 +5,14 @@ individual async calls accumulate into a list; the wrapped callable runs once
 per batch (``async def fn(self, items: List)`` -> list of results, one per
 caller) when the batch fills or the wait timeout fires. On TPU replicas this
 is the lever that turns single requests into MXU-sized batches.
+
+Batching composes with @serve.multiplexed: the pending queue is PARTITIONED
+by the caller's multiplexed model id, so one flush never mixes requests for
+different models, and the batch task re-enters the model-id context before
+running the handler — ``get_multiplexed_model_id()`` inside the batch
+function returns the batch's model id, not "" (the handler runs in a fresh
+task, outside every caller's contextvar scope, so it must be restored
+explicitly).
 """
 
 from __future__ import annotations
@@ -12,7 +20,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import inspect
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 
 class _BatchQueue:
@@ -20,34 +28,48 @@ class _BatchQueue:
         self._fn = fn
         self._max = max_batch_size
         self._wait_s = wait_s
-        self._pending: List[tuple] = []  # (item, future)
-        self._timer: Optional[asyncio.TimerHandle] = None
+        # model id -> [(item, future)]: per-model queues so a flush is
+        # always single-model (the "" partition is the unmultiplexed path)
+        self._pending: Dict[str, List[tuple]] = {}
+        self._timers: Dict[str, asyncio.TimerHandle] = {}
         # strong refs: the loop only weakly references tasks, and a collected
         # batch task would strand every caller future in it
         self._tasks: set = set()
 
     async def submit(self, item: Any):
+        from .multiplex import get_multiplexed_model_id
+
+        model_id = get_multiplexed_model_id()
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._pending.append((item, fut))
-        if len(self._pending) >= self._max:
-            self._flush()
-        elif self._timer is None:
-            self._timer = loop.call_later(self._wait_s, self._flush)
+        pending = self._pending.setdefault(model_id, [])
+        pending.append((item, fut))
+        if len(pending) >= self._max:
+            self._flush(model_id)
+        elif model_id not in self._timers:
+            self._timers[model_id] = loop.call_later(
+                self._wait_s, self._flush, model_id
+            )
         return await fut
 
-    def _flush(self):
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
-        if not self._pending:
+    def _flush(self, model_id: str):
+        timer = self._timers.pop(model_id, None)
+        if timer is not None:
+            timer.cancel()
+        batch = self._pending.pop(model_id, None)
+        if not batch:
             return
-        batch, self._pending = self._pending, []
-        task = asyncio.ensure_future(self._run(batch))
+        task = asyncio.ensure_future(self._run(batch, model_id))
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
-    async def _run(self, batch: List[tuple]):
+    async def _run(self, batch: List[tuple], model_id: str):
+        from .multiplex import _set_multiplexed_model_id
+
+        # this task copied whatever context ensure_future saw at flush time
+        # (a timer callback or one arbitrary caller) — pin the batch's model
+        # id so the handler's get_multiplexed_model_id()/get_model() work
+        _set_multiplexed_model_id(model_id)
         items = [item for item, _f in batch]
         try:
             results = await self._fn(items)

@@ -14,9 +14,33 @@ from __future__ import annotations
 import random
 import threading
 import time
+import zlib
 from typing import Any, Dict, Optional
 
 from .. import api
+
+
+def _prefix_affinity_key(args, kwargs, num_tokens: int) -> Optional[int]:
+    """Stable hash of a request's leading prompt tokens, for cache-affine
+    routing. Looks for the serving request dict convention ({"token_ids":
+    ...} or {"prompt": ...}) in the call args; hashes the first
+    ``num_tokens`` token ids (or 4x that many prompt characters — a rough
+    token-length proxy). zlib.crc32, NOT hash(): the key must agree across
+    processes and PYTHONHASHSEED randomizes str/bytes hashing per-process."""
+    for value in list(args) + list(kwargs.values()):
+        if not isinstance(value, dict):
+            continue
+        token_ids = value.get("token_ids")
+        if token_ids is not None:
+            try:
+                head = ",".join(str(int(t)) for t in list(token_ids)[:num_tokens])
+            except (TypeError, ValueError):
+                continue
+            return zlib.crc32(head.encode())
+        prompt = value.get("prompt")
+        if isinstance(prompt, str):
+            return zlib.crc32(prompt[: 4 * num_tokens].encode())
+    return None
 
 
 class DeploymentResponse:
@@ -88,8 +112,18 @@ class Router:
             self._table = table
             self._last_refresh = now
 
-    def pick(self, deployment: str):
-        """Power-of-two-choices on reported queue length."""
+    # an affine replica keeps winning until its queue runs this many
+    # requests longer than the random alternative's — cache reuse is worth
+    # a little imbalance, but not a hot spot
+    _AFFINITY_SLACK = 2
+
+    def pick(self, deployment: str, affinity: Optional[int] = None):
+        """Power-of-two-choices on reported queue length. With an
+        ``affinity`` key (hash of the request's prompt prefix), the pick is
+        biased: one candidate is always the key's preferred replica, which
+        wins unless its queue is more than _AFFINITY_SLACK behind — so
+        repeated prefixes land where their KV blocks already live, and
+        overload still spills to the rest of the fleet."""
         self._refresh()
         deadline = time.time() + 30
         while True:
@@ -106,6 +140,17 @@ class Router:
             self._refresh(force=True)
         if len(replicas) == 1:
             return replicas[0][1]
+        if affinity is not None:
+            # replica ids sorted so every process maps the key to the SAME
+            # preferred replica regardless of table ordering
+            ordered = sorted(replicas, key=lambda r: str(r[0]))
+            preferred = ordered[affinity % len(ordered)]
+            other = random.choice(
+                [r for r in ordered if r is not preferred]
+            )
+            if preferred[2] <= other[2] + self._AFFINITY_SLACK:
+                return preferred[1]
+            return other[1]
         # two random candidates, shorter controller-reported queue wins;
         # round-robin counter breaks ties so equal queues still spread
         a, b = random.sample(replicas, 2)
@@ -119,13 +164,18 @@ class Router:
 class DeploymentHandle:
     def __init__(self, controller, app_name: str, deployment: str,
                  method: str = "__call__", multiplexed_model_id: str = "",
-                 stream: bool = False, _router: Optional[list] = None):
+                 stream: bool = False, prefix_affinity_tokens: int = 0,
+                 _router: Optional[list] = None):
         self._controller = controller
         self._app_name = app_name
         self._deployment = deployment
         self._method = method
         self._multiplexed_model_id = multiplexed_model_id
         self._stream = stream
+        # > 0: hash this many leading prompt tokens of each request and
+        # bias replica picking toward the hash's replica (prefix-cache
+        # affinity); 0 disables
+        self._prefix_affinity_tokens = prefix_affinity_tokens
         # the router depends only on (controller, app_name), both immutable
         # across options()/method handles — a shared mutable holder means
         # whichever handle first routes a request creates the Router and all
@@ -134,7 +184,8 @@ class DeploymentHandle:
 
     def options(self, *, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                prefix_affinity_tokens: Optional[int] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self._controller,
             self._app_name,
@@ -144,6 +195,9 @@ class DeploymentHandle:
             if multiplexed_model_id is not None
             else self._multiplexed_model_id,
             stream if stream is not None else self._stream,
+            prefix_affinity_tokens
+            if prefix_affinity_tokens is not None
+            else self._prefix_affinity_tokens,
             _router=self._router_holder,
         )
 
@@ -154,13 +208,19 @@ class DeploymentHandle:
         return DeploymentHandle(
             self._controller, self._app_name, self._deployment, name,
             self._multiplexed_model_id, self._stream,
+            self._prefix_affinity_tokens,
             _router=self._router_holder,
         )
 
     def remote(self, *args, **kwargs):
         if self._router_holder[0] is None:
             self._router_holder[0] = Router(self._controller, self._app_name)
-        replica = self._router_holder[0].pick(self._deployment)
+        affinity = None
+        if self._prefix_affinity_tokens > 0:
+            affinity = _prefix_affinity_key(
+                args, kwargs, self._prefix_affinity_tokens
+            )
+        replica = self._router_holder[0].pick(self._deployment, affinity)
         metadata = None
         if self._multiplexed_model_id:
             metadata = {"multiplexed_model_id": self._multiplexed_model_id}
@@ -187,5 +247,6 @@ class DeploymentHandle:
         return (
             DeploymentHandle,
             (self._controller, self._app_name, self._deployment, self._method,
-             self._multiplexed_model_id, self._stream),
+             self._multiplexed_model_id, self._stream,
+             self._prefix_affinity_tokens),
         )

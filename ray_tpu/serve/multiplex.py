@@ -30,6 +30,11 @@ def get_multiplexed_model_id() -> str:
 
 
 def _set_multiplexed_model_id(model_id: str):
+    """Bind the model id in the CURRENT task's context. asyncio tasks copy
+    the context at creation, so a task spawned to run work on behalf of
+    tagged callers (replica request handling, @serve.batch's per-model
+    batch task) must re-bind explicitly — setting here never leaks into the
+    callers' contexts."""
     _model_id_ctx.set(model_id)
 
 

@@ -580,6 +580,150 @@ def sample_device_memory() -> Dict[str, Dict[str, float]]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# KV-cache plane instrumentation (the paged prefix cache's proof layer):
+# the engine records per-admission hit/computed token counts and TTFT
+# (tagged hit | miss), the KVCacheManager keeps the block-pool gauges and
+# eviction/backpressure counters current. kvcache_summary() is the one
+# aggregation shared by state.metrics_summary(), the `ray_tpu kvcache`
+# CLI, and the dashboard's /api/kvcache.
+# ---------------------------------------------------------------------------
+
+_KVCACHE_TTFT_BOUNDARIES_MS = [
+    1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
+]
+
+_kvcache_metrics: Optional[dict] = None
+_kvcache_init_lock = threading.Lock()
+
+
+def _ensure_kvcache_metrics() -> dict:
+    global _kvcache_metrics
+    if _kvcache_metrics is None:
+        with _kvcache_init_lock:
+            if _kvcache_metrics is None:
+                _kvcache_metrics = {
+                    "hit_tokens": Counter(
+                        "kvcache_prefix_hit_tokens_total",
+                        "Prompt tokens served from the prefix cache "
+                        "instead of prefilled",
+                    ),
+                    "prefill_tokens": Counter(
+                        "kvcache_prefill_tokens_total",
+                        "Prompt tokens actually computed at admission",
+                    ),
+                    "evictions": Counter(
+                        "kvcache_evictions_total",
+                        "KV blocks LRU-evicted from the prefix index",
+                    ),
+                    "blocked": Counter(
+                        "kvcache_admission_blocked_total",
+                        "Admissions deferred: block pool exhausted "
+                        "(backpressure, not OOM)",
+                    ),
+                    "blocks_in_use": Gauge(
+                        "kvcache_blocks_in_use",
+                        "Allocated KV blocks in this engine's pool",
+                    ),
+                    "blocks_capacity": Gauge(
+                        "kvcache_blocks_capacity",
+                        "Total KV blocks in this engine's pool",
+                    ),
+                    "ttft": Histogram(
+                        "kvcache_ttft_ms",
+                        "Time to first token (ms) by prefix-cache outcome",
+                        boundaries=_KVCACHE_TTFT_BOUNDARIES_MS,
+                        tag_keys=("cache",),
+                    ),
+                }
+    return _kvcache_metrics
+
+
+def record_kvcache_prefill(hit_tokens: int, computed_tokens: int):
+    m = _ensure_kvcache_metrics()
+    m["hit_tokens"].inc(float(hit_tokens))
+    m["prefill_tokens"].inc(float(computed_tokens))
+
+
+def record_kvcache_eviction(n: int = 1):
+    _ensure_kvcache_metrics()["evictions"].inc(float(n))
+
+
+def record_kvcache_blocked():
+    _ensure_kvcache_metrics()["blocked"].inc(1.0)
+
+
+def set_kvcache_blocks(in_use: int, capacity: int):
+    m = _ensure_kvcache_metrics()
+    m["blocks_in_use"].set(float(in_use))
+    m["blocks_capacity"].set(float(capacity))
+
+
+def record_kvcache_ttft(seconds: float, hit: bool):
+    _ensure_kvcache_metrics()["ttft"].observe(
+        seconds * 1000.0, {"cache": "hit" if hit else "miss"}
+    )
+
+
+def kvcache_counters() -> Dict[str, float]:
+    """Process-local counter readback (tests + bench; no cluster needed)."""
+    m = _ensure_kvcache_metrics()
+
+    def _total(metric) -> float:
+        with metric._lock:
+            return float(sum(metric._values.values()))
+
+    return {
+        "prefix_hit_tokens": _total(m["hit_tokens"]),
+        "prefill_tokens_computed": _total(m["prefill_tokens"]),
+        "evictions": _total(m["evictions"]),
+        "admission_blocked": _total(m["blocked"]),
+    }
+
+
+def kvcache_summary(payloads: List[dict]) -> Dict[str, object]:
+    """Cluster-wide KV-cache rollup from pushed payloads: counters and
+    block gauges summed across engines (each engine owns its own pool, so
+    the cluster total is the sum), TTFT mean by hit/miss tag."""
+    out: Dict[str, object] = {
+        "prefix_hit_tokens": 0.0,
+        "prefill_tokens_computed": 0.0,
+        "evictions": 0.0,
+        "admission_blocked": 0.0,
+        "blocks_in_use": 0.0,
+        "blocks_capacity": 0.0,
+        "ttft_ms": {},
+    }
+    simple = {
+        "kvcache_prefix_hit_tokens_total": "prefix_hit_tokens",
+        "kvcache_prefill_tokens_total": "prefill_tokens_computed",
+        "kvcache_evictions_total": "evictions",
+        "kvcache_admission_blocked_total": "admission_blocked",
+        "kvcache_blocks_in_use": "blocks_in_use",
+        "kvcache_blocks_capacity": "blocks_capacity",
+    }
+    ttft: Dict[str, Dict[str, float]] = out["ttft_ms"]  # type: ignore[assignment]
+    for payload in payloads:
+        for snap in payload.get("metrics", []):
+            name = snap["name"]
+            if name in simple:
+                out[simple[name]] += float(sum(snap["values"].values()))
+            elif name == "kvcache_ttft_ms":
+                for tag_json, counts in snap.get("counts", {}).items():
+                    tags = dict(zip(snap["tag_keys"], json.loads(tag_json)))
+                    row = ttft.setdefault(
+                        tags.get("cache", "?"), {"count": 0.0, "sum_ms": 0.0}
+                    )
+                    row["count"] += float(sum(counts))
+                    row["sum_ms"] += float(
+                        snap["values"].get(tag_json, 0.0)
+                    )
+    for row in ttft.values():
+        if row["count"]:
+            row["mean_ms"] = row["sum_ms"] / row["count"]
+    return out
+
+
 def _node_hex() -> str:
     from .. import _worker_api
 

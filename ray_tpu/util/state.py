@@ -113,7 +113,7 @@ def metrics_summary() -> Dict[str, Any]:
     analogue: `ray status -v` + the metrics agent's aggregation)."""
     import json as _json
 
-    from .metrics import device_rows, fetch_metric_payloads
+    from .metrics import device_rows, fetch_metric_payloads, kvcache_summary
 
     payloads = fetch_metric_payloads(_gcs_call)
     collective: Dict[str, Dict[str, float]] = {}
@@ -167,6 +167,7 @@ def metrics_summary() -> Dict[str, Any]:
         "step_breakdown": steps,
         "scaling_efficiency": efficiency,
         "devices": device_rows(payloads),
+        "kvcache": kvcache_summary(payloads),
     }
 
 

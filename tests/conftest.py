@@ -24,6 +24,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running coverage excluded from the budgeted tier-1 lane "
+        "(-m 'not slow'); run explicitly or without the marker filter",
+    )
+
+
 @pytest.fixture
 def ray_start_regular():
     """Single-node cluster, torn down after the test (reference:

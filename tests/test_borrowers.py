@@ -124,6 +124,7 @@ def test_no_reconstruction_while_borrower_holds(borrower_cluster):
     assert ray_tpu.get(counter.value.remote(), timeout=30) == 1
 
 
+@pytest.mark.slow
 def test_dead_borrower_cannot_pin_forever(borrower_cluster):
     """Chaos variant: the owner's liveness probe prunes a crashed borrower,
     so the deferred free eventually happens instead of leaking the object."""

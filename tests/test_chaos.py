@@ -77,6 +77,7 @@ def test_rpc_chaos_injection(shutdown_only):
         )
 
 
+@pytest.mark.slow
 def test_tasks_survive_node_removal():
     """Tasks scheduled onto a node that dies are retried on survivors
     (reference: chaos node-kill suites)."""

@@ -8,6 +8,7 @@ import pytest
 import ray_tpu
 from ray_tpu.collective import ReduceOp
 from ray_tpu.collective.xla_group import XlaGroup
+from ray_tpu._internal.jax_compat import shard_map
 
 
 @pytest.fixture(scope="module")
@@ -82,7 +83,7 @@ def test_lax_helpers_in_shard_map():
         return total, gathered
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh, in_specs=P("g"),
             out_specs=(P(), P()), check_vma=False,
         )

@@ -56,6 +56,7 @@ def test_reconstruction_after_node_death(two_node_cluster):
     assert float(value[0]) == 7.0
 
 
+@pytest.mark.slow
 def test_transitive_reconstruction(two_node_cluster):
     """A lost object whose creating task needs another lost object: both
     re-execute (the re-executed consumer's arg fetch fails on its executor,

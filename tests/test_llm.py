@@ -42,6 +42,7 @@ def _greedy_reference(cfg, params, prompt, n_new):
     return toks[len(prompt):]
 
 
+@pytest.mark.slow
 def test_cache_decode_matches_full_forward(tiny_engine):
     cfg, params, engine = tiny_engine
     prompt = [3, 14, 15, 92, 65, 35]
@@ -55,6 +56,7 @@ def test_cache_decode_matches_full_forward(tiny_engine):
     assert out.finished_reason == "length"
 
 
+@pytest.mark.slow
 def test_batched_same_length_prompts(tiny_engine):
     cfg, params, engine = tiny_engine
     prompts = [[1, 2, 3, 4], [9, 8, 7, 6], [5, 5, 5, 5]]
@@ -65,6 +67,7 @@ def test_batched_same_length_prompts(tiny_engine):
         assert o.token_ids == _greedy_reference(cfg, params, p, 5)
 
 
+@pytest.mark.slow
 def test_mixed_length_prompts_grouped(tiny_engine):
     cfg, params, engine = tiny_engine
     prompts = [[1, 2], [3, 4, 5, 6], [7, 8], [9, 10, 11, 12]]
@@ -75,6 +78,7 @@ def test_mixed_length_prompts_grouped(tiny_engine):
         assert o.token_ids == _greedy_reference(cfg, params, p, 4)
 
 
+@pytest.mark.slow
 def test_eos_stops_generation(tiny_engine):
     cfg, params, engine = tiny_engine
     prompt = [3, 14, 15, 92]
@@ -109,6 +113,7 @@ def test_seq_len_guard(tiny_engine):
         )
 
 
+@pytest.mark.slow
 def test_llm_serve_deployment(ray_start_regular):
     from ray_tpu import serve
 
@@ -130,6 +135,7 @@ def test_llm_serve_deployment(ray_start_regular):
         serve.shutdown()
 
 
+@pytest.mark.slow
 def test_llm_batch_stage(ray_start_regular):
     from ray_tpu import data as rd
 
@@ -149,6 +155,7 @@ def test_llm_batch_stage(ray_start_regular):
     assert all(len(r["generated"]) == 3 for r in out)
 
 
+@pytest.mark.slow
 class TestContinuousBatching:
     def test_matches_full_forward(self, tiny_engine):
         from ray_tpu.llm.engine import ContinuousBatchingEngine
@@ -222,6 +229,135 @@ class TestContinuousBatching:
         assert results[rid].token_ids == ref[:3]
 
 
+class TestAdmission:
+    """Regression tests for the CB admission path (slot bookkeeping and
+    queue discipline, with and without the memory gate)."""
+
+    def test_pending_fifo_under_full_slots(self, tiny_engine):
+        """More requests than slots: admission order == arrival order."""
+        from ray_tpu.llm.engine import ContinuousBatchingEngine
+
+        cfg, params, _ = tiny_engine
+        engine = ContinuousBatchingEngine(cfg, params, num_slots=2)
+        rids = [
+            engine.add_request(
+                GenerationRequest(token_ids=[i + 1, i + 2], max_new_tokens=6)
+            )
+            for i in range(5)
+        ]
+        admitted_order = []
+        while engine.num_active:
+            engine.step()
+            for slot in engine._slots.values():
+                if slot.request_id not in admitted_order:
+                    admitted_order.append(slot.request_id)
+        assert admitted_order == rids
+
+    def test_slot_reuse_after_finish_at_admission(self, tiny_engine):
+        """max_new_tokens=1 finishes AT admission: its slot must be handed
+        to the next pending request in the same step, not leaked."""
+        from ray_tpu.llm.engine import ContinuousBatchingEngine
+
+        cfg, params, _ = tiny_engine
+        engine = ContinuousBatchingEngine(cfg, params, num_slots=1)
+        r1 = engine.add_request(
+            GenerationRequest(token_ids=[3, 14], max_new_tokens=1)
+        )
+        r2 = engine.add_request(
+            GenerationRequest(token_ids=[15, 92], max_new_tokens=3)
+        )
+        finished = dict(engine.step())
+        assert r1 in finished and len(finished[r1].token_ids) == 1
+        # r2 took the freed slot within the same admission pass
+        assert {s.request_id for s in engine._slots.values()} == {r2}
+        results = engine.run_until_complete()
+        assert len(results[r2].token_ids) == 3
+
+    def test_finish_at_admission_via_eos(self, tiny_engine):
+        cfg, params, _ = tiny_engine
+        from ray_tpu.llm.engine import ContinuousBatchingEngine
+
+        engine = ContinuousBatchingEngine(cfg, params, num_slots=2)
+        prompt = [3, 14, 15, 92]
+        ref = _greedy_reference(cfg, params, prompt, 1)
+        rid = engine.add_request(
+            GenerationRequest(
+                token_ids=prompt, max_new_tokens=8, eos_token_id=ref[0]
+            )
+        )
+        results = engine.run_until_complete()
+        assert results[rid].finished_reason == "eos"
+        assert results[rid].token_ids == ref[:1]
+        assert not engine._slots
+
+    def test_run_until_complete_leaks_nothing(self, tiny_engine):
+        """After draining, every per-request structure must be empty (a
+        serving loop runs forever; any residue is a leak)."""
+        from ray_tpu.llm.engine import ContinuousBatchingEngine
+
+        cfg, params, _ = tiny_engine
+        engine = ContinuousBatchingEngine(cfg, params, num_slots=2)
+        for i in range(6):
+            engine.add_request(
+                GenerationRequest(
+                    token_ids=[i + 1, i + 2, i + 3],
+                    max_new_tokens=1 + i % 3,
+                )
+            )
+        results = engine.run_until_complete()
+        assert len(results) == 6
+        assert engine.num_active == 0
+        assert not engine._slots
+        assert not engine._pending
+        assert not engine._finished_buf
+        assert not engine._enqueue_ts
+
+    def test_memory_gated_admission_preserves_fifo(self, tiny_engine):
+        """With a KV pool too small for two prompts, the blocked request
+        waits at the HEAD of the queue (no reordering, no crash) and
+        admits after the holder retires."""
+        from ray_tpu.kvcache import KVCacheManager
+        from ray_tpu.llm.engine import ContinuousBatchingEngine
+
+        cfg, params, _ = tiny_engine
+        kv = KVCacheManager(num_blocks=2, block_size=16)
+        engine = ContinuousBatchingEngine(
+            cfg, params, num_slots=4, kv_cache=kv
+        )
+        rids = [
+            engine.add_request(
+                GenerationRequest(
+                    token_ids=list(range(b, b + 33)), max_new_tokens=4
+                )
+            )
+            for b in (1, 100, 200)
+        ]
+        engine.step()
+        assert len(engine._slots) == 1  # only the first fit
+        assert [rid for rid, _ in engine._pending] == rids[1:]
+        results = engine.run_until_complete()
+        assert set(results) == set(rids)
+        assert kv.stats()["admission_blocked"] >= 1
+        assert engine.num_active == 0
+
+
+def test_engine_seed_reproducible_and_per_instance():
+    """Sampling seed control: an explicit seed reproduces the sampled
+    stream exactly; different seeds diverge at high temperature (the old
+    hardcoded PRNGKey(0) made every replica emit identical samples)."""
+    cfg = LlamaConfig.tiny(max_seq_len=64)
+    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
+    req = lambda: GenerationRequest(  # noqa: E731
+        token_ids=[1, 2, 3, 4], max_new_tokens=16, temperature=5.0
+    )
+    a = LLMEngine(cfg, params, max_batch_size=2, seed=11).generate([req()])
+    b = LLMEngine(cfg, params, max_batch_size=2, seed=11).generate([req()])
+    c = LLMEngine(cfg, params, max_batch_size=2, seed=12).generate([req()])
+    assert a[0].token_ids == b[0].token_ids
+    assert a[0].token_ids != c[0].token_ids
+
+
+@pytest.mark.slow
 def test_tp_sharded_decode_matches_single_device():
     """Serving tensor parallelism: an engine over GSPMD-sharded params on a
     tp x fsdp mesh decodes token-for-token identically to the unsharded
@@ -269,6 +405,7 @@ def test_engine_generate_stream_matches_batch(tiny_engine):
     assert summary.num_prompt_tokens == len(prompt)
 
 
+@pytest.mark.slow
 def test_llm_serve_token_streaming_e2e(ray_start_regular):
     """Token-streaming end-to-end through serve (the reference's
     DeploymentResponseGenerator path for ray.llm): the first token arrives
@@ -313,6 +450,7 @@ def test_llm_serve_token_streaming_e2e(ray_start_regular):
         serve.shutdown()
 
 
+@pytest.mark.slow
 def test_llm_deployment_with_replica_autoscaling(ray_start_regular):
     """BASELINE configs[4]: LLM serving with replica autoscaling — the
     builder wires LLMConfig.autoscaling_config into the serve deployment

@@ -12,6 +12,7 @@ from ray_tpu.ops.flash_attention import reference_attention
 from ray_tpu.parallel.mesh import MeshSpec, make_mesh, mesh_axis_size
 from ray_tpu.parallel.ring_attention import ring_attention
 from ray_tpu.parallel.sharding import logical_to_spec, param_shardings, unbox_params
+from ray_tpu._internal.jax_compat import shard_map
 
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 virtual devices"
@@ -48,7 +49,7 @@ def test_ring_attention_matches_reference():
         for i in range(3)
     )
     ring = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
             mesh=mesh,
             in_specs=(P(None, None, "sp", None),) * 3,
@@ -70,7 +71,7 @@ def test_ring_attention_grads_match():
         for i in range(3)
     )
     ring = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
             mesh=mesh,
             in_specs=(P(None, None, "sp", None),) * 3,
@@ -90,6 +91,7 @@ def test_ring_attention_grads_match():
         assert rel < 2e-2, rel
 
 
+@pytest.mark.slow
 def test_llama_sharded_matches_single_device():
     cfg = LlamaConfig.tiny()
     mesh = make_mesh(dp=1, fsdp=2, sp=2, tp=2)
@@ -105,6 +107,7 @@ def test_llama_sharded_matches_single_device():
     assert abs(float(loss_sharded) - float(loss_single)) < 2e-2
 
 
+@pytest.mark.slow
 def test_llama_lora_params_exist():
     cfg = LlamaConfig.tiny(lora_rank=4)
     params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
@@ -119,6 +122,7 @@ def test_llama_lora_params_exist():
     assert float(jnp.abs(out_lora - out_base).max()) < 1e-3
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     import importlib.util
     import os

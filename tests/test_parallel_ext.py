@@ -21,6 +21,7 @@ from ray_tpu.parallel.mesh import make_mesh
 from ray_tpu.parallel.pipeline import pipeline_apply, select_stage_params
 from ray_tpu.parallel.sharding import param_shardings, unbox_params
 from ray_tpu.parallel.ulysses import ulysses_attention
+from ray_tpu._internal.jax_compat import shard_map
 
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 virtual devices"
@@ -37,7 +38,7 @@ def test_ulysses_matches_reference():
     )
     spec = P(None, None, "sp", None)
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp"),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -58,7 +59,7 @@ def test_ulysses_gqa():
     v = jax.random.normal(jax.random.PRNGKey(2), (b, hk, s, d), jnp.float32)
     spec = P(None, None, "sp", None)
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp"),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -116,7 +117,7 @@ class TestExpertParallel:
             return moe_combine(y, combine, axis_name="ep")
 
         sharded = jax.jit(
-            jax.shard_map(
+            shard_map(
                 local,
                 mesh=mesh,
                 in_specs=(P("ep", None), P(None, None, None)),
@@ -158,7 +159,7 @@ def test_pipeline_apply_4_stages():
         return jax.lax.psum(out, "pp")
 
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             run,
             mesh=mesh,
             in_specs=(P(), P()),
@@ -169,6 +170,7 @@ def test_pipeline_apply_4_stages():
     np.testing.assert_allclose(np.asarray(out), np.asarray(xs) * 210.0, rtol=1e-5)
 
 
+@pytest.mark.slow
 class TestMoEModel:
     def test_loss_and_grads_finite(self):
         cfg = MoEConfig.tiny()

@@ -131,6 +131,42 @@ def test_tracing_disabled_overhead_guard(shutdown_only, monkeypatch):
     assert tracing.get_spans() == []  # plane fully dormant when disabled
 
 
+def test_prefix_cache_prefill_computes_only_suffix():
+    """Perf guard for the KV-cache plane (CPU-safe, counter-based): a
+    repeated prompt must prefill ONLY the tokens past its cached prefix —
+    the counters are what bench.py's llm_prefix_cache TTFT win rests on,
+    and a silent full-prefill regression would keep outputs correct while
+    erasing the speedup."""
+    import jax
+
+    from ray_tpu.kvcache import KVCacheManager
+    from ray_tpu.llm.engine import ContinuousBatchingEngine, GenerationRequest
+    from ray_tpu.models.llama import LlamaConfig, init_params
+    from ray_tpu.parallel.sharding import unbox_params
+
+    cfg = LlamaConfig.tiny(max_seq_len=128)
+    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
+    kv = KVCacheManager(num_blocks=16, block_size=16)
+    eng = ContinuousBatchingEngine(cfg, params, num_slots=2, kv_cache=kv)
+    prompt = list(range(7, 7 + 56))  # 3 full blocks + 8-token tail
+
+    eng.generate([GenerationRequest(token_ids=prompt, max_new_tokens=2,
+                                    temperature=0.0)])
+    s0 = kv.stats()
+    assert s0["prefill_tokens_computed"] == len(prompt)  # cold: everything
+
+    eng.generate([GenerationRequest(token_ids=prompt, max_new_tokens=2,
+                                    temperature=0.0)])
+    s1 = kv.stats()
+    computed = s1["prefill_tokens_computed"] - s0["prefill_tokens_computed"]
+    hit = s1["prefix_hit_tokens"] - s0["prefix_hit_tokens"]
+    assert hit == 48, f"expected 3 cached blocks (48 tokens), hit {hit}"
+    assert computed == len(prompt) - 48, (
+        f"fully-cached prefix recomputed {computed} tokens, "
+        f"expected only the {len(prompt) - 48}-token suffix"
+    )
+
+
 def test_scale_smoke_queued_tasks(shutdown_only):
     """Queue-depth envelope smoke (BASELINE.md 'tasks queued on a single
     node'): hundreds of queued no-op tasks on 2 workers all complete

@@ -163,6 +163,7 @@ def test_dqn_update_reduces_td_loss(cluster):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_dqn_cartpole_improves(cluster):
     from ray_tpu import rllib
 

@@ -336,6 +336,94 @@ def test_serve_multiplexed_lru(cluster):
     serve.delete("mux-app")
 
 
+def test_prefix_affinity_key_stability():
+    """The affinity key must be stable across processes (crc32, not
+    hash()) and derived from the leading tokens only."""
+    from ray_tpu.serve.handle import _prefix_affinity_key
+
+    req = {"token_ids": list(range(40)), "max_new_tokens": 4}
+    k1 = _prefix_affinity_key((req,), {}, 16)
+    k2 = _prefix_affinity_key((), {"request": dict(req)}, 16)
+    assert k1 is not None and k1 == k2
+    # same head, different tail -> same key (that's the cache-reuse signal)
+    other = {"token_ids": list(range(16)) + [999]}
+    assert _prefix_affinity_key((other,), {}, 16) == k1
+    # different head -> (almost surely) different key
+    assert _prefix_affinity_key(({"token_ids": [7] * 16},), {}, 16) != k1
+    # prompt-string fallback, and None when there is nothing to hash
+    assert _prefix_affinity_key(({"prompt": "hello world"},), {}, 8) is not None
+    assert _prefix_affinity_key((42, "x"), {}, 8) is None
+
+
+def test_prefix_affinity_routes_same_prompt_to_same_replica(cluster):
+    """handle.options(prefix_affinity_tokens=N): requests sharing a prompt
+    prefix keep landing on one replica (where its KV blocks live) instead
+    of spraying across the fleet pow2-style."""
+    import os
+
+    @serve.deployment(num_replicas=2)
+    class Which:
+        def __call__(self, request):
+            return os.getpid()
+
+    handle = serve.run(Which.bind(), name="affinity-app", _proxy=False)
+    affine = handle.options(prefix_affinity_tokens=8)
+    prompt = {"token_ids": [5, 6, 7, 8, 9, 10, 11, 12], "max_new_tokens": 2}
+    pids = {
+        affine.remote(dict(prompt)).result(timeout_s=60) for _ in range(6)
+    }
+    assert len(pids) == 1, f"shared prefix spread across replicas: {pids}"
+    # a longer prompt with the same head co-locates with it
+    longer = {"token_ids": prompt["token_ids"] + [99, 98], "max_new_tokens": 2}
+    assert affine.remote(longer).result(timeout_s=60) in pids
+    serve.delete("affinity-app")
+
+
+def test_serve_batch_composes_with_multiplex(cluster):
+    """@serve.batch under @serve.multiplexed: pending queues are
+    partitioned by model id, so one flush never mixes models, and the
+    batch task re-enters the model-id context — the handler's
+    get_multiplexed_model_id() returns the batch's model, not ""
+    (regression: a single shared queue interleaved m1/m2 items and the
+    handler ran with an empty model id)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class MuxBatcher:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id):
+            return {"id": model_id}
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        async def handle(self, items):
+            # the whole point: the batch task must know its model id
+            model = await self.get_model()
+            ctx = serve.get_multiplexed_model_id()
+            return [
+                {"v": i, "model": model["id"], "ctx": ctx,
+                 "batch": len(items)}
+                for i in items
+            ]
+
+        async def __call__(self, x):
+            return await self.handle(x)
+
+    handle = serve.run(MuxBatcher.bind(), name="muxbatch-app", _proxy=False)
+    responses = [
+        (f"m{1 + i % 2}",
+         handle.options(multiplexed_model_id=f"m{1 + i % 2}").remote(i))
+        for i in range(8)
+    ]
+    results = [(m, r.result(timeout_s=60)) for m, r in responses]
+    for i, (model_id, out) in enumerate(results):
+        assert out["v"] == i
+        assert out["model"] == model_id, "batch mixed models"
+        assert out["ctx"] == model_id, "model-id context lost in batch task"
+    # same-model requests still actually batch together
+    assert max(out["batch"] for _m, out in results) >= 2
+    serve.delete("muxbatch-app")
+
+
 def test_local_testing_mode_no_cluster():
     """serve.run(_local_testing_mode=True) needs no cluster at all
     (reference: serve/_private/local_testing_mode.py)."""
