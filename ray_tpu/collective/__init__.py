@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from ..exceptions import CollectiveAbortedError
 from .base import BaseGroup, ReduceOp
 from .cpu_group import GcsStoreGroup
 from .xla_group import XlaGroup
@@ -83,6 +84,23 @@ def destroy_collective_group(group_name: str = "default"):
         group.destroy()
 
 
+def abort_collective_group(
+    group_name: str = "default", epoch: Optional[int] = None,
+    reason: str = "explicit abort",
+) -> bool:
+    """Abort the group's in-flight ops cluster-wide: every member blocked in
+    a rendezvous (any process) raises :class:`CollectiveAbortedError` within
+    ~1 s. ``epoch`` defaults to the locally-registered group's epoch (0 if
+    the group isn't local — the common case for a controller/CLI caller that
+    knows the epoch and passes it explicitly)."""
+    from .cpu_group import write_abort
+
+    if epoch is None:
+        local = _groups.get(group_name)
+        epoch = local.epoch if local is not None else 0
+    return write_abort(group_name, epoch, reason)
+
+
 def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     return get_group(group_name).allreduce(tensor, op)
 
@@ -113,8 +131,10 @@ def barrier(group_name: str = "default"):
 
 __all__ = [
     "BaseGroup", "ReduceOp", "GcsStoreGroup", "XlaGroup",
+    "CollectiveAbortedError",
     "init_collective_group", "create_collective_group",
-    "destroy_collective_group", "get_group", "is_group_initialized",
+    "destroy_collective_group", "abort_collective_group",
+    "get_group", "is_group_initialized",
     "allreduce", "allgather", "reducescatter", "broadcast",
     "send", "recv", "barrier",
 ]

@@ -46,10 +46,16 @@ class BaseGroup(ABC):
     #: "gcs_store" = host/control-plane fallback)
     backend = "base"
 
-    def __init__(self, world_size: int, rank: int, group_name: str):
+    def __init__(self, world_size: int, rank: int, group_name: str,
+                 epoch: int = 0):
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
+        # group epoch: bumped every time the gang re-forms after a member
+        # loss (elastic resize). Rendezvous state is epoch-scoped so a
+        # re-formed group never reads an aborted epoch's keys, and an abort
+        # signal targets every epoch <= its value.
+        self.epoch = epoch
 
     def _record_op(self, op: str, nbytes: int, start: float):
         """Record one finished op into the collective bytes/latency/

@@ -8,19 +8,32 @@ nccl_collective_group.py:29). Suitable for control-plane payloads and tests,
 not the tensor fast path — that's the XLA group.
 
 Protocol: every op gets a monotonically increasing sequence number agreed by
-construction order; rank r writes ``col:<group>:<seq>:<phase>:<r>`` and polls
-for peers. Keys from finished ops are deleted by rank 0 two ops later.
+construction order; rank r writes ``col:<group>:<epoch>:<seq>:<phase>:<r>``
+and polls for peers. Keys from finished ops are deleted by rank 0 two ops
+later.
+
+Abort plane: each member registers ``colmember:<group>:<epoch>:<rank>`` with
+its worker/node identity at init. When any member dies, the GCS death path
+(report_worker_death / node-death) — or the controller explicitly — writes
+``colabort:<group>`` holding the aborted epoch as an ascii int. Every
+blocking poll loop checks that key at ~0.25 s cadence, so survivors stuck in
+an allreduce raise :class:`CollectiveAbortedError` within ~1 s of the death
+instead of burning the full rendezvous timeout. The re-formed gang comes
+back at a higher epoch, whose keys the abort does not poison; rank 0 sweeps
+the dead epochs' leaked rendezvous keys at init.
 """
 
 from __future__ import annotations
 
+import json
 import time
-from typing import Any, List
+from typing import Any, List, Optional
 
 import numpy as np
 
 from .. import _worker_api
 from .._internal import serialization
+from ..exceptions import CollectiveAbortedError
 from .base import BaseGroup, ReduceOp, tensor_nbytes
 
 _REDUCERS = {
@@ -30,6 +43,12 @@ _REDUCERS = {
     ReduceOp.MAX: lambda arrs: np.max(arrs, axis=0),
 }
 
+#: how often a blocking poll re-reads the abort key (the bound on how long a
+#: survivor keeps spinning after a member death is roughly this + one GCS RTT)
+_ABORT_CHECK_INTERVAL_S = 0.25
+#: how long a read of the chaos delay key is trusted before re-reading
+_DELAY_TTL_S = 2.0
+
 
 def _kv_call(method, *args):
     worker = _worker_api.get_core_worker()
@@ -37,18 +56,134 @@ def _kv_call(method, *args):
     return _worker_api.run_on_worker_loop(client.call(method, *args))
 
 
+def abort_key(group_name: str) -> str:
+    return f"colabort:{group_name}"
+
+
+def member_key(group_name: str, epoch: int, rank: int) -> str:
+    return f"colmember:{group_name}:{epoch}:{rank}"
+
+
+def read_abort_epoch(group_name: str) -> int:
+    """Latest aborted epoch for the group, or -1 if never aborted."""
+    raw = _kv_call("kv_get", abort_key(group_name))
+    if raw is None:
+        return -1
+    try:
+        return int(bytes(raw).decode())
+    except (ValueError, UnicodeDecodeError):
+        return -1
+
+
+def write_abort(group_name: str, epoch: int, reason: str = "") -> bool:
+    """Abort every collective epoch <= ``epoch`` of the group. Monotonic:
+    never lowers an already-written abort epoch. Returns True if this call
+    advanced the abort mark."""
+    if read_abort_epoch(group_name) >= epoch:
+        return False
+    _kv_call("kv_put", abort_key(group_name), str(epoch).encode(), True)
+    return True
+
+
 class GcsStoreGroup(BaseGroup):
     backend = "gcs_store"
 
-    def __init__(self, world_size: int, rank: int, group_name: str):
-        super().__init__(world_size, rank, group_name)
+    def __init__(self, world_size: int, rank: int, group_name: str, *,
+                 epoch: int = 0):
+        super().__init__(world_size, rank, group_name, epoch=epoch)
         self._seq = 0
         # point-to-point ops use per-(src,dst) counters so they don't
         # desynchronize the group-wide collective sequence
         self._p2p_seq = {}
+        self._aborted = False
+        self._last_abort_check = 0.0
+        self._delay_read_at = 0.0
+        self._delay_s = 0.0
+        if _worker_api.is_initialized():
+            self._register_member()
+            if rank == 0:
+                self._sweep_stale_epochs()
+
+    # -- abort plane -------------------------------------------------------
+
+    def _register_member(self):
+        """Advertise this member's worker/node identity so the GCS death
+        path can abort the group when the process or its node dies."""
+        try:
+            from ..runtime_context import get_runtime_context
+
+            rc = get_runtime_context()
+            payload = json.dumps(
+                {"worker_id": rc.get_worker_id(), "node_id": rc.get_node_id()}
+            ).encode()
+            _kv_call(
+                "kv_put", member_key(self.group_name, self.epoch, self.rank),
+                payload, True,
+            )
+        except Exception:
+            # membership is an optimization (fast abort); a failed
+            # registration must not fail group construction
+            pass
+
+    def _sweep_stale_epochs(self):
+        """Delete rendezvous/member keys left behind by dead epochs of this
+        group — aborted ops never reach the happy-path cleanup, so without
+        this sweep every abnormal exit leaks its in-flight keys forever."""
+        try:
+            for prefix in (f"col:{self.group_name}:",
+                           f"colmember:{self.group_name}:"):
+                for key in _kv_call("kv_keys", prefix) or []:
+                    head = key[len(prefix):].split(":", 1)[0]
+                    try:
+                        key_epoch = int(head)
+                    except ValueError:
+                        # not this group's key (e.g. a sibling group whose
+                        # name extends ours, like "<group>:host")
+                        continue
+                    if key_epoch < self.epoch:
+                        _kv_call("kv_del", key)
+        except Exception:
+            pass
+
+    def _raise_aborted(self):
+        self._aborted = True
+        from ..util import metrics
+
+        metrics.record_collective_abort(self.group_name)
+        raise CollectiveAbortedError(self.group_name, self.epoch)
+
+    def _check_abort(self, force: bool = False):
+        """Raise CollectiveAbortedError if this epoch has been aborted.
+        Rate-limited to one KV read per _ABORT_CHECK_INTERVAL_S unless
+        forced; an aborted group stays poisoned (fails fast forever)."""
+        if self._aborted:
+            raise CollectiveAbortedError(self.group_name, self.epoch)
+        now = time.monotonic()
+        if not force and now - self._last_abort_check < _ABORT_CHECK_INTERVAL_S:
+            return
+        self._last_abort_check = now
+        if read_abort_epoch(self.group_name) >= self.epoch:
+            self._raise_aborted()
+
+    def _maybe_delay(self):
+        """Chaos hook: ``coldelay:<group>`` holds an ascii float; every op
+        start sleeps that long. Cached so the hot path adds one KV read per
+        _DELAY_TTL_S, not per op."""
+        now = time.monotonic()
+        if now - self._delay_read_at >= _DELAY_TTL_S:
+            self._delay_read_at = now
+            raw = _kv_call("kv_get", f"coldelay:{self.group_name}")
+            try:
+                self._delay_s = float(bytes(raw).decode()) if raw else 0.0
+            except (ValueError, UnicodeDecodeError):
+                self._delay_s = 0.0
+        if self._delay_s > 0:
+            time.sleep(self._delay_s)
+
+    # -- rendezvous --------------------------------------------------------
 
     def _key(self, seq: int, phase: str, rank: int) -> str:
-        return f"col:{self.group_name}:{seq}:{phase}:{rank}"
+        return f"col:{self.group_name}:{self.epoch}:{seq}:{phase}:{rank}"
 
     def _put(self, seq: int, phase: str, value: Any):
         _kv_call("kv_put", self._key(seq, phase, self.rank),
@@ -62,6 +197,7 @@ class GcsStoreGroup(BaseGroup):
             raw = _kv_call("kv_get", key)
             if raw is not None:
                 return serialization.unpack(raw)
+            self._check_abort()
             time.sleep(delay)
             delay = min(delay * 1.5, 0.1)
         raise TimeoutError(f"collective {self.group_name} seq={seq} rank={rank}")
@@ -79,6 +215,8 @@ class GcsStoreGroup(BaseGroup):
                     _kv_call("kv_del", self._key(old, phase, r))
 
     def _next_seq(self) -> int:
+        self._check_abort()
+        self._maybe_delay()
         seq = self._seq
         self._seq += 1
         self._cleanup(seq)
@@ -141,16 +279,18 @@ class GcsStoreGroup(BaseGroup):
         return n
 
     def send(self, tensor, dst_rank: int):
+        self._check_abort()
         start = time.perf_counter()
         n = self._p2p_key(self.rank, dst_rank)
-        key = f"col:{self.group_name}:p2p:{self.rank}:{dst_rank}:{n}"
+        key = f"col:{self.group_name}:{self.epoch}:p2p:{self.rank}:{dst_rank}:{n}"
         _kv_call("kv_put", key, serialization.pack(tensor), True)
         self._record_op("send", tensor_nbytes(tensor), start)
 
     def recv(self, src_rank: int):
+        self._check_abort()
         start = time.perf_counter()
         n = self._p2p_key(src_rank, self.rank)
-        key = f"col:{self.group_name}:p2p:{src_rank}:{self.rank}:{n}"
+        key = f"col:{self.group_name}:{self.epoch}:p2p:{src_rank}:{self.rank}:{n}"
         deadline = time.time() + 120.0
         delay = 0.002
         while time.time() < deadline:
@@ -160,6 +300,7 @@ class GcsStoreGroup(BaseGroup):
                 out = serialization.unpack(raw)
                 self._record_op("recv", len(raw), start)
                 return out
+            self._check_abort()
             time.sleep(delay)
             delay = min(delay * 1.5, 0.1)
         raise TimeoutError(
@@ -174,6 +315,23 @@ class GcsStoreGroup(BaseGroup):
         self._record_op("barrier", 0, start)
 
     def destroy(self):
+        try:
+            _kv_call(
+                "kv_del", member_key(self.group_name, self.epoch, self.rank)
+            )
+        except Exception:
+            pass
+        if self.rank == 0:
+            # full-epoch sweep (covers keys the seq-window cleanup missed,
+            # including p2p counters and abort leftovers)
+            try:
+                for key in _kv_call(
+                    "kv_keys", f"col:{self.group_name}:{self.epoch}:"
+                ) or []:
+                    _kv_call("kv_del", key)
+                return
+            except Exception:
+                pass
         for seq in range(max(0, self._seq - 2), self._seq):
             for phase in ("d", "s"):
                 for r in range(self.world_size):

@@ -86,8 +86,9 @@ class XlaGroup(BaseGroup):
         *,
         bootstrap_distributed: bool = False,
         devices: Optional[List] = None,
+        epoch: int = 0,
     ):
-        super().__init__(world_size, rank, group_name)
+        super().__init__(world_size, rank, group_name, epoch=epoch)
         self._host = None
         if bootstrap_distributed and world_size > 1:
             coord = _rendezvous_coordinator(group_name, rank, world_size)
@@ -189,7 +190,8 @@ class XlaGroup(BaseGroup):
             from .cpu_group import GcsStoreGroup
 
             self._host = GcsStoreGroup(
-                self.world_size, self.rank, f"{self.group_name}:host"
+                self.world_size, self.rank, f"{self.group_name}:host",
+                epoch=self.epoch,
             )
         return self._host
 
@@ -217,6 +219,11 @@ class XlaGroup(BaseGroup):
         x = jnp.zeros((len(self.devices),), jnp.int32)
         jax.block_until_ready(self._reduce(self._device_shard(x), "sum"))
         self._record_op("barrier", 0, start)
+
+    def destroy(self):
+        if self._host is not None:
+            self._host.destroy()
+            self._host = None
 
     # -- in-graph surface (use inside shard_map/jit) ------------------------
 

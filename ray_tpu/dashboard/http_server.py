@@ -12,6 +12,7 @@ Routes:
   GET  /api/version
   GET  /api/nodes | /api/actors | /api/tasks | /api/placement_groups
   GET  /api/cluster_resources | /api/cluster_status
+  GET  /api/train              (elastic-training FT rollup + live runs)
   GET  /api/jobs/              (list submitted jobs)
   POST /api/jobs/              (submit: {"entrypoint": ..., "runtime_env": ...})
   GET  /api/jobs/{id}
@@ -189,6 +190,9 @@ class DashboardServer:
             ("GET", "/api/devices"): self._devices,
             # KV-cache plane rollup (prefix hits, block pool, TTFT)
             ("GET", "/api/kvcache"): self._kvcache,
+            # train fault-tolerance rollup (resizes/restarts/aborts/
+            # recovery time) + live run records for chaos tooling
+            ("GET", "/api/train"): self._train,
             ("GET", "/metrics"): self._metrics,
             # browser UI (role of the React frontend, dashboard/client/ —
             # here a dependency-free single page over the same REST API)
@@ -241,6 +245,30 @@ class DashboardServer:
         from ..util.metrics import kvcache_summary
 
         return 200, kvcache_summary(self._metric_payloads()), None
+
+    def _train(self, body):
+        import json as _json
+
+        from ..util.metrics import train_ft_summary
+
+        runs = []
+        try:
+            for key in self._gcs("kv_keys", "trainrun:") or []:
+                raw = self._gcs("kv_get", key)
+                if not raw:
+                    continue
+                try:
+                    rec = _json.loads(bytes(raw).decode())
+                except Exception:
+                    continue
+                rec["name"] = key[len("trainrun:"):]
+                runs.append(rec)
+        except Exception:
+            pass
+        return 200, {
+            "runs": runs,
+            "fault_tolerance": train_ft_summary(self._metric_payloads()),
+        }, None
 
     def _metrics(self, body):
         from ..util.metrics import render_prometheus

@@ -395,6 +395,7 @@ class GcsServer:
         self._node_sync_versions.pop(node_id, None)
         logger.warning("node %s dead: %s", node_id, reason)
         self._reap_node_metrics(node_id)
+        self._abort_member_groups(node_hex=node_id.hex(), reason=reason)
         self.publisher.publish("node", ("dead", node))
         self.weight_registry.on_node_death(node.address)
         await self.actor_manager.on_node_death(node_id)
@@ -407,7 +408,62 @@ class GcsServer:
         # reap the dead worker's pushed metrics snapshot, or its series
         # would live in every /metrics scrape forever
         self._drop_metrics_key(f"metrics:{worker_id.hex()}")
+        # abort any collective group the dead worker was a member of, so
+        # surviving ranks blocked in a rendezvous unblock within ~1 s
+        # instead of burning the full timeout (covers raylet
+        # connection-loss AND memory-monitor recall kills — both land here)
+        self._abort_member_groups(worker_hex=worker_id.hex(), reason=reason)
         return True
+
+    def _abort_member_groups(self, *, worker_hex: str = None,
+                             node_hex: str = None, reason: str = ""):
+        """Scan ``colmember:<group>:<epoch>:<rank>`` registrations and write
+        ``colabort:<group>`` (ascii epoch, monotonic max) for every group
+        the dead worker/node belonged to. Plain-ascii value on purpose: the
+        server writes it without the client serialization module, and any
+        client can parse it with int()."""
+        for key in [k for k in self._kv if k.startswith("colmember:")]:
+            try:
+                payload = json.loads(self._kv[key])
+            except Exception:
+                continue
+            if not isinstance(payload, dict):
+                continue
+            if worker_hex is not None and payload.get("worker_id") != worker_hex:
+                continue
+            if node_hex is not None and payload.get("node_id") != node_hex:
+                continue
+            # group names may themselves contain ':' — epoch and rank are
+            # always the last two segments
+            parts = key[len("colmember:"):].rsplit(":", 2)
+            if len(parts) != 3:
+                continue
+            group, epoch_s, _rank = parts
+            try:
+                epoch = int(epoch_s)
+            except ValueError:
+                continue
+            abort_key = f"colabort:{group}"
+            prev = self._kv.get(abort_key)
+            try:
+                prev_epoch = int(prev.decode()) if prev is not None else -1
+            except (ValueError, UnicodeDecodeError):
+                prev_epoch = -1
+            if epoch > prev_epoch:
+                value = str(epoch).encode()
+                self._kv[abort_key] = value
+                self.storage.put("kv", abort_key, value)
+                logger.warning(
+                    "collective group %r epoch %d aborted: member rank %s "
+                    "died (%s)", group, epoch, _rank, reason,
+                )
+            # the registration served its purpose; drop it so a later
+            # unrelated death doesn't rescan a dead member
+            self._kv.pop(key, None)
+            try:
+                self.storage.delete("kv", key)
+            except Exception:
+                pass
 
     def _drop_metrics_key(self, key: str):
         if self._kv.pop(key, None) is not None:

@@ -1638,6 +1638,24 @@ class CoreWorker:
     async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         gcs = self.client_pool.get(*self.gcs_address)
         await gcs.call("kill_actor", actor_id, no_restart)
+        if no_restart:
+            # The GCS has marked the actor DEAD before replying, but the
+            # caller's local view is updated by an async pubsub edge — a
+            # submission issued right after kill() returns can race the
+            # SIGKILL to the still-running executor and succeed. Apply DEAD
+            # locally now so post-kill calls fail deterministically (the
+            # pubsub edge that follows is terminal and idempotent).
+            state = self._actors.get(actor_id)
+            if state is not None:
+                state.state = ActorState.DEAD
+                state.death_cause = "killed via kill()"
+                state.address = None
+                while state.queue:
+                    _spec, fut = state.queue.popleft()
+                    if not fut.done():
+                        fut.set_exception(
+                            ActorDiedError(actor_id, state.death_cause)
+                        )
 
     # ------------------------------------------------------------------
     # execution side (reference: task_execution/, task_receiver.h)

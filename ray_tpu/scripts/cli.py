@@ -234,6 +234,82 @@ def cmd_kvcache(args):
     return 0
 
 
+def cmd_chaos(args):
+    """`ray_tpu chaos`: fault injection against a live cluster — the
+    operator-facing face of the elastic-training chaos layer.
+
+    - ``list``: live train runs (``trainrun:*`` records: state, group,
+      epoch, per-rank pids) plus recovery counters.
+    - ``kill-rank``: SIGKILL one rank's worker process (same-host pids
+      only) — deterministic chip/host-loss injection.
+    - ``abort-group``: write the collective abort key so every member
+      blocked in a rendezvous raises CollectiveAbortedError within ~1 s.
+    - ``delay-collective``: make every op of a group sleep N seconds at
+      entry (straggler injection); 0 clears.
+    """
+    _connected(args)
+    from ..util import state
+
+    if args.chaos_action in ("abort-group", "delay-collective") and not args.group:
+        print(f"{args.chaos_action} needs --group", file=sys.stderr)
+        return 1
+
+    def _kv(method, *cargs):
+        from .. import _worker_api
+
+        worker = _worker_api.get_core_worker()
+        client = worker.client_pool.get(*worker.gcs_address)
+        return _worker_api.run_on_worker_loop(client.call(method, *cargs))
+
+    if args.chaos_action == "list":
+        out = {
+            "runs": state.list_train_runs(),
+            "train_ft": state.metrics_summary()["train_ft"],
+        }
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    if args.chaos_action == "kill-rank":
+        runs = {r["name"]: r for r in state.list_train_runs()}
+        rec = runs.get(args.run)
+        if rec is None:
+            print(f"no live train run {args.run!r}; see `ray_tpu chaos list`",
+                  file=sys.stderr)
+            return 1
+        for w in rec.get("workers", []):
+            if w.get("rank") == args.rank:
+                pid = w.get("pid")
+                try:
+                    os.kill(int(pid), signal.SIGKILL)
+                except (OSError, TypeError, ValueError) as e:
+                    print(f"kill pid {pid} failed: {e} (kill-rank only "
+                          f"reaches same-host pids)", file=sys.stderr)
+                    return 1
+                print(f"killed run {args.run!r} rank {args.rank} (pid {pid})")
+                return 0
+        print(f"run {args.run!r} has no rank {args.rank}", file=sys.stderr)
+        return 1
+    if args.chaos_action == "abort-group":
+        from ..collective import abort_collective_group
+
+        advanced = abort_collective_group(
+            args.group, args.epoch, reason="cli abort"
+        )
+        print(f"abort {'written' if advanced else 'already >= requested'} "
+              f"for group {args.group!r} epoch {args.epoch}")
+        return 0
+    if args.chaos_action == "delay-collective":
+        key = f"coldelay:{args.group}"
+        if args.seconds > 0:
+            _kv("kv_put", key, str(args.seconds).encode(), True)
+            print(f"group {args.group!r}: every op now sleeps "
+                  f"{args.seconds}s at entry (TTL-cached ~2s in members)")
+        else:
+            _kv("kv_del", key)
+            print(f"group {args.group!r}: delay cleared")
+        return 0
+    return 1
+
+
 def cmd_timeline(args):
     """`ray_tpu timeline`: export the cluster-wide chrome trace — GCS
     task-state bars merged with every traced node's spans (reference:
@@ -350,6 +426,27 @@ def main(argv=None):
     )
     p.add_argument("--address", required=True, help="head host:port")
     p.set_defaults(fn=cmd_kvcache)
+
+    p = sub.add_parser(
+        "chaos", help="fault injection: kill ranks, abort/delay collectives"
+    )
+    p.add_argument(
+        "chaos_action",
+        choices=["list", "kill-rank", "abort-group", "delay-collective"],
+    )
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument("--run", default=None, help="train run name (kill-rank)")
+    p.add_argument("--rank", type=int, default=0, help="world rank to kill")
+    p.add_argument("--group", default=None, help="collective group name")
+    p.add_argument(
+        "--epoch", type=int, default=0,
+        help="abort epochs <= this (abort-group)",
+    )
+    p.add_argument(
+        "--seconds", type=float, default=0.0,
+        help="per-op delay for delay-collective; 0 clears",
+    )
+    p.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser(
         "timeline", help="export the cluster chrome trace (ray timeline)"

@@ -101,6 +101,66 @@ class WorkerKiller:
         self.stop()
 
 
+class KillWorkerAtStep:
+    """Deterministic train-chaos injector: SIGKILL the train worker holding
+    ``rank`` the first time any rank reports index >= ``step``.
+
+    Duck-typed TrainCallback (all five controller hooks present, no import
+    of ray_tpu.train at module scope): pass it in ``RunConfig.callbacks``.
+    The kill is delivered from the controller process to the worker's OS
+    pid, exactly like a chip/host loss — the raylet notices the connection
+    drop, reports the death to the GCS, and the GCS aborts the rank's
+    collective group so survivors unblock.
+
+        RunConfig(failure_config=FailureConfig(elastic=True),
+                  callbacks=[KillWorkerAtStep(rank=3, step=2)])
+    """
+
+    def __init__(self, rank: int, step: int, max_kills: int = 1):
+        self.rank = rank
+        self.step = step
+        self.max_kills = max_kills
+        self.kills: List[dict] = []  # {"rank", "pid", "at_report"}
+        self._wg = None
+
+    def before_worker_group_start(self, scaling_config):
+        return None
+
+    def after_worker_group_start(self, worker_group):
+        self._wg = worker_group
+
+    def on_report(self, report):
+        if (
+            len(self.kills) >= self.max_kills
+            or self._wg is None
+            or report.index < self.step
+        ):
+            return
+        for w in self._wg.workers:
+            if w.world_rank == self.rank:
+                pid = w.metadata.get("pid")
+                if not pid:
+                    return
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    return
+                self.kills.append(
+                    {"rank": self.rank, "pid": pid, "at_report": report.index}
+                )
+                logger.info(
+                    "KillWorkerAtStep: killed rank %d (pid %d) at report %d",
+                    self.rank, pid, report.index,
+                )
+                return
+
+    def before_worker_group_shutdown(self, worker_group):
+        pass
+
+    def after_run(self, result):
+        pass
+
+
 class NodeKiller:
     """Removes random non-head nodes from a cluster_utils.Cluster at an
     interval (reference: NodeKillerBase killing raylets during chaos

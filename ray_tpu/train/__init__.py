@@ -21,6 +21,7 @@ from .sharded_checkpoint import (
 )
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
 from .controller import Result, RunState, TrainController
+from .elastic import publish_train_state, restore_train_state
 from .session import (
     get_checkpoint,
     get_context,
@@ -83,4 +84,6 @@ __all__ = [
     "get_dataset_shard",
     "in_session",
     "report",
+    "publish_train_state",
+    "restore_train_state",
 ]

@@ -85,11 +85,38 @@ class CheckpointConfig:
 
 @dataclass
 class FailureConfig:
-    """Worker-group-level retry budget (reference:
+    """Worker-group-level failure policy (reference:
     v2/_internal/execution/failure_handling/failure_policy.py).
-    ``max_failures=-1`` retries forever."""
+
+    Two recovery moves, tried in order:
+
+    - **Elastic resize** (``elastic=True``): on a worker/node death the
+      controller keeps the surviving ``TrainWorker`` actors, aborts the
+      in-flight collectives (survivors raise ``CollectiveAbortedError``
+      within ~1 s), drops the dead ranks, re-ranks, bumps the group epoch,
+      and resumes training at the surviving world size — as long as at
+      least ``min_workers`` survive. Workers re-resolve params/step from
+      the weight plane (``restore_train_state``), so a resize needs no
+      filesystem checkpoint restore. Resizes do NOT count against
+      ``max_failures``: they are the steady-state recovery move on
+      preemptible fleets, not a retry.
+
+    - **Gang restart** (always available): tear down the whole group and
+      respawn it full-size from the latest checkpoint. Used when
+      ``elastic=False`` (the default — today's all-or-nothing behavior),
+      or when survivors fall below ``min_workers``, or when a worker fails
+      with a real user-code error. Each gang restart consumes one unit of
+      ``max_failures``; ``max_failures=-1`` retries forever, ``0`` (the
+      default) fails the run on the first gang-level failure.
+    """
 
     max_failures: int = 0
+    elastic: bool = False
+    min_workers: int = 1
+
+    def __post_init__(self):
+        if self.min_workers < 1:
+            raise ValueError("min_workers must be >= 1")
 
 
 def _default_storage_path() -> str:

@@ -12,11 +12,13 @@ failure policy — restart the whole gang (SPMD requires all-or-nothing) up to
 from __future__ import annotations
 
 import enum
+import json
 import logging
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from ..exceptions import CollectiveAbortedError
 from .backend import BackendConfig
 from .checkpoint import Checkpoint, CheckpointManager, load_latest_checkpoint
 from .config import RunConfig, ScalingConfig
@@ -30,6 +32,10 @@ class RunState(enum.Enum):
     INITIALIZING = "INITIALIZING"
     SCHEDULING = "SCHEDULING"
     RUNNING = "RUNNING"
+    # elastic recovery: survivors kept, dead ranks dropped, group re-formed
+    # at the surviving world size under a new collective epoch
+    RESIZING = "RESIZING"
+    # gang recovery: whole worker group torn down and respawned full-size
     RESTARTING = "RESTARTING"
     FINISHED = "FINISHED"
     ERRORED = "ERRORED"
@@ -80,6 +86,12 @@ class TrainController:
         self._scaling = self._scaling_policy.scaling_config
         self._failures = 0
         self._metrics_history: List[Dict[str, Any]] = []
+        # collective group epoch within the current attempt; bumped on
+        # every elastic resize so the re-formed gang's rendezvous keys
+        # never collide with an aborted epoch's
+        self._epoch = 0
+        self._resizes = 0
+        self._restart_t0: Optional[float] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -111,6 +123,10 @@ class TrainController:
                         _safe(cb.after_run, result)
                     return result
                 self.state = RunState.RESTARTING
+                from ..util import metrics
+
+                metrics.record_train_restart(self._run_config.name or "")
+                self._restart_t0 = time.perf_counter()
                 logger.warning(
                     "worker group failed (attempt %d/%s): %s — restarting from "
                     "latest checkpoint",
@@ -141,34 +157,28 @@ class TrainController:
             placement_group_override=overrides.get("placement_group_override"),
             bundle_label_selector=overrides.get("bundle_label_selector"),
         )
+        self._epoch = 0
         try:
             wg.create()
             for cb in self._callbacks:
                 _safe(cb.after_worker_group_start, wg)
-            # attempt-scoped group name: a restarted gang must not read the
-            # failed attempt's stale rendezvous keys from the GCS KV
-            run_fields = dict(
-                experiment_name=self._run_config.name,
-                run_dir=self._run_config.run_dir,
-                collective_group=f"train:{self._run_config.name}:{self._failures}",
-            )
-            wg.init_contexts(run_fields)
+            wg.init_contexts(self._run_fields())
             self._setup_dataset_shards(wg)
             backend = self._backend_config.backend()
             backend.on_start(wg)
-            # resume: push the latest checkpoint into each worker context
-            resume = self._checkpoints.latest_checkpoint or load_latest_checkpoint(
-                self._run_config.run_dir
-            )
-            if resume is not None:
-                def _set_resume(ckpt=resume):
-                    from . import session
-
-                    session.get_context().latest_checkpoint = ckpt
-
-                wg.execute(_set_resume)
+            self._push_resume(wg)
             self.state = RunState.RUNNING
             wg.start_training(self._train_fn, self._train_fn_config)
+            self._publish_run_record(wg, "RUNNING")
+            if self._restart_t0 is not None:
+                from ..util import metrics
+
+                metrics.record_train_recovery(
+                    self._run_config.name or "",
+                    time.perf_counter() - self._restart_t0,
+                    kind="restart",
+                )
+                self._restart_t0 = None
             error = self._poll_until_done(wg)
             backend.on_shutdown(wg)
             if error is not None:
@@ -181,27 +191,192 @@ class TrainController:
                 metrics_history=list(self._metrics_history),
             )
         finally:
+            self._delete_run_record()
             for cb in self._callbacks:
                 _safe(cb.before_worker_group_shutdown, wg)
             wg.shutdown()
 
+    def _run_fields(self) -> dict:
+        # attempt-scoped group name: a restarted gang must not read the
+        # failed attempt's stale rendezvous keys from the GCS KV; within an
+        # attempt, elastic resizes keep the name and bump the epoch instead
+        return dict(
+            experiment_name=self._run_config.name,
+            run_dir=self._run_config.run_dir,
+            collective_group=self._group_name(),
+            collective_epoch=self._epoch,
+        )
+
+    def _group_name(self) -> str:
+        return f"train:{self._run_config.name}:{self._failures}"
+
+    def _push_resume(self, wg: WorkerGroup):
+        # resume: push the latest checkpoint into each worker context
+        resume = self._checkpoints.latest_checkpoint or load_latest_checkpoint(
+            self._run_config.run_dir
+        )
+        if resume is not None:
+            def _set_resume(ckpt=resume):
+                from . import session
+
+                session.get_context().latest_checkpoint = ckpt
+
+            wg.execute(_set_resume)
+
     def _poll_until_done(self, wg: WorkerGroup) -> Optional[Exception]:
-        """Drain reports until every worker finishes or one fails."""
+        """Drain reports until every worker finishes or one fails. With
+        ``FailureConfig(elastic=True)`` a worker/actor death (or an aborted
+        collective) triggers an in-place resize instead of failing the
+        attempt: survivors are kept, ranks re-assigned, and training
+        resumes at the surviving world size."""
+        elastic = self._run_config.failure_config.elastic
         while True:
-            try:
-                statuses = wg.poll()
-            except Exception as e:  # worker/actor died (node loss etc.)
-                return e
-            for status in statuses:
-                for report in status["reports"]:
-                    self._process_report(report)
-            for status in statuses:
-                if status["error"] is not None:
-                    exc = status.get("error_exc") or RuntimeError(status["error"])
-                    return exc
+            statuses = wg.poll_each()
+            dead = [
+                i for i, s in enumerate(statuses) if not isinstance(s, dict)
+            ]
+            for s in statuses:
+                if isinstance(s, dict):
+                    for report in s["reports"]:
+                        self._process_report(report)
+            aborted = False
+            for s in statuses:
+                if isinstance(s, dict) and s["error"] is not None:
+                    exc = s.get("error_exc") or RuntimeError(s["error"])
+                    if elastic and isinstance(exc, CollectiveAbortedError):
+                        # a resize casualty, not a user failure: the worker's
+                        # in-flight collective was aborted by a peer death
+                        aborted = True
+                    else:
+                        return exc
+            if dead or aborted:
+                if not elastic:
+                    return statuses[dead[0]]
+                error = self._resize(wg)
+                if error is not None:
+                    return error
+                continue
             if all(s["done"] for s in statuses):
                 return None
             time.sleep(self._poll_interval)
+
+    def _resize(self, wg: WorkerGroup) -> Optional[Exception]:
+        """Elastic recovery: abort the epoch, drop dead ranks, re-rank the
+        survivors, bump the epoch, and restart training without respawning
+        healthy processes. Returns an exception when a resize can't satisfy
+        ``min_workers`` — the caller then falls back to a gang restart
+        (which counts against ``max_failures``)."""
+        from .. import collective
+        from ..util import metrics
+
+        fc = self._run_config.failure_config
+        run_name = self._run_config.name or ""
+        t0 = time.perf_counter()
+        self.state = RunState.RESIZING
+        self._publish_run_record(wg, "RESIZING")
+        # belt and braces: the GCS death path normally writes the abort the
+        # moment the raylet reports the worker gone, but an explicit write
+        # here also covers deaths the pub path missed (partitioned raylet)
+        try:
+            collective.abort_collective_group(
+                self._group_name(), self._epoch, reason="controller resize"
+            )
+        except Exception:
+            pass
+        alive = wg.ping()
+        dead_idx = [i for i, ok in enumerate(alive) if not ok]
+        survivors = len(wg.workers) - len(dead_idx)
+        if survivors < max(fc.min_workers, 1):
+            return RuntimeError(
+                f"elastic resize impossible: {survivors} survivor(s) < "
+                f"min_workers={fc.min_workers} — falling back to gang restart"
+            )
+        try:
+            if dead_idx:
+                removed = wg.remove_workers(dead_idx)
+                logger.warning(
+                    "elastic resize: lost rank(s) %s — re-forming at "
+                    "world_size=%d",
+                    [w.world_rank for w in removed],
+                    len(wg.workers),
+                )
+            # survivors' aborted train threads must exit before the re-form
+            wg.reset_for_restart()
+            # final drain: reports queued between the abort and the thread
+            # exit would otherwise vanish when init_contexts replaces the
+            # context
+            for s in wg.poll_each():
+                if isinstance(s, dict):
+                    for report in s["reports"]:
+                        self._process_report(report)
+            self._epoch += 1
+            wg.init_contexts(self._run_fields())
+            self._setup_dataset_shards(wg)
+            self._push_resume(wg)
+            wg.start_training(self._train_fn, self._train_fn_config)
+        except Exception as e:  # a second death mid-re-form etc.
+            logger.warning("elastic resize failed (%s) — gang restart", e)
+            return e
+        self.state = RunState.RUNNING
+        self._resizes += 1
+        metrics.record_train_resize(run_name)
+        metrics.record_train_recovery(
+            run_name, time.perf_counter() - t0, kind="resize"
+        )
+        self._publish_run_record(wg, "RUNNING")
+        logger.warning(
+            "elastic resize complete: world_size=%d epoch=%d (%.2fs)",
+            len(wg.workers), self._epoch, time.perf_counter() - t0,
+        )
+        return None
+
+    # -- run record (chaos CLI / dashboards) -------------------------------
+
+    def _publish_run_record(self, wg: WorkerGroup, state: str):
+        """Publish this run's live topology to the GCS KV
+        (``trainrun:<name>``) so out-of-process tooling — the chaos CLI,
+        dashboards — can target a specific rank/pid or the collective
+        group/epoch."""
+        try:
+            record = {
+                "state": state,
+                "group": self._group_name(),
+                "epoch": self._epoch,
+                "world_size": len(wg.workers),
+                "resizes": self._resizes,
+                "failures": self._failures,
+                "workers": [
+                    {
+                        "rank": w.world_rank,
+                        "pid": w.metadata.get("pid"),
+                        "node_id": w.node_id,
+                        "hostname": w.metadata.get("hostname"),
+                    }
+                    for w in wg.workers
+                ],
+            }
+            self._kv_call(
+                "kv_put",
+                f"trainrun:{self._run_config.name}",
+                json.dumps(record).encode(),
+                True,
+            )
+        except Exception:
+            pass
+
+    def _delete_run_record(self):
+        try:
+            self._kv_call("kv_del", f"trainrun:{self._run_config.name}")
+        except Exception:
+            pass
+
+    @staticmethod
+    def _kv_call(method: str, *args):
+        from .. import _worker_api
+
+        worker = _worker_api.get_core_worker()
+        client = worker.client_pool.get(*worker.gcs_address)
+        return _worker_api.run_on_worker_loop(client.call(method, *args))
 
     def _process_report(self, report: TrainingReport):
         if report.metrics:

@@ -1,0 +1,85 @@
+"""Weight-plane train-state resume for elastic training.
+
+On an elastic resize the surviving workers restart their train fn and must
+pick up where the gang left off WITHOUT a filesystem checkpoint restore —
+recovery has to land in seconds. The mechanism: rank 0 publishes a small
+replicated record ``{"params", "opt_state", "step"}`` to the weight plane
+(``train-state:<experiment>``) alongside (or instead of) each checkpoint;
+after a resize every worker re-resolves the latest version over the
+broadcast tree and continues from ``step + 1``.
+
+    def train_loop(config):
+        state = restore_train_state()          # None on a fresh start
+        step = state["step"] + 1 if state else 0
+        params = state["params"] if state else init_params()
+        while step < config["steps"]:
+            params = train_step(params)        # CollectiveAbortedError
+            publish_train_state(params, step=step)   # rank 0 only
+            ray_tpu.train.report({"step": step})
+            step += 1
+
+The step rides inside the published pytree (the registry's ``get`` returns
+no metadata), and is duplicated into the publish metadata so
+``ray_tpu list weights`` shows it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .session import get_context
+
+
+def _state_name(name: Optional[str]) -> str:
+    return name if name else f"train-state:{get_context().experiment_name}"
+
+
+def publish_train_state(
+    params: Any,
+    opt_state: Any = None,
+    step: int = 0,
+    *,
+    name: Optional[str] = None,
+    meta: Optional[dict] = None,
+):
+    """Publish the run's resumable state to the weight plane. Rank 0 only —
+    other ranks no-op (SPMD state is replicated) and return None. Returns
+    the published :class:`WeightHandle` on rank 0."""
+    ctx = get_context()
+    if ctx.world_rank != 0:
+        return None
+    from .. import weights
+
+    payload = {
+        "params": params,
+        "opt_state": opt_state,
+        # int64 scalar rides as a pytree leaf: chunk_pytree np.asarray's
+        # every leaf, so it round-trips exactly
+        "step": np.int64(step),
+    }
+    full_meta = {"step": int(step), "world_size": ctx.world_size}
+    if meta:
+        full_meta.update(meta)
+    return weights.publish(_state_name(name), payload, meta=full_meta)
+
+
+def restore_train_state(
+    *, name: Optional[str] = None, sharding: Any = None
+) -> Optional[Dict[str, Any]]:
+    """Fetch the latest published train state over the weight plane.
+    Returns ``{"params", "opt_state", "step", "version"}`` or None when
+    nothing has been published yet (fresh start)."""
+    from .. import weights
+
+    try:
+        version, payload = weights.fetch(_state_name(name), sharding=sharding)
+    except KeyError:
+        return None
+    return {
+        "params": payload.get("params"),
+        "opt_state": payload.get("opt_state"),
+        "step": int(payload["step"]),
+        "version": version,
+    }

@@ -37,6 +37,10 @@ class TrainContext:
     experiment_name: str
     run_dir: str
     collective_group: str = ""
+    # bumped by the controller on every elastic resize; scopes the
+    # collective rendezvous keys so a re-formed gang never reads an
+    # aborted epoch's state
+    collective_epoch: int = 0
     latest_checkpoint: Optional[Checkpoint] = None
     dataset_shards: Dict[str, Any] = field(default_factory=dict)
 

@@ -42,6 +42,10 @@ class TrainWorker:
         self._error_exc: Optional[Exception] = None
         self._done = False
         self._ctx: Optional[TrainContext] = None
+        # bumped on reset_for_restart: a zombie train thread from a previous
+        # generation (join timed out mid-abort) must not write done/error
+        # state into the restarted run
+        self._gen = 0
 
     def get_metadata(self) -> dict:
         import os
@@ -68,6 +72,7 @@ class TrainWorker:
                 self._ctx.world_rank,
                 backend="gcs",
                 group_name=self._ctx.collective_group,
+                epoch=self._ctx.collective_epoch,
             )
         return True
 
@@ -84,6 +89,7 @@ class TrainWorker:
         (reference: thread_runner.py)."""
         if self._thread is not None:
             raise RuntimeError("training already started")
+        gen = self._gen
 
         def _run():
             try:
@@ -95,11 +101,15 @@ class TrainWorker:
                 else:
                     train_fn()
             except BaseException as e:  # noqa: BLE001
-                self._error = traceback.format_exc()
-                self._error_exc = e if isinstance(e, Exception) else RuntimeError(str(e))
-                logger.error("train fn failed:\n%s", self._error)
+                if self._gen == gen:
+                    self._error = traceback.format_exc()
+                    self._error_exc = (
+                        e if isinstance(e, Exception) else RuntimeError(str(e))
+                    )
+                    logger.error("train fn failed:\n%s", self._error)
             finally:
-                self._done = True
+                if self._gen == gen:
+                    self._done = True
 
         self._thread = threading.Thread(target=_run, daemon=True, name="train_fn")
         self._thread.start()
@@ -120,6 +130,30 @@ class TrainWorker:
             "error": error,
             "error_exc": error_exc,
         }
+
+    def reset_for_restart(self, join_timeout: float = 30.0) -> dict:
+        """Prepare this surviving worker for an elastic re-form: wait for
+        the (aborted) train thread to exit, tear down the poisoned
+        collective group, and clear run state — WITHOUT killing the actor
+        process. The controller then re-ranks, re-inits contexts at the
+        next epoch, and restarts training."""
+        self._gen += 1
+        thread_exited = True
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout)
+            thread_exited = not self._thread.is_alive()
+        if self._ctx and self._ctx.collective_group:
+            from .. import collective
+
+            try:
+                collective.destroy_collective_group(self._ctx.collective_group)
+            except Exception:
+                pass
+        self._thread = None
+        self._error = None
+        self._error_exc = None
+        self._done = False
+        return {"thread_exited": thread_exited}
 
     def shutdown(self):
         if self._ctx and self._ctx.collective_group:
@@ -198,29 +232,37 @@ class WorkerGroup:
                 ).remote()
             )
         metas = ray_api.get([a.get_metadata.remote() for a in actors])
-        # rank assignment: group by node, sort nodes by id for determinism,
-        # rank 0 first (reference: worker_group rank sorting :728-813)
-        order = sorted(range(n), key=lambda i: (metas[i]["node_id"], i))
+        self.workers = self._assign_ranks(list(zip(actors, metas)))
+        return self
+
+    @staticmethod
+    def _assign_ranks(pairs: List[tuple]) -> List[WorkerInfo]:
+        """Rank assignment: group by node, sort nodes by id for determinism,
+        rank 0 first (reference: worker_group rank sorting :728-813).
+        ``pairs`` is (actor, metadata) in a stable pre-order."""
+        n = len(pairs)
+        order = sorted(range(n), key=lambda i: (pairs[i][1]["node_id"], i))
         node_ids: List[str] = []
-        self.workers = []
+        workers: List[WorkerInfo] = []
         local_counts: Dict[str, int] = {}
         for world_rank, idx in enumerate(order):
-            node_id = metas[idx]["node_id"]
+            actor, meta = pairs[idx]
+            node_id = meta["node_id"]
             if node_id not in node_ids:
                 node_ids.append(node_id)
             local_rank = local_counts.get(node_id, 0)
             local_counts[node_id] = local_rank + 1
-            self.workers.append(
+            workers.append(
                 WorkerInfo(
-                    actor=actors[idx],
+                    actor=actor,
                     world_rank=world_rank,
                     local_rank=local_rank,
                     node_rank=node_ids.index(node_id),
                     node_id=node_id,
-                    metadata=metas[idx],
+                    metadata=meta,
                 )
             )
-        return self
+        return workers
 
     @property
     def placement_group(self) -> Optional[PlacementGroup]:
@@ -261,6 +303,68 @@ class WorkerGroup:
 
     def poll(self) -> List[dict]:
         return ray_api.get([w.actor.poll.remote() for w in self.workers])
+
+    def poll_each(self, timeout: float = 30.0) -> List[Any]:
+        """Per-worker poll: each entry is the status dict OR the exception
+        that poll raised (a dead actor yields ActorDiedError instead of
+        failing the whole batch — the elastic controller needs to know
+        exactly which ranks died)."""
+        refs = [w.actor.poll.remote() for w in self.workers]
+        out: List[Any] = []
+        for ref in refs:
+            try:
+                out.append(ray_api.get(ref, timeout=timeout))
+            except Exception as e:  # noqa: BLE001
+                out.append(e)
+        return out
+
+    def ping(self, timeout: float = 10.0) -> List[bool]:
+        """Liveness probe ordered like ``workers``: False = actor dead or
+        unresponsive."""
+        refs = [w.actor.get_metadata.remote() for w in self.workers]
+        alive = []
+        for ref in refs:
+            try:
+                ray_api.get(ref, timeout=timeout)
+                alive.append(True)
+            except Exception:
+                alive.append(False)
+        return alive
+
+    def remove_workers(self, indices: List[int]) -> List[WorkerInfo]:
+        """Drop the given (current-list) indices — killing their actors
+        best-effort — and re-rank the survivors. Returns the removed
+        WorkerInfos. The placement group is kept as-is: removing it would
+        tear down the surviving placed actors, and the dead ranks' bundles
+        stay reserved as grow-back capacity for a later full restart."""
+        doomed = set(indices)
+        removed = []
+        survivors = []
+        for i, w in enumerate(self.workers):
+            (removed if i in doomed else survivors).append(w)
+        for w in removed:
+            try:
+                ray_api.kill(w.actor)
+            except Exception:
+                pass
+        # survivors keep their relative rank order (stable re-rank): pass
+        # them in current world_rank order so rank gaps close without
+        # reshuffling the remaining ranks
+        self.workers = self._assign_ranks(
+            [(w.actor, w.metadata) for w in survivors]
+        )
+        return removed
+
+    def reset_for_restart(self, join_timeout: float = 30.0) -> List[dict]:
+        """Elastic re-form step: every surviving worker joins its aborted
+        train thread and clears run state (see TrainWorker.reset_for_restart)."""
+        return ray_api.get(
+            [
+                w.actor.reset_for_restart.remote(join_timeout)
+                for w in self.workers
+            ],
+            timeout=join_timeout + 30.0,
+        )
 
     def shutdown(self):
         for w in self.workers:

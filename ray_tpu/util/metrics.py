@@ -517,6 +517,139 @@ class StepBreakdown:
 
 
 # ---------------------------------------------------------------------------
+# Train fault-tolerance telemetry: elastic resizes, gang restarts, collective
+# aborts, and kill-to-resumed recovery time. Raw recovery samples are kept
+# process-locally alongside the histogram so bench/CLI readers get exact
+# p50/p99 (buckets alone can't give those).
+# ---------------------------------------------------------------------------
+
+_TRAIN_RECOVERY_BOUNDARIES_S = [
+    0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300,
+]
+
+_train_ft_metrics: Optional[dict] = None
+_train_ft_init_lock = threading.Lock()
+_recovery_samples: List[float] = []
+
+
+def _ensure_train_ft_metrics() -> dict:
+    global _train_ft_metrics
+    if _train_ft_metrics is None:
+        with _train_ft_init_lock:
+            if _train_ft_metrics is None:
+                _train_ft_metrics = {
+                    "resize": Counter(
+                        "train_resize_total",
+                        "Elastic worker-group resizes (survivors kept, "
+                        "group re-formed at a new epoch)",
+                        tag_keys=("run",),
+                    ),
+                    "restart": Counter(
+                        "train_restart_total",
+                        "Full gang restarts (all workers respawned)",
+                        tag_keys=("run",),
+                    ),
+                    "abort": Counter(
+                        "collective_abort_total",
+                        "In-flight collective ops aborted by member "
+                        "death or explicit abort",
+                        tag_keys=("group",),
+                    ),
+                    "recovery": Histogram(
+                        "train_recovery_seconds",
+                        "Failure-detected to training-resumed wall time",
+                        boundaries=_TRAIN_RECOVERY_BOUNDARIES_S,
+                        tag_keys=("run", "kind"),
+                    ),
+                }
+    return _train_ft_metrics
+
+
+def record_train_resize(run: str):
+    _ensure_train_ft_metrics()["resize"].inc(1.0, {"run": run})
+
+
+def record_train_restart(run: str):
+    _ensure_train_ft_metrics()["restart"].inc(1.0, {"run": run})
+
+
+def record_collective_abort(group: str):
+    _ensure_train_ft_metrics()["abort"].inc(1.0, {"group": group})
+
+
+def record_train_recovery(run: str, seconds: float, kind: str = "resize"):
+    _ensure_train_ft_metrics()["recovery"].observe(
+        seconds, {"run": run, "kind": kind}
+    )
+    with _train_ft_init_lock:
+        _recovery_samples.append(seconds)
+        # bounded: a pathological kill-loop must not grow memory forever
+        if len(_recovery_samples) > 10_000:
+            del _recovery_samples[:5_000]
+
+
+def train_recovery_percentiles() -> Dict[str, float]:
+    """Process-local exact recovery-time percentiles (bench + CLI)."""
+    with _train_ft_init_lock:
+        samples = sorted(_recovery_samples)
+    if not samples:
+        return {}
+
+    def _pct(p: float) -> float:
+        return samples[min(len(samples) - 1, int(p * len(samples)))]
+
+    return {
+        "count": float(len(samples)),
+        "p50_s": _pct(0.50),
+        "p99_s": _pct(0.99),
+        "max_s": samples[-1],
+    }
+
+
+def train_ft_counters() -> Dict[str, float]:
+    """Process-local totals across all tag values (tests + CLI)."""
+    m = _ensure_train_ft_metrics()
+    out: Dict[str, float] = {}
+    for label, metric in (
+        ("resizes", m["resize"]),
+        ("restarts", m["restart"]),
+        ("aborts", m["abort"]),
+    ):
+        with metric._lock:
+            out[label] = float(sum(metric._values.values()))
+    return out
+
+
+def train_ft_summary(payloads: List[dict]) -> Dict[str, object]:
+    """Cluster rollup of the train fault-tolerance plane from every
+    worker's pushed snapshot (state.metrics_summary / dashboard)."""
+    out = {
+        "resizes": 0.0,
+        "restarts": 0.0,
+        "aborts": 0.0,
+        "recoveries": 0.0,
+        "recovery_mean_s": 0.0,
+    }
+    recovery_sum = 0.0
+    for payload in payloads:
+        for snap in payload.get("metrics", []):
+            name = snap.get("name")
+            if name == "train_resize_total":
+                out["resizes"] += sum(snap["values"].values())
+            elif name == "train_restart_total":
+                out["restarts"] += sum(snap["values"].values())
+            elif name == "collective_abort_total":
+                out["aborts"] += sum(snap["values"].values())
+            elif name == "train_recovery_seconds":
+                for counts in snap.get("counts", {}).values():
+                    out["recoveries"] += float(sum(counts))
+                recovery_sum += sum(snap.get("values", {}).values())
+    if out["recoveries"]:
+        out["recovery_mean_s"] = recovery_sum / out["recoveries"]
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Device telemetry: per-device HBM used/limit gauges sampled from
 # jax.local_devices() memory stats, tagged by node and device. Sampled by
 # the metrics pusher whenever jax is already imported in this process (no

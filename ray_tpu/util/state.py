@@ -113,7 +113,12 @@ def metrics_summary() -> Dict[str, Any]:
     analogue: `ray status -v` + the metrics agent's aggregation)."""
     import json as _json
 
-    from .metrics import device_rows, fetch_metric_payloads, kvcache_summary
+    from .metrics import (
+        device_rows,
+        fetch_metric_payloads,
+        kvcache_summary,
+        train_ft_summary,
+    )
 
     payloads = fetch_metric_payloads(_gcs_call)
     collective: Dict[str, Dict[str, float]] = {}
@@ -168,7 +173,28 @@ def metrics_summary() -> Dict[str, Any]:
         "scaling_efficiency": efficiency,
         "devices": device_rows(payloads),
         "kvcache": kvcache_summary(payloads),
+        "train_ft": train_ft_summary(payloads),
     }
+
+
+def list_train_runs() -> List[Dict[str, Any]]:
+    """Live train-run records published by TrainController (``trainrun:*``
+    KV keys): state, collective group+epoch, and per-rank worker identity —
+    the index the chaos CLI uses to target a specific run/rank."""
+    import json as _json
+
+    out = []
+    for key in _gcs_call("kv_keys", "trainrun:") or []:
+        raw = _gcs_call("kv_get", key)
+        if not raw:
+            continue
+        try:
+            rec = _json.loads(bytes(raw).decode())
+        except Exception:
+            continue
+        rec["name"] = key[len("trainrun:"):]
+        out.append(rec)
+    return out
 
 
 def list_weights() -> List[Dict[str, Any]]:

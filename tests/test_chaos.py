@@ -1,7 +1,10 @@
 """Chaos tests: workloads complete correctly under random worker kills and
 RPC failure injection (reference: the chaos suites driven by
-_private/test_utils killers and RAY_testing_rpc_failure)."""
+_private/test_utils killers and RAY_testing_rpc_failure), and the elastic
+training plane recovers from deterministic rank kills."""
 
+import os
+import signal
 import time
 
 import pytest
@@ -130,3 +133,228 @@ def test_actor_task_rpc_chaos_exactly_once(shutdown_only):
     values = [ray_tpu.get(c.incr.remote(), timeout=60) for _ in range(30)]
     # strict: no skips (deadlock), no double-execution (duplicate applies)
     assert values == list(range(1, 31))
+
+
+# ---------------------------------------------------------------------------
+# Collective abort plane + elastic training (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+
+def _make_member_cls():
+    @ray_tpu.remote(max_restarts=0)
+    class Member:
+        def join(self, world_size, rank, group):
+            from ray_tpu import collective
+
+            collective.init_collective_group(
+                world_size, rank, backend="gcs", group_name=group
+            )
+            return os.getpid()
+
+        def reduce(self, group):
+            import numpy as np
+
+            from ray_tpu import collective
+            from ray_tpu.exceptions import CollectiveAbortedError
+
+            t0 = time.perf_counter()
+            try:
+                out = collective.allreduce(np.ones(4), group_name=group)
+                return ("ok", float(out[0]), time.perf_counter() - t0)
+            except CollectiveAbortedError:
+                return ("aborted", 0.0, time.perf_counter() - t0)
+
+    return Member
+
+
+def test_collective_abort_unblocks_survivors(shutdown_only):
+    """A rank SIGKILLed mid-allreduce unblocks the surviving ranks with
+    CollectiveAbortedError within 5 s of the death (not the 120 s rendezvous
+    timeout): raylet connection-loss -> GCS report_worker_death -> colabort
+    key -> survivors' poll loops."""
+    ray_tpu.init(num_cpus=4)
+    Member = _make_member_cls()
+    members = [Member.remote() for _ in range(3)]
+    pids = ray_tpu.get(
+        [m.join.remote(3, r, "abrt") for r, m in enumerate(members)],
+        timeout=60,
+    )
+    # ranks 0 and 1 enter the allreduce; rank 2 never contributes
+    refs = [members[0].reduce.remote("abrt"), members[1].reduce.remote("abrt")]
+    time.sleep(0.5)  # let the survivors block in the rendezvous poll
+    os.kill(pids[2], signal.SIGKILL)
+    t_kill = time.perf_counter()
+    out = ray_tpu.get(refs, timeout=30)
+    unblocked_in = time.perf_counter() - t_kill
+    assert [o[0] for o in out] == ["aborted", "aborted"]
+    assert unblocked_in < 5.0, f"survivors took {unblocked_in:.1f}s to abort"
+    # the group stays poisoned: later ops fail fast instead of hanging
+    again = ray_tpu.get(members[0].reduce.remote("abrt"), timeout=30)
+    assert again[0] == "aborted"
+    assert again[2] < 1.0
+
+
+def test_abort_collective_group_api(shutdown_only):
+    """collective.abort_collective_group() (the `ray_tpu chaos abort-group`
+    CLI path) unblocks members stuck in a rendezvous."""
+    from ray_tpu import collective
+
+    ray_tpu.init(num_cpus=4)
+    Member = _make_member_cls()
+    members = [Member.remote() for _ in range(2)]
+    ray_tpu.get(
+        [m.join.remote(3, r, "expl") for r, m in enumerate(members)],
+        timeout=60,
+    )
+    refs = [m.reduce.remote("expl") for m in members]
+    time.sleep(0.3)
+    assert collective.abort_collective_group("expl", epoch=0, reason="test")
+    out = ray_tpu.get(refs, timeout=30)
+    assert [o[0] for o in out] == ["aborted", "aborted"]
+    # monotonic: re-aborting the same epoch is a no-op
+    assert not collective.abort_collective_group("expl", epoch=0)
+
+
+def test_memory_monitor_death_report_aborts_group(shutdown_only):
+    """A worker death reported through the GCS death RPC (the same path the
+    memory-monitor recall kill lands on) aborts the dead rank's collective
+    group."""
+    import json
+
+    from ray_tpu._internal.ids import WorkerID
+    from ray_tpu.collective.cpu_group import _kv_call
+
+    ray_tpu.init(num_cpus=4)
+    Member = _make_member_cls()
+    members = [Member.remote() for _ in range(3)]
+    ray_tpu.get(
+        [m.join.remote(3, r, "memmon") for r, m in enumerate(members)],
+        timeout=60,
+    )
+    refs = [members[0].reduce.remote("memmon"), members[1].reduce.remote("memmon")]
+    time.sleep(0.3)
+    # look up rank 2's registered membership and report its death exactly
+    # like the raylet memory monitor would
+    raw = _kv_call("kv_get", "colmember:memmon:0:2")
+    assert raw is not None, "rank 2 never registered its group membership"
+    info = json.loads(bytes(raw).decode())
+    t0 = time.perf_counter()
+    _kv_call(
+        "report_worker_death",
+        WorkerID.from_hex(info["worker_id"]),
+        "Task was killed due to the node running low on memory (recall)",
+    )
+    out = ray_tpu.get(refs, timeout=30)
+    assert [o[0] for o in out] == ["aborted", "aborted"]
+    assert time.perf_counter() - t0 < 5.0
+
+
+def _elastic_train_loop(config):
+    import time
+
+    import numpy as np
+
+    from ray_tpu import collective
+    from ray_tpu import train as t
+
+    ctx = t.get_context()
+    state = t.restore_train_state()
+    if state is None:
+        step, params = 0, np.zeros(2)
+    else:
+        step = state["step"] + 1
+        params = np.asarray(state["params"])
+    while step < config["steps"]:
+        # pace the loop so the controller-side chaos callback can land its
+        # kill mid-run instead of after the whole loop already finished
+        time.sleep(config.get("step_time", 0.0))
+        # data-parallel "gradient": the allreduce hangs the survivors when a
+        # rank dies, so every step exercises the abort plane
+        grad = collective.allreduce(
+            np.ones(2), group_name=ctx.collective_group
+        )
+        params = params + grad
+        t.publish_train_state(params, step=step)
+        t.report(
+            {
+                "step": step,
+                "world_size": ctx.get_world_size(),
+                "epoch": ctx.collective_epoch,
+                "psum": float(np.sum(params)),
+            }
+        )
+        step += 1
+
+
+def test_elastic_resume_after_rank_kill(shutdown_only, tmp_path):
+    """The headline elastic scenario: a 4-worker run loses rank 3 mid-step,
+    the controller resizes to world_size=3 (no full respawn, no filesystem
+    checkpoint), and training resumes from the weight plane with a
+    continuous step count."""
+    from ray_tpu import train as rt_train
+    from ray_tpu.testing import KillWorkerAtStep
+    from ray_tpu.util import metrics
+
+    ray_tpu.init(num_cpus=8)
+    os.environ["RAY_TPU_STORAGE_PATH"] = str(tmp_path / "results")
+    try:
+        killer = KillWorkerAtStep(rank=3, step=2)
+        trainer = rt_train.JaxTrainer(
+            _elastic_train_loop,
+            train_loop_config={"steps": 6, "step_time": 0.3},
+            scaling_config=rt_train.ScalingConfig(num_workers=4),
+            run_config=rt_train.RunConfig(
+                name="elastic-chaos",
+                failure_config=rt_train.FailureConfig(
+                    max_failures=0, elastic=True, min_workers=2
+                ),
+                callbacks=[killer],
+            ),
+        )
+        resizes_before = metrics.train_ft_counters()["resizes"]
+        result = trainer.fit()
+    finally:
+        os.environ.pop("RAY_TPU_STORAGE_PATH", None)
+
+    assert result.error is None, f"elastic run failed: {result.error!r}"
+    assert killer.kills and killer.kills[0]["rank"] == 3
+    r0 = sorted(
+        (e for e in result.metrics_history if e["_world_rank"] == 0),
+        key=lambda e: e["step"],
+    )
+    steps = [e["step"] for e in r0]
+    # continuous: every step 0..5 reported exactly once by rank 0 — the
+    # weight-plane resume restarted at published step + 1, no gap, no replay
+    assert steps == list(range(6)), f"step sequence broken: {steps}"
+    sizes = [e["world_size"] for e in r0]
+    assert sizes[0] == 4 and sizes[-1] == 3, f"world sizes: {sizes}"
+    assert {4, 3} == set(sizes)
+    # the re-formed gang runs at a bumped collective epoch
+    assert r0[0]["epoch"] == 0 and r0[-1]["epoch"] >= 1
+    # allreduce of ones sums the live world size: psum tracks 2*ws per step
+    expected, total = [], 0.0
+    for ws in sizes:
+        total += 2.0 * ws
+        expected.append(total)
+    assert [e["psum"] for e in r0] == pytest.approx(expected)
+    # the controller (this process) recorded the resize + recovery time
+    assert metrics.train_ft_counters()["resizes"] >= resizes_before + 1
+    pct = metrics.train_recovery_percentiles()
+    assert pct["count"] >= 1 and pct["max_s"] > 0.0
+
+
+def test_delay_collective_injection(shutdown_only):
+    """`ray_tpu chaos delay-collective` backing path: a coldelay:<group> KV
+    value makes every member op sleep that long at entry (TTL-cached)."""
+    import numpy as np
+
+    from ray_tpu.collective.cpu_group import GcsStoreGroup, _kv_call
+
+    ray_tpu.init(num_cpus=2)
+    _kv_call("kv_put", "coldelay:slowg", b"0.4", True)
+    g = GcsStoreGroup(1, 0, "slowg", epoch=0)
+    t0 = time.perf_counter()
+    g.allreduce(np.ones(2))
+    assert time.perf_counter() - t0 >= 0.4
+    _kv_call("kv_del", "coldelay:slowg")
+    g.destroy()

@@ -151,3 +151,15 @@ def test_timeline_endpoint_and_ui_panels(dashboard_cluster):
     for anchor in ('id="timeline"', 'id="sparklines"', 'id="pgs"',
                    "/api/timeline", "renderSparklines"):
         assert anchor in html, anchor
+
+
+def test_train_endpoint(dashboard_cluster):
+    """/api/train serves live run records plus the cluster fault-tolerance
+    rollup (resizes/restarts/aborts/recovery)."""
+    dash = dashboard_cluster
+    out = _get_json(dash.url + "/api/train")
+    assert out["runs"] == []  # nothing training in this cluster
+    ft = out["fault_tolerance"]
+    assert set(ft) == {
+        "resizes", "restarts", "aborts", "recoveries", "recovery_mean_s"
+    }

@@ -145,3 +145,58 @@ def test_node_death_detection():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_gcs_group_epoch_sweep_reclaims_leaked_keys(shutdown_only):
+    """A collective epoch that dies without destroy() leaks its rendezvous
+    and membership keys in the GCS KV; rank 0 of the next epoch sweeps every
+    dead epoch's keys at init (the elastic re-form path)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.collective.cpu_group import GcsStoreGroup, _kv_call
+
+    ray_tpu.init(num_cpus=2)
+    g0 = GcsStoreGroup(1, 0, "sweep", epoch=0)
+    for _ in range(3):
+        g0.allreduce(np.ones(2))
+    # simulate a crash: no destroy() — the lagged-cleanup scheme leaves the
+    # last ops' keys and the membership record behind
+    assert _kv_call("kv_keys", "col:sweep:0:")
+    assert _kv_call("kv_get", "colmember:sweep:0:0") is not None
+
+    g1 = GcsStoreGroup(1, 0, "sweep", epoch=1)
+    assert not _kv_call("kv_keys", "col:sweep:0:")
+    assert not _kv_call("kv_keys", "colmember:sweep:0:")
+    # the new epoch still works and registered itself
+    out = g1.allreduce(np.ones(2))
+    assert float(out[0]) == 1.0
+    assert _kv_call("kv_get", "colmember:sweep:1:0") is not None
+    g1.destroy()
+    assert _kv_call("kv_get", "colmember:sweep:1:0") is None
+
+
+def test_abort_epoch_is_scoped_to_older_epochs(shutdown_only):
+    """colabort applies to epochs <= the written mark: a re-formed gang at a
+    higher epoch is not poisoned by the old abort."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.collective.cpu_group import (
+        GcsStoreGroup,
+        read_abort_epoch,
+        write_abort,
+    )
+    from ray_tpu.exceptions import CollectiveAbortedError
+
+    ray_tpu.init(num_cpus=2)
+    g0 = GcsStoreGroup(1, 0, "scoped", epoch=0)
+    write_abort("scoped", 0, reason="test kill")
+    assert read_abort_epoch("scoped") == 0
+    with pytest.raises(CollectiveAbortedError):
+        g0.allreduce(np.ones(2))
+    # the next epoch ignores the stale abort mark — no key deletion needed
+    g1 = GcsStoreGroup(1, 0, "scoped", epoch=1)
+    out = g1.allreduce(np.ones(2))
+    assert float(out[0]) == 1.0
+    g1.destroy()

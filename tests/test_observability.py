@@ -474,3 +474,71 @@ def test_device_profile_writes_xplane(tmp_path):
     for root, _dirs, files in os.walk(logdir):
         found.extend(f for f in files if f.endswith((".pb", ".xplane.pb")))
     assert found, f"no profile artifacts under {logdir}"
+
+
+def test_train_ft_metrics_units():
+    """Train fault-tolerance metrics: counters, recovery histogram, and the
+    exact-percentile sample path (process-local, no cluster needed)."""
+    from ray_tpu.util import metrics
+
+    before = metrics.train_ft_counters()
+    metrics.record_train_resize("obs-run")
+    metrics.record_train_restart("obs-run")
+    metrics.record_collective_abort("obs-group")
+    metrics.record_train_recovery("obs-run", 0.5, kind="resize")
+    metrics.record_train_recovery("obs-run", 2.0, kind="restart")
+
+    after = metrics.train_ft_counters()
+    assert after["resizes"] == before["resizes"] + 1
+    assert after["restarts"] == before["restarts"] + 1
+    assert after["aborts"] == before["aborts"] + 1
+
+    pct = metrics.train_recovery_percentiles()
+    assert pct["count"] >= 2
+    assert 0.0 < pct["p50_s"] <= pct["p99_s"] <= pct["max_s"]
+    assert pct["max_s"] >= 2.0
+
+
+def test_train_ft_summary_rollup():
+    """train_ft_summary aggregates pushed metric snapshots from many
+    processes into the cluster-wide fault-tolerance rollup the dashboard
+    and `ray_tpu chaos list` serve."""
+    from ray_tpu.util.metrics import train_ft_summary
+
+    import json as _json
+
+    payloads = [
+        {
+            "metrics": [
+                {
+                    "name": "train_resize_total",
+                    "values": {_json.dumps(["a"]): 2.0},
+                },
+                {
+                    "name": "collective_abort_total",
+                    "values": {_json.dumps(["g"]): 3.0},
+                },
+                {
+                    "name": "train_recovery_seconds",
+                    # histogram snapshot: values = per-label sums, counts =
+                    # per-label bucket observation counts
+                    "values": {_json.dumps(["a", "resize"]): 3.0},
+                    "counts": {_json.dumps(["a", "resize"]): [1, 1, 0]},
+                },
+            ]
+        },
+        {
+            "metrics": [
+                {
+                    "name": "train_restart_total",
+                    "values": {_json.dumps(["b"]): 1.0},
+                }
+            ]
+        },
+    ]
+    out = train_ft_summary(payloads)
+    assert out["resizes"] == 2.0
+    assert out["restarts"] == 1.0
+    assert out["aborts"] == 3.0
+    assert out["recoveries"] == 2
+    assert out["recovery_mean_s"] == pytest.approx(1.5)

@@ -326,3 +326,34 @@ def test_lora_split_merge_and_frozen_base():
         (v == "lora") == (k[-1] in ("lora_a", "lora_b"))
         for k, v in flat_labels.items()
     )
+
+
+def test_failure_config_elastic_fields():
+    fc = rt_train.FailureConfig()
+    assert fc.elastic is False
+    assert fc.min_workers == 1
+    fc2 = rt_train.FailureConfig(max_failures=2, elastic=True, min_workers=3)
+    assert fc2.elastic and fc2.min_workers == 3
+    with pytest.raises(ValueError):
+        rt_train.FailureConfig(min_workers=0)
+    # RESIZING is a first-class run state, distinct from gang RESTARTING
+    assert rt_train.RunState.RESIZING.value == "RESIZING"
+    assert rt_train.RunState.RESIZING is not rt_train.RunState.RESTARTING
+
+
+def test_worker_group_rank_reassignment_units():
+    """_assign_ranks re-ranks survivors stably after removals: world ranks
+    stay dense 0..n-1 and preserve the (node, arrival) order."""
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    pairs = [
+        (f"actor{i}", {"node_id": f"node{i % 2}", "pid": 100 + i, "hostname": "h"})
+        for i in range(4)
+    ]
+    infos = WorkerGroup._assign_ranks(pairs)
+    assert [w.world_rank for w in infos] == [0, 1, 2, 3]
+    # drop one survivor's pair: ranks collapse to 0..2, order preserved
+    survivors = [(w.actor, w.metadata) for w in infos if w.world_rank != 1]
+    rebuilt = WorkerGroup._assign_ranks(survivors)
+    assert [w.world_rank for w in rebuilt] == [0, 1, 2]
+    assert [w.actor for w in rebuilt] == [w.actor for w in infos if w.world_rank != 1]
