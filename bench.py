@@ -643,11 +643,137 @@ def elastic_recover():
     }))
 
 
+def serve_churn():
+    """`python bench.py serve_churn` — serving fault-tolerance benchmark.
+
+    A steady closed-loop request stream (4 caller threads) runs against a
+    3-replica deployment while a chaos thread SIGKILLs one replica every
+    few seconds; the controller replaces it and the handle's retry
+    envelope fails the in-flight requests over. Reports success rate,
+    p50/p99 latency, kills absorbed, and the serve_ft counters (retries
+    recorded caller-side, sheds from the cluster metrics rollup). CPU
+    backend: the failover path is backend-independent."""
+    import statistics
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import ray_tpu
+    from ray_tpu import serve, testing
+    from ray_tpu.util import state as rt_state
+    from ray_tpu.util.metrics import serve_ft_counters
+
+    duration_s, kill_every_s, callers = 18.0, 5.0, 4
+    work_s = 0.05
+    ray_tpu.init(num_cpus=8)
+    try:
+        @serve.deployment(num_replicas=3, max_ongoing_requests=8,
+                          max_queued_requests=32)
+        class Worker:
+            def __call__(self, x):
+                time.sleep(work_s)
+                return x
+
+        handle = serve.run(Worker.bind(), name="churn", _proxy=False)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            rows = [r for r in testing.list_serve_replicas("churn")
+                    if r["state"] == "RUNNING" and r["pid"]]
+            if len(rows) == 3:
+                break
+            time.sleep(0.1)
+        _log(f"3 replicas up; streaming for {duration_s}s, "
+             f"killing one every {kill_every_s}s")
+
+        stop = threading.Event()
+        latencies, failures = [], []
+        lock = threading.Lock()
+
+        def caller():
+            i = 0
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    out = handle.remote(i).result(timeout_s=30)
+                    ok = out == i
+                except Exception as exc:  # noqa: BLE001 — tallied
+                    ok = False
+                    with lock:
+                        failures.append(type(exc).__name__)
+                dt = time.perf_counter() - t0
+                with lock:
+                    if ok:
+                        latencies.append(dt)
+                i += 1
+
+        kills = []
+
+        def chaos():
+            while not stop.wait(kill_every_s):
+                rid, pid = testing.kill_serve_replica("churn")
+                if rid is not None:
+                    kills.append(rid)
+                    _log(f"killed replica {rid} (pid {pid})")
+
+        threads = [threading.Thread(target=caller, daemon=True)
+                   for _ in range(callers)]
+        threads.append(threading.Thread(target=chaos, daemon=True))
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=35)
+
+        time.sleep(3.5)  # one metrics push interval: collect replica sheds
+        counters = serve_ft_counters()
+        try:
+            ft = rt_state.metrics_summary().get("serve_ft", {})
+        except Exception:
+            ft = {}
+        total = len(latencies) + len(failures)
+        success = len(latencies) / total if total else 0.0
+        lat_sorted = sorted(latencies)
+        p50 = statistics.median(lat_sorted) if lat_sorted else 0.0
+        p99 = lat_sorted[int(0.99 * (len(lat_sorted) - 1))] if lat_sorted \
+            else 0.0
+        _log(
+            f"{total} requests, {len(failures)} failed "
+            f"({sorted(set(failures))}), {len(kills)} kills, "
+            f"{counters['retries']} retries; p50={p50 * 1e3:.1f}ms "
+            f"p99={p99 * 1e3:.1f}ms"
+        )
+        print(json.dumps({
+            "metric": "serve_churn_success_rate",
+            "value": round(success, 4),
+            "unit": "fraction of requests completed while replicas die",
+            "requests": total,
+            "failures": len(failures),
+            "failure_types": sorted(set(failures)),
+            "replicas_killed": len(kills),
+            "failover_retries": counters["retries"],
+            "sheds": ft.get("sheds", 0),
+            "latency_p50_ms": round(p50 * 1e3, 1),
+            "latency_p99_ms": round(p99 * 1e3, 1),
+            "config": {
+                "num_replicas": 3, "caller_threads": callers,
+                "duration_s": duration_s, "kill_every_s": kill_every_s,
+                "work_s": work_s, "backend": "cpu",
+            },
+        }))
+    finally:
+        ray_tpu.shutdown()
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "llm_prefix_cache":
         llm_prefix_cache()
     elif len(sys.argv) > 1 and sys.argv[1] == "elastic_recover":
         elastic_recover()
+    elif len(sys.argv) > 1 and sys.argv[1] == "serve_churn":
+        serve_churn()
     elif len(sys.argv) > 1:
         raise SystemExit(f"unknown bench mode {sys.argv[1]!r}")
     else:

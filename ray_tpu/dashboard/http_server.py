@@ -193,6 +193,9 @@ class DashboardServer:
             # train fault-tolerance rollup (resizes/restarts/aborts/
             # recovery time) + live run records for chaos tooling
             ("GET", "/api/train"): self._train,
+            # serve fault-tolerance rollup (failover retries, sheds,
+            # DOA rejections, drain durations)
+            ("GET", "/api/serve"): self._serve,
             ("GET", "/metrics"): self._metrics,
             # browser UI (role of the React frontend, dashboard/client/ —
             # here a dependency-free single page over the same REST API)
@@ -268,6 +271,13 @@ class DashboardServer:
         return 200, {
             "runs": runs,
             "fault_tolerance": train_ft_summary(self._metric_payloads()),
+        }, None
+
+    def _serve(self, body):
+        from ..util.metrics import serve_ft_summary
+
+        return 200, {
+            "fault_tolerance": serve_ft_summary(self._metric_payloads()),
         }, None
 
     def _metrics(self, body):

@@ -139,6 +139,67 @@ class CollectiveAbortedError(RayTpuError):
         return (type(self), (self.group_name, self.epoch, self.reason))
 
 
+class BackPressureError(RayTpuError):
+    """A replica refused a request because its admission queue is full
+    (reference: serve/exceptions.py BackPressureError). Raised fast —
+    before the request is accepted — so callers get a typed 503-style
+    rejection in milliseconds instead of a 60 s timeout pileup. Retryable
+    on another replica (subject to RequestRouterConfig.retry_backpressure)."""
+
+    def __init__(self, replica_id: str = "", ongoing: int = 0,
+                 queued: int = 0, retry_after_s: float = 0.1):
+        self.replica_id = replica_id
+        self.ongoing = ongoing
+        self.queued = queued
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"replica {replica_id!r} shed request: {ongoing} ongoing, "
+            f"{queued} queued (queue cap reached); retry after "
+            f"{retry_after_s}s"
+        )
+
+    def __reduce__(self):
+        return (type(self), (self.replica_id, self.ongoing, self.queued,
+                             self.retry_after_s))
+
+
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """The request's end-to-end deadline passed. Raised by the replica for
+    dead-on-arrival work (deadline already expired when the request was
+    admitted) and by the handle when the retry budget runs out. Not
+    retryable: the caller has already stopped waiting."""
+
+    def __init__(self, deployment: str = "", elapsed_s: float = 0.0,
+                 timeout_s: float = 0.0, where: str = "replica"):
+        self.deployment = deployment
+        self.elapsed_s = elapsed_s
+        self.timeout_s = timeout_s
+        self.where = where
+        super().__init__(
+            f"request to {deployment!r} exceeded its {timeout_s}s deadline "
+            f"({elapsed_s:.3f}s elapsed, detected at {where})"
+        )
+
+    def __reduce__(self):
+        return (type(self), (self.deployment, self.elapsed_s,
+                             self.timeout_s, self.where))
+
+
+class ReplicaDrainingError(RayTpuError):
+    """The target replica is DRAINING and no longer admits new requests
+    (the routing table was stale). Retryable: the handle force-refreshes
+    and resubmits to a replica that is still RUNNING."""
+
+    def __init__(self, replica_id: str = ""):
+        self.replica_id = replica_id
+        super().__init__(
+            f"replica {replica_id!r} is draining and rejects new requests"
+        )
+
+    def __reduce__(self):
+        return (type(self), (self.replica_id,))
+
+
 class RpcError(RayTpuError):
     """Transport-level RPC failure."""
 

@@ -123,6 +123,23 @@ class ObjectRefGenerator:
     def __repr__(self):
         return f"ObjectRefGenerator({self._task_id.hex()})"
 
+    def close(self):
+        """Eagerly release the owner's stream bookkeeping (don't wait for
+        GC): the next item the executor reports finds no stream state and
+        learns the consumer is gone, so the replica-side generator is
+        closed instead of producing into the void."""
+        from . import _worker_api
+
+        worker = _worker_api.maybe_get_core_worker()
+        if worker is None:
+            return
+        try:
+            worker.loop.call_soon_threadsafe(
+                worker.drop_stream, self._task_id
+            )
+        except RuntimeError:
+            pass
+
     def __del__(self):
         # abandoning the generator releases the owner's stream bookkeeping
         # (a failed or half-consumed stream must not pin state forever)

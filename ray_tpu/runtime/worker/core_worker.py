@@ -1260,14 +1260,16 @@ class CoreWorker:
         if state is not None:
             state.reported.add(index)
             state.pulse()
-        else:
-            # stream already dropped/terminated (state is created at submit
-            # time, so None means the consumer abandoned it): free the item
-            # we just stored, or a still-producing generator pins every
-            # remaining yield for the process lifetime. _maybe_free respects
-            # live ObjectRefs, so re-reports of already-read items survive.
-            self._maybe_free(object_id)
-        return True
+            return True
+        # stream already dropped/terminated (state is created at submit
+        # time, so None means the consumer abandoned it): free the item
+        # we just stored, or a still-producing generator pins every
+        # remaining yield for the process lifetime. _maybe_free respects
+        # live ObjectRefs, so re-reports of already-read items survive.
+        # False tells the executor nobody is listening — it closes the
+        # user generator instead of producing items into the void.
+        self._maybe_free(object_id)
+        return False
 
     async def next_stream_item(self, task_id: TaskID) -> Optional[ObjectRef]:
         """Next ObjectRef of a streaming task, in yield order; None at
@@ -1787,7 +1789,7 @@ class CoreWorker:
             if size <= self.config.max_direct_call_object_size:
                 packed = bytearray(size)
                 serialization.pack_into(meta, bufs, memoryview(packed))
-                await owner.call(
+                consumer_alive = await owner.call(
                     "report_generator_item", spec.task_id, count,
                     bytes(packed), size, False, None,
                 )
@@ -1795,11 +1797,27 @@ class CoreWorker:
                 await self._put_plasma(
                     object_id, meta, bufs, size, primary=True
                 )
-                await owner.call(
+                consumer_alive = await owner.call(
                     "report_generator_item", spec.task_id, count,
                     None, size, True, self.raylet_address,
                 )
             count += 1
+            if consumer_alive is False:
+                # the owner dropped the stream (consumer closed/abandoned
+                # the ObjectRefGenerator — e.g. an HTTP client disconnected
+                # mid-stream): stop driving and close the user generator so
+                # its finally blocks run and it stops burning compute
+                close = getattr(gen, "aclose", None) or getattr(
+                    gen, "close", None
+                )
+                if close is not None:
+                    try:
+                        result = close()
+                        if asyncio.iscoroutine(result):
+                            await result
+                    except Exception:  # noqa: BLE001
+                        pass
+                break
         # the exhausted generator's closure still pins the deserialized
         # args; drop it so borrowed_refs reflects only user-stashed refs
         del gen

@@ -246,6 +246,12 @@ def cmd_chaos(args):
       blocked in a rendezvous raises CollectiveAbortedError within ~1 s.
     - ``delay-collective``: make every op of a group sleep N seconds at
       entry (straggler injection); 0 clears.
+    - ``kill-replica`` / ``pause-replica``: SIGKILL / SIGSTOP one serve
+      replica process (same-host pids only) — replica-loss / stuck-replica
+      injection; the handle retry envelope plus controller health polling
+      must absorb it.
+    - ``drain``: gracefully drain one serve replica through the
+      controller's DRAINING state machine (rolling-restart injection).
     """
     _connected(args)
     from ..util import state
@@ -262,11 +268,57 @@ def cmd_chaos(args):
         return _worker_api.run_on_worker_loop(client.call(method, *cargs))
 
     if args.chaos_action == "list":
+        from ..testing import list_serve_replicas
+
+        summary = state.metrics_summary()
         out = {
             "runs": state.list_train_runs(),
-            "train_ft": state.metrics_summary()["train_ft"],
+            "train_ft": summary["train_ft"],
+            "serve_replicas": list_serve_replicas(args.app),
+            "serve_ft": summary.get("serve_ft", {}),
         }
         print(json.dumps(out, indent=2, default=str))
+        return 0
+    if args.chaos_action in ("kill-replica", "pause-replica"):
+        from ..testing import kill_serve_replica
+
+        sig = signal.SIGKILL if args.chaos_action == "kill-replica" \
+            else signal.SIGSTOP
+        rid, pid = kill_serve_replica(
+            args.app, deployment=args.deployment, replica_id=args.replica,
+            sig=sig,
+        )
+        if rid is None:
+            print(f"no matching RUNNING replica in app {args.app!r} "
+                  f"(pids are same-host only; see `ray_tpu chaos list`)",
+                  file=sys.stderr)
+            return 1
+        verb = "killed" if sig == signal.SIGKILL else "paused"
+        print(f"{verb} replica {rid} (pid {pid}) of app {args.app!r}")
+        return 0
+    if args.chaos_action == "drain":
+        from .. import api
+        from ..serve.controller import CONTROLLER_NAME
+
+        if not args.replica:
+            print("drain needs --replica (see `ray_tpu chaos list`)",
+                  file=sys.stderr)
+            return 1
+        try:
+            controller = api.get_actor(CONTROLLER_NAME)
+            ok = api.get(
+                controller.drain_replica.remote(args.app, args.replica),
+                timeout=10,
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"drain failed: {e}", file=sys.stderr)
+            return 1
+        if not ok:
+            print(f"replica {args.replica!r} not found (or not RUNNING) in "
+                  f"app {args.app!r}", file=sys.stderr)
+            return 1
+        print(f"draining replica {args.replica} of app {args.app!r}; the "
+              f"controller replaces it once in-flight requests finish")
         return 0
     if args.chaos_action == "kill-rank":
         runs = {r["name"]: r for r in state.list_train_runs()}
@@ -428,14 +480,30 @@ def main(argv=None):
     p.set_defaults(fn=cmd_kvcache)
 
     p = sub.add_parser(
-        "chaos", help="fault injection: kill ranks, abort/delay collectives"
+        "chaos",
+        help="fault injection: kill ranks/replicas, abort/delay "
+             "collectives, drain replicas",
     )
     p.add_argument(
         "chaos_action",
-        choices=["list", "kill-rank", "abort-group", "delay-collective"],
+        choices=["list", "kill-rank", "abort-group", "delay-collective",
+                 "kill-replica", "pause-replica", "drain"],
     )
     p.add_argument("--address", required=True, help="head host:port")
     p.add_argument("--run", default=None, help="train run name (kill-rank)")
+    p.add_argument(
+        "--app", default="default",
+        help="serve app name (kill-replica/pause-replica/drain)",
+    )
+    p.add_argument(
+        "--deployment", default=None,
+        help="restrict kill-replica/pause-replica to one deployment",
+    )
+    p.add_argument(
+        "--replica", default=None,
+        help="replica id (required for drain; optional filter for "
+             "kill-replica/pause-replica)",
+    )
     p.add_argument("--rank", type=int, default=0, help="world rank to kill")
     p.add_argument("--group", default=None, help="collective group name")
     p.add_argument(

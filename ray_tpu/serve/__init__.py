@@ -22,7 +22,7 @@ from .api import (
 )
 from .batching import batch
 from .grpc_proxy import grpc_call
-from .config import AutoscalingConfig, DeploymentConfig
+from .config import AutoscalingConfig, DeploymentConfig, RequestRouterConfig
 from .handle import (
     DeploymentHandle,
     DeploymentResponse,
@@ -52,4 +52,5 @@ __all__ = [
     "ingress",
     "AutoscalingConfig",
     "DeploymentConfig",
+    "RequestRouterConfig",
 ]

@@ -14,7 +14,12 @@ from typing import Any, Dict, List, Optional
 
 from .. import api as ray_api
 from .._internal import serialization
-from .config import ApplicationStatus, AutoscalingConfig, DeploymentConfig
+from .config import (
+    ApplicationStatus,
+    AutoscalingConfig,
+    DeploymentConfig,
+    RequestRouterConfig,
+)
 from .controller import CONTROLLER_NAME, ServeController
 from .handle import DeploymentHandle, DeploymentResponse
 
@@ -88,6 +93,10 @@ def deployment(_target=None, **options):
         if isinstance(options.get("autoscaling_config"), dict):
             options["autoscaling_config"] = AutoscalingConfig(
                 **options["autoscaling_config"]
+            )
+        if isinstance(options.get("request_router_config"), dict):
+            options["request_router_config"] = RequestRouterConfig(
+                **options["request_router_config"]
             )
         cfg = DeploymentConfig(
             name=options.pop("name", None) or target.__name__, **options

@@ -39,10 +39,44 @@ class AutoscalingConfig:
 
 
 @dataclass
+class RequestRouterConfig:
+    """Handle-side failover policy, distributed to every router via the
+    routing table (reference: serve/config.py RequestRouterConfig — there
+    it picks the router class; here it parameterizes the retry envelope
+    around ``handle.remote()``).
+
+    ``max_attempts`` counts total submissions (1 = no failover).
+    ``retry_backpressure`` controls whether a BackPressureError shed is
+    retried on another replica or surfaced to the caller immediately —
+    proxies surface it (they own the 503/Retry-After contract), plain
+    handles retry by default.
+    """
+
+    max_attempts: int = 3
+    backoff_s: float = 0.05
+    default_timeout_s: float = 60.0
+    retry_backpressure: bool = True
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "max_attempts": self.max_attempts,
+            "backoff_s": self.backoff_s,
+            "default_timeout_s": self.default_timeout_s,
+            "retry_backpressure": self.retry_backpressure,
+        }
+
+
+@dataclass
 class DeploymentConfig:
     name: str = ""
     num_replicas: int = 1
     max_ongoing_requests: int = 100
+    # admission control: requests beyond max_ongoing_requests wait on the
+    # replica up to this queue depth; past it the replica sheds with a
+    # typed BackPressureError instead of letting latency pile up
+    # (reference: serve DeploymentConfig.max_queued_requests)
+    max_queued_requests: int = 64
+    request_router_config: Optional[RequestRouterConfig] = None
     user_config: Optional[Any] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
@@ -63,7 +97,7 @@ class DeploymentConfig:
 @dataclass
 class ReplicaStatus:
     replica_id: str
-    state: str  # STARTING | RUNNING | STOPPING | DEAD
+    state: str  # STARTING | RUNNING | DRAINING | STOPPING | DEAD
     queue_len: int = 0
 
 

@@ -38,6 +38,12 @@ class _ReplicaState:
         self.queue_len = 0
         self.consecutive_health_failures = 0
         self.started_at = time.time()
+        self.pid = 0  # captured from get_metrics; chaos CLI targets it
+        # drain bookkeeping (state == "DRAINING"): the in-flight drain()
+        # call and the hard deadline after which the replica is killed
+        # whether or not it acked
+        self.drain_ref = None
+        self.drain_deadline = 0.0
 
 
 class _DeploymentState:
@@ -308,6 +314,7 @@ class ServeController:
             items = list(self._deployments.items())
         for full_name, dep in items:
             self._poll_replicas(dep)
+            self._reap_draining(dep)
             if dep.config.autoscaling_config:
                 self._autoscale(dep)
             self._converge(full_name, dep)
@@ -321,6 +328,7 @@ class ServeController:
             try:
                 metrics = api.get(replica.handle.get_metrics.remote(), timeout=5)
                 replica.queue_len = metrics["queue_len"]
+                replica.pid = metrics.get("pid", replica.pid)
                 replica.consecutive_health_failures = 0
             except Exception:
                 replica.consecutive_health_failures += 1
@@ -334,6 +342,54 @@ class ServeController:
                         api.kill(replica.handle)
                     except Exception:
                         pass
+
+    def _begin_drain(self, dep: _DeploymentState, rid: str):
+        """Transition a RUNNING replica to DRAINING: routers stop picking it
+        (routing table filters to RUNNING), the replica finishes in-flight
+        and queued work bounded by graceful_shutdown_timeout_s, then acks;
+        _reap_draining kills it after the ack or the deadline. Asynchronous —
+        reconcile keeps running while the replica drains (reference:
+        deployment_state.py graceful-stop via STOPPING states)."""
+        with self._lock:
+            replica = dep.replicas.get(rid)
+            if replica is None or replica.state != "RUNNING":
+                return
+            replica.state = "DRAINING"
+            dep.version += 1
+            self._dirty = True
+        timeout_s = dep.config.graceful_shutdown_timeout_s
+        try:
+            replica.drain_ref = replica.handle.drain.remote(timeout_s)
+        except Exception:
+            replica.drain_ref = None
+        # small slack over the replica-side bound so a clean ack wins the race
+        replica.drain_deadline = time.time() + timeout_s + 2.0
+
+    def _reap_draining(self, dep: _DeploymentState):
+        from .. import api
+
+        for rid, replica in list(dep.replicas.items()):
+            if replica.state != "DRAINING":
+                continue
+            done = replica.drain_ref is None
+            if not done:
+                try:
+                    api.get(replica.drain_ref, timeout=0.05)
+                    done = True
+                except TimeoutError:
+                    done = False
+                except Exception:
+                    # replica died or drain errored; nothing left to wait for
+                    done = True
+            if done or time.time() >= replica.drain_deadline:
+                with self._lock:
+                    dep.replicas.pop(rid, None)
+                    dep.version += 1
+                    self._dirty = True
+                try:
+                    api.kill(replica.handle)
+                except Exception:
+                    pass
 
     def _autoscale(self, dep: _DeploymentState):
         cfg: AutoscalingConfig = dep.config.autoscaling_config
@@ -362,17 +418,30 @@ class ServeController:
     def _converge(self, full_name: str, dep: _DeploymentState):
         from .. import api
 
-        live = len(dep.replicas)
-        if live < dep.target_replicas:
-            for _ in range(dep.target_replicas - live):
+        # DRAINING replicas are lame ducks: they still exist (finishing
+        # accepted work) but don't count toward the target, so a drained
+        # replica's replacement starts immediately and rolling
+        # replacement/scale-down never dips serving capacity to zero
+        active = [
+            r for r in dep.replicas.values()
+            if r.state in ("STARTING", "RUNNING")
+        ]
+        if len(active) < dep.target_replicas:
+            for _ in range(dep.target_replicas - len(active)):
                 self._start_replica(full_name, dep)
-        elif live > dep.target_replicas:
-            excess = live - dep.target_replicas
-            victims = sorted(dep.replicas.values(), key=lambda r: r.queue_len)[
-                :excess
-            ]
+        elif len(active) > dep.target_replicas:
+            excess = len(active) - dep.target_replicas
+            # STARTING victims first (nothing accepted yet — cheap kill),
+            # then the least-loaded RUNNING ones, which drain gracefully
+            victims = sorted(
+                active,
+                key=lambda r: (r.state != "STARTING", r.queue_len),
+            )[:excess]
             for v in victims:
-                self._stop_replica(dep, v.replica_id)
+                if v.state == "STARTING":
+                    self._stop_replica(dep, v.replica_id)
+                else:
+                    self._begin_drain(dep, v.replica_id)
         for replica in list(dep.replicas.values()):
             if replica.state == "STARTING":
                 # short probe per iteration: a slow-loading replica stays
@@ -411,11 +480,16 @@ class ServeController:
         dep.next_replica_idx += 1
         opts = dict(dep.config.ray_actor_options or {})
         opts.setdefault("num_cpus", 1)
-        # headroom above max_ongoing_requests so control-plane calls
-        # (get_metrics/check_health) are not starved behind a saturated
-        # data plane and falsely mark the replica unhealthy
+        # getattr: configs unpickled from a pre-admission-control checkpoint
+        # lack the queue knob
+        max_queued = getattr(dep.config, "max_queued_requests", 64)
+        # headroom above the admission caps so control-plane calls
+        # (get_metrics/check_health/drain) are not starved behind a
+        # saturated data plane and falsely mark the replica unhealthy —
+        # queued requests each hold an actor-concurrency slot while waiting
         opts.setdefault(
-            "max_concurrency", dep.config.max_ongoing_requests + 8
+            "max_concurrency",
+            dep.config.max_ongoing_requests + max(0, max_queued) + 8,
         )
         ReplicaActor = api.remote(**opts)(Replica)
         handle = ReplicaActor.remote(
@@ -425,6 +499,8 @@ class ServeController:
             dep.init_args,
             dep.init_kwargs,
             dep.config.user_config,
+            max_ongoing_requests=dep.config.max_ongoing_requests,
+            max_queued_requests=max_queued,
         )
         with self._lock:
             dep.replicas[rid] = _ReplicaState(rid, handle)
@@ -456,13 +532,21 @@ class ServeController:
     # -- router / status API -------------------------------------------------
 
     def get_routing_table(self, app_name: str) -> Dict[str, Any]:
-        """deployment short-name -> {version, replicas: [(rid, handle)]}."""
+        """deployment short-name -> {version, replicas: [(rid, handle,
+        queue_len)], router_config}. DRAINING/UNHEALTHY replicas are
+        filtered out here, so routers never pick a lame duck; the
+        router_config dict distributes the deployment's failover policy to
+        every handle (reference: LongPollClient pushing DeploymentConfig)."""
+        from .config import RequestRouterConfig
+
         with self._lock:
             out = {}
             for short, full in self._apps.get(app_name, {}).items():
                 dep = self._deployments.get(full)
                 if dep is None:
                     continue
+                rc = getattr(dep.config, "request_router_config", None) \
+                    or RequestRouterConfig()
                 out[short] = {
                     "version": dep.version,
                     "replicas": [
@@ -470,7 +554,43 @@ class ServeController:
                         for r in dep.replicas.values()
                         if r.state == "RUNNING"
                     ],
+                    "router_config": rc.as_dict(),
                 }
+            return out
+
+    def drain_replica(self, app_name: str, replica_id: str) -> bool:
+        """Chaos/ops entry point: gracefully drain one replica. Converge
+        starts its replacement on the next reconcile tick (the drained
+        replica stops counting toward the target)."""
+        with self._lock:
+            candidates = [
+                self._deployments[full]
+                for full in self._apps.get(app_name, {}).values()
+                if full in self._deployments
+            ]
+        for dep in candidates:
+            if replica_id in dep.replicas:
+                self._begin_drain(dep, replica_id)
+                return True
+        return False
+
+    def list_replica_info(self, app_name: str) -> List[Dict[str, Any]]:
+        """Replica inventory for the chaos CLI and tests: deployment,
+        replica_id, state, pid (SIGKILL/SIGSTOP target), queue_len."""
+        with self._lock:
+            out = []
+            for short, full in self._apps.get(app_name, {}).items():
+                dep = self._deployments.get(full)
+                if dep is None:
+                    continue
+                for r in dep.replicas.values():
+                    out.append({
+                        "deployment": short,
+                        "replica_id": r.replica_id,
+                        "state": r.state,
+                        "pid": r.pid,
+                        "queue_len": r.queue_len,
+                    })
             return out
 
     def get_ingress_info(self, app_name: str) -> Dict[str, Any]:

@@ -86,16 +86,54 @@ class GRPCProxy:
             app_name = envelope.get("application", "default")
             method = envelope.get("method", "__call__")
             payload = envelope.get("payload")
+            # per-request deadline: an explicit envelope field wins, else
+            # the client's gRPC deadline (context.time_remaining()), else
+            # the deployment's default (60 s out of the box)
+            timeout_s = envelope.get("timeout_s")
+            if timeout_s is None:
+                try:
+                    remaining = context.time_remaining()
+                except Exception:  # noqa: BLE001
+                    remaining = None
+                if remaining is not None and remaining > 0:
+                    timeout_s = remaining
             result = await asyncio.get_event_loop().run_in_executor(
-                None, self._call_ingress, app_name, method, payload
+                None, self._call_ingress, app_name, method, payload, timeout_s
             )
             if isinstance(result, Exception):
-                return json.dumps({"ok": False, "error": repr(result)}).encode()
+                return self._error_reply(result, context)
             return json.dumps({"ok": True, "result": result}).encode()
         except Exception as e:  # noqa: BLE001
             return json.dumps({"ok": False, "error": repr(e)}).encode()
 
-    def _call_ingress(self, app_name: str, method: str, payload):
+    @staticmethod
+    def _error_reply(exc: Exception, context) -> bytes:
+        """Map typed serve errors onto gRPC semantics: sheds become
+        RESOURCE_EXHAUSTED with a retry_after_s hint, deadline expiry
+        becomes DEADLINE_EXCEEDED (reference: the proxy's status-code
+        mapping, serve/_private/proxy.py gRPC path)."""
+        import grpc
+
+        from ..exceptions import (
+            BackPressureError,
+            DeadlineExceededError,
+            GetTimeoutError,
+        )
+
+        cause = getattr(exc, "cause", None) or exc
+        body = {"ok": False, "error": repr(cause)}
+        try:
+            if isinstance(cause, BackPressureError):
+                context.set_code(grpc.StatusCode.RESOURCE_EXHAUSTED)
+                body["retry_after_s"] = cause.retry_after_s
+            elif isinstance(cause, (DeadlineExceededError, GetTimeoutError)):
+                context.set_code(grpc.StatusCode.DEADLINE_EXCEEDED)
+        except Exception:  # noqa: BLE001 — status is advisory; reply wins
+            pass
+        return json.dumps(body).encode()
+
+    def _call_ingress(self, app_name: str, method: str, payload,
+                      timeout_s: Optional[float] = None):
         from .api import get_app_handle
 
         try:
@@ -105,7 +143,11 @@ class GRPCProxy:
                 self._handles[app_name] = handle
             if method != "__call__":
                 handle = handle.options(method_name=method)
-            return handle.remote(payload).result(timeout_s=60)
+            if timeout_s is not None:
+                handle = handle.options(timeout_s=float(timeout_s))
+            # the handle's deadline (explicit or the deployment default)
+            # bounds the wait — no hardcoded proxy-side 60 s
+            return handle.remote(payload).result()
         except Exception as e:  # noqa: BLE001
             return e
 

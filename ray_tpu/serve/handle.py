@@ -7,17 +7,39 @@ power-of-two-choices replica picker
 replicas and routes to the one with the shorter queue, using queue lengths
 from the controller's routing table (refreshed on a version poll). Works
 from any process — handles serialize (controller handle + names only).
+
+Fault tolerance: ``remote()`` wraps every submission in a retryable
+envelope. A per-request deadline (``options(timeout_s=...)``, or the
+deployment's ``RequestRouterConfig.default_timeout_s``) rides in the
+request metadata so replicas can reject dead-on-arrival work; on replica
+death, transport failure, a stale-table ``ReplicaDrainingError``, or (by
+policy) a ``BackPressureError`` shed, the response force-refreshes the
+routing table, excludes the failed replica, and resubmits — bounded by
+``max_attempts`` and the remaining deadline budget. Streaming responses
+retry only while no partial output has been consumed (the idempotency
+guard: a half-delivered stream must not silently restart).
 """
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
 import zlib
-from typing import Any, Dict, Optional
+from typing import Any, Dict, FrozenSet, Optional, Set
 
 from .. import api
+from ..exceptions import (
+    ActorDiedError,
+    BackPressureError,
+    DeadlineExceededError,
+    ReplicaDrainingError,
+    RpcError,
+    WorkerCrashedError,
+)
+
+logger = logging.getLogger(__name__)
 
 
 def _prefix_affinity_key(args, kwargs, num_tokens: int) -> Optional[int]:
@@ -43,16 +65,153 @@ def _prefix_affinity_key(args, kwargs, num_tokens: int) -> Optional[int]:
     return None
 
 
+def _unwrap(exc: BaseException) -> BaseException:
+    """User/replica exceptions travel wrapped as TaskError with ``.cause``
+    set to the original; classification wants the original."""
+    cause = getattr(exc, "cause", None)
+    return cause if isinstance(cause, BaseException) else exc
+
+
+_TYPED_SERVE_ERRORS = (
+    BackPressureError, DeadlineExceededError, ReplicaDrainingError,
+)
+
+
+class _RequestContext:
+    """Everything needed to resubmit one request to a different replica:
+    the routing inputs, the failover policy from the deployment's
+    RequestRouterConfig, and the mutable attempt state (current replica,
+    replicas already tried). Shared by unary and streaming responses."""
+
+    def __init__(self, router: "Router", deployment: str, method: str,
+                 args: tuple, kwargs: dict, metadata: Optional[dict],
+                 affinity: Optional[int], stream: bool,
+                 deadline_ts: Optional[float], router_cfg: Dict[str, Any],
+                 replica_id: str):
+        self.router = router
+        self.deployment = deployment
+        self.method = method
+        self.args = args
+        self.kwargs = kwargs
+        self.metadata = metadata
+        self.affinity = affinity
+        self.stream = stream
+        self.deadline_ts = deadline_ts
+        self.max_attempts = max(1, int(router_cfg.get("max_attempts", 3)))
+        self.backoff_s = float(router_cfg.get("backoff_s", 0.05))
+        self.retry_backpressure = bool(
+            router_cfg.get("retry_backpressure", True)
+        )
+        self.attempt = 1
+        self.replica_id = replica_id
+        self.tried: Set[str] = {replica_id}
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline_ts is None:
+            return None
+        return self.deadline_ts - time.time()
+
+    def _retryable(self, exc: BaseException) -> bool:
+        if isinstance(exc, (ActorDiedError, WorkerCrashedError, RpcError,
+                            ReplicaDrainingError)):
+            return True
+        if isinstance(exc, BackPressureError):
+            return self.retry_backpressure
+        return False
+
+    def classify(self, raw_exc: BaseException):
+        """(exception to raise to the caller, retryable?). Typed serve
+        errors surface unwrapped (callers/proxies except BackPressureError,
+        not TaskError); everything else keeps its existing shape."""
+        exc = _unwrap(raw_exc)
+        to_raise = exc if isinstance(exc, _TYPED_SERVE_ERRORS) else raw_exc
+        return to_raise, self._retryable(exc)
+
+    def failover(self, raw_exc: BaseException):
+        """Try to resubmit after ``raw_exc``. Returns the new submission
+        (ref or ref-gen) or None when the error must surface (not
+        retryable, attempts exhausted, or no deadline budget left)."""
+        to_raise, retryable = self.classify(raw_exc)
+        if not retryable or self.attempt >= self.max_attempts:
+            return None
+        remaining = self.remaining_s()
+        backoff = self.backoff_s * self.attempt
+        if remaining is not None and remaining <= backoff:
+            return None
+        cause = _unwrap(raw_exc)
+        from ..util.metrics import record_serve_retry
+
+        record_serve_retry(self.deployment, type(cause).__name__)
+        logger.info(
+            "serve failover (%s attempt %d/%d): %s on replica %s; "
+            "resubmitting", self.deployment, self.attempt, self.max_attempts,
+            type(cause).__name__, self.replica_id,
+        )
+        if backoff > 0:
+            time.sleep(backoff)
+        self.attempt += 1
+        # the failed replica may be a fresh death the controller hasn't
+        # noticed yet — exclude it explicitly so the refreshed table can't
+        # hand it straight back
+        try:
+            rid, replica = self.router.pick(
+                self.deployment, self.affinity,
+                exclude=frozenset(self.tried), force_refresh=True,
+                deadline_ts=self.deadline_ts,
+            )
+        except Exception:
+            return None
+        self.replica_id = rid
+        self.tried.add(rid)
+        return _submit(replica, self)
+
+
+def _submit(replica, ctx: "_RequestContext"):
+    """One raw submission of the request to a replica actor."""
+    if ctx.stream:
+        return replica.handle_request_stream.options(
+            num_returns="streaming"
+        ).remote(ctx.method, ctx.args, ctx.kwargs, ctx.metadata)
+    return replica.handle_request.remote(
+        ctx.method, ctx.args, ctx.kwargs, ctx.metadata
+    )
+
+
 class DeploymentResponse:
     """Future for one request (reference: serve/handle.py
     DeploymentResponse): .result() blocks; ._to_object_ref() exposes the ref
-    for composition with ray_tpu.get/wait."""
+    for composition with ray_tpu.get/wait.
 
-    def __init__(self, ref):
+    With a retry context, ``result()`` is where failover happens: the
+    submission was eager (fire-and-forget callers never block), so a
+    replica death is only observed — and absorbed — when the result is
+    awaited."""
+
+    def __init__(self, ref, ctx: Optional[_RequestContext] = None):
         self._ref = ref
+        self._ctx = ctx
 
     def result(self, timeout_s: Optional[float] = None):
-        return api.get(self._ref, timeout=timeout_s)
+        while True:
+            wait_s = timeout_s
+            if self._ctx is not None:
+                remaining = self._ctx.remaining_s()
+                if remaining is not None:
+                    remaining = max(remaining, 0.001)
+                    wait_s = remaining if wait_s is None \
+                        else min(wait_s, remaining)
+            try:
+                return api.get(self._ref, timeout=wait_s)
+            except BaseException as exc:  # noqa: BLE001
+                if self._ctx is None:
+                    raise
+                new_ref = self._ctx.failover(exc)
+                if new_ref is None:
+                    to_raise, _ = self._ctx.classify(exc)
+                    if to_raise is exc:
+                        raise
+                    raise to_raise from exc
+                self._ref = new_ref
 
     def _to_object_ref(self):
         return self._ref
@@ -62,22 +221,64 @@ class DeploymentResponseGenerator:
     """Streaming response (reference: serve/handle.py:557
     DeploymentResponseGenerator): iterating yields each item the replica's
     generator produces, as soon as it is reported — the first item is
-    consumable while the replica is still generating."""
+    consumable while the replica is still generating.
 
-    def __init__(self, ref_gen, timeout_s: Optional[float] = 60.0):
+    Failover is guarded by consumption: once any item has been delivered
+    to the caller, a mid-stream failure surfaces instead of retrying (a
+    restarted stream would silently replay or skip output)."""
+
+    def __init__(self, ref_gen, timeout_s: Optional[float] = 60.0,
+                 ctx: Optional[_RequestContext] = None):
         self._ref_gen = ref_gen
         self._timeout_s = timeout_s
+        self._ctx = ctx
+        self._consumed = 0
 
     def __iter__(self):
         return self
 
+    def _item_timeout(self) -> Optional[float]:
+        if self._ctx is not None and self._ctx.deadline_ts is not None:
+            return max(self._ctx.deadline_ts - time.time(), 0.001)
+        return self._timeout_s
+
+    def _maybe_failover(self, exc: BaseException) -> bool:
+        """Replace the underlying stream with a fresh submission if the
+        idempotency guard (zero items consumed) and retry policy allow."""
+        if self._ctx is None or self._consumed > 0:
+            return False
+        new_gen = self._ctx.failover(exc)
+        if new_gen is None:
+            return False
+        self.close()
+        self._ref_gen = new_gen
+        return True
+
     def __next__(self):
-        ref = next(self._ref_gen)  # raises StopIteration at end of stream
-        return api.get(ref, timeout=self._timeout_s)
+        while True:
+            try:
+                ref = next(self._ref_gen)  # StopIteration at end of stream
+                return_value = api.get(ref, timeout=self._item_timeout())
+            except StopIteration:
+                raise
+            except BaseException as exc:  # noqa: BLE001
+                if self._maybe_failover(exc):
+                    continue
+                # release the owner's stream bookkeeping NOW — a leaked
+                # half-consumed stream pins its reported items until GC
+                self.close()
+                if self._ctx is not None:
+                    to_raise, _ = self._ctx.classify(exc)
+                    if to_raise is not exc:
+                        raise to_raise from exc
+                raise
+            self._consumed += 1
+            return return_value
 
     def close(self):
-        """Stop consuming; abandoning the underlying ObjectRefGenerator
-        releases the owner's stream bookkeeping (object_ref.py __del__)."""
+        """Stop consuming; closing the underlying ObjectRefGenerator
+        eagerly releases the owner's stream bookkeeping AND signals the
+        producing replica to stop generating (object_ref.py close())."""
         close = getattr(self._ref_gen, "close", None)
         if close is not None:
             close()
@@ -91,45 +292,96 @@ class Router:
     """Per-process replica picker for one application."""
 
     _REFRESH_S = 1.0
+    _STALE_WARN_S = 10.0
 
     def __init__(self, controller, app_name: str):
         self._controller = controller
         self._app_name = app_name
         self._table: Dict[str, dict] = {}
         self._last_refresh = 0.0
+        self._ever_refreshed = False
+        self._last_stale_warn = 0.0
         self._lock = threading.Lock()
         self._rr = 0
 
     def _refresh(self, force: bool = False):
+        """Pull the routing table from the controller. A slow or briefly
+        unreachable controller must NOT fail the request path: on error we
+        keep serving from the cached (stale) table with a rate-limited
+        warning, and only raise if there has never been a successful
+        refresh (nothing cached to fall back on)."""
         now = time.time()
         if not force and now - self._last_refresh < self._REFRESH_S:
             return
-        table = api.get(
-            self._controller.get_routing_table.remote(self._app_name),
-            timeout=30,
-        )
+        try:
+            table = api.get(
+                self._controller.get_routing_table.remote(self._app_name),
+                timeout=5,
+            )
+        except Exception as exc:
+            with self._lock:
+                if not self._ever_refreshed:
+                    raise
+                stale_s = now - self._last_refresh
+                # back off further refresh attempts for one TTL so every
+                # request doesn't eat the controller timeout serially
+                self._last_refresh = now
+                if now - self._last_stale_warn >= self._STALE_WARN_S:
+                    self._last_stale_warn = now
+                    logger.warning(
+                        "serve controller unreachable (%s); routing %r "
+                        "from routing table %.1fs stale",
+                        type(exc).__name__, self._app_name, stale_s,
+                    )
+            return
         with self._lock:
             self._table = table
             self._last_refresh = now
+            self._ever_refreshed = True
+
+    def router_config(self, deployment: str) -> Dict[str, Any]:
+        """The deployment's failover policy as distributed through the
+        routing table; defaults when the table predates the field."""
+        self._refresh()
+        with self._lock:
+            entry = self._table.get(deployment) or {}
+        cfg = entry.get("router_config")
+        if not cfg:
+            from .config import RequestRouterConfig
+
+            cfg = RequestRouterConfig().as_dict()
+        return cfg
 
     # an affine replica keeps winning until its queue runs this many
     # requests longer than the random alternative's — cache reuse is worth
     # a little imbalance, but not a hot spot
     _AFFINITY_SLACK = 2
 
-    def pick(self, deployment: str, affinity: Optional[int] = None):
-        """Power-of-two-choices on reported queue length. With an
-        ``affinity`` key (hash of the request's prompt prefix), the pick is
-        biased: one candidate is always the key's preferred replica, which
-        wins unless its queue is more than _AFFINITY_SLACK behind — so
-        repeated prefixes land where their KV blocks already live, and
-        overload still spills to the rest of the fleet."""
-        self._refresh()
+    def pick(self, deployment: str, affinity: Optional[int] = None,
+             exclude: FrozenSet[str] = frozenset(),
+             force_refresh: bool = False,
+             deadline_ts: Optional[float] = None):
+        """Power-of-two-choices on reported queue length; returns
+        ``(replica_id, handle)``. With an ``affinity`` key (hash of the
+        request's prompt prefix), the pick is biased: one candidate is
+        always the key's preferred replica, which wins unless its queue is
+        more than _AFFINITY_SLACK behind — so repeated prefixes land where
+        their KV blocks already live, and overload still spills to the rest
+        of the fleet. ``exclude`` drops replicas a failover already tried —
+        unless that would leave no candidate (a 1-replica deployment's
+        restart is still worth a retry)."""
+        self._refresh(force=force_refresh)
         deadline = time.time() + 30
+        if deadline_ts is not None:
+            deadline = min(deadline, deadline_ts)
         while True:
             with self._lock:
                 entry = self._table.get(deployment)
                 replicas = entry["replicas"] if entry else []
+            if exclude:
+                kept = [r for r in replicas if r[0] not in exclude]
+                if kept:
+                    replicas = kept
             if replicas:
                 break
             if time.time() > deadline:
@@ -139,7 +391,7 @@ class Router:
             time.sleep(0.1)
             self._refresh(force=True)
         if len(replicas) == 1:
-            return replicas[0][1]
+            return replicas[0][0], replicas[0][1]
         if affinity is not None:
             # replica ids sorted so every process maps the key to the SAME
             # preferred replica regardless of table ordering
@@ -149,22 +401,25 @@ class Router:
                 [r for r in ordered if r is not preferred]
             )
             if preferred[2] <= other[2] + self._AFFINITY_SLACK:
-                return preferred[1]
-            return other[1]
+                return preferred[0], preferred[1]
+            return other[0], other[1]
         # two random candidates, shorter controller-reported queue wins;
         # round-robin counter breaks ties so equal queues still spread
         a, b = random.sample(replicas, 2)
         qa, qb = a[2], b[2]
         if qa == qb:
             self._rr += 1
-            return (a if self._rr % 2 else b)[1]
-        return (a if qa < qb else b)[1]
+            winner = a if self._rr % 2 else b
+        else:
+            winner = a if qa < qb else b
+        return winner[0], winner[1]
 
 
 class DeploymentHandle:
     def __init__(self, controller, app_name: str, deployment: str,
                  method: str = "__call__", multiplexed_model_id: str = "",
                  stream: bool = False, prefix_affinity_tokens: int = 0,
+                 timeout_s: Optional[float] = None,
                  _router: Optional[list] = None):
         self._controller = controller
         self._app_name = app_name
@@ -176,6 +431,9 @@ class DeploymentHandle:
         # bias replica picking toward the hash's replica (prefix-cache
         # affinity); 0 disables
         self._prefix_affinity_tokens = prefix_affinity_tokens
+        # per-request deadline; None defers to the deployment's
+        # RequestRouterConfig.default_timeout_s
+        self._timeout_s = timeout_s
         # the router depends only on (controller, app_name), both immutable
         # across options()/method handles — a shared mutable holder means
         # whichever handle first routes a request creates the Router and all
@@ -185,7 +443,8 @@ class DeploymentHandle:
     def options(self, *, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
                 stream: Optional[bool] = None,
-                prefix_affinity_tokens: Optional[int] = None) -> "DeploymentHandle":
+                prefix_affinity_tokens: Optional[int] = None,
+                timeout_s: Optional[float] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self._controller,
             self._app_name,
@@ -198,6 +457,7 @@ class DeploymentHandle:
             prefix_affinity_tokens
             if prefix_affinity_tokens is not None
             else self._prefix_affinity_tokens,
+            timeout_s if timeout_s is not None else self._timeout_s,
             _router=self._router_holder,
         )
 
@@ -208,22 +468,36 @@ class DeploymentHandle:
         return DeploymentHandle(
             self._controller, self._app_name, self._deployment, name,
             self._multiplexed_model_id, self._stream,
-            self._prefix_affinity_tokens,
+            self._prefix_affinity_tokens, self._timeout_s,
             _router=self._router_holder,
         )
 
     def remote(self, *args, **kwargs):
         if self._router_holder[0] is None:
             self._router_holder[0] = Router(self._controller, self._app_name)
+        router: Router = self._router_holder[0]
         affinity = None
         if self._prefix_affinity_tokens > 0:
             affinity = _prefix_affinity_key(
                 args, kwargs, self._prefix_affinity_tokens
             )
-        replica = self._router_holder[0].pick(self._deployment, affinity)
-        metadata = None
+        router_cfg = router.router_config(self._deployment)
+        timeout_s = self._timeout_s
+        if timeout_s is None:
+            timeout_s = router_cfg.get("default_timeout_s", 60.0)
+        deadline_ts = time.time() + timeout_s if timeout_s else None
+        rid, replica = router.pick(
+            self._deployment, affinity, deadline_ts=deadline_ts
+        )
+        metadata: Dict[str, Any] = {}
         if self._multiplexed_model_id:
-            metadata = {"multiplexed_model_id": self._multiplexed_model_id}
+            metadata["multiplexed_model_id"] = self._multiplexed_model_id
+        if deadline_ts is not None:
+            # the deadline rides WITH the request so the replica can reject
+            # dead-on-arrival work; retries inherit the same absolute
+            # deadline (remaining budget, not a fresh timeout)
+            metadata["deadline_ts"] = deadline_ts
+            metadata["timeout_s"] = timeout_s
         # response chaining (reference: passing DeploymentResponse into a
         # downstream .remote — serve/handle.py): a response argument becomes
         # its ObjectRef, which the task-arg machinery resolves to the VALUE
@@ -233,20 +507,25 @@ class DeploymentHandle:
 
         args = tuple(chain(a) for a in args)
         kwargs = {k: chain(v) for k, v in kwargs.items()}
+        ctx = _RequestContext(
+            router, self._deployment, self._method, args, kwargs,
+            metadata or None, affinity, self._stream, deadline_ts,
+            router_cfg, rid,
+        )
         if self._stream:
             # replica-side async generator shipped item-by-item through the
             # runtime's streaming-generator path (ObjectRefGenerator)
-            ref_gen = replica.handle_request_stream.options(
-                num_returns="streaming"
-            ).remote(self._method, args, kwargs, metadata)
-            return DeploymentResponseGenerator(ref_gen)
-        ref = replica.handle_request.remote(self._method, args, kwargs, metadata)
-        return DeploymentResponse(ref)
+            ref_gen = _submit(replica, ctx)
+            return DeploymentResponseGenerator(
+                ref_gen, timeout_s=timeout_s or 60.0, ctx=ctx
+            )
+        ref = _submit(replica, ctx)
+        return DeploymentResponse(ref, ctx=ctx)
 
     def __reduce__(self):
         return (
             DeploymentHandle,
             (self._controller, self._app_name, self._deployment, self._method,
              self._multiplexed_model_id, self._stream,
-             self._prefix_affinity_tokens),
+             self._prefix_affinity_tokens, self._timeout_s),
         )

@@ -43,10 +43,13 @@ class LocalDeploymentResponse:
     in flight (dispatched eagerly, like the real handle) and ``result``
     just waits."""
 
-    def __init__(self, future):
+    def __init__(self, future, default_timeout_s: Optional[float] = None):
         self._future = future
+        self._default_timeout_s = default_timeout_s
 
     def result(self, timeout_s: Optional[float] = None):
+        if timeout_s is None:
+            timeout_s = self._default_timeout_s
         return self._future.result(timeout_s)
 
 
@@ -77,16 +80,24 @@ class LocalDeploymentHandle:
 
     def __init__(self, instances: Dict[str, Any], deployment: str,
                  method: str = "__call__", multiplexed_model_id: str = "",
-                 stream: bool = False):
+                 stream: bool = False, prefix_affinity_tokens: int = 0,
+                 timeout_s: Optional[float] = None):
         self._instances = instances
         self._deployment = deployment
         self._method = method
         self._multiplexed_model_id = multiplexed_model_id
         self._stream = stream
+        # accepted for parity with DeploymentHandle.options so code under
+        # test can set them unconditionally; with one in-process instance
+        # there is nothing to bias, and timeout_s bounds the result() wait
+        self._prefix_affinity_tokens = prefix_affinity_tokens
+        self._timeout_s = timeout_s
 
     def options(self, *, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
-                stream: Optional[bool] = None):
+                stream: Optional[bool] = None,
+                prefix_affinity_tokens: Optional[int] = None,
+                timeout_s: Optional[float] = None):
         return LocalDeploymentHandle(
             self._instances,
             self._deployment,
@@ -95,6 +106,10 @@ class LocalDeploymentHandle:
             if multiplexed_model_id is not None
             else self._multiplexed_model_id,
             stream if stream is not None else self._stream,
+            prefix_affinity_tokens
+            if prefix_affinity_tokens is not None
+            else self._prefix_affinity_tokens,
+            timeout_s if timeout_s is not None else self._timeout_s,
         )
 
     def __getattr__(self, name: str):
@@ -103,6 +118,7 @@ class LocalDeploymentHandle:
         return LocalDeploymentHandle(
             self._instances, self._deployment, name,
             self._multiplexed_model_id, self._stream,
+            self._prefix_affinity_tokens, self._timeout_s,
         )
 
     def _remote_stream(self, *args, **kwargs) -> "LocalResponseGenerator":
@@ -197,7 +213,9 @@ class LocalDeploymentHandle:
         # eager dispatch, matching the real handle: fire-and-forget calls
         # still execute and concurrent requests actually overlap
         future = asyncio.run_coroutine_threadsafe(invoke(), loop)
-        return LocalDeploymentResponse(future)
+        return LocalDeploymentResponse(
+            future, default_timeout_s=self._timeout_s
+        )
 
 
 def run_local(app, name: str = "default") -> LocalDeploymentHandle:

@@ -103,6 +103,47 @@ class HTTPProxy:
                     best = (prefix, app_name)
         return best
 
+    @staticmethod
+    def _request_timeout_s(request) -> Optional[float]:
+        """Per-request deadline from the ``X-Request-Timeout-S`` header
+        (reference: serve's RAY_SERVE_REQUEST_PROCESSING_TIMEOUT_S header
+        override); None defers to the deployment's
+        RequestRouterConfig.default_timeout_s (60 s out of the box)."""
+        raw = request.headers.get("X-Request-Timeout-S")
+        if not raw:
+            return None
+        try:
+            timeout_s = float(raw)
+        except ValueError:
+            return None
+        return timeout_s if timeout_s > 0 else None
+
+    @staticmethod
+    def _error_response(exc: Exception):
+        """Map typed serve errors onto HTTP semantics: backpressure sheds
+        are 503 + Retry-After (the client should back off and retry),
+        deadline expiry is 504, everything else stays a 500."""
+        from aiohttp import web
+
+        from ..exceptions import (
+            BackPressureError,
+            DeadlineExceededError,
+            GetTimeoutError,
+        )
+
+        cause = getattr(exc, "cause", None) or exc
+        if isinstance(cause, BackPressureError):
+            return web.json_response(
+                {"error": repr(cause), "retry_after_s": cause.retry_after_s},
+                status=503,
+                headers={
+                    "Retry-After": str(max(1, int(cause.retry_after_s + 0.5)))
+                },
+            )
+        if isinstance(cause, (DeadlineExceededError, GetTimeoutError)):
+            return web.json_response({"error": repr(cause)}, status=504)
+        return web.json_response({"error": repr(exc)}, status=500)
+
     async def _handle_request(self, request):
         from aiohttp import web
 
@@ -128,16 +169,18 @@ class HTTPProxy:
                     body = json.loads(raw)
                 except json.JSONDecodeError:
                     body = raw.decode("utf-8", "replace")
+        timeout_s = self._request_timeout_s(request)
         if info.get("stream"):
-            return await self._handle_stream(request, app_name, body)
+            return await self._handle_stream(request, app_name, body,
+                                             timeout_s)
         # forward to the app's ingress deployment off-loop (the handle API
         # is blocking); one thread per in-flight request keeps the proxy
         # loop responsive
         result = await asyncio.get_event_loop().run_in_executor(
-            None, self._call_ingress, app_name, path, prefix, body
+            None, self._call_ingress, app_name, path, prefix, body, timeout_s
         )
         if isinstance(result, Exception):
-            return web.json_response({"error": repr(result)}, status=500)
+            return self._error_response(result)
         if isinstance(result, (dict, list, int, float, str, bool)) or result is None:
             return web.json_response({"result": result})
         return web.Response(body=bytes(result))
@@ -175,9 +218,19 @@ class HTTPProxy:
             self._handles[app_name] = handle
         return handle
 
-    def _call_ingress(self, app_name: str, path: str, prefix: str, body):
+    def _call_ingress(self, app_name: str, path: str, prefix: str, body,
+                      timeout_s: Optional[float] = None):
+        # the deadline rides through the handle into the replica; the
+        # result() wait is bounded by it (default 60 s — no more hardcoded
+        # proxy timeout disagreeing with the request's actual budget). The
+        # handle absorbs replica deaths/drains (and sheds, per the
+        # deployment's RequestRouterConfig); what still escapes maps to
+        # typed HTTP statuses in _error_response.
         try:
-            return self._get_handle(app_name).remote(body).result(timeout_s=60)
+            handle = self._get_handle(app_name).options(
+                timeout_s=timeout_s
+            ) if timeout_s is not None else self._get_handle(app_name)
+            return handle.remote(body).result()
         except Exception as e:  # noqa: BLE001
             return e
 
@@ -226,10 +279,14 @@ class HTTPProxy:
         finally:
             stop.set()
 
-    async def _handle_stream(self, request, app_name: str, body):
+    async def _handle_stream(self, request, app_name: str, body,
+                             timeout_s: Optional[float] = None):
         """Generator ingress -> chunked HTTP: newline-delimited JSON, or SSE
         when the client asks for text/event-stream (reference: proxy
-        streaming of DeploymentResponseGenerator outputs)."""
+        streaming of DeploymentResponseGenerator outputs). Teardown (client
+        disconnect, early close) closes the DeploymentResponseGenerator,
+        which cancels the replica-side generator — the replica stops
+        producing tokens nobody will read."""
         from aiohttp import web
 
         sse = "text/event-stream" in request.headers.get("Accept", "")
@@ -238,7 +295,10 @@ class HTTPProxy:
         await resp.prepare(request)
 
         def make_gen():
-            return self._get_handle(app_name).options(stream=True).remote(body)
+            opts = {"stream": True}
+            if timeout_s is not None:
+                opts["timeout_s"] = timeout_s
+            return self._get_handle(app_name).options(**opts).remote(body)
 
         from contextlib import aclosing
 

@@ -4,12 +4,21 @@ Role-equivalent of the reference's ReplicaActor
 (python/ray/serve/_private/replica.py:1210): runs user __init__ once,
 serves requests while tracking ongoing-request count (the autoscaling
 metric), supports reconfigure(user_config) and health checks.
+
+Fault-tolerant data plane: every request passes admission control before
+user code runs — dead-on-arrival requests (deadline already passed) are
+rejected without computing, DRAINING replicas refuse new work with a
+retryable typed error, and once ``max_ongoing_requests`` are executing
+further requests wait in a bounded queue (``max_queued_requests``) past
+which the replica sheds fast with ``BackPressureError`` instead of letting
+the caller's 60 s timeout pile up.
 """
 
 from __future__ import annotations
 
 import asyncio
 import inspect
+import os
 import time
 from typing import Any, Dict, Optional
 
@@ -25,6 +34,8 @@ class Replica:
         init_args: tuple,
         init_kwargs: dict,
         user_config: Any,
+        max_ongoing_requests: int = 100,
+        max_queued_requests: int = 64,
     ):
         from .._internal import serialization
 
@@ -33,7 +44,16 @@ class Replica:
         self._deployment_name = deployment_name
         self._replica_id = replica_id
         self._ongoing = 0
+        self._queued = 0
         self._total_served = 0
+        self._shed_total = 0
+        self._doa_total = 0
+        self._draining = False
+        self._max_ongoing = max(1, int(max_ongoing_requests))
+        self._max_queued = max(0, int(max_queued_requests))
+        # set on every request completion so queued waiters re-check for a
+        # free slot (created lazily: __init__ may run before a loop exists)
+        self._slot_free: Optional[asyncio.Event] = None
         self._pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f"replica-{replica_id}"
         )
@@ -45,6 +65,97 @@ class Replica:
         self._is_function = not inspect.isclass(target)
         if user_config is not None:
             self._reconfigure_sync(user_config)
+
+    # -- admission control ----------------------------------------------------
+
+    def _deadline_of(self, metadata: Optional[dict]) -> Optional[float]:
+        if not metadata:
+            return None
+        d = metadata.get("deadline_ts")
+        return float(d) if d is not None else None
+
+    def _check_doa(self, metadata: Optional[dict]):
+        """Reject dead-on-arrival work: if the caller's deadline already
+        passed, nobody is waiting for the result — don't compute it."""
+        deadline = self._deadline_of(metadata)
+        if deadline is not None and time.time() >= deadline:
+            from ..exceptions import DeadlineExceededError
+            from ..util.metrics import record_serve_doa
+
+            self._doa_total += 1
+            record_serve_doa(self._deployment_name)
+            timeout_s = float((metadata or {}).get("timeout_s") or 0.0)
+            raise DeadlineExceededError(
+                deployment=self._deployment_name,
+                elapsed_s=time.time() - (deadline - timeout_s)
+                if timeout_s
+                else 0.0,
+                timeout_s=timeout_s,
+                where=f"replica {self._replica_id} admission",
+            )
+
+    async def _admit(self, metadata: Optional[dict]):
+        """Admission control, runs BEFORE user code and before the request
+        counts as accepted. Order matters: drain check first (stale routers
+        get a retryable error), then DOA, then capacity. Raises fast —
+        shedding must cost milliseconds, not a timeout."""
+        if self._draining:
+            from ..exceptions import ReplicaDrainingError
+
+            raise ReplicaDrainingError(self._replica_id)
+        self._check_doa(metadata)
+        if self._ongoing < self._max_ongoing:
+            self._ongoing += 1
+            return
+        if self._queued >= self._max_queued:
+            from ..exceptions import BackPressureError
+            from ..util.metrics import record_serve_shed
+
+            self._shed_total += 1
+            record_serve_shed(self._deployment_name)
+            raise BackPressureError(
+                replica_id=self._replica_id,
+                ongoing=self._ongoing,
+                queued=self._queued,
+                retry_after_s=0.1,
+            )
+        # wait for a slot; bounded by the request deadline (if any) so a
+        # queued request never outlives its caller
+        if self._slot_free is None:
+            self._slot_free = asyncio.Event()
+        deadline = self._deadline_of(metadata)
+        self._queued += 1
+        try:
+            while True:
+                if self._draining:
+                    from ..exceptions import ReplicaDrainingError
+
+                    raise ReplicaDrainingError(self._replica_id)
+                self._check_doa(metadata)
+                if self._ongoing < self._max_ongoing:
+                    self._ongoing += 1
+                    return
+                self._slot_free.clear()
+                wait_s = 0.25
+                if deadline is not None:
+                    wait_s = min(wait_s, max(0.0, deadline - time.time()))
+                try:
+                    await asyncio.wait_for(
+                        self._slot_free.wait(), timeout=wait_s + 0.001
+                    )
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            self._queued -= 1
+
+    def _release(self):
+        self._ongoing -= 1
+        self._total_served += 1
+        if self._slot_free is not None:
+            self._slot_free.set()
+
+    def _dequeue(self):
+        self._queued -= 1
 
     # -- request path --------------------------------------------------------
 
@@ -85,7 +196,7 @@ class Replica:
 
     async def handle_request(self, method: str, args: tuple, kwargs: dict,
                              metadata: Optional[dict] = None):
-        self._ongoing += 1
+        await self._admit(metadata)
         try:
             fn, args, kwargs = await self._prepare_call(
                 method, args, kwargs, metadata
@@ -103,8 +214,7 @@ class Replica:
                 self._pool, lambda: ctx.run(fn, *args, **kwargs)
             )
         finally:
-            self._ongoing -= 1
-            self._total_served += 1
+            self._release()
 
     async def handle_request_stream(self, method: str, args: tuple,
                                     kwargs: dict,
@@ -115,7 +225,7 @@ class Replica:
         to the caller through the runtime's streaming-generator machinery as
         soon as it exists."""
         _SENTINEL = object()
-        self._ongoing += 1
+        await self._admit(metadata)
         try:
             fn, args, kwargs = await self._prepare_call(
                 method, args, kwargs, metadata
@@ -154,16 +264,21 @@ class Replica:
                     return
                 yield item
         finally:
-            self._ongoing -= 1
-            self._total_served += 1
+            self._release()
 
     # -- control plane -------------------------------------------------------
 
     def get_metrics(self) -> Dict[str, Any]:
         return {
             "replica_id": self._replica_id,
-            "queue_len": self._ongoing,
+            "queue_len": self._ongoing + self._queued,
+            "ongoing": self._ongoing,
+            "queued": self._queued,
+            "shed_total": self._shed_total,
+            "doa_total": self._doa_total,
             "total_served": self._total_served,
+            "draining": self._draining,
+            "pid": os.getpid(),
         }
 
     def check_health(self) -> bool:
@@ -181,14 +296,9 @@ class Replica:
         self._reconfigure_sync(user_config)
         return True
 
-    async def prepare_for_shutdown(self, timeout_s: float = 5.0) -> bool:
-        """Drain: wait for ongoing requests to finish (reference:
-        graceful_shutdown_timeout_s semantics)."""
-        deadline = time.time() + timeout_s
-        while self._ongoing > 0 and time.time() < deadline:
-            await asyncio.sleep(0.05)
-        # run user cleanup before the controller hard-kills this actor;
-        # an explicit shutdown() wins over __del__ (which GC may also run)
+    async def _run_shutdown_hook(self):
+        """Run user cleanup before the controller hard-kills this actor;
+        an explicit shutdown() wins over __del__ (which GC may also run)."""
         for hook in ("shutdown", "__del__"):
             fn = getattr(type(self._callable), hook, None)
             if fn is not None:
@@ -199,4 +309,34 @@ class Replica:
                 except Exception:
                     pass
                 break
-        return self._ongoing == 0
+
+    async def _wait_idle(self, timeout_s: float) -> bool:
+        deadline = time.time() + timeout_s
+        while (self._ongoing > 0 or self._queued > 0) and time.time() < deadline:
+            await asyncio.sleep(0.05)
+        return self._ongoing == 0 and self._queued == 0
+
+    async def drain(self, timeout_s: float = 5.0) -> bool:
+        """Graceful drain: stop admitting new requests, finish everything
+        in-flight AND queued (bounded by timeout_s), then ack. The
+        controller only kills this actor after the ack or the deadline
+        (reference: replica.py perform_graceful_shutdown). Returns True if
+        the replica drained clean (zero dropped accepted requests)."""
+        from ..util.metrics import record_serve_drain
+
+        start = time.time()
+        self._draining = True
+        clean = await self._wait_idle(timeout_s)
+        await self._run_shutdown_hook()
+        record_serve_drain(self._deployment_name, time.time() - start)
+        return clean
+
+    async def prepare_for_shutdown(self, timeout_s: float = 5.0) -> bool:
+        """Drain: wait for ongoing requests to finish (reference:
+        graceful_shutdown_timeout_s semantics). Kept as the synchronous
+        stop path; sets _draining so no new work is admitted while the
+        controller blocks on us."""
+        self._draining = True
+        clean = await self._wait_idle(timeout_s)
+        await self._run_shutdown_hook()
+        return clean

@@ -161,6 +161,54 @@ class KillWorkerAtStep:
         pass
 
 
+def list_serve_replicas(app_name: str = "default"):
+    """Replica inventory rows ({deployment, replica_id, state, pid,
+    queue_len}) from the live serve controller (None if no controller)."""
+    from . import api
+    from .serve.controller import CONTROLLER_NAME
+
+    try:
+        controller = api.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return []
+    try:
+        return api.get(
+            controller.list_replica_info.remote(app_name), timeout=10
+        )
+    except Exception:
+        return []
+
+
+def kill_serve_replica(app_name: str = "default",
+                       deployment: Optional[str] = None,
+                       replica_id: Optional[str] = None,
+                       sig: int = signal.SIGKILL):
+    """Serve-chaos primitive: SIGKILL (or SIGSTOP, for a pause) one replica
+    process of the app, exactly like losing its host — the controller's
+    health poll replaces it and in-flight requests fail over through the
+    handle's retry envelope. Picks the first RUNNING replica matching the
+    filters; returns (replica_id, pid) or (None, None) when nothing
+    matched (no replica up yet, or pid not yet polled)."""
+    for row in list_serve_replicas(app_name):
+        if row.get("state") != "RUNNING" or not row.get("pid"):
+            continue
+        if deployment is not None and row["deployment"] != deployment:
+            continue
+        if replica_id is not None and row["replica_id"] != replica_id:
+            continue
+        pid = row["pid"]
+        try:
+            os.kill(pid, sig)
+        except ProcessLookupError:
+            continue
+        logger.info(
+            "kill_serve_replica: sent signal %s to replica %s (pid %d)",
+            sig, row["replica_id"], pid,
+        )
+        return row["replica_id"], pid
+    return None, None
+
+
 class NodeKiller:
     """Removes random non-head nodes from a cluster_utils.Cluster at an
     interval (reference: NodeKillerBase killing raylets during chaos

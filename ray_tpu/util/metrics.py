@@ -650,6 +650,135 @@ def train_ft_summary(payloads: List[dict]) -> Dict[str, object]:
 
 
 # ---------------------------------------------------------------------------
+# Serve fault-tolerance plane: handle-side failover retries, replica-side
+# sheds (admission queue cap) and dead-on-arrival rejections, and graceful
+# drain durations. Same shape as the train_ft section above: pushed
+# snapshots roll up cluster-wide via serve_ft_summary; process-local
+# serve_ft_counters back tests and bench.
+# ---------------------------------------------------------------------------
+
+_SERVE_DRAIN_BOUNDARIES_S = [
+    0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60,
+]
+
+_serve_ft_metrics: Optional[dict] = None
+_serve_ft_init_lock = threading.Lock()
+
+
+def _ensure_serve_ft_metrics() -> dict:
+    global _serve_ft_metrics
+    if _serve_ft_metrics is None:
+        with _serve_ft_init_lock:
+            if _serve_ft_metrics is None:
+                _serve_ft_metrics = {
+                    "retry": Counter(
+                        "serve_retry_total",
+                        "Handle-side failover resubmissions (replica "
+                        "death, drain race, transport failure, or "
+                        "retried backpressure)",
+                        tag_keys=("deployment", "reason"),
+                    ),
+                    "shed": Counter(
+                        "serve_shed_total",
+                        "Requests shed by replica admission control "
+                        "(queue cap reached -> BackPressureError)",
+                        tag_keys=("deployment",),
+                    ),
+                    "doa": Counter(
+                        "serve_doa_total",
+                        "Dead-on-arrival rejections (request deadline "
+                        "already passed at admission)",
+                        tag_keys=("deployment",),
+                    ),
+                    "drain": Histogram(
+                        "serve_drain_seconds",
+                        "Graceful replica drain duration (stop-routing "
+                        "to last in-flight request finished)",
+                        boundaries=_SERVE_DRAIN_BOUNDARIES_S,
+                        tag_keys=("deployment",),
+                    ),
+                }
+    return _serve_ft_metrics
+
+
+def record_serve_retry(deployment: str, reason: str):
+    _ensure_serve_ft_metrics()["retry"].inc(
+        1.0, {"deployment": deployment, "reason": reason}
+    )
+
+
+def record_serve_shed(deployment: str):
+    _ensure_serve_ft_metrics()["shed"].inc(1.0, {"deployment": deployment})
+
+
+def record_serve_doa(deployment: str):
+    _ensure_serve_ft_metrics()["doa"].inc(1.0, {"deployment": deployment})
+
+
+def record_serve_drain(deployment: str, seconds: float):
+    _ensure_serve_ft_metrics()["drain"].observe(
+        seconds, {"deployment": deployment}
+    )
+
+
+def serve_ft_counters() -> Dict[str, float]:
+    """Process-local totals across all tag values (tests + bench). Note:
+    retries count in the CALLING process (the handle runs the envelope),
+    sheds/DOA/drains count in the replica process."""
+    m = _ensure_serve_ft_metrics()
+    out: Dict[str, float] = {}
+    for label, metric in (
+        ("retries", m["retry"]),
+        ("sheds", m["shed"]),
+        ("doa", m["doa"]),
+    ):
+        with metric._lock:
+            out[label] = float(sum(metric._values.values()))
+    drain = m["drain"]
+    with drain._lock:
+        out["drains"] = float(
+            sum(sum(c) for c in drain._counts.values())
+        )
+    return out
+
+
+def serve_ft_summary(payloads: List[dict]) -> Dict[str, object]:
+    """Cluster rollup of the serve fault-tolerance plane from every
+    worker's pushed snapshot (state.metrics_summary / dashboard)."""
+    out = {
+        "retries": 0.0,
+        "sheds": 0.0,
+        "doa": 0.0,
+        "drains": 0.0,
+        "drain_mean_s": 0.0,
+        "retry_reasons": {},
+    }
+    drain_sum = 0.0
+    for payload in payloads:
+        for snap in payload.get("metrics", []):
+            name = snap.get("name")
+            if name == "serve_retry_total":
+                out["retries"] += sum(snap["values"].values())
+                for tag_json, value in snap["values"].items():
+                    tags = dict(zip(snap["tag_keys"], json.loads(tag_json)))
+                    reason = tags.get("reason", "?")
+                    out["retry_reasons"][reason] = (
+                        out["retry_reasons"].get(reason, 0.0) + value
+                    )
+            elif name == "serve_shed_total":
+                out["sheds"] += sum(snap["values"].values())
+            elif name == "serve_doa_total":
+                out["doa"] += sum(snap["values"].values())
+            elif name == "serve_drain_seconds":
+                for counts in snap.get("counts", {}).values():
+                    out["drains"] += float(sum(counts))
+                drain_sum += sum(snap.get("values", {}).values())
+    if out["drains"]:
+        out["drain_mean_s"] = drain_sum / out["drains"]
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Device telemetry: per-device HBM used/limit gauges sampled from
 # jax.local_devices() memory stats, tagged by node and device. Sampled by
 # the metrics pusher whenever jax is already imported in this process (no

@@ -117,6 +117,7 @@ def metrics_summary() -> Dict[str, Any]:
         device_rows,
         fetch_metric_payloads,
         kvcache_summary,
+        serve_ft_summary,
         train_ft_summary,
     )
 
@@ -174,6 +175,7 @@ def metrics_summary() -> Dict[str, Any]:
         "devices": device_rows(payloads),
         "kvcache": kvcache_summary(payloads),
         "train_ft": train_ft_summary(payloads),
+        "serve_ft": serve_ft_summary(payloads),
     }
 
 

@@ -1,0 +1,280 @@
+"""Serving fault-tolerance tests: failover, deadlines, drain, shedding
+(reference model: python/ray/serve/tests/test_replica_request_context.py,
+test_backpressure.py, test_graceful_shutdown.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu import testing
+from ray_tpu.exceptions import BackPressureError, DeadlineExceededError
+from ray_tpu.util.metrics import serve_ft_counters
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=8, resources={"TPU": 4})
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_apps():
+    yield
+    try:
+        for app in list(serve.status().keys()):
+            serve.delete(app)
+    except Exception:
+        pass
+
+
+def _wait_replicas(app, n, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rows = [
+            r for r in testing.list_serve_replicas(app)
+            if r["state"] == "RUNNING" and r["pid"]
+        ]
+        if len(rows) == n:
+            return rows
+        time.sleep(0.1)
+    raise TimeoutError(f"{app}: never reached {n} RUNNING replicas with pids")
+
+
+def test_kill_replica_mid_request_failover(cluster):
+    """Chaos kill one replica while requests are in flight: every caller
+    request still completes and at least one retry is counted."""
+
+    @serve.deployment(num_replicas=2)
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.8)
+            return x * 2
+
+    handle = serve.run(Slow.bind(), name="killapp", _proxy=False)
+    _wait_replicas("killapp", 2)
+    before = serve_ft_counters()["retries"]
+
+    responses = [handle.remote(i) for i in range(8)]
+    time.sleep(0.3)  # let requests land on both replicas
+    rid, pid = testing.kill_serve_replica("killapp")
+    assert rid is not None and pid
+
+    results = [r.result(timeout_s=30) for r in responses]
+    assert sorted(results) == [i * 2 for i in range(8)]
+    # in-flight work on the killed replica failed over (recorded caller-side)
+    assert serve_ft_counters()["retries"] > before
+
+
+def test_drain_on_scale_down_zero_dropped(cluster):
+    """Scaling 2 -> 1 drains the victim: accepted in-flight requests all
+    complete, none are dropped."""
+
+    @serve.deployment(num_replicas=2, graceful_shutdown_timeout_s=10.0)
+    class Steady:
+        def __call__(self, x):
+            time.sleep(0.5)
+            return x + 100
+
+    app = Steady.bind()
+    handle = serve.run(app, name="drainapp", _proxy=False)
+    _wait_replicas("drainapp", 2)
+
+    responses = [handle.remote(i) for i in range(10)]
+    time.sleep(0.2)  # requests accepted on both replicas
+    serve.run(Steady.options(num_replicas=1).bind(), name="drainapp",
+              _proxy=False, _blocking=False)
+
+    results = [r.result(timeout_s=30) for r in responses]
+    assert sorted(results) == [i + 100 for i in range(10)]
+    _wait_replicas("drainapp", 1)
+
+
+def test_drain_replica_replacement(cluster):
+    """controller.drain_replica (the `ray_tpu chaos drain` path) retires
+    one replica gracefully and the controller converges back to target."""
+
+    @serve.deployment(num_replicas=2)
+    class Svc:
+        def __call__(self, x):
+            return x
+
+    serve.run(Svc.bind(), name="drainone", _proxy=False)
+    rows = _wait_replicas("drainone", 2)
+    victim = rows[0]["replica_id"]
+
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    assert ray_tpu.get(
+        controller.drain_replica.remote("drainone", victim), timeout=10
+    )
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        rows = _wait_replicas("drainone", 2)
+        if all(r["replica_id"] != victim for r in rows):
+            return
+        time.sleep(0.1)
+    raise AssertionError("drained replica was never replaced")
+
+
+def test_shed_under_overload(cluster):
+    """Queue-cap saturation raises typed BackPressureError fast (<1s), not
+    a slow timeout."""
+
+    @serve.deployment(
+        max_ongoing_requests=1,
+        max_queued_requests=1,
+        request_router_config={"retry_backpressure": False},
+    )
+    class Busy:
+        def __call__(self, x):
+            time.sleep(3.0)
+            return x
+
+    handle = serve.run(Busy.bind(), name="shedapp", _proxy=False)
+    _wait_replicas("shedapp", 1)
+
+    fillers = [handle.remote(i) for i in range(2)]  # 1 ongoing + 1 queued
+    time.sleep(0.5)  # let the fillers occupy slot and queue
+
+    t0 = time.time()
+    with pytest.raises(BackPressureError) as info:
+        handle.remote(99).result(timeout_s=10)
+    elapsed = time.time() - t0
+    assert elapsed < 1.0, f"shed took {elapsed:.2f}s, expected fast rejection"
+    assert info.value.retry_after_s > 0
+
+    assert sorted(f.result(timeout_s=30) for f in fillers) == [0, 1]
+
+
+def test_dead_on_arrival_rejected_by_replica(cluster):
+    """A request whose deadline already passed is rejected at admission
+    without running user code, and counted in replica metrics."""
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    serve.run(Echo.bind(), name="doaapp", _proxy=False)
+    _wait_replicas("doaapp", 1)
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    table = ray_tpu.get(controller.get_routing_table.remote("doaapp"))
+    _rid, replica, _q = table["Echo"]["replicas"][0]
+
+    with pytest.raises(Exception) as info:
+        ray_tpu.get(
+            replica.handle_request.remote(
+                "__call__", (1,), {}, {"deadline_ts": time.time() - 5.0}
+            ),
+            timeout=10,
+        )
+    cause = getattr(info.value, "cause", info.value)
+    assert isinstance(cause, DeadlineExceededError)
+    metrics = ray_tpu.get(replica.get_metrics.remote(), timeout=10)
+    assert metrics["doa_total"] >= 1
+
+
+def test_caller_deadline_bounds_result(cluster):
+    """handle.options(timeout_s=...) bounds the end-to-end wait: a stuck
+    replica surfaces a TimeoutError near the deadline, not 60s later."""
+
+    @serve.deployment
+    class Stuck:
+        def __call__(self, x):
+            time.sleep(5.0)
+            return x
+
+    handle = serve.run(Stuck.bind(), name="deadlineapp", _proxy=False)
+    _wait_replicas("deadlineapp", 1)
+
+    t0 = time.time()
+    with pytest.raises(TimeoutError):
+        handle.options(timeout_s=0.5).remote(1).result()
+    assert time.time() - t0 < 3.0
+
+
+def test_stale_routing_table_failover(cluster):
+    """A dead controller must not fail the request path once a routing
+    table is cached; a never-refreshed router still raises."""
+    from ray_tpu.serve.handle import Router
+
+    @serve.deployment
+    class Ok:
+        def __call__(self, x):
+            return x
+
+    serve.run(Ok.bind(), name="staleapp", _proxy=False)
+    _wait_replicas("staleapp", 1)
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+
+    class _BoomMethod:
+        def remote(self, *a, **k):
+            raise ConnectionError("controller unreachable")
+
+    class _DeadController:
+        def __getattr__(self, name):
+            return _BoomMethod()
+
+    router = Router(controller, "staleapp")
+    rid, _ = router.pick("Ok")
+    assert rid is not None
+
+    router._controller = _DeadController()
+    router._refresh(force=True)  # swallowed: serve from the stale cache
+    rid2, replica = router.pick("Ok", force_refresh=True)
+    assert rid2 == rid
+    assert ray_tpu.get(
+        replica.handle_request.remote("__call__", (7,), {}, {}), timeout=10
+    ) == 7
+
+    fresh = Router(_DeadController(), "staleapp")
+    with pytest.raises(Exception):
+        fresh.pick("Ok")
+
+
+def test_stream_error_closes_generator(cluster):
+    """A mid-stream user error surfaces once and the generator is closed —
+    further iteration stops instead of hanging."""
+
+    @serve.deployment
+    class Flaky:
+        def __call__(self, n):
+            yield "first"
+            raise ValueError("boom mid-stream")
+
+    handle = serve.run(Flaky.bind(), name="flakystream", _proxy=False)
+    _wait_replicas("flakystream", 1)
+
+    gen = handle.options(stream=True).remote(2)
+    assert next(gen) == "first"
+    with pytest.raises(Exception) as info:
+        next(gen)
+    assert "boom mid-stream" in str(info.value)
+    with pytest.raises(StopIteration):
+        next(gen)
+
+
+def test_local_mode_parity_new_knobs():
+    """local_testing_mode accepts the failover-era handle options so code
+    under test runs unchanged."""
+
+    @serve.deployment
+    class Greeter:
+        def __call__(self, name):
+            return f"hi {name}"
+
+        def stream_n(self, n):
+            for i in range(n):
+                yield i
+
+    handle = serve.run(Greeter.bind(), name="localft",
+                       _local_testing_mode=True)
+    h = handle.options(timeout_s=5.0, prefix_affinity_tokens=4)
+    assert h.remote("x").result() == "hi x"
+    out = list(
+        h.options(method_name="stream_n", stream=True).remote(3)
+    )
+    assert out == [0, 1, 2]
