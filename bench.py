@@ -767,6 +767,116 @@ def serve_churn():
         ray_tpu.shutdown()
 
 
+def serve_autoscale():
+    """`python bench.py serve_autoscale` — closed-loop SLO autoscaling demo.
+
+    Replays the bundled ramp -> burst -> decay traffic trace open loop
+    (the generator never slows down for a saturated target) against a
+    1-replica deployment governed by an AutoscalePolicy. Asserts the
+    closed loop actually closes: replica count rises under the burst,
+    decays back to min afterwards via graceful drain, and every caller
+    request completes. Reports the replica-count path sampled alongside
+    the replay plus the autoscaler's own decision log. CPU backend: the
+    control loop is backend-independent."""
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import ray_tpu
+    from ray_tpu import loadgen, serve, testing
+    from ray_tpu.util import state as rt_state
+
+    work_s, time_scale = 0.15, 0.5
+    policy = {
+        "min_replicas": 1, "max_replicas": 3, "interval_s": 0.5,
+        "target_queue_per_replica": 2.0, "up_hysteresis": 1,
+        "down_hysteresis": 2, "idle_queue_per_replica": 0.5,
+        "cooldown_up_s": 1.0, "cooldown_down_s": 1.5,
+        "scale_up_step": 1, "scale_down_step": 1,
+    }
+    ray_tpu.init(num_cpus=8)
+    try:
+        @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                          max_queued_requests=256,
+                          graceful_shutdown_timeout_s=15.0,
+                          autoscale_policy=policy)
+        class Worker:
+            def __call__(self, payload):
+                time.sleep(work_s)
+                return len(payload.get("token_ids", []))
+
+        handle = serve.run(Worker.bind(), name="autoscale", _proxy=False)
+        trace = loadgen.bundled_trace("ramp_burst_decay").scaled(time_scale)
+        _log(f"replaying {len(trace.requests)} requests over "
+             f"{trace.duration_s:.1f}s (time_scale={time_scale})")
+
+        def replicas_now():
+            return sum(1 for r in testing.list_serve_replicas("autoscale")
+                       if r["state"] == "RUNNING")
+
+        stop = threading.Event()
+        replica_path = []
+
+        def sampler():
+            while not stop.wait(0.25):
+                replica_path.append(replicas_now())
+
+        t = threading.Thread(target=sampler, daemon=True)
+        t.start()
+        gen = loadgen.LoadGenerator(
+            loadgen.HandleTarget(handle), max_inflight=64
+        )
+        result = gen.run(trace)
+
+        # after the decay tail the autoscaler should drain back to min
+        deadline = time.time() + 30
+        while time.time() < deadline and replicas_now() > 1:
+            time.sleep(0.25)
+        stop.set()
+        t.join(timeout=2)
+        replica_path.append(replicas_now())
+
+        events = rt_state.autoscale_log()
+        ups = [e for e in events if e["direction"] == "up"]
+        downs = [e for e in events if e["direction"] == "down"]
+        summary = result.summary()
+        peak, final = max(replica_path), replica_path[-1]
+        scaled = peak > 1 and final == 1 and ups and downs
+        failures = len(result.failures)
+        _log(
+            f"replicas 1 -> {peak} -> {final}; {len(ups)} up / "
+            f"{len(downs)} down decisions; outcomes {summary['outcomes']}"
+        )
+        if failures:
+            _log(f"FAIL: {failures} caller failures: "
+                 f"{sorted({r.outcome for r in result.failures})}")
+        print(json.dumps({
+            "metric": "serve_autoscale_closed_loop",
+            "value": 1.0 if (scaled and failures == 0) else 0.0,
+            "unit": "1.0 = scaled up under burst, drained back to min, "
+                    "zero caller failures",
+            "requests": summary["requests"],
+            "outcomes": summary["outcomes"],
+            "caller_failures": failures,
+            "ttft_p50_ms": summary.get("ttft_p50_ms"),
+            "ttft_p99_ms": summary.get("ttft_p99_ms"),
+            "max_lag_s": summary["max_lag_s"],
+            "replicas_peak": peak,
+            "replicas_final": final,
+            "scale_up_events": len(ups),
+            "scale_down_events": len(downs),
+            "first_up_breach_age_s": ups[0]["breach_age_s"] if ups else None,
+            "config": {
+                "trace": "ramp_burst_decay", "time_scale": time_scale,
+                "work_s": work_s, "policy": policy, "backend": "cpu",
+            },
+        }))
+    finally:
+        ray_tpu.shutdown()
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "llm_prefix_cache":
         llm_prefix_cache()
@@ -774,6 +884,8 @@ if __name__ == "__main__":
         elastic_recover()
     elif len(sys.argv) > 1 and sys.argv[1] == "serve_churn":
         serve_churn()
+    elif len(sys.argv) > 1 and sys.argv[1] == "serve_autoscale":
+        serve_autoscale()
     elif len(sys.argv) > 1:
         raise SystemExit(f"unknown bench mode {sys.argv[1]!r}")
     else:

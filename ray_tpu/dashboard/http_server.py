@@ -13,6 +13,7 @@ Routes:
   GET  /api/nodes | /api/actors | /api/tasks | /api/placement_groups
   GET  /api/cluster_resources | /api/cluster_status
   GET  /api/train              (elastic-training FT rollup + live runs)
+  GET  /api/autoscale          (SLO-autoscaler decision log + counters)
   GET  /api/jobs/              (list submitted jobs)
   POST /api/jobs/              (submit: {"entrypoint": ..., "runtime_env": ...})
   GET  /api/jobs/{id}
@@ -196,6 +197,8 @@ class DashboardServer:
             # serve fault-tolerance rollup (failover retries, sheds,
             # DOA rejections, drain durations)
             ("GET", "/api/serve"): self._serve,
+            # SLO-autoscaler decision log + scale counters
+            ("GET", "/api/autoscale"): self._autoscale,
             ("GET", "/metrics"): self._metrics,
             # browser UI (role of the React frontend, dashboard/client/ —
             # here a dependency-free single page over the same REST API)
@@ -280,6 +283,23 @@ class DashboardServer:
             "fault_tolerance": serve_ft_summary(self._metric_payloads()),
         }, None
 
+    def _autoscale(self, body):
+        import json as _json
+
+        from ..util.metrics import autoscale_summary
+
+        events = []
+        try:
+            raw = self._gcs("kv_get", "serve:autoscale_log")
+            if raw:
+                events = _json.loads(bytes(raw).decode())
+        except Exception:
+            pass
+        return 200, {
+            "events": events[-100:],
+            "summary": autoscale_summary(self._metric_payloads()),
+        }, None
+
     def _metrics(self, body):
         from ..util.metrics import render_prometheus
 
@@ -320,6 +340,7 @@ _INDEX_HTML = """<!doctype html>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Devices (HBM)</h2><table id="devices"></table>
 <h2>KV cache</h2><table id="kvcache"></table>
+<h2>Autoscale</h2><table id="autoscale"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Placement groups</h2><table id="pgs"></table>
 <h2>Jobs</h2><table id="jobs"></table>
@@ -435,6 +456,16 @@ async function refresh() {
       evictions: kv.evictions, blocked: kv.admission_blocked,
       ttft_hit: fmtTtft(ttft.hit), ttft_miss: fmtTtft(ttft.miss),
     }], ["hit_tokens", "computed_tokens", "blocks", "evictions", "blocked", "ttft_hit", "ttft_miss"]);
+    const asc = await j("/api/autoscale");
+    const ascSum = asc.summary || {};
+    fill("autoscale", (asc.events || []).slice(-10).reverse().map(ev => ({
+      time: new Date((ev.ts || 0) * 1000).toLocaleTimeString(),
+      deployment: ev.deployment || "",
+      decision: ev.direction + ": " + ev.from + " -> " + ev.to,
+      reason: (ev.reason || []).join(", "),
+      breach_s: (ev.breach_age_s ?? 0).toFixed(2),
+      totals: "up " + (ascSum.scale_ups ?? 0) + " / down " + (ascSum.scale_downs ?? 0),
+    })), ["time", "deployment", "decision", "reason", "breach_s", "totals"]);
     const actors = await j("/api/actors");
     fill("actors", actors.map(a => ({
       id: (a.actor_id || "").slice(0, 12),

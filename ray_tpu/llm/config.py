@@ -31,6 +31,10 @@ class LLMConfig:
     # target_ongoing_requests/...). When set, num_replicas is ignored and
     # the serve controller scales TPU replicas with request pressure.
     autoscaling_config: Optional[Dict[str, Any]] = None
+    # closed-loop SLO autoscaling; dict mirroring serve.AutoscalePolicy
+    # fields (target_ttft_p99_ms/target_queue_per_replica/min_replicas/
+    # max_replicas/...). Takes precedence over autoscaling_config.
+    autoscale_policy: Optional[Dict[str, Any]] = None
     resources_per_replica: Dict[str, float] = field(
         default_factory=lambda: {"TPU": 0.0, "CPU": 1.0}
     )

@@ -56,16 +56,25 @@ class _LLMReplica:
         self._weights_name = weights_name
         self._weights_sub = None
         self._weights_version = None
+        self._weights_resolve_s = 0.0
         if weights_name is not None:
             # hot-reloadable weights from the weight plane: the replica
             # subscribes to the named model and serves its head version;
-            # reload_weights()/reconfigure swap in fresh versions in place
+            # reload_weights()/reconfigure swap in fresh versions in place.
+            # Resolving here — inside __init__ — is what makes cold
+            # scale-up correct: the serve controller's health probe (and so
+            # the STARTING -> RUNNING transition) queues behind __init__,
+            # so a replica never reports RUNNING with unresolved weights.
+            import time as _time
+
             from ..weights import WeightSubscriber
 
+            t0 = _time.perf_counter()
             self._weights_sub = WeightSubscriber(weights_name)
             self._weights_version, params = self._weights_sub.get(
                 timeout=60.0
             )
+            self._weights_resolve_s = _time.perf_counter() - t0
         elif params_blob is not None:
             from .._internal import serialization
 
@@ -104,6 +113,22 @@ class _LLMReplica:
             from transformers import AutoTokenizer
 
             self._tokenizer = AutoTokenizer.from_pretrained(tokenizer_name)
+
+    def warmup(self) -> Dict[str, Any]:
+        """Serve replica warmup hook (runs at the end of Replica.__init__,
+        before the replica can report healthy): assert weight-plane
+        resolution actually happened so a STARTING replica with a
+        ``weights_name`` can never reach RUNNING serving unresolved
+        weights."""
+        if self._weights_name is not None and self._weights_version is None:
+            raise RuntimeError(
+                f"weights {self._weights_name!r} not resolved at warmup"
+            )
+        return {
+            "weights_name": self._weights_name,
+            "weights_version": self._weights_version,
+            "weights_resolve_s": self._weights_resolve_s,
+        }
 
     # -- hot weight reload (weight plane) ------------------------------------
 
@@ -145,6 +170,7 @@ class _LLMReplica:
         return {
             "weights_name": self._weights_name,
             "version": self._weights_version,
+            "resolve_s": self._weights_resolve_s,
             "staleness": (
                 self._weights_sub.staleness()
                 if self._weights_sub is not None
@@ -238,7 +264,16 @@ def build_llm_deployment(
         name=name or llm_config.model_id,
         ray_actor_options=dict(llm_config.resources_per_replica),
     )
-    if llm_config.autoscaling_config:
+    autoscale_policy = getattr(llm_config, "autoscale_policy", None)
+    if autoscale_policy:
+        # closed-loop SLO autoscaling (serve/autoscale.py): TTFT p99 /
+        # queue / shed pressure instead of the raw ongoing-requests signal
+        options["autoscale_policy"] = (
+            dict(autoscale_policy)
+            if isinstance(autoscale_policy, dict)
+            else autoscale_policy
+        )
+    elif llm_config.autoscaling_config:
         # TPU replica autoscaling: the serve controller adds/removes engine
         # replicas from queue depth (serve/_private autoscaling policy)
         options["autoscaling_config"] = dict(llm_config.autoscaling_config)

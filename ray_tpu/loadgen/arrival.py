@@ -1,0 +1,92 @@
+"""Arrival processes for the open-loop load generator.
+
+Both processes pre-materialize the full list of arrival offsets (seconds
+from trace start) so a run is deterministic given its seed and the same
+schedule can be saved into a replayable trace. ``PoissonArrivals`` is the
+classic constant-rate process; ``BurstyRampArrivals`` models the shapes
+serving actually sees — ramps, bursts, decays — as a piecewise-linear
+rate profile sampled as a non-homogeneous Poisson process via thinning
+(Lewis & Shedler: draw candidates at the peak rate, keep each with
+probability rate(t)/peak).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Sequence, Tuple
+
+
+class PoissonArrivals:
+    """Constant-rate Poisson arrivals over ``duration_s`` seconds."""
+
+    def __init__(self, rate_hz: float, duration_s: float, seed: int = 0):
+        if rate_hz <= 0 or duration_s <= 0:
+            raise ValueError("rate_hz and duration_s must be > 0")
+        self.rate_hz = float(rate_hz)
+        self.duration_s = float(duration_s)
+        self.seed = int(seed)
+
+    def times(self) -> List[float]:
+        rng = random.Random(self.seed)
+        t = 0.0
+        out: List[float] = []
+        while True:
+            t += rng.expovariate(self.rate_hz)
+            if t >= self.duration_s:
+                return out
+            out.append(t)
+
+
+class BurstyRampArrivals:
+    """Piecewise-linear rate profile: ``phases`` is a sequence of
+    ``(duration_s, start_rate_hz, end_rate_hz)`` segments (a 2-tuple
+    ``(duration_s, rate_hz)`` means a flat segment); the rate interpolates
+    linearly inside each segment. A ramp-burst-decay day-in-the-life is
+    e.g. ``[(4, 0.5, 8), (4, 16, 16), (4, 8, 0.5)]``."""
+
+    def __init__(self, phases: Sequence[Tuple[float, ...]], seed: int = 0):
+        norm: List[Tuple[float, float, float]] = []
+        for phase in phases:
+            if len(phase) == 2:
+                dur, r0 = phase
+                r1 = r0
+            elif len(phase) == 3:
+                dur, r0, r1 = phase
+            else:
+                raise ValueError(
+                    "phase must be (duration_s, rate) or "
+                    "(duration_s, start_rate, end_rate)"
+                )
+            if dur <= 0 or r0 < 0 or r1 < 0:
+                raise ValueError(f"bad phase {phase!r}")
+            norm.append((float(dur), float(r0), float(r1)))
+        if not norm:
+            raise ValueError("at least one phase required")
+        self.phases = norm
+        self.seed = int(seed)
+
+    @property
+    def duration_s(self) -> float:
+        return sum(p[0] for p in self.phases)
+
+    def rate_at(self, t: float) -> float:
+        for dur, r0, r1 in self.phases:
+            if t < dur:
+                return r0 + (r1 - r0) * (t / dur)
+            t -= dur
+        return 0.0
+
+    def times(self) -> List[float]:
+        rng = random.Random(self.seed)
+        peak = max(max(r0, r1) for _, r0, r1 in self.phases)
+        if peak <= 0:
+            return []
+        duration = self.duration_s
+        t = 0.0
+        out: List[float] = []
+        while True:
+            t += rng.expovariate(peak)
+            if t >= duration:
+                return out
+            if rng.random() < self.rate_at(t) / peak:
+                out.append(t)

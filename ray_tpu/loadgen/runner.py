@@ -1,0 +1,290 @@
+"""The open-loop load generator and its targets.
+
+Open loop is the defining property: a dispatcher thread issues each
+request at its trace-scheduled time into a worker pool, regardless of how
+many earlier requests are still in flight. A closed-loop generator (issue
+the next request when the previous returns) slows its own arrival rate
+exactly when the target saturates — the coordinated-omission failure mode
+that makes overloaded systems look healthy. Here the arrival process
+never closes the loop on latency, so queueing and shedding show up in the
+recorded outcomes instead of silently in the schedule.
+
+Targets adapt a ``TraceRecord`` to a transport and return per-request
+``(ttft_s, latency_s)``:
+
+- ``HandleTarget``: a serve ``DeploymentHandle`` (unary or streaming;
+  streaming TTFT = first yielded item). Deadlines ride as
+  ``handle.options(timeout_s=...)`` so the PR 7 deadline plane enforces
+  them end to end.
+- ``HTTPTarget``: POST against a serve HTTP proxy route, deadline in the
+  ``X-Request-Timeout-S`` header the proxy honors.
+- ``CallableTarget``: any in-process callable (tests, custom transports).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import asdict, dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .trace import Trace, TraceRecord
+
+
+class CallableTarget:
+    """Wrap ``fn(payload) -> Any`` as a target (TTFT == latency)."""
+
+    def __init__(self, fn: Callable[[Dict[str, Any]], Any]):
+        self._fn = fn
+
+    def __call__(self, record: TraceRecord) -> Tuple[float, float]:
+        t0 = time.perf_counter()
+        self._fn(record.payload())
+        dt = time.perf_counter() - t0
+        return dt, dt
+
+
+class HandleTarget:
+    """Drive a serve DeploymentHandle. ``stream=True`` iterates the
+    response generator and takes TTFT at the first item."""
+
+    def __init__(self, handle, stream: bool = False,
+                 method: Optional[str] = None):
+        if method is not None:
+            handle = handle.options(method_name=method)
+        self._handle = handle
+        self._stream = stream
+
+    def __call__(self, record: TraceRecord) -> Tuple[float, float]:
+        h = self._handle
+        if record.deadline_s is not None:
+            h = h.options(timeout_s=record.deadline_s)
+        t0 = time.perf_counter()
+        if self._stream:
+            first: Optional[float] = None
+            for item in h.options(stream=True).remote(record.payload()):
+                if first is None:
+                    first = time.perf_counter() - t0
+            latency = time.perf_counter() - t0
+            return (first if first is not None else latency), latency
+        h.remote(record.payload()).result()
+        dt = time.perf_counter() - t0
+        return dt, dt
+
+
+class HTTPTarget:
+    """POST each request's payload as JSON to a serve proxy URL. The
+    per-request deadline ships in the X-Request-Timeout-S header."""
+
+    def __init__(self, url: str):
+        self._url = url
+
+    def __call__(self, record: TraceRecord) -> Tuple[float, float]:
+        import urllib.request
+
+        data = json.dumps(record.payload()).encode()
+        req = urllib.request.Request(
+            self._url, data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        timeout = None
+        if record.deadline_s is not None:
+            req.add_header("X-Request-Timeout-S", str(record.deadline_s))
+            timeout = record.deadline_s + 1.0
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            # first body byte approximates TTFT for streaming responses;
+            # for buffered JSON both stamps collapse to response time
+            resp.read(1)
+            first = time.perf_counter() - t0
+            resp.read()
+        latency = time.perf_counter() - t0
+        return first, latency
+
+
+@dataclass
+class RequestResult:
+    index: int
+    sched_t: float  # scheduled offset (after time_scale)
+    start_t: float  # actual dispatch offset
+    ttft_s: float
+    latency_s: float
+    outcome: str  # ok | deadline | shed | error:<Type>
+    cls: str = "default"
+    prefix_id: int = 0
+
+    @property
+    def lag_s(self) -> float:
+        """Dispatch lag: how far behind schedule this request was issued
+        (generator-side pressure, not target latency)."""
+        return self.start_t - self.sched_t
+
+
+class LoadResult:
+    """Per-request records + rollup for one generator run."""
+
+    def __init__(self, records: List[RequestResult], trace: Trace,
+                 wall_s: float):
+        self.records = records
+        self.trace = trace
+        self.wall_s = wall_s
+
+    @property
+    def ok(self) -> List[RequestResult]:
+        return [r for r in self.records if r.outcome == "ok"]
+
+    @property
+    def failures(self) -> List[RequestResult]:
+        return [r for r in self.records if r.outcome != "ok"]
+
+    def summary(self) -> Dict[str, Any]:
+        outcomes: Dict[str, int] = {}
+        for r in self.records:
+            outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
+        ok = self.ok
+        ttfts = sorted(r.ttft_s for r in ok)
+        lats = sorted(r.latency_s for r in ok)
+        out: Dict[str, Any] = {
+            "requests": len(self.records),
+            "wall_s": round(self.wall_s, 3),
+            "offered_rps": round(
+                len(self.records) / self.wall_s, 2
+            ) if self.wall_s > 0 else 0.0,
+            "outcomes": outcomes,
+            "max_lag_s": round(
+                max((r.lag_s for r in self.records), default=0.0), 4
+            ),
+        }
+        if ok:
+            out.update(
+                ttft_p50_ms=round(_pct(ttfts, 0.50) * 1000, 2),
+                ttft_p99_ms=round(_pct(ttfts, 0.99) * 1000, 2),
+                latency_p50_ms=round(_pct(lats, 0.50) * 1000, 2),
+                latency_p99_ms=round(_pct(lats, 0.99) * 1000, 2),
+            )
+        return out
+
+    def to_trace(self) -> Trace:
+        """Round-trip the recorded run back into a replayable trace (the
+        recorded ACTUAL dispatch offsets become the new schedule)."""
+        by_index = {r.index: r for r in self.records}
+        return Trace(
+            meta={**self.trace.meta, "recorded": True},
+            requests=[
+                TraceRecord(
+                    t=round(by_index[i].start_t, 4) if i in by_index
+                    else rec.t,
+                    cls=rec.cls,
+                    prefix_id=rec.prefix_id,
+                    token_ids=list(rec.token_ids),
+                    max_new_tokens=rec.max_new_tokens,
+                    deadline_s=rec.deadline_s,
+                )
+                for i, rec in enumerate(self.trace.requests)
+            ],
+        )
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "summary": self.summary(),
+                    "records": [asdict(r) for r in self.records],
+                    "trace": self.trace.as_dict(),
+                },
+                f,
+            )
+            f.write("\n")
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def _classify(exc: BaseException) -> str:
+    try:
+        from ..exceptions import BackPressureError, DeadlineExceededError
+    except Exception:  # clusterless targets: no typed serve errors
+        BackPressureError = DeadlineExceededError = ()  # type: ignore
+    cause = getattr(exc, "cause", None) or exc
+    if isinstance(cause, DeadlineExceededError) or isinstance(
+        exc, TimeoutError
+    ):
+        return "deadline"
+    if isinstance(cause, BackPressureError):
+        return "shed"
+    return f"error:{type(cause).__name__}"
+
+
+class LoadGenerator:
+    """Replay a Trace against a target, open loop.
+
+    The dispatcher thread sleeps until each record's scheduled offset and
+    hands it to a ``max_inflight``-wide thread pool; worker threads block
+    on the target while the dispatcher keeps issuing. If the pool is
+    exhausted the dispatch lag shows up in ``RequestResult.lag_s`` (and
+    ``summary()["max_lag_s"]``) rather than silently reshaping the
+    arrival process."""
+
+    def __init__(self, target: Callable[[TraceRecord], Tuple[float, float]],
+                 max_inflight: int = 256):
+        self.target = target
+        self.max_inflight = max(1, int(max_inflight))
+
+    def run(self, trace: Trace, time_scale: float = 1.0) -> LoadResult:
+        records: List[Optional[RequestResult]] = [None] * len(trace.requests)
+        pool = ThreadPoolExecutor(
+            max_workers=self.max_inflight, thread_name_prefix="loadgen"
+        )
+        base = time.perf_counter()
+        inflight = threading.Semaphore(self.max_inflight)
+        futures = []
+        try:
+            for i, rec in enumerate(trace.requests):
+                sched = rec.t * time_scale
+                delay = base + sched - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                # the semaphore only bounds memory (pending futures), it is
+                # not a closed loop: capacity max_inflight >> typical
+                # concurrency, and exhaustion is recorded as dispatch lag
+                inflight.acquire()
+                futures.append(pool.submit(
+                    self._one, i, rec, sched, base, records, inflight
+                ))
+            for f in futures:
+                f.result()
+        finally:
+            pool.shutdown(wait=True)
+        wall = time.perf_counter() - base
+        done = [r for r in records if r is not None]
+        return LoadResult(done, trace, wall)
+
+    def _one(self, index: int, rec: TraceRecord, sched: float, base: float,
+             records: List[Optional[RequestResult]],
+             inflight: threading.Semaphore):
+        start = time.perf_counter() - base
+        try:
+            try:
+                ttft, latency = self.target(rec)
+                outcome = "ok"
+            except BaseException as exc:  # noqa: BLE001 — recorded, not raised
+                ttft = latency = time.perf_counter() - base - start
+                outcome = _classify(exc)
+            records[index] = RequestResult(
+                index=index,
+                sched_t=round(sched, 4),
+                start_t=round(start, 4),
+                ttft_s=ttft,
+                latency_s=latency,
+                outcome=outcome,
+                cls=rec.cls,
+                prefix_id=rec.prefix_id,
+            )
+        finally:
+            inflight.release()

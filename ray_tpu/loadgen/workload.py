@@ -1,0 +1,118 @@
+"""Workload synthesis: request classes + Zipf-skewed shared prefixes.
+
+Serving traffic is not uniform: a few system prompts / chat sessions
+dominate (Zipf-distributed prefix popularity) and requests split into
+short interactive calls vs long-context ones. ``synthesize`` turns an
+arrival schedule into a concrete ``Trace`` by sampling a request class
+(weighted) and a shared prefix (Zipf rank) per arrival, so replaying the
+trace exercises the paged prefix cache and prefix-affinity routing the
+way real traffic would.
+"""
+
+from __future__ import annotations
+
+import bisect
+import random
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from .trace import Trace, TraceRecord
+
+
+@dataclass
+class RequestClass:
+    """One traffic class: sampling weight, prompt/generation lengths, and
+    the per-request deadline the caller attaches."""
+
+    name: str
+    weight: float = 1.0
+    prompt_tokens: int = 32
+    max_new_tokens: int = 16
+    deadline_s: Optional[float] = 30.0
+
+
+class ZipfPrefixes:
+    """Zipf(alpha)-skewed shared prompt prefixes: rank k is drawn with
+    probability proportional to 1/k^alpha, so the head few prefixes absorb
+    most traffic — the regime where a prefix cache pays. Prefix token ids
+    are deterministic per (seed, prefix_id): every replay regenerates
+    byte-identical prefixes, so affinity keys and cache-block hashes match
+    across runs."""
+
+    def __init__(self, num_prefixes: int = 64, alpha: float = 1.1,
+                 prefix_tokens: int = 16, seed: int = 0,
+                 vocab_size: int = 32000):
+        if num_prefixes < 1 or prefix_tokens < 0:
+            raise ValueError("need num_prefixes >= 1 and prefix_tokens >= 0")
+        self.num_prefixes = int(num_prefixes)
+        self.alpha = float(alpha)
+        self.prefix_tokens = int(prefix_tokens)
+        self.seed = int(seed)
+        self.vocab_size = int(vocab_size)
+        weights = [1.0 / (k + 1) ** self.alpha
+                   for k in range(self.num_prefixes)]
+        total = sum(weights)
+        self._cdf: List[float] = []
+        acc = 0.0
+        for w in weights:
+            acc += w / total
+            self._cdf.append(acc)
+        self._cdf[-1] = 1.0
+
+    def sample(self, rng: random.Random) -> int:
+        return bisect.bisect_left(self._cdf, rng.random())
+
+    def tokens(self, prefix_id: int) -> List[int]:
+        rng = random.Random((self.seed << 20) ^ (prefix_id + 1))
+        return [rng.randrange(self.vocab_size)
+                for _ in range(self.prefix_tokens)]
+
+
+def synthesize(
+    arrival_times: Sequence[float],
+    classes: Sequence[RequestClass],
+    prefixes: ZipfPrefixes,
+    seed: int = 0,
+) -> Trace:
+    """Assemble a Trace: per arrival, pick a class (weighted) and a prefix
+    (Zipf), then pad the prompt with per-request suffix tokens up to the
+    class's prompt length."""
+    if not classes:
+        raise ValueError("at least one RequestClass required")
+    rng = random.Random(seed)
+    total_w = sum(max(c.weight, 0.0) for c in classes)
+    if total_w <= 0:
+        raise ValueError("class weights must sum > 0")
+    cls_cdf: List[float] = []
+    acc = 0.0
+    for c in classes:
+        acc += max(c.weight, 0.0) / total_w
+        cls_cdf.append(acc)
+    cls_cdf[-1] = 1.0
+
+    records: List[TraceRecord] = []
+    for t in sorted(arrival_times):
+        cls = classes[bisect.bisect_left(cls_cdf, rng.random())]
+        prefix_id = prefixes.sample(rng)
+        prefix = prefixes.tokens(prefix_id)
+        suffix_len = max(0, cls.prompt_tokens - len(prefix))
+        token_ids = prefix + [
+            rng.randrange(prefixes.vocab_size) for _ in range(suffix_len)
+        ]
+        records.append(TraceRecord(
+            t=round(float(t), 4),
+            cls=cls.name,
+            prefix_id=prefix_id,
+            token_ids=token_ids,
+            max_new_tokens=cls.max_new_tokens,
+            deadline_s=cls.deadline_s,
+        ))
+    return Trace(
+        meta={
+            "seed": seed,
+            "num_prefixes": prefixes.num_prefixes,
+            "alpha": prefixes.alpha,
+            "prefix_tokens": prefixes.prefix_tokens,
+        },
+        requests=records,
+    )

@@ -234,6 +234,29 @@ def cmd_kvcache(args):
     return 0
 
 
+def cmd_autoscale(args):
+    """`ray_tpu autoscale`: the SLO autoscaler's decision record.
+
+    - ``log``: most recent scale-up/down decision events (direction,
+      replica counts, triggering reasons, breach age, the signal snapshot
+      at decision time) from the controller's GCS KV mirror.
+    - ``status``: cluster rollup of the ``autoscale_*`` metrics —
+      scale-up/down totals per deployment and decision-latency quantiles.
+    """
+    _connected(args)
+    from ..util import state
+
+    if args.autoscale_action == "log":
+        print(json.dumps(
+            state.autoscale_log(limit=args.limit), indent=2, default=str
+        ))
+    else:
+        print(json.dumps(
+            state.metrics_summary()["autoscale"], indent=2, default=str
+        ))
+    return 0
+
+
 def cmd_chaos(args):
     """`ray_tpu chaos`: fault injection against a live cluster — the
     operator-facing face of the elastic-training chaos layer.
@@ -478,6 +501,18 @@ def main(argv=None):
     )
     p.add_argument("--address", required=True, help="head host:port")
     p.set_defaults(fn=cmd_kvcache)
+
+    p = sub.add_parser(
+        "autoscale",
+        help="SLO autoscaler decision log and scale-up/down counters",
+    )
+    p.add_argument("autoscale_action", choices=["log", "status"])
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument(
+        "--limit", type=int, default=100,
+        help="max decision events to show (log)",
+    )
+    p.set_defaults(fn=cmd_autoscale)
 
     p = sub.add_parser(
         "chaos",

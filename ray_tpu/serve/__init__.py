@@ -20,6 +20,7 @@ from .api import (
     start,
     status,
 )
+from .autoscale import AutoscalePolicy
 from .batching import batch
 from .grpc_proxy import grpc_call
 from .config import AutoscalingConfig, DeploymentConfig, RequestRouterConfig
@@ -50,6 +51,7 @@ __all__ = [
     "DeploymentResponse",
     "DeploymentResponseGenerator",
     "ingress",
+    "AutoscalePolicy",
     "AutoscalingConfig",
     "DeploymentConfig",
     "RequestRouterConfig",

@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional
 
 from .. import api as ray_api
 from .._internal import serialization
+from .autoscale import AutoscalePolicy
 from .config import (
     ApplicationStatus,
     AutoscalingConfig,
@@ -97,6 +98,10 @@ def deployment(_target=None, **options):
         if isinstance(options.get("request_router_config"), dict):
             options["request_router_config"] = RequestRouterConfig(
                 **options["request_router_config"]
+            )
+        if isinstance(options.get("autoscale_policy"), dict):
+            options["autoscale_policy"] = AutoscalePolicy(
+                **options["autoscale_policy"]
             )
         cfg = DeploymentConfig(
             name=options.pop("name", None) or target.__name__, **options

@@ -79,6 +79,11 @@ class DeploymentConfig:
     request_router_config: Optional[RequestRouterConfig] = None
     user_config: Optional[Any] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
+    # SLO-driven closed-loop autoscaling (serve/autoscale.py). Takes
+    # precedence over autoscaling_config when both are set: the policy
+    # reads TTFT p99 / queue depth / shed deltas from live telemetry
+    # instead of the single instantaneous ongoing-requests signal.
+    autoscale_policy: Optional[Any] = None
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     route_prefix: Optional[str] = None
     health_check_period_s: float = 2.0

@@ -39,6 +39,10 @@ class _ReplicaState:
         self.consecutive_health_failures = 0
         self.started_at = time.time()
         self.pid = 0  # captured from get_metrics; chaos CLI targets it
+        # captured from get_metrics: distinct prefix-affinity keys recently
+        # routed here (scale-down victim signal) and cold-start wall time
+        self.affinity_keys = 0
+        self.warmup_s = 0.0
         # drain bookkeeping (state == "DRAINING"): the in-flight drain()
         # call and the hard deadline after which the replica is killed
         # whether or not it acked
@@ -57,6 +61,15 @@ class _DeploymentState:
         self.target_replicas = config.num_replicas
         if config.autoscaling_config:
             self.target_replicas = config.autoscaling_config.min_replicas
+        policy = getattr(config, "autoscale_policy", None)
+        if policy is not None:
+            self.target_replicas = max(
+                policy.min_replicas,
+                min(policy.max_replicas, config.num_replicas),
+            )
+        # per-deployment SLO-autoscaler evaluation state (lazily created
+        # for deployments recovered from pre-policy checkpoints)
+        self.autoscale_state = None
         self.last_scale_up = 0.0
         self.last_scale_down = 0.0
         # bumped whenever replica membership changes, so routers cheap-poll
@@ -81,6 +94,10 @@ class ServeController:
         # thread vs deploy RPC thread) cannot land out of order and regress
         # the durable state to an older snapshot
         self._ckpt_lock = threading.Lock()
+        # SLO-autoscaler decision event log (bounded). Mirrored to the GCS
+        # KV under AUTOSCALE_LOG_KEY so dashboard/CLI read it without an
+        # actor handle; actor method autoscale_log serves it directly.
+        self._autoscale_events: List[dict] = []
         try:
             self._recover_from_checkpoint()
         except Exception:
@@ -244,6 +261,9 @@ class ServeController:
         try:
             # intentional teardown: a later controller must start fresh
             self._kv_call("kv_del", CHECKPOINT_KEY)
+            from .autoscale import AUTOSCALE_LOG_KEY
+
+            self._kv_call("kv_del", AUTOSCALE_LOG_KEY)
         except Exception:
             pass
         return True
@@ -267,8 +287,17 @@ class ServeController:
                 else:
                     old_user_config = existing.config.user_config
                     existing.config = config
-                    if not config.autoscaling_config:
+                    policy = getattr(config, "autoscale_policy", None)
+                    if not config.autoscaling_config and policy is None:
                         existing.target_replicas = config.num_replicas
+                    elif policy is not None:
+                        # keep the autoscaler's target across re-deploys,
+                        # clamped into the (possibly new) policy bounds
+                        existing.target_replicas = max(
+                            policy.min_replicas,
+                            min(policy.max_replicas,
+                                existing.target_replicas),
+                        )
                     if config.user_config != old_user_config:
                         # push new user_config without replica restarts
                         # (reference: reconfigure path)
@@ -308,14 +337,29 @@ class ServeController:
     # -- reconcile -----------------------------------------------------------
 
     def _reconcile_once(self):
-        from .. import api
-
         with self._lock:
             items = list(self._deployments.items())
+        # metric payloads are fetched at most once per tick, and only when
+        # some SLO-policy deployment is actually due for an evaluation
+        payload_cache: Dict[str, list] = {}
+
+        def _payloads() -> list:
+            if "p" not in payload_cache:
+                try:
+                    from ..util.metrics import fetch_metric_payloads
+
+                    payload_cache["p"] = fetch_metric_payloads(self._kv_call)
+                except Exception:
+                    payload_cache["p"] = []
+            return payload_cache["p"]
+
         for full_name, dep in items:
             self._poll_replicas(dep)
             self._reap_draining(dep)
-            if dep.config.autoscaling_config:
+            policy = getattr(dep.config, "autoscale_policy", None)
+            if policy is not None:
+                self._autoscale_slo(full_name, dep, policy, _payloads)
+            elif dep.config.autoscaling_config:
                 self._autoscale(dep)
             self._converge(full_name, dep)
 
@@ -329,6 +373,10 @@ class ServeController:
                 metrics = api.get(replica.handle.get_metrics.remote(), timeout=5)
                 replica.queue_len = metrics["queue_len"]
                 replica.pid = metrics.get("pid", replica.pid)
+                replica.affinity_keys = int(metrics.get("affinity_keys", 0))
+                replica.warmup_s = float(
+                    metrics.get("warmup_s", replica.warmup_s)
+                )
                 replica.consecutive_health_failures = 0
             except Exception:
                 replica.consecutive_health_failures += 1
@@ -415,6 +463,82 @@ class ServeController:
             dep.last_scale_up = now
             dep.last_scale_down = now
 
+    def _autoscale_slo(self, full_name, dep, policy, payloads_fn):
+        """Closed-loop SLO autoscaler (serve/autoscale.py): every
+        ``policy.interval_s`` build the pressure signals — queue depth from
+        this tick's replica polls (instantaneous, so sustained pressure
+        turns into a scale-up within one evaluation interval), TTFT p99 and
+        shed counts as windowed deltas from the metrics push plane — run
+        the pure ``evaluate`` state machine, and apply the decision by
+        moving ``target_replicas`` (converge does the actual start/drain).
+        Every applied decision lands in the autoscale_* metrics and the
+        event log."""
+        import json as _json
+
+        from . import autoscale as _as
+
+        st = dep.autoscale_state
+        if st is None:
+            st = dep.autoscale_state = _as.AutoscaleState()
+        now = time.time()
+        if now - st.last_eval_ts < policy.interval_s:
+            return
+        st.last_eval_ts = now
+        running = [r for r in dep.replicas.values() if r.state == "RUNNING"]
+        starting = [r for r in dep.replicas.values() if r.state == "STARTING"]
+        if not running:
+            return
+        payloads = payloads_fn()
+        shed_now = _as.shed_total(payloads, dep.config.name)
+        queue_depth = float(sum(r.queue_len for r in running))
+        sig = _as.AutoscaleSignals(
+            queue_depth=queue_depth,
+            queue_per_replica=queue_depth / len(running),
+            shed_delta=max(0.0, shed_now - st.last_shed_total),
+            ttft_p99_ms=_as.ttft_p99_ms(payloads, dep.config.name, st),
+            running=len(running),
+            starting=len(starting),
+            target=dep.target_replicas,
+        )
+        st.last_shed_total = shed_now
+        decision = _as.evaluate(policy, st, sig, now)
+        if decision is None:
+            return
+        with self._lock:
+            dep.target_replicas = decision.to_replicas
+            self._dirty = True
+        from ..util.metrics import record_autoscale_decision
+
+        record_autoscale_decision(
+            dep.config.name, decision.direction, decision.breach_age_s
+        )
+        logger.info(
+            "autoscale %s: %s %d -> %d (%s)",
+            full_name, decision.direction, decision.from_replicas,
+            decision.to_replicas, decision.reason,
+        )
+        event = {
+            "ts": now,
+            "deployment": full_name,
+            "direction": decision.direction,
+            "from": decision.from_replicas,
+            "to": decision.to_replicas,
+            "reason": decision.reason,
+            "breach_age_s": round(decision.breach_age_s, 3),
+            "signals": sig.as_dict(),
+        }
+        self._autoscale_events.append(event)
+        del self._autoscale_events[:-_as.LOG_LIMIT]
+        try:
+            self._kv_call(
+                "kv_put",
+                _as.AUTOSCALE_LOG_KEY,
+                _json.dumps(self._autoscale_events).encode(),
+                True,
+            )
+        except Exception:
+            logger.exception("autoscale event-log push failed")
+
     def _converge(self, full_name: str, dep: _DeploymentState):
         from .. import api
 
@@ -432,10 +556,15 @@ class ServeController:
         elif len(active) > dep.target_replicas:
             excess = len(active) - dep.target_replicas
             # STARTING victims first (nothing accepted yet — cheap kill),
-            # then the least-loaded RUNNING ones, which drain gracefully
+            # then RUNNING ones with the fewest recently-routed prefix-
+            # affinity keys (draining a cold replica preserves more of the
+            # cluster's reusable KV prefix state), queue length as the tie
+            # break
             victims = sorted(
                 active,
-                key=lambda r: (r.state != "STARTING", r.queue_len),
+                key=lambda r: (
+                    r.state != "STARTING", r.affinity_keys, r.queue_len,
+                ),
             )[:excess]
             for v in victims:
                 if v.state == "STARTING":
@@ -590,6 +719,8 @@ class ServeController:
                         "state": r.state,
                         "pid": r.pid,
                         "queue_len": r.queue_len,
+                        "affinity_keys": r.affinity_keys,
+                        "warmup_s": r.warmup_s,
                     })
             return out
 
@@ -613,6 +744,12 @@ class ServeController:
                 if getattr(dep.config, "ingress", False):
                     return info
             return first or {}
+
+    def autoscale_log(self, limit: int = 100) -> List[dict]:
+        """Most recent SLO-autoscaler decisions, oldest first (`ray_tpu
+        autoscale log`, tests)."""
+        with self._lock:
+            return list(self._autoscale_events)[-max(0, limit):]
 
     def list_applications(self) -> List[str]:
         with self._lock:

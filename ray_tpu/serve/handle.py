@@ -492,6 +492,11 @@ class DeploymentHandle:
         metadata: Dict[str, Any] = {}
         if self._multiplexed_model_id:
             metadata["multiplexed_model_id"] = self._multiplexed_model_id
+        if affinity is not None:
+            # the key rides with the request so the replica can count the
+            # distinct prefixes recently routed to it — the controller's
+            # scale-down victim signal (drain the fewest-prefixes replica)
+            metadata["affinity_key"] = affinity
         if deadline_ts is not None:
             # the deadline rides WITH the request so the replica can reject
             # dead-on-arrival work; retries inherit the same absolute

@@ -37,10 +37,13 @@ class Replica:
         max_ongoing_requests: int = 100,
         max_queued_requests: int = 64,
     ):
+        from collections import OrderedDict
+
         from .._internal import serialization
 
         from concurrent.futures import ThreadPoolExecutor
 
+        warmup_start = time.perf_counter()
         self._deployment_name = deployment_name
         self._replica_id = replica_id
         self._ongoing = 0
@@ -57,6 +60,10 @@ class Replica:
         self._pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f"replica-{replica_id}"
         )
+        # recently-routed distinct prefix-affinity keys (bounded recency
+        # map key -> last-seen ts); the controller reads the live count as
+        # its scale-down victim signal
+        self._affinity_keys: "OrderedDict[int, float]" = OrderedDict()
         target = serialization.loads(cls_or_fn_bytes)
         if inspect.isclass(target):
             self._callable = target(*init_args, **init_kwargs)
@@ -65,6 +72,42 @@ class Replica:
         self._is_function = not inspect.isclass(target)
         if user_config is not None:
             self._reconfigure_sync(user_config)
+        # cold-start accounting: everything between actor start and
+        # ready-to-serve counts — deserialize, user __init__ (weight-plane
+        # resolution for LLM replicas happens there), reconfigure, and an
+        # optional synchronous warmup() hook. check_health (and therefore
+        # the STARTING -> RUNNING transition) cannot run before this
+        # completes, so RUNNING always implies warmed-up.
+        warmup_hook = getattr(self._callable, "warmup", None)
+        if warmup_hook is not None and not inspect.iscoroutinefunction(
+            warmup_hook
+        ):
+            warmup_hook()
+        self._warmup_s = time.perf_counter() - warmup_start
+        from ..util.metrics import record_serve_replica_warmup
+
+        record_serve_replica_warmup(deployment_name, self._warmup_s)
+
+    _AFFINITY_KEY_WINDOW_S = 60.0
+    _AFFINITY_KEY_CAP = 4096
+
+    def _note_affinity(self, metadata: Optional[dict]):
+        key = (metadata or {}).get("affinity_key")
+        if key is None:
+            return
+        self._affinity_keys.pop(key, None)
+        self._affinity_keys[key] = time.time()
+        while len(self._affinity_keys) > self._AFFINITY_KEY_CAP:
+            self._affinity_keys.popitem(last=False)
+
+    def _live_affinity_keys(self) -> int:
+        cutoff = time.time() - self._AFFINITY_KEY_WINDOW_S
+        while self._affinity_keys:
+            key, ts = next(iter(self._affinity_keys.items()))
+            if ts >= cutoff:
+                break
+            self._affinity_keys.popitem(last=False)
+        return len(self._affinity_keys)
 
     # -- admission control ----------------------------------------------------
 
@@ -196,23 +239,36 @@ class Replica:
 
     async def handle_request(self, method: str, args: tuple, kwargs: dict,
                              metadata: Optional[dict] = None):
+        from ..util.metrics import record_serve_ttft
+
+        t0 = time.perf_counter()
         await self._admit(metadata)
+        self._note_affinity(metadata)
         try:
             fn, args, kwargs = await self._prepare_call(
                 method, args, kwargs, metadata
             )
             if inspect.iscoroutinefunction(fn):
-                return await fn(*args, **kwargs)
-            # sync user code must not block the worker's event loop (it
-            # services RPC + heartbeats); run it on the request pool. The
-            # context carries the multiplexed model id across the thread hop.
-            import contextvars
+                result = await fn(*args, **kwargs)
+            else:
+                # sync user code must not block the worker's event loop (it
+                # services RPC + heartbeats); run it on the request pool. The
+                # context carries the multiplexed model id across the thread
+                # hop.
+                import contextvars
 
-            loop = asyncio.get_running_loop()
-            ctx = contextvars.copy_context()
-            return await loop.run_in_executor(
-                self._pool, lambda: ctx.run(fn, *args, **kwargs)
+                loop = asyncio.get_running_loop()
+                ctx = contextvars.copy_context()
+                result = await loop.run_in_executor(
+                    self._pool, lambda: ctx.run(fn, *args, **kwargs)
+                )
+            # unary TTFT = first (and only) output; queue wait is included
+            # on purpose — that is the latency the caller experiences and
+            # the signal the autoscaler scales on
+            record_serve_ttft(
+                self._deployment_name, time.perf_counter() - t0
             )
+            return result
         finally:
             self._release()
 
@@ -224,14 +280,29 @@ class Replica:
         method must be a (sync or async) generator; every yielded item ships
         to the caller through the runtime's streaming-generator machinery as
         soon as it exists."""
+        from ..util.metrics import record_serve_ttft
+
         _SENTINEL = object()
+        t0 = time.perf_counter()
+        first_emitted = False
+
+        def _note_first():
+            nonlocal first_emitted
+            if not first_emitted:
+                first_emitted = True
+                record_serve_ttft(
+                    self._deployment_name, time.perf_counter() - t0
+                )
+
         await self._admit(metadata)
+        self._note_affinity(metadata)
         try:
             fn, args, kwargs = await self._prepare_call(
                 method, args, kwargs, metadata
             )
             if inspect.isasyncgenfunction(fn):
                 async for item in fn(*args, **kwargs):
+                    _note_first()
                     yield item
                 return
             if inspect.iscoroutinefunction(fn):
@@ -262,6 +333,7 @@ class Replica:
                 )
                 if item is _SENTINEL:
                     return
+                _note_first()
                 yield item
         finally:
             self._release()
@@ -279,6 +351,8 @@ class Replica:
             "total_served": self._total_served,
             "draining": self._draining,
             "pid": os.getpid(),
+            "affinity_keys": self._live_affinity_keys(),
+            "warmup_s": round(self._warmup_s, 6),
         }
 
     def check_health(self) -> bool:

@@ -965,6 +965,8 @@ def kvcache_summary(payloads: List[dict]) -> Dict[str, object]:
         "kvcache_blocks_capacity": "blocks_capacity",
     }
     ttft: Dict[str, Dict[str, float]] = out["ttft_ms"]  # type: ignore[assignment]
+    ttft_buckets: Dict[str, List[float]] = {}
+    ttft_bounds: Dict[str, List[float]] = {}
     for payload in payloads:
         for snap in payload.get("metrics", []):
             name = snap["name"]
@@ -973,16 +975,312 @@ def kvcache_summary(payloads: List[dict]) -> Dict[str, object]:
             elif name == "kvcache_ttft_ms":
                 for tag_json, counts in snap.get("counts", {}).items():
                     tags = dict(zip(snap["tag_keys"], json.loads(tag_json)))
+                    cache = tags.get("cache", "?")
                     row = ttft.setdefault(
-                        tags.get("cache", "?"), {"count": 0.0, "sum_ms": 0.0}
+                        cache, {"count": 0.0, "sum_ms": 0.0}
                     )
                     row["count"] += float(sum(counts))
                     row["sum_ms"] += float(
                         snap["values"].get(tag_json, 0.0)
                     )
-    for row in ttft.values():
+                    merged = ttft_buckets.setdefault(cache, [0.0] * len(counts))
+                    if len(merged) < len(counts):
+                        merged.extend([0.0] * (len(counts) - len(merged)))
+                    for i, c in enumerate(counts):
+                        merged[i] += c
+                    ttft_bounds.setdefault(
+                        cache,
+                        list(snap.get("boundaries")
+                             or _KVCACHE_TTFT_BOUNDARIES_MS),
+                    )
+    for cache, row in ttft.items():
         if row["count"]:
             row["mean_ms"] = row["sum_ms"] / row["count"]
+            counts = ttft_buckets.get(cache)
+            if counts:
+                bounds = ttft_bounds[cache]
+                row["p50_ms"] = quantile_from_buckets(bounds, counts, 0.50)
+                row["p99_ms"] = quantile_from_buckets(bounds, counts, 0.99)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Histogram quantiles from pushed buckets. The push plane ships bucket
+# counts, not raw samples, so cluster rollups (state.metrics_summary, the
+# autoscale controller, the dashboard) estimate percentiles by linear
+# interpolation inside the containing bucket — the same estimator
+# Prometheus's histogram_quantile uses. Exact sample percentiles stay
+# available only where a process kept raw samples (e.g. train recovery).
+# ---------------------------------------------------------------------------
+
+
+def quantile_from_buckets(
+    boundaries: List[float], counts: List[float], q: float
+) -> Optional[float]:
+    """Estimate the q-quantile from non-cumulative histogram buckets.
+
+    Bucket i spans (boundaries[i-1], boundaries[i]]; the first bucket's
+    lower edge is 0 (all recorded values are non-negative) and the overflow
+    bucket clamps to the last boundary since it has no upper edge to
+    interpolate toward. Returns None for an empty histogram."""
+    total = float(sum(counts))
+    if total <= 0:
+        return None
+    rank = min(max(q, 0.0), 1.0) * total
+    cum = 0.0
+    lo = 0.0
+    for i, c in enumerate(counts):
+        if c and cum + c >= rank:
+            if i >= len(boundaries):
+                return float(lo)
+            hi = float(boundaries[i])
+            return lo + (hi - lo) * ((rank - cum) / c)
+        cum += c
+        if i < len(boundaries):
+            lo = float(boundaries[i])
+    return float(lo)
+
+
+def merged_histogram(
+    payloads: List[dict],
+    name: str,
+    tag_filter: Optional[Dict[str, str]] = None,
+) -> Optional[dict]:
+    """Merge one histogram's buckets across every pushed payload, keeping
+    only series whose tags include ``tag_filter``. Returns {boundaries,
+    counts, sum, count} or None if no matching series was pushed."""
+    boundaries: Optional[List[float]] = None
+    merged: Optional[List[float]] = None
+    total_sum = 0.0
+    for payload in payloads:
+        for snap in payload.get("metrics", []):
+            if snap.get("name") != name:
+                continue
+            for tag_json, counts in snap.get("counts", {}).items():
+                if tag_filter:
+                    tags = dict(
+                        zip(snap.get("tag_keys", ()), json.loads(tag_json))
+                    )
+                    if any(tags.get(k) != v for k, v in tag_filter.items()):
+                        continue
+                if merged is None:
+                    boundaries = list(snap.get("boundaries") or [])
+                    merged = [0.0] * len(counts)
+                if len(merged) < len(counts):
+                    merged.extend([0.0] * (len(counts) - len(merged)))
+                for i, c in enumerate(counts):
+                    merged[i] += c
+                total_sum += float(snap.get("values", {}).get(tag_json, 0.0))
+    if merged is None:
+        return None
+    return {
+        "boundaries": boundaries or [],
+        "counts": merged,
+        "sum": total_sum,
+        "count": float(sum(merged)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Serve latency plane: per-deployment TTFT (admission to first output:
+# first stream item, or completion for unary calls) and replica warmup
+# (actor start to ready-to-serve, including weight-plane resolution). The
+# TTFT p99 here is the SLO signal the autoscale controller evaluates.
+# ---------------------------------------------------------------------------
+
+_SERVE_TTFT_BOUNDARIES_S = [
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5,
+    10, 30,
+]
+
+_SERVE_WARMUP_BOUNDARIES_S = [
+    0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120,
+]
+
+_serve_latency_metrics: Optional[dict] = None
+_serve_latency_init_lock = threading.Lock()
+
+
+def _ensure_serve_latency_metrics() -> dict:
+    global _serve_latency_metrics
+    if _serve_latency_metrics is None:
+        with _serve_latency_init_lock:
+            if _serve_latency_metrics is None:
+                _serve_latency_metrics = {
+                    "ttft": Histogram(
+                        "serve_ttft_seconds",
+                        "Replica-side time to first output: admission "
+                        "(queue wait included) to first stream item or "
+                        "unary completion",
+                        boundaries=_SERVE_TTFT_BOUNDARIES_S,
+                        tag_keys=("deployment",),
+                    ),
+                    "warmup": Histogram(
+                        "serve_replica_warmup_seconds",
+                        "Replica cold-start: constructor entry to "
+                        "ready-to-serve (user init + weight resolution "
+                        "+ warmup hook)",
+                        boundaries=_SERVE_WARMUP_BOUNDARIES_S,
+                        tag_keys=("deployment",),
+                    ),
+                }
+    return _serve_latency_metrics
+
+
+def record_serve_ttft(deployment: str, seconds: float):
+    _ensure_serve_latency_metrics()["ttft"].observe(
+        seconds, {"deployment": deployment}
+    )
+
+
+def record_serve_replica_warmup(deployment: str, seconds: float):
+    _ensure_serve_latency_metrics()["warmup"].observe(
+        seconds, {"deployment": deployment}
+    )
+
+
+def serve_latency_summary(payloads: List[dict]) -> Dict[str, object]:
+    """Cluster rollup: per-deployment TTFT (ms) and warmup (s) with
+    bucket-derived p50/p99 (state.metrics_summary / dashboard / CLI)."""
+    out: Dict[str, object] = {"ttft_ms": {}, "warmup_s": {}}
+    specs = (
+        ("serve_ttft_seconds", "ttft_ms", 1000.0),
+        ("serve_replica_warmup_seconds", "warmup_s", 1.0),
+    )
+    deployments: Dict[str, set] = {key: set() for _, key, _ in specs}
+    for payload in payloads:
+        for snap in payload.get("metrics", []):
+            for name, key, _scale in specs:
+                if snap.get("name") != name:
+                    continue
+                for tag_json in snap.get("counts", {}):
+                    tags = dict(
+                        zip(snap.get("tag_keys", ()), json.loads(tag_json))
+                    )
+                    deployments[key].add(tags.get("deployment", "?"))
+    for name, key, scale in specs:
+        section: Dict[str, dict] = out[key]  # type: ignore[assignment]
+        for dep in sorted(deployments[key]):
+            m = merged_histogram(payloads, name, {"deployment": dep})
+            if not m or not m["count"]:
+                continue
+            section[dep] = {
+                "count": m["count"],
+                "mean": m["sum"] / m["count"] * scale,
+                "p50": _scaled_quantile(m, 0.50, scale),
+                "p99": _scaled_quantile(m, 0.99, scale),
+            }
+    return out
+
+
+def _scaled_quantile(m: dict, q: float, scale: float) -> Optional[float]:
+    est = quantile_from_buckets(m["boundaries"], m["counts"], q)
+    return None if est is None else est * scale
+
+
+# ---------------------------------------------------------------------------
+# Autoscale decision telemetry: scale-up/down counters per deployment and
+# the breach-to-decision latency histogram (how long pressure persisted
+# before the controller acted — the "reacting in seconds, not minutes"
+# proof). Recorded in the serve controller process; events themselves live
+# in the controller's event log (GCS key serve:autoscale_log).
+# ---------------------------------------------------------------------------
+
+_AUTOSCALE_DECISION_BOUNDARIES_S = [
+    0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300,
+]
+
+_autoscale_metrics: Optional[dict] = None
+_autoscale_init_lock = threading.Lock()
+
+
+def _ensure_autoscale_metrics() -> dict:
+    global _autoscale_metrics
+    if _autoscale_metrics is None:
+        with _autoscale_init_lock:
+            if _autoscale_metrics is None:
+                _autoscale_metrics = {
+                    "up": Counter(
+                        "autoscale_scale_up_total",
+                        "SLO-autoscaler scale-up decisions applied",
+                        tag_keys=("deployment",),
+                    ),
+                    "down": Counter(
+                        "autoscale_scale_down_total",
+                        "SLO-autoscaler scale-down decisions applied",
+                        tag_keys=("deployment",),
+                    ),
+                    "decision": Histogram(
+                        "autoscale_decision_seconds",
+                        "Pressure-onset (or idle-onset) to applied "
+                        "decision wall time",
+                        boundaries=_AUTOSCALE_DECISION_BOUNDARIES_S,
+                        tag_keys=("deployment", "direction"),
+                    ),
+                }
+    return _autoscale_metrics
+
+
+def record_autoscale_decision(
+    deployment: str, direction: str, breach_age_s: float
+):
+    m = _ensure_autoscale_metrics()
+    m["up" if direction == "up" else "down"].inc(
+        1.0, {"deployment": deployment}
+    )
+    m["decision"].observe(
+        max(breach_age_s, 0.0),
+        {"deployment": deployment, "direction": direction},
+    )
+
+
+def autoscale_counters() -> Dict[str, float]:
+    """Process-local totals across deployments (tests + bench)."""
+    m = _ensure_autoscale_metrics()
+    out: Dict[str, float] = {}
+    for label, metric in (("scale_ups", m["up"]), ("scale_downs", m["down"])):
+        with metric._lock:
+            out[label] = float(sum(metric._values.values()))
+    return out
+
+
+def autoscale_summary(payloads: List[dict]) -> Dict[str, object]:
+    """Cluster rollup of autoscaler activity from pushed snapshots
+    (state.metrics_summary / dashboard /api/autoscale / CLI)."""
+    out: Dict[str, object] = {
+        "scale_ups": 0.0,
+        "scale_downs": 0.0,
+        "by_deployment": {},
+        "decision_p50_s": None,
+        "decision_p99_s": None,
+    }
+    by_dep: Dict[str, dict] = out["by_deployment"]  # type: ignore[assignment]
+    for payload in payloads:
+        for snap in payload.get("metrics", []):
+            field = {
+                "autoscale_scale_up_total": "scale_ups",
+                "autoscale_scale_down_total": "scale_downs",
+            }.get(snap.get("name", ""))
+            if field is None:
+                continue
+            for tag_json, value in snap["values"].items():
+                out[field] += value
+                tags = dict(
+                    zip(snap.get("tag_keys", ()), json.loads(tag_json))
+                )
+                row = by_dep.setdefault(
+                    tags.get("deployment", "?"),
+                    {"scale_ups": 0.0, "scale_downs": 0.0},
+                )
+                row[field] += value
+    m = merged_histogram(payloads, "autoscale_decision_seconds")
+    if m and m["count"]:
+        out["decision_p50_s"] = quantile_from_buckets(
+            m["boundaries"], m["counts"], 0.50
+        )
+        out["decision_p99_s"] = quantile_from_buckets(
+            m["boundaries"], m["counts"], 0.99
+        )
     return out
 
 

@@ -114,10 +114,12 @@ def metrics_summary() -> Dict[str, Any]:
     import json as _json
 
     from .metrics import (
+        autoscale_summary,
         device_rows,
         fetch_metric_payloads,
         kvcache_summary,
         serve_ft_summary,
+        serve_latency_summary,
         train_ft_summary,
     )
 
@@ -176,6 +178,8 @@ def metrics_summary() -> Dict[str, Any]:
         "kvcache": kvcache_summary(payloads),
         "train_ft": train_ft_summary(payloads),
         "serve_ft": serve_ft_summary(payloads),
+        "serve_latency": serve_latency_summary(payloads),
+        "autoscale": autoscale_summary(payloads),
     }
 
 
@@ -197,6 +201,23 @@ def list_train_runs() -> List[Dict[str, Any]]:
         rec["name"] = key[len("trainrun:"):]
         out.append(rec)
     return out
+
+
+def autoscale_log(limit: int = 100) -> List[Dict[str, Any]]:
+    """Most recent SLO-autoscaler decision events, oldest first, read from
+    the controller's GCS KV mirror (``serve:autoscale_log``) — works from
+    any connected process without a controller actor handle (`ray_tpu
+    autoscale log`, dashboard)."""
+    import json as _json
+
+    raw = _gcs_call("kv_get", "serve:autoscale_log")
+    if not raw:
+        return []
+    try:
+        events = _json.loads(bytes(raw).decode())
+    except Exception:
+        return []
+    return events[-max(0, limit):]
 
 
 def list_weights() -> List[Dict[str, Any]]:

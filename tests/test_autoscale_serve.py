@@ -1,0 +1,309 @@
+"""Closed-loop SLO autoscaling against a live cluster: the loadgen plane
+drives a deployment governed by an AutoscalePolicy, and the controller
+must scale up under pressure, drain back down after decay (picking the
+replica with the fewest prefix-affinity hits), and warm cold replicas
+through the weight plane before they report RUNNING."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import loadgen, serve, testing
+from ray_tpu.util import state as rt_state
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=8, resources={"TPU": 4})
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_apps():
+    yield
+    try:
+        for app in list(serve.status().keys()):
+            serve.delete(app)
+    except Exception:
+        pass
+
+
+def _running(app):
+    return [r for r in testing.list_serve_replicas(app)
+            if r["state"] == "RUNNING" and r["pid"]]
+
+
+def _wait_replicas(app, n, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rows = _running(app)
+        if len(rows) == n:
+            return rows
+        time.sleep(0.1)
+    raise TimeoutError(f"{app}: never reached {n} RUNNING replicas")
+
+
+_POLICY = {
+    "min_replicas": 1, "max_replicas": 3, "interval_s": 0.5,
+    "target_queue_per_replica": 2.0, "up_hysteresis": 1,
+    "down_hysteresis": 2, "idle_queue_per_replica": 0.5,
+    "cooldown_up_s": 1.0, "cooldown_down_s": 1.5,
+    "scale_up_step": 2, "scale_down_step": 2,
+}
+
+
+def test_closed_loop_scale_up_then_drain_down(cluster):
+    """The PR's acceptance demo: sustained open-loop pressure scales the
+    deployment up within ~one evaluation interval, the load decays, the
+    autoscaler drains back to min via the graceful path, and not one
+    caller request is dropped along the way. Both transitions land in the
+    decision log (actor + KV mirror) and the autoscale_* metrics."""
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=64,
+                      graceful_shutdown_timeout_s=10.0,
+                      autoscale_policy=dict(_POLICY))
+    class Work:
+        def __call__(self, payload):
+            time.sleep(0.15)
+            return len(payload.get("token_ids", []))
+
+    handle = serve.run(Work.bind(), name="slo", _proxy=False)
+    _wait_replicas("slo", 1)
+
+    # ~14 rps against a 6.7 rps single replica: queue pressure within one
+    # 0.5s evaluation interval, then nothing — the decay phase
+    trace = loadgen.synthesize(
+        loadgen.PoissonArrivals(14.0, 3.0, seed=5).times(),
+        [loadgen.RequestClass("short", prompt_tokens=8,
+                              max_new_tokens=2, deadline_s=60.0)],
+        loadgen.ZipfPrefixes(num_prefixes=4, prefix_tokens=4, seed=5),
+        seed=5,
+    )
+    gen = loadgen.LoadGenerator(
+        loadgen.HandleTarget(handle), max_inflight=64
+    )
+    result = gen.run(trace)
+
+    # zero dropped: the open-loop burst all completed (queue + scale-out)
+    assert [r.outcome for r in result.records].count("ok") == len(
+        trace.requests
+    ), result.summary()
+
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    events = ray_tpu.get(controller.autoscale_log.remote(), timeout=10)
+    ups = [e for e in events if e["direction"] == "up"]
+    assert ups, f"no scale-up decision: {events}"
+    # pressure was acted on within one evaluation interval of onset
+    assert ups[0]["breach_age_s"] <= _POLICY["interval_s"] + 0.3
+    assert ups[0]["deployment"].endswith("Work")
+    assert ups[0]["to"] > ups[0]["from"]
+    assert ups[0]["signals"]["queue_per_replica"] > 2.0
+    assert _POLICY["max_replicas"] >= max(
+        len(_running("slo")), ups[0]["to"]
+    )
+
+    # decay: drain back down to min via graceful scale-down
+    deadline = time.time() + 40
+    while time.time() < deadline and len(_running("slo")) > 1:
+        time.sleep(0.2)
+    assert len(_running("slo")) == 1, "never drained back to min_replicas"
+    events = ray_tpu.get(controller.autoscale_log.remote(), timeout=10)
+    downs = [e for e in events if e["direction"] == "down"]
+    assert downs, f"no scale-down decision: {events}"
+    assert downs[-1]["to"] < downs[-1]["from"]
+
+    # the KV mirror serves the same log handle-free (CLI + dashboard path)
+    mirrored = rt_state.autoscale_log()
+    assert [e["direction"] for e in mirrored] == [
+        e["direction"] for e in events
+    ]
+
+    # the decision metrics reach the cluster rollup within a push interval
+    deadline = time.time() + 15
+    rollup = {}
+    while time.time() < deadline:
+        rollup = rt_state.metrics_summary()["autoscale"]
+        if rollup["scale_ups"] >= 1 and rollup["scale_downs"] >= 1:
+            break
+        time.sleep(0.5)
+    assert rollup["scale_ups"] >= 1 and rollup["scale_downs"] >= 1, rollup
+    assert rollup["decision_p99_s"] is not None
+
+
+def test_scale_down_victim_has_fewest_affinity_hits(cluster):
+    """Scale-down victim selection: the replica holding the most live
+    prefix-affinity keys (hence the warmest KV blocks) survives; traffic
+    for the drained replica's prefixes re-biases to the survivor."""
+    import random
+
+    from ray_tpu.serve.handle import _prefix_affinity_key
+
+    @serve.deployment(num_replicas=2)
+    class Which:
+        def __call__(self, payload):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(Which.bind(), name="aff", _proxy=False)
+    rows = _wait_replicas("aff", 2)
+    ordered = sorted(r["replica_id"] for r in rows)
+
+    # craft prompts whose affinity keys map to a chosen replica: index
+    # key % 2 into the sorted replica-id list (router invariant)
+    rng = random.Random(0)
+    hot_idx = 0
+    hot_prompts, cold_prompt = [], None
+    while len(hot_prompts) < 6 or cold_prompt is None:
+        toks = [rng.randrange(1000) for _ in range(6)]
+        payload = {"token_ids": toks, "max_new_tokens": 1}
+        idx = _prefix_affinity_key((payload,), {}, 4) % 2
+        if idx == hot_idx and len(hot_prompts) < 6:
+            hot_prompts.append(payload)
+        elif idx != hot_idx and cold_prompt is None:
+            cold_prompt = payload
+
+    affine = handle.options(prefix_affinity_tokens=4)
+    for p in hot_prompts:
+        affine.remote(dict(p)).result(timeout_s=30)
+    cold_pid = affine.remote(dict(cold_prompt)).result(timeout_s=30)
+
+    hot_rid, cold_rid = ordered[hot_idx], ordered[1 - hot_idx]
+    # the controller's replica polls pick up the per-replica live-key
+    # counts (6 distinct keys on hot, 1 on cold)
+    deadline = time.time() + 15
+    counts = {}
+    while time.time() < deadline:
+        counts = {r["replica_id"]: r["affinity_keys"]
+                  for r in _running("aff")}
+        if counts.get(hot_rid, 0) >= 6 and counts.get(cold_rid, 0) >= 1:
+            break
+        time.sleep(0.2)
+    assert counts.get(hot_rid, 0) >= 6, counts
+    assert counts.get(hot_rid, 0) > counts.get(cold_rid, 0), counts
+
+    serve.run(Which.options(num_replicas=1).bind(), name="aff",
+              _proxy=False, _blocking=False)
+    survivor = _wait_replicas("aff", 1, timeout=40)[0]["replica_id"]
+    assert survivor == hot_rid, (
+        f"drained the affinity-hot replica: kept {survivor}, "
+        f"counts were {counts}"
+    )
+
+    # the cold prefix re-biases to the survivor and still completes
+    pid_after = affine.remote(dict(cold_prompt)).result(timeout_s=30)
+    hot_pid = affine.remote(dict(hot_prompts[0])).result(timeout_s=30)
+    assert pid_after == hot_pid
+    assert pid_after != cold_pid
+
+
+def test_cold_replica_resolves_weights_before_running(cluster):
+    """A STARTING replica with a weights_name resolves the published
+    version inside __init__ (before the controller can see it healthy),
+    so RUNNING always implies warmed; the warmup duration is recorded
+    per replica and rolls up into serve_replica_warmup_seconds."""
+    import numpy as np
+
+    from ray_tpu import weights as rt_weights
+
+    version = rt_weights.WeightPublisher("srvmodel").publish(
+        {"w": np.ones(4, dtype=np.float32)}
+    )
+
+    @serve.deployment(num_replicas=1)
+    class Warmed:
+        def __init__(self):
+            from ray_tpu.weights import WeightSubscriber
+
+            self._version, params = WeightSubscriber("srvmodel").get(
+                timeout=30.0
+            )
+            self._w_sum = float(params["w"].sum())
+            time.sleep(0.05)  # make the warmup window measurable
+
+        def warmup(self):
+            # replica.py runs this before reporting ready
+            if self._version is None:
+                raise RuntimeError("serving before weights resolved")
+
+        def __call__(self, _):
+            return {"version": self._version, "w_sum": self._w_sum}
+
+    handle = serve.run(Warmed.bind(), name="warm", _proxy=False)
+    rows = _wait_replicas("warm", 1)
+    # RUNNING implies the weights already resolved — first request needs
+    # no lazy load
+    out = handle.remote(None).result(timeout_s=30)
+    assert out == {"version": version, "w_sum": 4.0}
+    # warmup duration captured by the controller's polls (>= the 50ms nap)
+    deadline = time.time() + 15
+    warm_s = 0.0
+    while time.time() < deadline:
+        rows = _running("warm")
+        warm_s = rows[0]["warmup_s"] if rows else 0.0
+        if warm_s >= 0.05:
+            break
+        time.sleep(0.2)
+    assert warm_s >= 0.05, rows
+
+    # and the histogram reaches the cluster rollup within a push interval
+    deadline = time.time() + 15
+    summary = {}
+    while time.time() < deadline:
+        summary = rt_state.metrics_summary()["serve_latency"]["warmup_s"]
+        if any(k.endswith("Warmed") for k in summary):
+            break
+        time.sleep(0.5)
+    row = next(v for k, v in summary.items() if k.endswith("Warmed"))
+    assert row["count"] >= 1
+    assert row["p99"] is not None and row["p99"] >= 0.05
+
+
+@pytest.mark.slow
+def test_bundled_trace_replay_full(cluster):
+    """Heavy variant of the bench: the full bundled ramp-burst-decay trace
+    at real time against an autoscaled deployment; replica count must rise
+    and fall with the load and every request completes."""
+    import threading
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=256,
+                      graceful_shutdown_timeout_s=15.0,
+                      autoscale_policy={**_POLICY, "scale_up_step": 1,
+                                        "scale_down_step": 1})
+    class Work:
+        def __call__(self, payload):
+            time.sleep(0.15)
+            return len(payload.get("token_ids", []))
+
+    handle = serve.run(Work.bind(), name="replay", _proxy=False)
+    _wait_replicas("replay", 1)
+    trace = loadgen.bundled_trace("ramp_burst_decay")
+
+    stop = threading.Event()
+    path = []
+
+    def sampler():
+        while not stop.wait(0.25):
+            path.append(len(_running("replay")))
+
+    t = threading.Thread(target=sampler, daemon=True)
+    t.start()
+    result = loadgen.LoadGenerator(
+        loadgen.HandleTarget(handle), max_inflight=128
+    ).run(trace)
+    deadline = time.time() + 40
+    while time.time() < deadline and len(_running("replay")) > 1:
+        time.sleep(0.25)
+    stop.set()
+    t.join(timeout=2)
+
+    assert not result.failures, result.summary()
+    assert max(path) > 1, "burst never scaled up"
+    assert len(_running("replay")) == 1, "decay never drained down"
+    events = rt_state.autoscale_log()
+    assert {"up", "down"} <= {e["direction"] for e in events}

@@ -163,3 +163,14 @@ def test_train_endpoint(dashboard_cluster):
     assert set(ft) == {
         "resizes", "restarts", "aborts", "recoveries", "recovery_mean_s"
     }
+
+
+def test_autoscale_endpoint(dashboard_cluster):
+    """/api/autoscale serves the SLO-autoscaler decision log (empty when
+    no policy deployment has acted) plus the autoscale_* metric rollup."""
+    dash = dashboard_cluster
+    out = _get_json(dash.url + "/api/autoscale")
+    assert out["events"] == []  # no autoscaled deployments in this cluster
+    summary = out["summary"]
+    assert summary["scale_ups"] == 0.0 and summary["scale_downs"] == 0.0
+    assert summary["decision_p50_s"] is None

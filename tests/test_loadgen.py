@@ -1,0 +1,545 @@
+"""Loadgen + autoscale-policy unit tests: no cluster required.
+
+Covers the open-loop generator's building blocks (arrival processes,
+Zipf workload synthesis, trace round-trips, dispatch/outcome recording),
+the bucket-quantile estimator the push plane's rollups use, and the
+``evaluate()`` policy state machine (hysteresis, cooldowns, step/bound
+clamps, the starting-replica guard).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from ray_tpu.exceptions import BackPressureError, DeadlineExceededError
+from ray_tpu.loadgen import (
+    BurstyRampArrivals,
+    CallableTarget,
+    LoadGenerator,
+    PoissonArrivals,
+    RequestClass,
+    Trace,
+    TraceRecord,
+    ZipfPrefixes,
+    bundled_trace,
+    synthesize,
+)
+from ray_tpu.serve.autoscale import (
+    AutoscalePolicy,
+    AutoscaleSignals,
+    AutoscaleState,
+    evaluate,
+    shed_total,
+    ttft_p99_ms,
+)
+from ray_tpu.util.metrics import (
+    autoscale_summary,
+    kvcache_summary,
+    merged_histogram,
+    quantile_from_buckets,
+    serve_latency_summary,
+)
+
+
+# ---------------------------------------------------------------------------
+# arrival processes
+# ---------------------------------------------------------------------------
+
+
+def test_poisson_arrivals_deterministic_and_rate():
+    a = PoissonArrivals(rate_hz=50.0, duration_s=10.0, seed=3)
+    times = a.times()
+    assert times == PoissonArrivals(50.0, 10.0, seed=3).times()
+    assert times != PoissonArrivals(50.0, 10.0, seed=4).times()
+    assert all(0 < t < 10.0 for t in times)
+    assert times == sorted(times)
+    # mean count 500; 5 sigma ~ 112
+    assert 350 < len(times) < 650
+
+
+def test_bursty_ramp_rate_profile_and_phases():
+    b = BurstyRampArrivals([(2.0, 0.0, 10.0), (2.0, 4.0), (1.0, 6.0, 0.0)])
+    assert b.duration_s == 5.0
+    assert b.rate_at(0.0) == 0.0
+    assert b.rate_at(1.0) == pytest.approx(5.0)
+    assert b.rate_at(2.5) == pytest.approx(4.0)  # flat 2-tuple phase
+    assert b.rate_at(4.5) == pytest.approx(3.0)
+    assert b.rate_at(99.0) == 0.0
+    times = b.times()
+    assert times == BurstyRampArrivals(
+        [(2.0, 0.0, 10.0), (2.0, 4.0), (1.0, 6.0, 0.0)]
+    ).times()
+    assert all(0 < t < 5.0 for t in times)
+    # thinning concentrates arrivals where the rate is high: the ramp's
+    # second half should out-arrive its first half
+    first = sum(1 for t in times if t < 1.0)
+    second = sum(1 for t in times if 1.0 <= t < 2.0)
+    assert second > first
+
+
+def test_bursty_ramp_validation():
+    with pytest.raises(ValueError):
+        BurstyRampArrivals([])
+    with pytest.raises(ValueError):
+        BurstyRampArrivals([(0.0, 1.0)])
+    with pytest.raises(ValueError):
+        BurstyRampArrivals([(1.0, -1.0, 2.0)])
+    with pytest.raises(ValueError):
+        BurstyRampArrivals([(1.0, 2.0, 3.0, 4.0)])
+    with pytest.raises(ValueError):
+        PoissonArrivals(0.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# workload synthesis
+# ---------------------------------------------------------------------------
+
+
+def test_zipf_prefixes_skew_and_determinism():
+    import random
+
+    z = ZipfPrefixes(num_prefixes=16, alpha=1.3, prefix_tokens=8, seed=11)
+    rng = random.Random(0)
+    draws = [z.sample(rng) for _ in range(4000)]
+    assert all(0 <= d < 16 for d in draws)
+    counts = [draws.count(k) for k in range(16)]
+    assert counts[0] == max(counts)  # rank 0 dominates
+    assert counts[0] > 3 * counts[8]
+    # prefix token ids are a pure function of (seed, prefix_id)
+    assert z.tokens(3) == ZipfPrefixes(16, 1.3, 8, seed=11).tokens(3)
+    assert z.tokens(3) != z.tokens(4)
+    assert len(z.tokens(3)) == 8
+
+
+def test_synthesize_classes_and_prefixes():
+    classes = [
+        RequestClass("short", weight=0.9, prompt_tokens=12,
+                     max_new_tokens=4, deadline_s=5.0),
+        RequestClass("long", weight=0.1, prompt_tokens=48,
+                     max_new_tokens=32, deadline_s=None),
+    ]
+    z = ZipfPrefixes(num_prefixes=8, alpha=1.2, prefix_tokens=8, seed=2)
+    trace = synthesize([0.5, 0.1, 0.3] + [i * 0.01 for i in range(400)],
+                       classes, z, seed=5)
+    assert [r.t for r in trace.requests] == sorted(
+        r.t for r in trace.requests
+    )
+    by_cls = {c.name: [r for r in trace.requests if r.cls == c.name]
+              for c in classes}
+    assert len(by_cls["short"]) > 5 * len(by_cls["long"])
+    for r in trace.requests:
+        expect = 12 if r.cls == "short" else 48
+        assert len(r.token_ids) == expect
+        assert r.token_ids[:8] == z.tokens(r.prefix_id)  # shared prefix
+        assert r.deadline_s == (5.0 if r.cls == "short" else None)
+    # same inputs, same trace
+    again = synthesize([0.5, 0.1, 0.3] + [i * 0.01 for i in range(400)],
+                       classes, z, seed=5)
+    assert [r.as_dict() for r in again.requests] == [
+        r.as_dict() for r in trace.requests
+    ]
+
+
+def test_synthesize_validation():
+    z = ZipfPrefixes(num_prefixes=2)
+    with pytest.raises(ValueError):
+        synthesize([0.1], [], z)
+    with pytest.raises(ValueError):
+        synthesize([0.1], [RequestClass("x", weight=0.0)], z)
+
+
+# ---------------------------------------------------------------------------
+# trace round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_trace_save_load_roundtrip(tmp_path):
+    trace = Trace(
+        meta={"name": "t"},
+        requests=[
+            TraceRecord(t=0.1, cls="a", prefix_id=2, token_ids=[1, 2, 3],
+                        max_new_tokens=7, deadline_s=1.5),
+            TraceRecord(t=0.4),
+        ],
+    )
+    path = str(tmp_path / "trace.json")
+    trace.save(path)
+    loaded = Trace.load(path)
+    assert loaded.meta == {"name": "t"}
+    assert [r.as_dict() for r in loaded.requests] == [
+        r.as_dict() for r in trace.requests
+    ]
+    assert loaded.duration_s == pytest.approx(0.4)
+
+
+def test_trace_scaled_and_limit():
+    trace = Trace(requests=[TraceRecord(t=float(i)) for i in range(10)])
+    fast = trace.scaled(0.5, limit=4)
+    assert [r.t for r in fast.requests] == [0.0, 0.5, 1.0, 1.5]
+    assert fast.meta["time_scale"] == 0.5
+    assert len(trace.requests) == 10  # original untouched
+
+
+def test_bundled_trace_shape():
+    trace = bundled_trace("ramp_burst_decay")
+    assert trace.meta["name"] == "ramp_burst_decay"
+    assert len(trace.requests) > 50
+    assert trace.duration_s < 13.0
+    # Zipf head: the hottest prefix appears far more often than the median
+    from collections import Counter
+
+    counts = Counter(r.prefix_id for r in trace.requests)
+    assert counts.most_common(1)[0][1] >= 10
+    assert {r.cls for r in trace.requests} == {"short", "long"}
+    with pytest.raises(FileNotFoundError):
+        bundled_trace("nope")
+
+
+# ---------------------------------------------------------------------------
+# open-loop generator
+# ---------------------------------------------------------------------------
+
+
+def _quick_trace(n, spacing, **kw):
+    return Trace(requests=[
+        TraceRecord(t=i * spacing, **kw) for i in range(n)
+    ])
+
+
+def test_loadgen_open_loop_does_not_wait_for_slow_target():
+    """The defining open-loop property: a target that takes 0.5s cannot
+    slow a 20ms-spaced schedule — dispatch lag stays near zero while all
+    requests overlap in flight."""
+    inflight = []
+    peak = []
+    lock = threading.Lock()
+
+    def slow(payload):
+        with lock:
+            inflight.append(1)
+            peak.append(len(inflight))
+        time.sleep(0.5)
+        with lock:
+            inflight.pop()
+
+    trace = _quick_trace(10, 0.02)
+    res = LoadGenerator(CallableTarget(slow), max_inflight=32).run(trace)
+    assert len(res.records) == 10
+    assert all(r.outcome == "ok" for r in res.records)
+    assert max(peak) >= 5  # closed-loop would never overlap
+    assert res.summary()["max_lag_s"] < 0.3
+
+
+def test_loadgen_outcome_classification():
+    def fail(payload):
+        n = payload["max_new_tokens"]
+        if n == 1:
+            raise DeadlineExceededError("too slow")
+        if n == 2:
+            raise BackPressureError("queue full")
+        if n == 3:
+            raise RuntimeError("boom")
+        return n
+
+    trace = Trace(requests=[
+        TraceRecord(t=0.0, max_new_tokens=n) for n in (1, 2, 3, 4)
+    ])
+    res = LoadGenerator(CallableTarget(fail), max_inflight=4).run(trace)
+    outcomes = {r.index: r.outcome for r in res.records}
+    assert outcomes == {
+        0: "deadline", 1: "shed", 2: "error:RuntimeError", 3: "ok"
+    }
+    s = res.summary()
+    assert s["outcomes"] == {
+        "deadline": 1, "shed": 1, "error:RuntimeError": 1, "ok": 1
+    }
+    assert len(res.failures) == 3 and len(res.ok) == 1
+
+
+def test_loadgen_result_save_and_to_trace(tmp_path):
+    trace = _quick_trace(5, 0.01, token_ids=[1, 2], cls="short")
+    res = LoadGenerator(
+        CallableTarget(lambda p: None), max_inflight=4
+    ).run(trace)
+    rec = res.to_trace()
+    assert len(rec.requests) == 5
+    assert rec.meta.get("recorded") is True
+    # recorded trace keeps payloads; schedule becomes actual dispatch times
+    assert all(r.token_ids == [1, 2] for r in rec.requests)
+    path = str(tmp_path / "run.json")
+    res.save(path)
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["summary"]["requests"] == 5
+    assert len(doc["records"]) == 5
+    assert len(doc["trace"]["requests"]) == 5
+
+
+def test_loadgen_time_scale_compresses_schedule():
+    trace = _quick_trace(5, 0.2)
+    t0 = time.perf_counter()
+    res = LoadGenerator(
+        CallableTarget(lambda p: None), max_inflight=4
+    ).run(trace, time_scale=0.1)
+    assert time.perf_counter() - t0 < 0.5  # 0.8s schedule compressed to 0.08
+    assert [r.sched_t for r in res.records] == pytest.approx(
+        [0.0, 0.02, 0.04, 0.06, 0.08]
+    )
+
+
+# ---------------------------------------------------------------------------
+# bucket quantiles + rollups
+# ---------------------------------------------------------------------------
+
+
+def test_quantile_from_buckets_interpolation():
+    bounds = [1.0, 2.0, 4.0]
+    assert quantile_from_buckets(bounds, [0, 0, 0, 0], 0.5) is None
+    # 10 samples all in (1, 2]: p50 interpolates to the bucket midpoint
+    assert quantile_from_buckets(bounds, [0, 10, 0, 0], 0.5) == pytest.approx(
+        1.5
+    )
+    # uniform mass across [0,1],(1,2],(2,4]: rank 3 of 12 is 3/4 into the
+    # first bucket; rank 9 is 1/4 into the third
+    counts = [4, 4, 4, 0]
+    assert quantile_from_buckets(bounds, counts, 0.25) == pytest.approx(0.75)
+    assert quantile_from_buckets(bounds, counts, 0.75) == pytest.approx(2.5)
+    # overflow bucket clamps to the last boundary
+    assert quantile_from_buckets(bounds, [0, 0, 0, 5], 0.99) == 4.0
+    # q clamped into [0, 1]
+    assert quantile_from_buckets(bounds, [5, 0, 0, 0], 2.0) == pytest.approx(
+        1.0
+    )
+
+
+def _payload(name, tag_keys, series, boundaries=None):
+    snap = {"name": name, "tag_keys": list(tag_keys), "values": {},
+            "counts": {}}
+    if boundaries is not None:
+        snap["boundaries"] = list(boundaries)
+    for tags, value, counts in series:
+        key = json.dumps(list(tags))
+        snap["values"][key] = value
+        if counts is not None:
+            snap["counts"][key] = list(counts)
+    return {"metrics": [snap]}
+
+
+def test_merged_histogram_across_payloads_with_tag_filter():
+    bounds = [0.1, 1.0]
+    p1 = _payload("h", ("deployment",),
+                  [(("a",), 5.0, [2, 1, 0]), (("b",), 9.0, [0, 0, 3])],
+                  boundaries=bounds)
+    p2 = _payload("h", ("deployment",), [(("a",), 1.0, [1, 0, 0])],
+                  boundaries=bounds)
+    m = merged_histogram([p1, p2], "h", {"deployment": "a"})
+    assert m["counts"] == [3, 1, 0]
+    assert m["sum"] == 6.0 and m["count"] == 4.0
+    assert merged_histogram([p1], "h", {"deployment": "zzz"}) is None
+    assert merged_histogram([p1], "other") is None
+    unfiltered = merged_histogram([p1, p2], "h")
+    assert unfiltered["count"] == 7.0
+
+
+def test_serve_latency_summary_from_buckets():
+    bounds = [0.1, 1.0, 10.0]
+    payloads = [
+        _payload("serve_ttft_seconds", ("deployment",),
+                 [(("dep",), 4.0, [0, 8, 0, 0])], boundaries=bounds),
+        _payload("serve_replica_warmup_seconds", ("deployment",),
+                 [(("dep",), 2.0, [0, 0, 2, 0])], boundaries=bounds),
+    ]
+    s = serve_latency_summary(payloads)
+    row = s["ttft_ms"]["dep"]
+    assert row["count"] == 8.0
+    assert row["mean"] == pytest.approx(500.0)  # 4s / 8 -> ms
+    assert row["p50"] == pytest.approx(550.0)  # mid (0.1, 1.0] in ms
+    assert 100.0 < row["p99"] <= 1000.0
+    warm = s["warmup_s"]["dep"]
+    assert warm["count"] == 2.0
+    assert 1.0 < warm["p50"] <= 10.0
+
+
+def test_kvcache_summary_bucket_quantiles():
+    bounds = [1.0, 10.0, 100.0]
+    payloads = [_payload(
+        "kvcache_ttft_ms", ("cache",),
+        [(("hit",), 40.0, [0, 10, 0, 0])], boundaries=bounds,
+    )]
+    row = kvcache_summary(payloads)["ttft_ms"]["hit"]
+    assert row["mean_ms"] == pytest.approx(4.0)
+    assert row["p50_ms"] == pytest.approx(5.5)  # mid (1, 10]
+    assert row["p99_ms"] <= 10.0
+
+
+def test_autoscale_summary_rollup():
+    bounds = [0.5, 2.0]
+    payloads = [
+        _payload("autoscale_scale_up_total", ("deployment",),
+                 [(("d1",), 3.0, None), (("d2",), 1.0, None)]),
+        _payload("autoscale_scale_down_total", ("deployment",),
+                 [(("d1",), 2.0, None)]),
+        _payload("autoscale_decision_seconds", ("deployment", "direction"),
+                 [(("d1", "up"), 2.0, [4, 0, 0])], boundaries=bounds),
+    ]
+    s = autoscale_summary(payloads)
+    assert s["scale_ups"] == 4.0 and s["scale_downs"] == 2.0
+    assert s["by_deployment"]["d1"] == {"scale_ups": 3.0, "scale_downs": 2.0}
+    assert s["by_deployment"]["d2"]["scale_ups"] == 1.0
+    assert 0.0 < s["decision_p50_s"] <= 0.5
+    assert s["decision_p99_s"] <= 0.5
+
+
+# ---------------------------------------------------------------------------
+# policy state machine
+# ---------------------------------------------------------------------------
+
+
+def _sig(**kw):
+    defaults = dict(queue_depth=0.0, queue_per_replica=0.0, shed_delta=0.0,
+                    ttft_p99_ms=None, running=1, starting=0, target=1)
+    defaults.update(kw)
+    return AutoscaleSignals(**defaults)
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(interval_s=0)
+    p = AutoscalePolicy.from_dict({"min_replicas": 2, "max_replicas": 5})
+    assert p.as_dict()["max_replicas"] == 5
+
+
+def test_evaluate_scale_up_on_queue_pressure_with_hysteresis():
+    policy = AutoscalePolicy(max_replicas=4, target_queue_per_replica=2.0,
+                             up_hysteresis=2, cooldown_up_s=0.0)
+    st = AutoscaleState()
+    sig = _sig(queue_per_replica=5.0, target=1)
+    assert evaluate(policy, st, sig, now=10.0) is None  # 1st breach: wait
+    d = evaluate(policy, st, sig, now=11.0)
+    assert d is not None and d.direction == "up"
+    assert (d.from_replicas, d.to_replicas) == (1, 2)
+    assert "queue/replica" in d.reason
+    assert d.breach_age_s == pytest.approx(1.0)  # onset at 10.0
+
+
+def test_evaluate_starting_guard_blocks_runaway_up():
+    policy = AutoscalePolicy(max_replicas=4, target_queue_per_replica=1.0,
+                             up_hysteresis=1, cooldown_up_s=0.0)
+    st = AutoscaleState()
+    sig = _sig(queue_per_replica=9.0, target=2, starting=1)
+    assert evaluate(policy, st, sig, now=1.0) is None
+    sig.starting = 0
+    assert evaluate(policy, st, sig, now=2.0).direction == "up"
+
+
+def test_evaluate_up_cooldown_and_step_clamp():
+    policy = AutoscalePolicy(max_replicas=4, target_queue_per_replica=1.0,
+                             up_hysteresis=1, cooldown_up_s=5.0,
+                             scale_up_step=10)
+    st = AutoscaleState()
+    d = evaluate(policy, st, _sig(queue_per_replica=9.0, target=1), now=10.0)
+    assert (d.from_replicas, d.to_replicas) == (1, 4)  # clamped to max
+    # still pressured immediately after: cooldown blocks
+    assert evaluate(
+        policy, st, _sig(queue_per_replica=9.0, target=4), now=11.0
+    ) is None
+    # at max anyway: nothing to do even after cooldown
+    assert evaluate(
+        policy, st, _sig(queue_per_replica=9.0, target=4), now=99.0
+    ) is None
+
+
+def test_evaluate_scale_down_requires_idle_streak_and_cooldown():
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                             target_queue_per_replica=2.0,
+                             idle_queue_per_replica=0.5, down_hysteresis=3,
+                             cooldown_down_s=0.0, scale_down_step=2)
+    st = AutoscaleState()
+    idle = _sig(queue_per_replica=0.0, target=4)
+    assert evaluate(policy, st, idle, now=1.0) is None
+    assert evaluate(policy, st, idle, now=2.0) is None
+    d = evaluate(policy, st, idle, now=3.0)
+    assert d.direction == "down"
+    assert (d.from_replicas, d.to_replicas) == (4, 2)
+    assert d.breach_age_s == pytest.approx(2.0)  # idle since 1.0
+    # a busy-but-not-pressured eval resets the idle streak
+    st2 = AutoscaleState()
+    mid = _sig(queue_per_replica=1.0, target=4)  # between idle and pressure
+    evaluate(policy, st2, idle, now=1.0)
+    evaluate(policy, st2, idle, now=2.0)
+    assert evaluate(policy, st2, mid, now=3.0) is None
+    assert st2.idle_evals == 0
+
+
+def test_evaluate_down_cooldown_counts_from_last_up():
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                             target_queue_per_replica=2.0,
+                             up_hysteresis=1, down_hysteresis=1,
+                             cooldown_up_s=0.0, cooldown_down_s=10.0)
+    st = AutoscaleState()
+    d = evaluate(policy, st, _sig(queue_per_replica=9.0, target=1), now=5.0)
+    assert d.direction == "up"
+    # idle right after the scale-up: down cooldown measured from last_up_ts
+    idle = _sig(queue_per_replica=0.0, target=2)
+    assert evaluate(policy, st, idle, now=6.0) is None
+    assert evaluate(policy, st, idle, now=16.0).direction == "down"
+    # never below min_replicas
+    assert evaluate(
+        policy, st, _sig(queue_per_replica=0.0, target=1), now=99.0
+    ) is None
+
+
+def test_evaluate_shed_and_ttft_pressure():
+    policy = AutoscalePolicy(max_replicas=4, target_queue_per_replica=0.0,
+                             max_shed_per_interval=0.0,
+                             target_ttft_p99_ms=100.0, up_hysteresis=1,
+                             cooldown_up_s=0.0)
+    st = AutoscaleState()
+    d = evaluate(policy, st, _sig(shed_delta=3.0, target=1), now=1.0)
+    assert d is not None and "sheds" in d.reason
+    st = AutoscaleState()
+    d = evaluate(policy, st, _sig(ttft_p99_ms=250.0, target=1), now=1.0)
+    assert d is not None and "ttft_p99" in d.reason
+    # ttft under target (or unknown): no pressure
+    st = AutoscaleState()
+    assert evaluate(policy, st, _sig(ttft_p99_ms=50.0, target=1), 1.0) is None
+    assert st.pressured_evals == 0
+
+
+def test_shed_total_and_ttft_signal_deltas():
+    mk = lambda shed, counts: [
+        _payload("serve_shed_total", ("deployment",),
+                 [(("dep",), shed, None)]),
+        _payload("serve_ttft_seconds", ("deployment",),
+                 [(("dep",), 1.0, counts)], boundaries=[0.1, 1.0]),
+    ]
+    assert shed_total(mk(5.0, [1, 0, 0]), "dep") == 5.0
+    assert shed_total(mk(5.0, [1, 0, 0]), "other") == 0.0
+
+    st = AutoscaleState()
+    # first window: all mass in (0.1, 1.0] -> p99 in (100, 1000] ms
+    p99 = ttft_p99_ms(mk(0.0, [0, 10, 0]), "dep", st)
+    assert 100.0 < p99 <= 1000.0
+    # no new samples since baseline -> None (window delta is empty)
+    assert ttft_p99_ms(mk(0.0, [0, 10, 0]), "dep", st) is None
+    # new fast samples dominate the window even though cumulative
+    # counts still hold the old slow mass
+    p99 = ttft_p99_ms(mk(0.0, [40, 10, 0]), "dep", st)
+    assert p99 <= 100.0
+    # deployment with no serve histogram falls back to kvcache buckets
+    st2 = AutoscaleState()
+    kv = [_payload("kvcache_ttft_ms", ("cache",),
+                   [(("miss",), 500.0, [0, 0, 4])],
+                   boundaries=[1.0, 10.0])]
+    est = ttft_p99_ms(kv, "dep", st2)
+    assert est == pytest.approx(10.0)  # overflow clamps to last bound (ms)
+    assert st2.last_ttft_source == "kvcache"
+    # and nothing at all -> None
+    st3 = AutoscaleState()
+    assert ttft_p99_ms([], "dep", st3) is None
