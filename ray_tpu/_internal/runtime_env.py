@@ -27,9 +27,11 @@ import sys
 import zipfile
 from typing import Any, Dict, Optional
 
+from ..runtime.gcs import keys as gcs_keys
+
 _VALID_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "conda",
                "config", "excludes"}
-_PKG_PREFIX = "pkg:"
+_PKG_PREFIX = gcs_keys.RUNTIME_ENV_PKG.scan
 _PKG_DIR = "/tmp/ray_tpu_pkgs"
 _MAX_PKG_BYTES = 100 * 1024 * 1024
 

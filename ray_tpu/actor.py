@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional
 from . import _worker_api
 from ._internal import serialization
 from ._internal.ids import ActorID
+from .runtime.gcs import keys as gcs_keys
 from ._internal.protocol import (
     DefaultSchedulingStrategy,
     FunctionDescriptor,
@@ -89,7 +90,7 @@ class ActorClass:
         if self._exported_for != id(worker):
             _worker_api.run_on_worker_loop(
                 worker.client_pool.get(*worker.gcs_address).call(
-                    "kv_put", f"fn:{self._hash}", self._pickled, True
+                    "kv_put", gcs_keys.FUNCTION.key(self._hash), self._pickled, True
                 )
             )
             self._exported_for = id(worker)

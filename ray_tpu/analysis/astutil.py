@@ -1,0 +1,89 @@
+"""Small AST helpers shared by the checkers."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set, Tuple
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def walk_shallow(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function/lambda
+    definitions. A nested ``def`` has its own execution context (this repo's
+    idiom hands such closures to an executor), so blocking-call rules must
+    judge it separately — nested ``async def`` s are found by the outer
+    file walk anyway."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(child, _FUNC_NODES):
+            stack.extend(ast.iter_child_nodes(child))
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fstring_literal_head(node: ast.JoinedStr) -> str:
+    """The leading literal chunk of an f-string ("colmember:" for
+    ``f"colmember:{g}:{r}"``), or "" if it starts with an expression."""
+    if node.values:
+        head = str_const(node.values[0])
+        if head is not None:
+            return head
+    return ""
+
+
+def docstring_positions(tree: ast.AST) -> Set[Tuple[int, int]]:
+    """(lineno, col) of every docstring constant, so literal-scanning rules
+    can skip them."""
+    out: Set[Tuple[int, int]] = set()
+    for node in ast.walk(tree):
+        if isinstance(
+            node, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            body = node.body
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and str_const(body[0].value) is not None
+            ):
+                c = body[0].value
+                out.add((c.lineno, c.col_offset))
+    return out
+
+
+def time_aliases(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(module aliases of ``time``, local names bound to ``time.sleep``)."""
+    mods: Set[str] = set()
+    sleeps: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    mods.add(alias.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    sleeps.add(alias.asname or "sleep")
+    return mods, sleeps

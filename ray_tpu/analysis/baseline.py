@@ -1,0 +1,84 @@
+"""Baseline: committed grandfather list for pre-existing findings.
+
+The baseline is a JSON file mapping finding fingerprints (rule + path +
+message, line-number-free so unrelated edits don't churn it) to the finding
+as last observed. ``ray_tpu lint`` subtracts it from the live findings;
+anything left fails the gate.
+
+Policy: **shrink-only, never grow.** A new PR fixes its findings instead of
+baselining them; entries disappear when the underlying finding is fixed
+(``lint --baseline-update`` rewrites the file from the current findings and
+the gate test fails on *stale* entries too, so a fixed finding forces the
+baseline to shrink in the same PR).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable, List, Optional, Tuple
+
+from .core import Finding
+
+#: the committed repo baseline, next to this module
+DEFAULT_BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+_VERSION = 1
+
+
+def load_baseline(path: Optional[Path | str] = None) -> List[dict]:
+    """Baseline entries (possibly empty). Raises on a malformed file —
+    a silently-ignored baseline would un-gate the whole repo."""
+    p = Path(path) if path is not None else DEFAULT_BASELINE_PATH
+    if not p.exists():
+        return []
+    doc = json.loads(p.read_text(encoding="utf-8"))
+    if not isinstance(doc, dict) or doc.get("version") != _VERSION:
+        raise ValueError(f"unsupported baseline format in {p}")
+    entries = doc.get("findings", [])
+    if not isinstance(entries, list):
+        raise ValueError(f"baseline {p} 'findings' must be a list")
+    return entries
+
+
+def write_baseline(
+    findings: Iterable[Finding], path: Optional[Path | str] = None
+) -> Path:
+    """Rewrite the baseline from the given findings (sorted, stable)."""
+    p = Path(path) if path is not None else DEFAULT_BASELINE_PATH
+    entries = [f.to_dict() for f in findings]
+    entries.sort(key=lambda e: (e["rule"], e["path"], e["message"]))
+    doc = {
+        "version": _VERSION,
+        "policy": "shrink-only: fix new findings, never add entries",
+        "findings": entries,
+    }
+    p.write_text(json.dumps(doc, indent=2) + "\n", encoding="utf-8")
+    return p
+
+
+def _entry_fingerprint(entry: dict) -> str:
+    return f"{entry.get('rule')}::{entry.get('path')}::{entry.get('message')}"
+
+
+def apply_baseline(
+    findings: Iterable[Finding], entries: Iterable[dict]
+) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """Split live findings against the baseline.
+
+    Returns ``(new, suppressed, stale)``: findings not in the baseline,
+    findings matched by it, and baseline entries whose finding no longer
+    exists (the shrink-only gate fails on those until the file is updated).
+    """
+    by_fp = {_entry_fingerprint(e): e for e in entries}
+    new: List[Finding] = []
+    suppressed: List[Finding] = []
+    seen_fps = set()
+    for f in findings:
+        if f.fingerprint in by_fp:
+            suppressed.append(f)
+            seen_fps.add(f.fingerprint)
+        else:
+            new.append(f)
+    stale = [e for fp, e in by_fp.items() if fp not in seen_fps]
+    return new, suppressed, stale

@@ -1,0 +1,8 @@
+"""Built-in project checkers. Importing this package registers them all."""
+
+from . import rt001_blocking_async  # noqa: F401
+from . import rt002_traced_executor  # noqa: F401
+from . import rt003_lock_discipline  # noqa: F401
+from . import rt004_metrics_registry  # noqa: F401
+from . import rt005_gcs_keys  # noqa: F401
+from . import rt006_pickle_exceptions  # noqa: F401

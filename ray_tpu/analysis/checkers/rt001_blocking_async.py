@@ -1,0 +1,94 @@
+"""RT001: no blocking calls inside ``async def`` bodies.
+
+Incident this encodes: the core worker's RPC server runs task executions
+concurrently on one event loop — a single ``time.sleep`` or blocking
+``Future.result()`` inside a coroutine stalls every in-flight task, lease
+renewal, and health heartbeat on that worker ("Exploring the limits of
+Concurrency on TPUs" dies on exactly this class of host-side stall). The
+sanctioned escapes are ``asyncio.sleep`` and handing the blocking closure to
+an executor (``_run_traced`` in the worker; ``run_in_executor`` elsewhere).
+
+Flagged inside any ``async def`` (nested sync ``def`` s are exempt — they
+are the executor-thunk idiom and run on a thread):
+
+- ``time.sleep(...)`` (any alias of the module or the function)
+- ``<fut>.result()`` / ``<fut>.result(timeout=None)`` — a blocking
+  concurrent-futures wait; await the future instead
+
+Scope: the asyncio planes of the codebase — ``runtime/``, ``serve/``,
+``dag/``, ``client/``, and the dashboard. Synchronous leaf libraries
+(collective rendezvous loops, loadgen dispatch threads) legitimately sleep.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, time_aliases, walk_shallow
+from ..core import Checker, register
+
+_SCOPE_DIRS = {"runtime", "serve", "dag", "client", "dashboard"}
+
+
+@register
+class BlockingInAsyncChecker(Checker):
+    RULE_ID = "RT001"
+    DESCRIPTION = (
+        "blocking call (time.sleep / Future.result) inside an async def"
+    )
+
+    def applies_to(self, path: str) -> bool:
+        return bool(_SCOPE_DIRS.intersection(path.split("/")))
+
+    def check_file(self, path, tree, source):
+        time_mods, sleep_names = time_aliases(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for child in walk_shallow(node):
+                if not isinstance(child, ast.Call):
+                    continue
+                name = call_name(child)
+                if name is not None:
+                    mod, _, attr = name.rpartition(".")
+                    if (mod in time_mods and attr == "sleep") or (
+                        not mod and attr in sleep_names
+                    ):
+                        yield self.finding(
+                            path, child,
+                            f"time.sleep inside async def "
+                            f"{node.name!r}: use asyncio.sleep or an "
+                            f"executor hand-off",
+                        )
+                        continue
+                if (
+                    isinstance(child.func, ast.Attribute)
+                    and child.func.attr == "result"
+                    and self._is_blocking_result(child)
+                ):
+                    yield self.finding(
+                        path, child,
+                        f"blocking .result() inside async def "
+                        f"{node.name!r}: await the future (or wrap it "
+                        f"with asyncio.wrap_future)",
+                    )
+
+    @staticmethod
+    def _is_blocking_result(call: ast.Call) -> bool:
+        """.result() with no bound, or an explicit timeout=None — an
+        unbounded blocking wait. A finite timeout is still a stall but is
+        at least bounded; keep the rule sharp (zero false positives on
+        deliberate short waits) rather than broad."""
+        if not call.args and not call.keywords:
+            return True
+        if len(call.args) == 1 and not call.keywords:
+            a = call.args[0]
+            return isinstance(a, ast.Constant) and a.value is None
+        if (
+            not call.args
+            and len(call.keywords) == 1
+            and call.keywords[0].arg == "timeout"
+        ):
+            v = call.keywords[0].value
+            return isinstance(v, ast.Constant) and v.value is None
+        return False

@@ -1,0 +1,106 @@
+"""RT002: traced-executor discipline in the core worker.
+
+Incident this encodes: PR 3's review found the worker's task trace context
+living in a process global — the RPC server executes tasks concurrently via
+``ensure_future``, so the global cross-contaminated concurrent tasks'
+parentage and non-LIFO exits left workers permanently "tracing on". The fix
+was two-part and both halves are load-bearing:
+
+1. trace context is a coroutine-local ``contextvars.ContextVar``;
+2. every hop onto an executor thread goes through
+   ``CoreWorker._run_traced``, which ``copy_context()``-s the dispatching
+   coroutine's context across so user code on the thread sees the right
+   parent span.
+
+This rule keeps both from regressing:
+
+- in ``core_worker.py``, any ``*.run_in_executor(...)`` call outside the
+  ``_run_traced`` definition is flagged (a raw hop silently drops the trace
+  context *and* whatever future ContextVars ride along);
+- in ``core_worker.py`` and ``tracing.py``, a module-level assignment that
+  names trace/span/context state but is not a ``ContextVar(...)`` is
+  flagged (the original PR 3 bug shape).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import call_name
+from ..core import Checker, register
+
+_TRACE_STATE_RE = re.compile(
+    r"^_?(current|active|task)_?(trace|span|context|ctx)\w*$"
+)
+
+
+@register
+class TracedExecutorChecker(Checker):
+    RULE_ID = "RT002"
+    DESCRIPTION = (
+        "run_in_executor outside _run_traced / non-ContextVar trace state"
+    )
+
+    def applies_to(self, path: str) -> bool:
+        base = path.rsplit("/", 1)[-1]
+        return base in ("core_worker.py", "tracing.py")
+
+    def check_file(self, path, tree, source):
+        base = path.rsplit("/", 1)[-1]
+        if base == "core_worker.py":
+            yield from self._check_executor_sites(path, tree)
+        yield from self._check_module_trace_state(path, tree)
+
+    def _check_executor_sites(self, path, tree):
+        # line spans of every `_run_traced` definition: calls inside are the
+        # one sanctioned raw run_in_executor site
+        sanctioned = [
+            (n.lineno, n.end_lineno)
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name == "_run_traced"
+        ]
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "run_in_executor"
+            ):
+                if any(lo <= node.lineno <= hi for lo, hi in sanctioned):
+                    continue
+                yield self.finding(
+                    path, node,
+                    "run_in_executor must route through _run_traced so the "
+                    "dispatching coroutine's contextvars (trace context) "
+                    "reach the executor thread",
+                )
+
+    def _check_module_trace_state(self, path, tree):
+        for node in tree.body:
+            targets = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            for target in targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                if not _TRACE_STATE_RE.match(target.id):
+                    continue
+                if self._is_contextvar(value):
+                    continue
+                yield self.finding(
+                    path, node,
+                    f"module-global trace state {target.id!r} must be a "
+                    f"contextvars.ContextVar (a process global "
+                    f"cross-contaminates concurrent tasks)",
+                )
+
+    @staticmethod
+    def _is_contextvar(value: ast.AST) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        name = call_name(value) or ""
+        return name.split(".")[-1] == "ContextVar"

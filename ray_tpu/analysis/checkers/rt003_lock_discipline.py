@@ -1,0 +1,130 @@
+"""RT003: lock discipline — attributes guarded in one method, bare in another.
+
+Incident this encodes: PR 2's review found the weight subscriber's
+``_current``/``_prefetched`` mutated under ``self._lock`` on the adoption
+path but written bare from the prefetch thread — the lost-race branch
+orphaned pins. PR 4's allocator had the same shape. The invariant: once a
+class protects an attribute with ``with self.<lock>:`` anywhere, every
+*mutation* of that attribute in every other method must hold the lock too.
+
+Mechanics: per class, collect attributes assigned (or aug-assigned) on
+``self`` inside a ``with self.<something matching 'lock'>:`` block; then
+flag assignments to those attributes outside any lock block in *other*
+methods. Deliberate limits to stay honest (low false-positive) rather than
+complete:
+
+- ``__init__``/``__del__``/``__enter__``/``__exit__`` are exempt — setup
+  and teardown run before/after concurrency exists;
+- bare *reads* are not flagged (too many benign monotonic-flag reads; the
+  write side is where lost updates corrupt state);
+- only ``self``-attribute locks are recognized, which is this codebase's
+  only locking idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..core import Checker, register
+
+_EXEMPT_METHODS = {"__init__", "__del__", "__enter__", "__exit__",
+                   "__post_init__"}
+
+
+def _lock_attr_name(item: ast.withitem) -> bool:
+    """True if the with-item is ``self.<attr>`` where attr names a lock."""
+    expr = item.context_expr
+    # `with self._lock:` and `with self._lock.something():` both count? No:
+    # only the bare acquire; a method call on the lock object is not an
+    # acquisition we can reason about.
+    return (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+        and "lock" in expr.attr.lower()
+    )
+
+
+def _self_attr_writes(node: ast.AST) -> List[Tuple[str, int]]:
+    """(attr, lineno) for every ``self.X = ...`` / ``self.X += ...`` in the
+    subtree, not descending into nested functions/classes."""
+    out = []
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                          ast.ClassDef)) and n is not node:
+            continue
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                n.targets if isinstance(n, ast.Assign) else [n.target]
+            )
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    out.append((t.attr, n.lineno))
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+@register
+class LockDisciplineChecker(Checker):
+    RULE_ID = "RT003"
+    DESCRIPTION = (
+        "attribute assigned under `with self._lock:` in one method but "
+        "mutated bare in another"
+    )
+
+    def check_file(self, path, tree, source):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(path, node)
+
+    def _check_class(self, path, cls: ast.ClassDef):
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        # pass 1: which attrs does any method write under a lock?
+        guarded: Dict[str, str] = {}  # attr -> method that guards it
+        for m in methods:
+            for w in ast.walk(m):
+                if isinstance(w, (ast.With, ast.AsyncWith)) and any(
+                    _lock_attr_name(i) for i in w.items
+                ):
+                    for attr, _line in _self_attr_writes(w):
+                        guarded.setdefault(attr, m.name)
+        if not guarded:
+            return
+        # pass 2: bare writes to those attrs in *other* methods
+        for m in methods:
+            if m.name in _EXEMPT_METHODS:
+                continue
+            locked_spans = [
+                (w.lineno, w.end_lineno)
+                for w in ast.walk(m)
+                if isinstance(w, (ast.With, ast.AsyncWith))
+                and any(_lock_attr_name(i) for i in w.items)
+            ]
+            for attr, line in _self_attr_writes(m):
+                if attr not in guarded or guarded[attr] == m.name:
+                    continue
+                if any(lo <= line <= hi for lo, hi in locked_spans):
+                    continue
+                yield self.finding(
+                    path,
+                    _LineNode(line),
+                    f"{cls.name}.{m.name} assigns self.{attr} without the "
+                    f"lock that guards it in {cls.name}.{guarded[attr]}",
+                )
+
+
+class _LineNode:
+    """Minimal stand-in carrying a line number for Checker.finding()."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno

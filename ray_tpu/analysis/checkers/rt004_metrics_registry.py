@@ -1,0 +1,145 @@
+"""RT004: metrics registry consistency.
+
+Incident this encodes: the metrics plane keys the process-wide registry by
+metric *name* (``util/metrics._registry[name]``) — two constructions with
+the same name silently alias one ``Metric`` object, and a tag-set mismatch
+between them makes ``prometheus_text`` emit series whose label tuples don't
+line up (the PR 3 review's last-worker-wins summary bug was the read-side
+twin of this). The invariants:
+
+- every ``Counter``/``Gauge``/``Histogram`` name is a **literal**
+  snake_case string (a computed name defeats grep, the baseline, and the
+  dashboard's metric tables);
+- each name is declared exactly **once**, and only in ``util/metrics.py``
+  (the single place ``_ensure_*`` lazy-init guards already live — a
+  declaration elsewhere races the pusher's registry snapshot);
+- when the same name *is* seen more than once (the fixture case), their
+  ``tag_keys`` must agree — a cross-file check, emitted from finalize().
+
+Import-aware: a file that does ``from collections import Counter`` is
+ignored; only names bound from ``util.metrics`` (or used inside
+``util/metrics.py`` itself) count as metric constructors.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..astutil import str_const
+from ..core import Checker, Finding, register
+
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+_SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_HOME_FILE = "util/metrics.py"
+
+
+def _metric_bindings(tree: ast.AST, path: str) -> Dict[str, str]:
+    """local name -> metric class, honoring imports. In util/metrics.py the
+    classes are defined locally so the bare names always bind."""
+    bound: Dict[str, str] = {}
+    if path.endswith(_HOME_FILE):
+        for cls in _METRIC_CLASSES:
+            bound[cls] = cls
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module.endswith("util.metrics") or node.module == "metrics"
+        ):
+            for alias in node.names:
+                if alias.name in _METRIC_CLASSES:
+                    bound[alias.asname or alias.name] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module == "collections":
+            for alias in node.names:
+                # shadows a metric-class name with collections.Counter
+                bound.pop(alias.asname or alias.name, None)
+    return bound
+
+
+@register
+class MetricsRegistryChecker(Checker):
+    RULE_ID = "RT004"
+    DESCRIPTION = (
+        "metric names: literal snake_case, declared once in util/metrics.py,"
+        " consistent tag sets"
+    )
+
+    def __init__(self):
+        # name -> list of (path, line, tag_keys or None)
+        self._declarations: Dict[str, List[Tuple[str, int, Optional[tuple]]]] = {}
+
+    def check_file(self, path, tree, source):
+        bound = _metric_bindings(tree, path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cls = self._metric_class(node, bound)
+            if cls is None:
+                continue
+            name_node = node.args[0] if node.args else None
+            name = str_const(name_node) if name_node is not None else None
+            if name is None:
+                yield self.finding(
+                    path, node,
+                    f"{cls} name must be a literal string (computed names "
+                    f"defeat the registry audit)",
+                )
+                continue
+            if not _SNAKE_RE.match(name):
+                yield self.finding(
+                    path, node,
+                    f"metric name {name!r} is not snake_case",
+                )
+            if not path.endswith(_HOME_FILE):
+                yield self.finding(
+                    path, node,
+                    f"metric {name!r} declared outside util/metrics.py — "
+                    f"all declarations live there so names can't collide",
+                )
+            self._declarations.setdefault(name, []).append(
+                (path, node.lineno, self._tag_keys(node))
+            )
+
+    def finalize(self):
+        for name, decls in sorted(self._declarations.items()):
+            if len(decls) > 1:
+                sites = ", ".join(f"{p}:{ln}" for p, ln, _ in decls)
+                yield Finding(
+                    rule=self.RULE_ID, path=decls[0][0], line=decls[0][1],
+                    message=f"metric {name!r} declared {len(decls)} times "
+                            f"({sites}) — the registry keys by name, later "
+                            f"declarations alias the first",
+                )
+            tag_sets = {t for _, _, t in decls if t is not None}
+            if len(tag_sets) > 1:
+                p, ln, _ = decls[0]
+                yield Finding(
+                    rule=self.RULE_ID, path=p, line=ln,
+                    message=f"metric {name!r} declared with conflicting "
+                            f"tag_keys {sorted(tag_sets)}",
+                )
+
+    @staticmethod
+    def _metric_class(node: ast.Call, bound: Dict[str, str]) -> Optional[str]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return bound.get(func.id)
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _METRIC_CLASSES
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("metrics", "ray_metrics")
+        ):
+            return func.attr
+        return None
+
+    @staticmethod
+    def _tag_keys(node: ast.Call) -> Optional[tuple]:
+        for kw in node.keywords:
+            if kw.arg == "tag_keys" and isinstance(
+                kw.value, (ast.Tuple, ast.List)
+            ):
+                keys = [str_const(e) for e in kw.value.elts]
+                if all(k is not None for k in keys):
+                    return tuple(keys)
+        return ()

@@ -1,0 +1,76 @@
+"""RT005: GCS key-space hygiene — no stray key-prefix literals.
+
+Incident this encodes: the PR 5 collective seq-key leak. Rendezvous keys
+were minted by f-strings scattered across the collective layer; the epoch
+sweep didn't know one of the formats existed, so every abnormal exit leaked
+its in-flight keys forever. The fix (and this rule's invariant): every
+reserved prefix of the GCS KV key space is declared once in
+``runtime/gcs/keys.py`` and every key is minted through that registry, so
+writers, scanners, and sweepers can never drift apart.
+
+Flags any string literal — plain or the literal head of an f-string —
+starting with ``<registered-prefix>:`` outside ``runtime/gcs/keys.py``.
+Docstrings are exempt (prose may name keys); comments are invisible to the
+AST anyway. The prefix list is imported from the registry itself, so adding
+a prefix automatically extends enforcement.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set, Tuple
+
+from ...runtime.gcs import keys as gcs_keys
+from ..astutil import docstring_positions, fstring_literal_head, str_const
+from ..core import Checker, register
+
+_HOME_FILE = "runtime/gcs/keys.py"
+
+
+def _scan_prefixes() -> Tuple[str, ...]:
+    return tuple(f"{name}:" for name in gcs_keys.known_prefixes())
+
+
+@register
+class GcsKeyHygieneChecker(Checker):
+    RULE_ID = "RT005"
+    DESCRIPTION = (
+        "GCS key literal bypassing the runtime/gcs/keys.py prefix registry"
+    )
+
+    def __init__(self):
+        self._prefixes = _scan_prefixes()
+
+    def applies_to(self, path: str) -> bool:
+        return not path.endswith(_HOME_FILE)
+
+    def check_file(self, path, tree, source):
+        skip: Set[Tuple[int, int]] = docstring_positions(tree)
+        # constants that are pieces of an f-string: judged via the
+        # JoinedStr head, not independently (avoids double reports)
+        fstring_parts = {
+            id(v)
+            for n in ast.walk(tree) if isinstance(n, ast.JoinedStr)
+            for v in n.values
+        }
+        for node in ast.walk(tree):
+            literal = None
+            if isinstance(node, ast.JoinedStr):
+                literal = fstring_literal_head(node)
+            elif id(node) not in fstring_parts:
+                literal = str_const(node)
+            if not literal:
+                continue
+            if (node.lineno, node.col_offset) in skip:
+                continue
+            hit = next(
+                (p for p in self._prefixes if literal.startswith(p)), None
+            )
+            if hit is None:
+                continue
+            yield self.finding(
+                path, node,
+                f"GCS key literal {literal.split(':')[0] + ':'!r} must be "
+                f"minted via runtime/gcs/keys.py "
+                f"(KeyPrefix {hit[:-1]!r}) so scans and sweeps can't drift",
+            )

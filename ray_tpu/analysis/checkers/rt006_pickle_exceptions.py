@@ -1,0 +1,68 @@
+"""RT006: typed exceptions must be pickle-safe.
+
+Incident this encodes: framework exceptions travel as object values — a
+failed task stores its exception, ``get`` re-raises it at the caller, and
+the serve retry envelope switches on the *type*. An exception class with a
+custom ``__init__`` but no ``__reduce__`` breaks that silently:
+``pickle.dumps`` stores ``(cls, self.args)``, and since ``args`` holds the
+*formatted message* (one string) instead of the constructor's parameters,
+``pickle.loads`` either raises ``TypeError`` (arity mismatch) or rebuilds a
+husk whose typed fields (``retry_after_s``, ``deadline``, ...) are gone —
+exactly what the PR 7 retry policy reads on the caller side.
+
+Rule, applied to ``exceptions.py``: every exception class whose
+``__init__`` takes parameters beyond ``self`` must define ``__reduce__``
+in its own body. (The dynamic twin — an actual ``pickle.loads(pickle.
+dumps(e))`` structural round-trip of every class — lives in
+``tests/test_analysis.py``.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, register
+
+
+@register
+class PickleSafeExceptionChecker(Checker):
+    RULE_ID = "RT006"
+    DESCRIPTION = (
+        "exception with a custom __init__ but no __reduce__ (breaks "
+        "pickle round-trip of typed fields)"
+    )
+
+    def applies_to(self, path: str) -> bool:
+        return path.rsplit("/", 1)[-1] == "exceptions.py"
+
+    def check_file(self, path, tree, source):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            init = None
+            has_reduce = False
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if item.name == "__init__":
+                        init = item
+                    elif item.name in ("__reduce__", "__reduce_ex__",
+                                       "__getnewargs__", "__getstate__"):
+                        has_reduce = True
+            if init is None or has_reduce:
+                continue
+            args = init.args
+            extra = (
+                len(args.args) - 1  # beyond self
+                + len(args.posonlyargs)
+                + len(args.kwonlyargs)
+                + (1 if args.vararg else 0)
+                + (1 if args.kwarg else 0)
+            )
+            if extra <= 0:
+                continue
+            yield self.finding(
+                path, node,
+                f"exception {node.name!r} has a custom __init__ but no "
+                f"__reduce__: pickle will rebuild it from the formatted "
+                f"message and drop/mangle its typed fields",
+            )

@@ -1,0 +1,168 @@
+"""Analysis core: finding model, checker registry, single-pass walker.
+
+Design goals, in order: zero dependencies beyond stdlib ``ast`` (the lint
+gate must run wherever the tests run), one parse per file no matter how many
+checkers are registered, and deterministic output (findings sorted, stable
+fingerprints) so the committed baseline diffs cleanly.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Type
+
+#: directories never scanned (relative path parts)
+_SKIP_PARTS = {"__pycache__", ".git", "build", "dist"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str  #: rule id, e.g. "RT003"
+    path: str  #: repo-relative posix path
+    line: int  #: 1-based line number
+    message: str  #: human-readable description of the violation
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity for baseline matching. Excludes the line number
+        on purpose: unrelated edits above a grandfathered finding must not
+        un-baseline it."""
+        return f"{self.rule}::{self.path}::{self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+class Checker:
+    """Base class for one rule.
+
+    Subclasses set ``RULE_ID``/``DESCRIPTION``, optionally narrow
+    ``applies_to``, and implement ``check_file``. Cross-file rules collect
+    state in ``check_file`` and emit from ``finalize`` (called once after
+    every file has been visited). A fresh checker instance is built per
+    :class:`Analyzer` run, so instance state never leaks between runs.
+    """
+
+    RULE_ID: str = ""
+    DESCRIPTION: str = ""
+
+    def applies_to(self, path: str) -> bool:
+        """``path`` is repo-relative posix; return False to skip the file."""
+        return True
+
+    def check_file(
+        self, path: str, tree: ast.AST, source: str
+    ) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        return ()
+
+    def finding(self, path: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=self.RULE_ID,
+            path=path,
+            line=getattr(node, "lineno", 0),
+            message=message,
+        )
+
+
+_CHECKERS: Dict[str, Type[Checker]] = {}
+
+
+def register(cls: Type[Checker]) -> Type[Checker]:
+    """Class decorator adding a checker to the global plugin registry."""
+    if not cls.RULE_ID:
+        raise ValueError(f"{cls.__name__} must set RULE_ID")
+    existing = _CHECKERS.get(cls.RULE_ID)
+    if existing is not None and existing is not cls:
+        raise ValueError(f"duplicate checker rule id {cls.RULE_ID}")
+    _CHECKERS[cls.RULE_ID] = cls
+    return cls
+
+
+def checker_catalog() -> Dict[str, Type[Checker]]:
+    """rule id -> checker class, for ``lint --rules`` and the docs table."""
+    # the subpackage import is what registers the built-ins; tolerate being
+    # called before ray_tpu.analysis.__init__ finished (cyclic first import)
+    from . import checkers  # noqa: F401
+
+    return dict(sorted(_CHECKERS.items()))
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    parse_errors: List[str] = field(default_factory=list)
+
+    def by_rule(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return dict(sorted(counts.items()))
+
+
+class Analyzer:
+    """Single-pass AST walker over a directory (or one file).
+
+    ``rel_to`` is the base findings are reported relative to; it defaults to
+    the parent of ``root`` so scanning ``<repo>/ray_tpu`` yields paths like
+    ``ray_tpu/serve/handle.py`` — the shape the committed baseline uses.
+    """
+
+    def __init__(
+        self,
+        root: Path | str,
+        rules: Optional[Sequence[str]] = None,
+        rel_to: Optional[Path | str] = None,
+    ):
+        self.root = Path(root).resolve()
+        self.rel_to = (
+            Path(rel_to).resolve() if rel_to is not None
+            else (self.root.parent if self.root.is_dir() else self.root.parent)
+        )
+        catalog = checker_catalog()
+        if rules is not None:
+            unknown = set(rules) - set(catalog)
+            if unknown:
+                raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+            catalog = {rid: catalog[rid] for rid in catalog if rid in set(rules)}
+        self.checkers: List[Checker] = [cls() for cls in catalog.values()]
+
+    def _iter_files(self) -> Iterable[Path]:
+        if self.root.is_file():
+            yield self.root
+            return
+        for path in sorted(self.root.rglob("*.py")):
+            if any(part in _SKIP_PARTS for part in path.parts):
+                continue
+            yield path
+
+    def run(self) -> AnalysisResult:
+        result = AnalysisResult()
+        for path in self._iter_files():
+            rel = path.relative_to(self.rel_to).as_posix()
+            try:
+                source = path.read_text(encoding="utf-8", errors="replace")
+                tree = ast.parse(source, filename=rel)
+            except SyntaxError as e:
+                result.parse_errors.append(f"{rel}:{e.lineno}: {e.msg}")
+                continue
+            result.files_scanned += 1
+            for checker in self.checkers:
+                if checker.applies_to(rel):
+                    result.findings.extend(checker.check_file(rel, tree, source))
+        for checker in self.checkers:
+            result.findings.extend(checker.finalize())
+        result.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+        return result

@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 from .._internal.config import Config
 from .._internal.event_loop import LoopThread
 from .._internal.rpc import RpcClient, RpcServer
+from ..runtime.gcs import keys as gcs_keys
 from ..runtime.worker.core_worker import CoreWorker, WorkerMode
 
 logger = logging.getLogger(__name__)
@@ -221,7 +222,7 @@ class ClientServer:
             fn_hash = hashlib.sha1(pickled).hexdigest()
             if fn_hash not in self._exported_fns:
                 await worker.client_pool.get(*self.gcs_address).call(
-                    "kv_put", f"fn:{fn_hash}", pickled, True
+                    "kv_put", gcs_keys.FUNCTION.key(fn_hash), pickled, True
                 )
                 self._exported_fns.add(fn_hash)
             structure, _refs = arglib.flatten((module, qualname, args_json), {})

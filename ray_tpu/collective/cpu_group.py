@@ -34,6 +34,7 @@ import numpy as np
 from .. import _worker_api
 from .._internal import serialization
 from ..exceptions import CollectiveAbortedError
+from ..runtime.gcs import keys as gcs_keys
 from .base import BaseGroup, ReduceOp, tensor_nbytes
 
 _REDUCERS = {
@@ -57,11 +58,11 @@ def _kv_call(method, *args):
 
 
 def abort_key(group_name: str) -> str:
-    return f"colabort:{group_name}"
+    return gcs_keys.COLLECTIVE_ABORT.key(group_name)
 
 
 def member_key(group_name: str, epoch: int, rank: int) -> str:
-    return f"colmember:{group_name}:{epoch}:{rank}"
+    return gcs_keys.COLLECTIVE_MEMBER.key(group_name, epoch, rank)
 
 
 def read_abort_epoch(group_name: str) -> int:
@@ -130,8 +131,8 @@ class GcsStoreGroup(BaseGroup):
         group — aborted ops never reach the happy-path cleanup, so without
         this sweep every abnormal exit leaks its in-flight keys forever."""
         try:
-            for prefix in (f"col:{self.group_name}:",
-                           f"colmember:{self.group_name}:"):
+            for prefix in (gcs_keys.COLLECTIVE.key(self.group_name) + ":",
+                           gcs_keys.COLLECTIVE_MEMBER.key(self.group_name) + ":"):
                 for key in _kv_call("kv_keys", prefix) or []:
                     head = key[len(prefix):].split(":", 1)[0]
                     try:
@@ -172,7 +173,9 @@ class GcsStoreGroup(BaseGroup):
         now = time.monotonic()
         if now - self._delay_read_at >= _DELAY_TTL_S:
             self._delay_read_at = now
-            raw = _kv_call("kv_get", f"coldelay:{self.group_name}")
+            raw = _kv_call(
+                "kv_get", gcs_keys.COLLECTIVE_DELAY.key(self.group_name)
+            )
             try:
                 self._delay_s = float(bytes(raw).decode()) if raw else 0.0
             except (ValueError, UnicodeDecodeError):
@@ -183,7 +186,9 @@ class GcsStoreGroup(BaseGroup):
     # -- rendezvous --------------------------------------------------------
 
     def _key(self, seq: int, phase: str, rank: int) -> str:
-        return f"col:{self.group_name}:{self.epoch}:{seq}:{phase}:{rank}"
+        return gcs_keys.COLLECTIVE.key(
+            self.group_name, self.epoch, seq, phase, rank
+        )
 
     def _put(self, seq: int, phase: str, value: Any):
         _kv_call("kv_put", self._key(seq, phase, self.rank),
@@ -282,7 +287,9 @@ class GcsStoreGroup(BaseGroup):
         self._check_abort()
         start = time.perf_counter()
         n = self._p2p_key(self.rank, dst_rank)
-        key = f"col:{self.group_name}:{self.epoch}:p2p:{self.rank}:{dst_rank}:{n}"
+        key = gcs_keys.COLLECTIVE.key(
+            self.group_name, self.epoch, "p2p", self.rank, dst_rank, n
+        )
         _kv_call("kv_put", key, serialization.pack(tensor), True)
         self._record_op("send", tensor_nbytes(tensor), start)
 
@@ -290,7 +297,9 @@ class GcsStoreGroup(BaseGroup):
         self._check_abort()
         start = time.perf_counter()
         n = self._p2p_key(src_rank, self.rank)
-        key = f"col:{self.group_name}:{self.epoch}:p2p:{src_rank}:{self.rank}:{n}"
+        key = gcs_keys.COLLECTIVE.key(
+            self.group_name, self.epoch, "p2p", src_rank, self.rank, n
+        )
         deadline = time.time() + 120.0
         delay = 0.002
         while time.time() < deadline:
@@ -326,7 +335,8 @@ class GcsStoreGroup(BaseGroup):
             # including p2p counters and abort leftovers)
             try:
                 for key in _kv_call(
-                    "kv_keys", f"col:{self.group_name}:{self.epoch}:"
+                    "kv_keys",
+                    gcs_keys.COLLECTIVE.key(self.group_name, self.epoch) + ":",
                 ) or []:
                     _kv_call("kv_del", key)
                 return

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..runtime.gcs import keys as gcs_keys
 from .base import BaseGroup, ReduceOp, tensor_nbytes
 from .._internal.jax_compat import shard_map
 
@@ -52,7 +53,7 @@ def _rendezvous_coordinator(group_name: str, rank: int, world_size: int,
         return None
     worker = _worker_api.get_core_worker()
     client = worker.client_pool.get(*worker.gcs_address)
-    key = f"xla_coord:{group_name}"
+    key = gcs_keys.XLA_COORD.key(group_name)
     if rank == 0:
         import socket
 

@@ -31,6 +31,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
 
 from .._internal.event_loop import LoopThread
+from ..runtime.gcs import keys as gcs_keys
 from .._internal.rpc import RpcClient
 from .job_manager import JobManager
 
@@ -259,7 +260,7 @@ class DashboardServer:
 
         runs = []
         try:
-            for key in self._gcs("kv_keys", "trainrun:") or []:
+            for key in self._gcs("kv_keys", gcs_keys.TRAIN_RUN.scan) or []:
                 raw = self._gcs("kv_get", key)
                 if not raw:
                     continue
@@ -267,7 +268,7 @@ class DashboardServer:
                     rec = _json.loads(bytes(raw).decode())
                 except Exception:
                     continue
-                rec["name"] = key[len("trainrun:"):]
+                rec["name"] = gcs_keys.TRAIN_RUN.strip(key)
                 runs.append(rec)
         except Exception:
             pass
@@ -290,7 +291,7 @@ class DashboardServer:
 
         events = []
         try:
-            raw = self._gcs("kv_get", "serve:autoscale_log")
+            raw = self._gcs("kv_get", gcs_keys.SERVE_AUTOSCALE_LOG)
             if raw:
                 events = _json.loads(bytes(raw).decode())
         except Exception:

@@ -20,6 +20,7 @@ import cloudpickle
 from ..._internal.ids import ActorID, NodeID, WorkerID
 from ..._internal.protocol import ActorInfo, ActorState, TaskSpec
 from ...exceptions import ActorUnschedulableError
+from . import keys as gcs_keys
 
 if TYPE_CHECKING:
     from .server import GcsServer
@@ -184,7 +185,9 @@ class GcsActorManager:
             return
 
     def _publish(self, info: ActorInfo):
-        self._gcs.publisher.publish(f"actor:{info.actor_id.hex()}", info)
+        self._gcs.publisher.publish(
+            gcs_keys.ACTOR_CHANNEL.key(info.actor_id.hex()), info
+        )
 
     # -- queries -----------------------------------------------------------
 

@@ -37,6 +37,7 @@ from ..._internal.protocol import (
     TaskSpec,
 )
 from ..._internal.rpc import ClientPool, RpcClient, RpcServer
+from . import keys as gcs_keys
 from .actor_manager import GcsActorManager
 from .placement_groups import GcsPlacementGroupManager
 from .pubsub import Publisher
@@ -407,7 +408,7 @@ class GcsServer:
         await self.actor_manager.on_worker_death(worker_id, reason)
         # reap the dead worker's pushed metrics snapshot, or its series
         # would live in every /metrics scrape forever
-        self._drop_metrics_key(f"metrics:{worker_id.hex()}")
+        self._drop_metrics_key(gcs_keys.METRICS.key(worker_id.hex()))
         # abort any collective group the dead worker was a member of, so
         # surviving ranks blocked in a rendezvous unblock within ~1 s
         # instead of burning the full timeout (covers raylet
@@ -422,7 +423,8 @@ class GcsServer:
         the dead worker/node belonged to. Plain-ascii value on purpose: the
         server writes it without the client serialization module, and any
         client can parse it with int()."""
-        for key in [k for k in self._kv if k.startswith("colmember:")]:
+        for key in [k for k in self._kv
+                    if gcs_keys.COLLECTIVE_MEMBER.matches(k)]:
             try:
                 payload = json.loads(self._kv[key])
             except Exception:
@@ -435,7 +437,7 @@ class GcsServer:
                 continue
             # group names may themselves contain ':' — epoch and rank are
             # always the last two segments
-            parts = key[len("colmember:"):].rsplit(":", 2)
+            parts = gcs_keys.COLLECTIVE_MEMBER.rsplit_tail(key, 2)
             if len(parts) != 3:
                 continue
             group, epoch_s, _rank = parts
@@ -443,7 +445,7 @@ class GcsServer:
                 epoch = int(epoch_s)
             except ValueError:
                 continue
-            abort_key = f"colabort:{group}"
+            abort_key = gcs_keys.COLLECTIVE_ABORT.key(group)
             prev = self._kv.get(abort_key)
             try:
                 prev_epoch = int(prev.decode()) if prev is not None else -1
@@ -477,7 +479,7 @@ class GcsServer:
         push is tagged with the pusher's node identity (util/metrics), so a
         node death reaps all of its workers' series at once."""
         want = node_id.hex()
-        for key in [k for k in self._kv if k.startswith("metrics:")]:
+        for key in [k for k in self._kv if gcs_keys.METRICS.matches(k)]:
             try:
                 payload = json.loads(self._kv[key])
             except Exception:

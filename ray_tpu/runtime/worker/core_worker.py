@@ -66,6 +66,7 @@ from ...exceptions import (
     WorkerCrashedError,
 )
 from ...object_ref import ObjectRef
+from ..gcs import keys as gcs_keys
 from ..gcs.pubsub import SubscriberClient
 from ..object_store.store import StoreClient
 from .memory_store import MemoryStore
@@ -1322,7 +1323,7 @@ class CoreWorker:
         state.creation_arg_pins = self._pin_task_args(spec)
         self._actors[spec.actor_id] = state
         await self._subscriber.subscribe(
-            f"actor:{spec.actor_id.hex()}", self._on_actor_update
+            gcs_keys.ACTOR_CHANNEL.key(spec.actor_id.hex()), self._on_actor_update
         )
         gcs = self.client_pool.get(*self.gcs_address)
         info: ActorInfo = await gcs.call("register_actor", spec, detached)
@@ -1347,7 +1348,7 @@ class CoreWorker:
 
         async def _sub():
             await self._subscriber.subscribe(
-                f"actor:{actor_id.hex()}", self._on_actor_update
+                gcs_keys.ACTOR_CHANNEL.key(actor_id.hex()), self._on_actor_update
             )
             # re-fetch after subscribing to close the startup race
             gcs = self.client_pool.get(*self.gcs_address)
@@ -1667,7 +1668,9 @@ class CoreWorker:
         fn = self._function_cache.get(descriptor.function_hash)
         if fn is None:
             gcs = self.client_pool.get(*self.gcs_address)
-            raw = await gcs.call("kv_get", f"fn:{descriptor.function_hash}")
+            raw = await gcs.call(
+                "kv_get", gcs_keys.FUNCTION.key(descriptor.function_hash)
+            )
             if raw is None:
                 raise TaskError(
                     descriptor.qualname, "function definition not found in GCS"
@@ -1930,7 +1933,9 @@ class CoreWorker:
 
     async def _handle_create_actor(self, spec: TaskSpec):
         gcs = self.client_pool.get(*self.gcs_address)
-        raw = await gcs.call("kv_get", f"fn:{spec.function.function_hash}")
+        raw = await gcs.call(
+            "kv_get", gcs_keys.FUNCTION.key(spec.function.function_hash)
+        )
         if raw is None:
             raise RuntimeError("actor class not found in GCS function table")
         cls = serialization.loads(raw)
@@ -1939,9 +1944,7 @@ class CoreWorker:
             self._executor_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=spec.max_concurrency
             )
-        instance = await self.loop.run_in_executor(
-            self._executor_pool, lambda: cls(*args, **kwargs)
-        )
+        instance = await self._run_traced(lambda: cls(*args, **kwargs))
         self._actor_instance = instance
         self._actor_spec = spec
         return True
@@ -2119,9 +2122,8 @@ class CoreWorker:
             from ...experimental import device_objects
 
             try:
-                args, kwargs = await self.loop.run_in_executor(
-                    self._executor_pool,
-                    lambda: device_objects.resolve_args(args, kwargs),
+                args, kwargs = await self._run_traced(
+                    lambda: device_objects.resolve_args(args, kwargs)
                 )
             except Exception as e:  # noqa: BLE001
                 return self._error_reply(spec, e)

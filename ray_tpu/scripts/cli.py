@@ -373,7 +373,9 @@ def cmd_chaos(args):
               f"for group {args.group!r} epoch {args.epoch}")
         return 0
     if args.chaos_action == "delay-collective":
-        key = f"coldelay:{args.group}"
+        from ..runtime.gcs import keys as gcs_keys
+
+        key = gcs_keys.COLLECTIVE_DELAY.key(args.group)
         if args.seconds > 0:
             _kv("kv_put", key, str(args.seconds).encode(), True)
             print(f"group {args.group!r}: every op now sleeps "
@@ -383,6 +385,83 @@ def cmd_chaos(args):
             print(f"group {args.group!r}: delay cleared")
         return 0
     return 1
+
+
+def cmd_lint(args):
+    """`ray_tpu lint`: the project-invariant static-analysis pass.
+
+    Runs the RT001..RT006 checkers (ray_tpu/analysis/) over the package —
+    or the given paths — subtracts the committed baseline, and reports
+    what's left. Exit codes: 0 clean, 1 findings (new or stale baseline),
+    2 internal error. ``--baseline-update`` rewrites the baseline from the
+    current findings (shrink-only policy: do this only to *remove* fixed
+    entries, never to grandfather new code).
+    """
+    import os as _os
+
+    from .. import analysis
+
+    try:
+        rules = args.rules.split(",") if args.rules else None
+        pkg_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        repo_root = _os.path.dirname(pkg_root)
+        targets = args.paths or [pkg_root]
+        findings = []
+        files = 0
+        parse_errors = []
+        for target in targets:
+            analyzer = analysis.Analyzer(
+                target, rules=rules,
+                rel_to=repo_root if _os.path.abspath(target).startswith(repo_root)
+                else None,
+            )
+            result = analyzer.run()
+            findings.extend(result.findings)
+            files += result.files_scanned
+            parse_errors.extend(result.parse_errors)
+
+        if args.baseline_update:
+            path = analysis.write_baseline(findings, args.baseline)
+            print(f"baseline rewritten with {len(findings)} finding(s): {path}")
+            return 0
+
+        entries = [] if args.no_baseline else analysis.load_baseline(args.baseline)
+        new, suppressed, stale = analysis.apply_baseline(findings, entries)
+
+        if getattr(args, "json", False):
+            print(json.dumps({
+                "files_scanned": files,
+                "parse_errors": parse_errors,
+                "findings": [f.to_dict() for f in new],
+                "baselined": len(suppressed),
+                "stale_baseline": stale,
+                "counts": {
+                    rule: sum(1 for f in new if f.rule == rule)
+                    for rule in sorted({f.rule for f in new})
+                },
+            }, indent=2))
+        else:
+            for f in new:
+                print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+            for e in stale:
+                print(
+                    f"stale baseline entry (finding fixed — shrink the "
+                    f"baseline): {e.get('rule')} {e.get('path')}: "
+                    f"{e.get('message')}"
+                )
+            for err in parse_errors:
+                print(f"parse error: {err}", file=sys.stderr)
+            print(
+                f"{files} file(s) scanned: {len(new)} finding(s), "
+                f"{len(suppressed)} baselined, {len(stale)} stale "
+                f"baseline entr{'y' if len(stale) == 1 else 'ies'}"
+            )
+        return 1 if (new or stale or parse_errors) else 0
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 — exit code 2 contract
+        print(f"lint internal error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
 
 
 def cmd_timeline(args):
@@ -550,6 +629,37 @@ def main(argv=None):
         help="per-op delay for delay-collective; 0 clears",
     )
     p.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser(
+        "lint",
+        help="run the RT001..RT006 static-analysis pass "
+             "(exit 0 clean / 1 findings / 2 internal error)",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files or directories to scan (default: the ray_tpu package)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    p.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--baseline", default=None,
+        help="baseline file (default: ray_tpu/analysis/baseline.json)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    p.add_argument(
+        "--baseline-update", action="store_true",
+        help="rewrite the baseline from current findings (shrink-only "
+             "policy: use to drop fixed entries)",
+    )
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser(
         "timeline", help="export the cluster chrome trace (ray timeline)"

@@ -23,9 +23,10 @@ from __future__ import annotations
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
 
+from ..runtime.gcs import keys as gcs_keys
 from ..util.metrics import merged_histogram, quantile_from_buckets
 
-AUTOSCALE_LOG_KEY = "serve:autoscale_log"
+AUTOSCALE_LOG_KEY = gcs_keys.SERVE_AUTOSCALE_LOG
 LOG_LIMIT = 200
 
 

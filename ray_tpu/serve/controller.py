@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Any, Dict, List
 
+from ..runtime.gcs import keys as gcs_keys
 from .config import (
     ApplicationStatus,
     AutoscalingConfig,
@@ -76,7 +77,7 @@ class _DeploymentState:
         self.version = 0
 
 
-CHECKPOINT_KEY = "serve:controller_ckpt"
+CHECKPOINT_KEY = gcs_keys.SERVE_CONTROLLER_CKPT
 
 
 class ServeController:

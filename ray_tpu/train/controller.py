@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from ..exceptions import CollectiveAbortedError
+from ..runtime.gcs import keys as gcs_keys
 from .backend import BackendConfig
 from .checkpoint import Checkpoint, CheckpointManager, load_latest_checkpoint
 from .config import RunConfig, ScalingConfig
@@ -357,7 +358,7 @@ class TrainController:
             }
             self._kv_call(
                 "kv_put",
-                f"trainrun:{self._run_config.name}",
+                gcs_keys.TRAIN_RUN.key(self._run_config.name),
                 json.dumps(record).encode(),
                 True,
             )
@@ -366,7 +367,9 @@ class TrainController:
 
     def _delete_run_record(self):
         try:
-            self._kv_call("kv_del", f"trainrun:{self._run_config.name}")
+            self._kv_call(
+                "kv_del", gcs_keys.TRAIN_RUN.key(self._run_config.name)
+            )
         except Exception:
             pass
 

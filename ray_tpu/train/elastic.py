@@ -29,11 +29,14 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..runtime.gcs import keys as gcs_keys
 from .session import get_context
 
 
 def _state_name(name: Optional[str]) -> str:
-    return name if name else f"train-state:{get_context().experiment_name}"
+    return name if name else gcs_keys.TRAIN_STATE.key(
+        get_context().experiment_name
+    )
 
 
 def publish_train_state(

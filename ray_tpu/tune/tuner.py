@@ -141,15 +141,18 @@ class _TrialRunner:
         from . import _session
 
         self._stop_criteria = dict(stop_criteria or {})
-        self._iteration = start_iteration
         self._start_checkpoint = (
             serialization.loads(checkpoint_bytes) if checkpoint_bytes else None
         )
-        self._latest_checkpoint_bytes: Optional[bytes] = checkpoint_bytes
-        # ship checkpoint bytes to the controller only when they change —
-        # polls run ~10x/s and a param-pytree checkpoint can be large
-        self._ckpt_version = 0
-        self._shipped_ckpt_version = 0
+        # the trial thread doesn't exist yet, but these attrs are shared
+        # with it once it does — hold the lock so the discipline is uniform
+        with self._lock:
+            self._iteration = start_iteration
+            self._latest_checkpoint_bytes: Optional[bytes] = checkpoint_bytes
+            # ship checkpoint bytes to the controller only when they change —
+            # polls run ~10x/s and a param-pytree checkpoint can be large
+            self._ckpt_version = 0
+            self._shipped_ckpt_version = 0
         fn = serialization.loads(fn_bytes)
 
         def _run():

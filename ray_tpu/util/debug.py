@@ -21,6 +21,7 @@ import uuid
 from typing import Optional
 
 from .. import _worker_api
+from ..runtime.gcs import keys as gcs_keys
 
 def _accept_timeout_s() -> float:
     return float(os.environ.get("RAY_TPU_DEBUGGER_TIMEOUT_S", "600"))
@@ -142,7 +143,7 @@ def _serve_session(reason: str, run):
     port = server.getsockname()[1]
     session_id = uuid.uuid4().hex[:12]
     info = {**_session_context(), "host": host, "port": port, "reason": reason}
-    key = f"debug:{session_id}"
+    key = gcs_keys.DEBUG_SESSION.key(session_id)
     _kv_call("kv_put", key, json.dumps(info).encode(), True)
     print(
         f"RAY_TPU DEBUGGER: {reason} — waiting for a client at "
@@ -255,7 +256,7 @@ def post_mortem_enabled() -> bool:
 
 def list_sessions() -> dict:
     """Advertised debug sessions: session id -> info dict."""
-    keys = _kv_call("kv_keys", "debug:") or []
+    keys = _kv_call("kv_keys", gcs_keys.DEBUG_SESSION.scan) or []
     out = {}
     for key in keys:
         raw = _kv_call("kv_get", key)

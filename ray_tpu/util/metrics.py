@@ -17,6 +17,8 @@ import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
+from ..runtime.gcs import keys as gcs_keys
+
 _registry_lock = threading.Lock()
 _registry: Dict[str, "Metric"] = {}
 _pusher_started = False
@@ -1331,7 +1333,7 @@ def _ensure_pusher():
                 _worker_api.run_on_worker_loop(
                     worker.client_pool.get(*worker.gcs_address).call(
                         "kv_put",
-                        f"metrics:{worker.worker_id.hex()}",
+                        gcs_keys.METRICS.key(worker.worker_id.hex()),
                         json.dumps(payload).encode(),
                         True,
                     ),
@@ -1348,7 +1350,7 @@ def fetch_metric_payloads(gcs_call) -> List[dict]:
     *args)`` and normalize to identity-tagged payload dicts. Shared by
     prometheus_text (driver side) and the dashboard (GCS-client side)."""
     payloads: List[dict] = []
-    for key in gcs_call("kv_keys", "metrics:"):
+    for key in gcs_call("kv_keys", gcs_keys.METRICS.scan):
         raw = gcs_call("kv_get", key)
         if raw is None:
             continue

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 from .. import _worker_api
+from ..runtime.gcs import keys as gcs_keys
 
 
 def _gcs_call(method: str, *args):
@@ -190,7 +191,7 @@ def list_train_runs() -> List[Dict[str, Any]]:
     import json as _json
 
     out = []
-    for key in _gcs_call("kv_keys", "trainrun:") or []:
+    for key in _gcs_call("kv_keys", gcs_keys.TRAIN_RUN.scan) or []:
         raw = _gcs_call("kv_get", key)
         if not raw:
             continue
@@ -198,7 +199,7 @@ def list_train_runs() -> List[Dict[str, Any]]:
             rec = _json.loads(bytes(raw).decode())
         except Exception:
             continue
-        rec["name"] = key[len("trainrun:"):]
+        rec["name"] = gcs_keys.TRAIN_RUN.strip(key)
         out.append(rec)
     return out
 
@@ -210,7 +211,7 @@ def autoscale_log(limit: int = 100) -> List[Dict[str, Any]]:
     autoscale log`, dashboard)."""
     import json as _json
 
-    raw = _gcs_call("kv_get", "serve:autoscale_log")
+    raw = _gcs_call("kv_get", gcs_keys.SERVE_AUTOSCALE_LOG)
     if not raw:
         return []
     try:
