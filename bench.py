@@ -849,6 +849,13 @@ def serve_autoscale():
             f"replicas 1 -> {peak} -> {final}; {len(ups)} up / "
             f"{len(downs)} down decisions; outcomes {summary['outcomes']}"
         )
+        slowest = result.slowest()
+        if slowest is not None:
+            _log(
+                f"slowest request: {slowest.latency_s * 1000:.1f}ms "
+                f"(trace_id={slowest.trace_id or 'tracing off'} — "
+                f"`ray_tpu timeline` renders its span tree)"
+            )
         if failures:
             _log(f"FAIL: {failures} caller failures: "
                  f"{sorted({r.outcome for r in result.failures})}")
@@ -863,6 +870,7 @@ def serve_autoscale():
             "ttft_p50_ms": summary.get("ttft_p50_ms"),
             "ttft_p99_ms": summary.get("ttft_p99_ms"),
             "max_lag_s": summary["max_lag_s"],
+            "slowest_trace_id": slowest.trace_id if slowest else None,
             "replicas_peak": peak,
             "replicas_final": final,
             "scale_up_events": len(ups),

@@ -1,0 +1,117 @@
+"""RT007: flight-recorder event-name registry consistency.
+
+The RT004 twin for the flight recorder (``util/events.py``): the event
+taxonomy is the process-wide ``_registry`` keyed by event *name*, and the
+``ray_tpu events --name X`` query plane plus the docs' event table are only
+trustworthy if every name is greppable and declared in one place. The
+invariants:
+
+- every ``EventName(...)`` construction takes a **literal** snake_case
+  string (a computed name defeats grep and the post-mortem query filter);
+- each name is constructed exactly **once**, and only in
+  ``util/events.py`` — the single home of the taxonomy, so an emitter
+  can't mint a private name that the docs and CLI never learn about.
+
+Import-aware like RT004: only ``EventName`` bound from ``util.events``
+(or used inside ``util/events.py`` itself) counts; an unrelated local
+class of the same name in some other module is ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from ..astutil import str_const
+from ..core import Checker, Finding, register
+
+_EVENT_CLASS = "EventName"
+_SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_HOME_FILE = "util/events.py"
+
+
+def _event_bindings(tree: ast.AST, path: str) -> Dict[str, str]:
+    """local name -> 'EventName', honoring imports. In util/events.py the
+    class is defined locally so the bare name always binds."""
+    bound: Dict[str, str] = {}
+    if path.endswith(_HOME_FILE):
+        bound[_EVENT_CLASS] = _EVENT_CLASS
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module.endswith("util.events") or node.module == "events"
+        ):
+            for alias in node.names:
+                if alias.name == _EVENT_CLASS:
+                    bound[alias.asname or alias.name] = _EVENT_CLASS
+    return bound
+
+
+@register
+class EventRegistryChecker(Checker):
+    RULE_ID = "RT007"
+    DESCRIPTION = (
+        "flight-recorder event names: literal snake_case, declared once in"
+        " util/events.py"
+    )
+
+    def __init__(self):
+        # name -> list of (path, line)
+        self._declarations: Dict[str, List[Tuple[str, int]]] = {}
+
+    def check_file(self, path, tree, source):
+        bound = _event_bindings(tree, path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._event_class(node, bound) is None:
+                continue
+            name_node = node.args[0] if node.args else None
+            name = str_const(name_node) if name_node is not None else None
+            if name is None:
+                yield self.finding(
+                    path, node,
+                    "EventName must be constructed from a literal string "
+                    "(computed names defeat the taxonomy audit and "
+                    "`ray_tpu events --name`)",
+                )
+                continue
+            if not _SNAKE_RE.match(name):
+                yield self.finding(
+                    path, node,
+                    f"event name {name!r} is not snake_case",
+                )
+            if not path.endswith(_HOME_FILE):
+                yield self.finding(
+                    path, node,
+                    f"event {name!r} declared outside util/events.py — the "
+                    f"taxonomy lives there so the docs/CLI can't drift",
+                )
+            self._declarations.setdefault(name, []).append(
+                (path, node.lineno)
+            )
+
+    def finalize(self):
+        for name, decls in sorted(self._declarations.items()):
+            if len(decls) > 1:
+                sites = ", ".join(f"{p}:{ln}" for p, ln in decls)
+                yield Finding(
+                    rule=self.RULE_ID, path=decls[0][0], line=decls[0][1],
+                    message=f"event {name!r} declared {len(decls)} times "
+                            f"({sites}) — the registry keys by name, later "
+                            f"declarations alias the first",
+                )
+
+    @staticmethod
+    def _event_class(node: ast.Call, bound: Dict[str, str]):
+        func = node.func
+        if isinstance(func, ast.Name):
+            return bound.get(func.id)
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == _EVENT_CLASS
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("events", "_events")
+        ):
+            return func.attr
+        return None

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from ..exceptions import CollectiveAbortedError
+from ..util import events as _events
 from .base import BaseGroup, ReduceOp
 from .cpu_group import GcsStoreGroup
 from .xla_group import XlaGroup
@@ -38,6 +39,11 @@ def init_collective_group(
     cls = _BACKENDS[backend]
     group = cls(world_size, rank, group_name, **kwargs)
     _groups[group_name] = group
+    _events.record_event(
+        _events.COLLECTIVE_EPOCH,
+        group=group_name, epoch=getattr(group, "epoch", 0),
+        world_size=world_size, rank=rank, backend=backend, phase="formed",
+    )
     return group
 
 
@@ -98,6 +104,10 @@ def abort_collective_group(
     if epoch is None:
         local = _groups.get(group_name)
         epoch = local.epoch if local is not None else 0
+    _events.record_event(
+        _events.COLLECTIVE_EPOCH,
+        group=group_name, epoch=epoch, phase="aborted", reason=reason,
+    )
     return write_abort(group_name, epoch, reason)
 
 

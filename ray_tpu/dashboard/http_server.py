@@ -14,6 +14,7 @@ Routes:
   GET  /api/cluster_resources | /api/cluster_status
   GET  /api/train              (elastic-training FT rollup + live runs)
   GET  /api/autoscale          (SLO-autoscaler decision log + counters)
+  GET  /api/events             (flight-recorder event query, post-mortem)
   GET  /api/jobs/              (list submitted jobs)
   POST /api/jobs/              (submit: {"entrypoint": ..., "runtime_env": ...})
   GET  /api/jobs/{id}
@@ -200,6 +201,10 @@ class DashboardServer:
             ("GET", "/api/serve"): self._serve,
             # SLO-autoscaler decision log + scale counters
             ("GET", "/api/autoscale"): self._autoscale,
+            # flight recorder: cluster-wide structured events (state
+            # transitions, retries, watchdog stack captures) — post-mortem
+            # queryable after a process SIGKILL
+            ("GET", "/api/events"): self._events,
             ("GET", "/metrics"): self._metrics,
             # browser UI (role of the React frontend, dashboard/client/ —
             # here a dependency-free single page over the same REST API)
@@ -300,6 +305,13 @@ class DashboardServer:
             "events": events[-100:],
             "summary": autoscale_summary(self._metric_payloads()),
         }, None
+
+    def _events(self, body):
+        try:
+            events = self._gcs("list_events", 1000, None)
+        except Exception:
+            events = []
+        return 200, {"events": events}, None
 
     def _metrics(self, body):
         from ..util.metrics import render_prometheus

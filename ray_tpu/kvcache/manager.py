@@ -384,6 +384,16 @@ class KVCacheManager:
             record_kvcache_blocked()
         except Exception:
             pass
+        try:
+            from ..util import events
+
+            events.record_event(
+                events.ADMISSION_BLOCKED,
+                blocks_free=self._alloc.num_free,
+                blocked_total=self._stats["admission_blocked"],
+            )
+        except Exception:
+            pass
 
     def _record_eviction(self, n: int) -> None:
         try:

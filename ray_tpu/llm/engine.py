@@ -32,6 +32,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..util import events as _events
+from ..util import tracing as _tracing
+
 
 def _resolve_seed(seed: Optional[int]) -> int:
     """Per-process default: replicas sampling at temperature > 0 must not
@@ -260,6 +263,7 @@ class _Slot:
     generated: List[int]
     last_token: int
     lease: Any = None  # KVCacheLease when the engine runs paged
+    trace: Any = None  # {"ctx", "wall"} when the request is traced
 
 
 class ContinuousBatchingEngine(_DecodeModelBase):
@@ -305,6 +309,12 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         # owning generate()/generate_stream() call collects them
         self._finished_buf: Dict[int, GenerationResult] = {}
         self._enqueue_ts: Dict[int, float] = {}  # rid -> monotonic, for TTFT
+        # rid -> {"ctx", "wall"}: populated only while the submitting
+        # request is traced, so the untraced path never touches it
+        self._req_trace: Dict[int, Any] = {}
+        # rids already reported as blocked on KV admission (one flight
+        # event per episode, not one per engine step while starved)
+        self._blocked_rids: set = set()
         # slot-row readback for retire-time commits (si is traced: 1 program)
         self._extract_row = jax.jit(
             lambda pool, si: jax.tree.map(
@@ -329,11 +339,16 @@ class ContinuousBatchingEngine(_DecodeModelBase):
     def add_request(self, request: GenerationRequest) -> int:
         if len(request.token_ids) + request.max_new_tokens > self._cfg.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        tr = None
+        if _tracing.is_tracing_enabled():
+            tr = {"ctx": _tracing.current_context(), "wall": time.time()}
         with self._lock:
             rid = self._next_id
             self._next_id += 1
             self._pending.append((rid, request))
             self._enqueue_ts[rid] = time.monotonic()
+            if tr is not None:
+                self._req_trace[rid] = tr
         return rid
 
     @property
@@ -376,6 +391,15 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                     finished_reason="eos" if done_eos else "length",
                 )
                 finished.append((slot.request_id, result))
+                if slot.trace is not None:
+                    _tracing.emit_span(
+                        "engine.decode", slot.trace["ctx"],
+                        slot.trace["wall"],
+                        time.time() - slot.trace["wall"],
+                        category="engine", request_id=slot.request_id,
+                        tokens=len(slot.generated),
+                        finished=result.finished_reason,
+                    )
                 self._retire_slot(si)
         return finished
 
@@ -391,8 +415,16 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         # was never fed back through the model
         tokens = list(req.token_ids) + slot.generated[:-1]
         if len(tokens) // self._kv.block_size > len(req.token_ids) // self._kv.block_size:
+            cm_t0 = time.time() if slot.trace else 0.0
             row = self._extract_row(self._cache, jnp.asarray(si, jnp.int32))
             self._kv.commit(slot.lease, tokens, row, pin=False)
+            if slot.trace:
+                _tracing.emit_span(
+                    "kvcache.commit", slot.trace["ctx"], cm_t0,
+                    time.time() - cm_t0, category="kvcache",
+                    request_id=slot.request_id, tokens=len(tokens),
+                    tail=True,
+                )
         self._kv.release(slot.lease)
 
     def run_until_complete(self) -> Dict[int, GenerationResult]:
@@ -488,13 +520,50 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         while free and self._pending:
             si = free.pop(0)
             rid, req = self._pending.pop(0)
+            tr = self._req_trace.get(rid)
             lease = None
             if self._kv is not None:
+                kv_t0 = time.time() if tr else 0.0
                 lease = self._kv.acquire(req.token_ids)
                 if lease is None:  # backpressure: wait for a release
                     self._pending.insert(0, (rid, req))
+                    if rid not in self._blocked_rids:
+                        self._blocked_rids.add(rid)
+                        _events.record_event(
+                            _events.ENGINE_ADMISSION_BLOCKED,
+                            request_id=rid,
+                            prompt_tokens=len(req.token_ids),
+                            pending=len(self._pending),
+                        )
                     break
-            logits, solo_cache = self._prefill_leased(req, lease)
+                self._blocked_rids.discard(rid)
+                if tr:
+                    _tracing.emit_span(
+                        "kvcache.acquire", tr["ctx"], kv_t0,
+                        time.time() - kv_t0, category="kvcache",
+                        request_id=rid,
+                        cached_tokens=lease.num_cached_tokens,
+                    )
+            tr = self._req_trace.pop(rid, None)
+            if tr:
+                now = time.time()
+                _tracing.emit_span(
+                    "engine.queue_wait", tr["ctx"], tr["wall"],
+                    now - tr["wall"], category="engine", request_id=rid,
+                )
+            pf_wall = time.time() if tr else 0.0
+            logits, solo_cache = self._prefill_leased(
+                req, lease, trace=tr
+            )
+            if tr:
+                cached = lease.num_cached_tokens if lease is not None else 0
+                _tracing.emit_span(
+                    "engine.prefill", tr["ctx"], pf_wall,
+                    time.time() - pf_wall, category="engine",
+                    request_id=rid, cached_tokens=cached,
+                    computed_tokens=len(req.token_ids) - cached,
+                    hit=cached > 0,
+                )
             first = int(
                 self._sample_tokens(
                     logits,
@@ -510,7 +579,14 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                     _record_ttft(time.monotonic() - ts, hit=cached > 0)
                 # commit the prompt's full blocks while the prefilled row
                 # is at hand; reserved blocks are consumed here
+                cm_t0 = time.time() if tr else 0.0
                 self._kv.commit(lease, req.token_ids, solo_cache)
+                if tr:
+                    _tracing.emit_span(
+                        "kvcache.commit", tr["ctx"], cm_t0,
+                        time.time() - cm_t0, category="kvcache",
+                        request_id=rid, tokens=len(req.token_ids),
+                    )
             if self._cache is None:
                 self._cache = self._empty_cache(solo_cache)
             # insert the prefilled K/V row + its write position into slot si
@@ -520,6 +596,9 @@ class ContinuousBatchingEngine(_DecodeModelBase):
             slot = _Slot(
                 request_id=rid, request=req, generated=[first],
                 last_token=first, lease=lease,
+                trace=(
+                    {"ctx": tr["ctx"], "wall": time.time()} if tr else None
+                ),
             )
             req_eos = req.eos_token_id is not None and first == req.eos_token_id
             if req_eos or req.max_new_tokens <= 1:
@@ -536,7 +615,7 @@ class ContinuousBatchingEngine(_DecodeModelBase):
             self._slots[si] = slot
         return finished
 
-    def _prefill_leased(self, req: GenerationRequest, lease):
+    def _prefill_leased(self, req: GenerationRequest, lease, trace=None):
         """Prefill a request, reusing the lease's cached prefix: a full
         prefill on a miss; on a hit, gather the cached blocks into a slot
         row and run only the uncached suffix through the decode program in
@@ -547,7 +626,14 @@ class ContinuousBatchingEngine(_DecodeModelBase):
             return self._prefill(
                 self._params, jnp.asarray([tokens], jnp.int32)
             )
+        as_t0 = time.time() if trace else 0.0
         row = self._kv.assemble(lease)
+        if trace:
+            _tracing.emit_span(
+                "kvcache.assemble", trace["ctx"], as_t0,
+                time.time() - as_t0, category="kvcache",
+                cached_tokens=lease.num_cached_tokens,
+            )
         logits = None
         pos = lease.num_cached_tokens
         while pos < len(tokens):

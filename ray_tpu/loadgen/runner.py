@@ -10,7 +10,10 @@ never closes the loop on latency, so queueing and shedding show up in the
 recorded outcomes instead of silently in the schedule.
 
 Targets adapt a ``TraceRecord`` to a transport and return per-request
-``(ttft_s, latency_s)``:
+``(ttft_s, latency_s)`` — or ``(ttft_s, latency_s, trace_id)`` when the
+transport can tie the request to a distributed trace (HandleTarget and
+HTTPTarget mint one trace per request when tracing is enabled, so every
+recorded outcome is joinable against ``ray_tpu timeline``):
 
 - ``HandleTarget``: a serve ``DeploymentHandle`` (unary or streaming;
   streaming TTFT = first yielded item). Deadlines ride as
@@ -57,21 +60,32 @@ class HandleTarget:
         self._handle = handle
         self._stream = stream
 
-    def __call__(self, record: TraceRecord) -> Tuple[float, float]:
+    def __call__(self, record: TraceRecord) -> Tuple[float, float, str]:
+        from ..util import tracing
+
         h = self._handle
         if record.deadline_s is not None:
             h = h.options(timeout_s=record.deadline_s)
+        # one fresh trace per request (not the process root): the recorded
+        # trace_id then names exactly this request's proxy->chip span tree
+        ctx = (
+            tracing.new_trace_context()
+            if tracing.is_tracing_enabled() else None
+        )
+        trace_id = ctx["trace_id"] if ctx else ""
         t0 = time.perf_counter()
-        if self._stream:
-            first: Optional[float] = None
-            for item in h.options(stream=True).remote(record.payload()):
-                if first is None:
-                    first = time.perf_counter() - t0
-            latency = time.perf_counter() - t0
-            return (first if first is not None else latency), latency
-        h.remote(record.payload()).result()
-        dt = time.perf_counter() - t0
-        return dt, dt
+        with tracing.request_span("loadgen.request", ctx, cls=record.cls):
+            if self._stream:
+                first: Optional[float] = None
+                for item in h.options(stream=True).remote(record.payload()):
+                    if first is None:
+                        first = time.perf_counter() - t0
+                latency = time.perf_counter() - t0
+                ttft = first if first is not None else latency
+                return ttft, latency, trace_id
+            h.remote(record.payload()).result()
+            dt = time.perf_counter() - t0
+            return dt, dt, trace_id
 
 
 class HTTPTarget:
@@ -81,8 +95,10 @@ class HTTPTarget:
     def __init__(self, url: str):
         self._url = url
 
-    def __call__(self, record: TraceRecord) -> Tuple[float, float]:
+    def __call__(self, record: TraceRecord) -> Tuple[float, float, str]:
         import urllib.request
+
+        from ..util import tracing
 
         data = json.dumps(record.payload()).encode()
         req = urllib.request.Request(
@@ -93,15 +109,22 @@ class HTTPTarget:
         if record.deadline_s is not None:
             req.add_header("X-Request-Timeout-S", str(record.deadline_s))
             timeout = record.deadline_s + 1.0
+        # generator-minted trace id rides the X-Trace-Id header; the proxy
+        # honors it as the request's trace root and echoes it back
+        trace_id = ""
+        if tracing.is_tracing_enabled():
+            trace_id = tracing.new_trace_context()["trace_id"]
+            req.add_header("X-Trace-Id", trace_id)
         t0 = time.perf_counter()
         with urllib.request.urlopen(req, timeout=timeout) as resp:
+            trace_id = resp.headers.get("X-Trace-Id", "") or trace_id
             # first body byte approximates TTFT for streaming responses;
             # for buffered JSON both stamps collapse to response time
             resp.read(1)
             first = time.perf_counter() - t0
             resp.read()
         latency = time.perf_counter() - t0
-        return first, latency
+        return first, latency, trace_id
 
 
 @dataclass
@@ -114,6 +137,7 @@ class RequestResult:
     outcome: str  # ok | deadline | shed | error:<Type>
     cls: str = "default"
     prefix_id: int = 0
+    trace_id: str = ""  # joins this request to its distributed trace
 
     @property
     def lag_s(self) -> float:
@@ -138,6 +162,12 @@ class LoadResult:
     @property
     def failures(self) -> List[RequestResult]:
         return [r for r in self.records if r.outcome != "ok"]
+
+    def slowest(self) -> Optional[RequestResult]:
+        """The slowest successful request — its ``trace_id`` is the first
+        thing to pull up in ``ray_tpu timeline`` when a run misses SLO."""
+        ok = self.ok
+        return max(ok, key=lambda r: r.latency_s) if ok else None
 
     def summary(self) -> Dict[str, Any]:
         outcomes: Dict[str, int] = {}
@@ -269,9 +299,13 @@ class LoadGenerator:
              records: List[Optional[RequestResult]],
              inflight: threading.Semaphore):
         start = time.perf_counter() - base
+        trace_id = ""
         try:
             try:
-                ttft, latency = self.target(rec)
+                out = self.target(rec)
+                # targets return (ttft, latency) or (ttft, latency, trace_id)
+                ttft, latency = out[0], out[1]
+                trace_id = out[2] if len(out) > 2 else ""
                 outcome = "ok"
             except BaseException as exc:  # noqa: BLE001 — recorded, not raised
                 ttft = latency = time.perf_counter() - base - start
@@ -285,6 +319,7 @@ class LoadGenerator:
                 outcome=outcome,
                 cls=rec.cls,
                 prefix_id=rec.prefix_id,
+                trace_id=trace_id,
             )
         finally:
             inflight.release()

@@ -78,6 +78,12 @@ class GcsServer:
         # (capped like task events; tracing off -> nothing ever arrives)
         self._spans: List[dict] = []
         self._spans_cap = 50000
+        # flight-recorder event store (util/events.py): every process's
+        # structured-event ring is streamed here continuously, so the
+        # cluster copy survives a SIGKILL of the recording process and
+        # `ray_tpu events` can post-mortem a dead replica
+        self._events: List[dict] = []
+        self._events_cap = 50000
         # autoscaler state (reference: GcsAutoscalerStateManager)
         self._node_demands: Dict[NodeID, list] = {}
         self._autoscaling_state: Optional[dict] = None
@@ -405,6 +411,19 @@ class GcsServer:
     # -- workers -----------------------------------------------------------
 
     async def handle_report_worker_death(self, worker_id: WorkerID, reason: str):
+        # synthetic flight-recorder marker: the dead worker can't dump its
+        # own ring (SIGKILL), but its continuously pushed events are already
+        # here — this stitches the death cause into the same event stream
+        self._events.append({
+            "ts": time.time(),
+            "pid": None,
+            "name": "worker_death",
+            "worker_id": worker_id.hex(),
+            "reason": reason,
+            "synthetic": True,
+        })
+        if len(self._events) > self._events_cap:
+            del self._events[: len(self._events) - self._events_cap]
         await self.actor_manager.on_worker_death(worker_id, reason)
         # reap the dead worker's pushed metrics snapshot, or its series
         # would live in every /metrics scrape forever
@@ -635,6 +654,21 @@ class GcsServer:
 
     async def handle_list_spans(self, limit: int = 100000):
         return self._spans[-limit:]
+
+    # -- flight-recorder event store (see util/events.py) ------------------
+
+    async def handle_report_events(self, events: List[dict]):
+        self._events.extend(events)
+        if len(self._events) > self._events_cap:
+            del self._events[: len(self._events) - self._events_cap]
+        return True
+
+    async def handle_list_events(
+        self, limit: int = 1000, name: Optional[str] = None
+    ):
+        if name is None:
+            return self._events[-limit:]
+        return [e for e in self._events if e.get("name") == name][-limit:]
 
     async def handle_register_job(self, metadata: dict) -> JobID:
         job_id = JobID.from_int(self._next_job)

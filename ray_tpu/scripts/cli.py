@@ -257,6 +257,22 @@ def cmd_autoscale(args):
     return 0
 
 
+def cmd_events(args):
+    """`ray_tpu events`: the cluster flight recorder — structured events
+    (replica state transitions, autoscale decisions, collective epochs,
+    admission blocks, retries, watchdog stack captures) streamed by every
+    process into the GCS event store. Works post-mortem: a SIGKILLed
+    process's last ~second of events is already in the store."""
+    _connected(args)
+    from ..util import state
+
+    print(json.dumps(
+        state.list_events(limit=args.limit, name=args.name),
+        indent=2, default=str,
+    ))
+    return 0
+
+
 def cmd_chaos(args):
     """`ray_tpu chaos`: fault injection against a live cluster — the
     operator-facing face of the elastic-training chaos layer.
@@ -390,7 +406,7 @@ def cmd_chaos(args):
 def cmd_lint(args):
     """`ray_tpu lint`: the project-invariant static-analysis pass.
 
-    Runs the RT001..RT006 checkers (ray_tpu/analysis/) over the package —
+    Runs the RT001..RT007 checkers (ray_tpu/analysis/) over the package —
     or the given paths — subtracts the committed baseline, and reports
     what's left. Exit codes: 0 clean, 1 findings (new or stale baseline),
     2 internal error. ``--baseline-update`` rewrites the baseline from the
@@ -594,6 +610,21 @@ def main(argv=None):
     p.set_defaults(fn=cmd_autoscale)
 
     p = sub.add_parser(
+        "events",
+        help="flight-recorder query: cluster-wide structured events "
+             "(state transitions, retries, watchdog stack captures)",
+    )
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument(
+        "--limit", type=int, default=100, help="max events to show"
+    )
+    p.add_argument(
+        "--name", default=None,
+        help="filter to one event name (e.g. replica_state, request_retry)",
+    )
+    p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser(
         "chaos",
         help="fault injection: kill ranks/replicas, abort/delay "
              "collectives, drain replicas",
@@ -632,7 +663,7 @@ def main(argv=None):
 
     p = sub.add_parser(
         "lint",
-        help="run the RT001..RT006 static-analysis pass "
+        help="run the RT001..RT007 static-analysis pass "
              "(exit 0 clean / 1 findings / 2 internal error)",
     )
     p.add_argument(

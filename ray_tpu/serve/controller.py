@@ -18,6 +18,7 @@ import time
 from typing import Any, Dict, List
 
 from ..runtime.gcs import keys as gcs_keys
+from ..util import events as _events
 from .config import (
     ApplicationStatus,
     AutoscalingConfig,
@@ -383,6 +384,11 @@ class ServeController:
                 replica.consecutive_health_failures += 1
                 if replica.consecutive_health_failures >= 3:
                     logger.warning("replica %s unhealthy; replacing", rid)
+                    _events.record_event(
+                        _events.REPLICA_STATE,
+                        deployment=dep.config.name, replica=rid,
+                        state="UNHEALTHY", reason="health_probe_failures",
+                    )
                     with self._lock:
                         dep.replicas.pop(rid, None)
                         dep.version += 1
@@ -406,6 +412,10 @@ class ServeController:
             replica.state = "DRAINING"
             dep.version += 1
             self._dirty = True
+        _events.record_event(
+            _events.REPLICA_STATE,
+            deployment=dep.config.name, replica=rid, state="DRAINING",
+        )
         timeout_s = dep.config.graceful_shutdown_timeout_s
         try:
             replica.drain_ref = replica.handle.drain.remote(timeout_s)
@@ -435,6 +445,11 @@ class ServeController:
                     dep.replicas.pop(rid, None)
                     dep.version += 1
                     self._dirty = True
+                _events.record_event(
+                    _events.REPLICA_STOP,
+                    deployment=dep.config.name, replica=rid,
+                    reason="drained" if done else "drain_deadline",
+                )
                 try:
                     api.kill(replica.handle)
                 except Exception:
@@ -454,10 +469,22 @@ class ServeController:
                     "autoscale %s: %d -> %d (ongoing=%.1f)",
                     dep.config.name, dep.target_replicas, desired, total_ongoing,
                 )
+                _events.record_event(
+                    _events.AUTOSCALE_DECISION,
+                    deployment=dep.config.name, direction="up",
+                    from_replicas=dep.target_replicas, to_replicas=desired,
+                    ongoing=total_ongoing,
+                )
                 dep.target_replicas = desired
                 dep.last_scale_up = now
         elif desired < dep.target_replicas:
             if now - dep.last_scale_down >= cfg.downscale_delay_s:
+                _events.record_event(
+                    _events.AUTOSCALE_DECISION,
+                    deployment=dep.config.name, direction="down",
+                    from_replicas=dep.target_replicas, to_replicas=desired,
+                    ongoing=total_ongoing,
+                )
                 dep.target_replicas = desired
                 dep.last_scale_down = now
         else:
@@ -512,6 +539,12 @@ class ServeController:
 
         record_autoscale_decision(
             dep.config.name, decision.direction, decision.breach_age_s
+        )
+        _events.record_event(
+            _events.AUTOSCALE_DECISION,
+            deployment=full_name, direction=decision.direction,
+            from_replicas=decision.from_replicas,
+            to_replicas=decision.to_replicas, reason=decision.reason,
         )
         logger.info(
             "autoscale %s: %s %d -> %d (%s)",
@@ -582,6 +615,11 @@ class ServeController:
                             replica.state = "RUNNING"
                             dep.version += 1
                             self._dirty = True
+                        _events.record_event(
+                            _events.REPLICA_STATE,
+                            deployment=dep.config.name,
+                            replica=replica.replica_id, state="RUNNING",
+                        )
                 except TimeoutError:
                     if (
                         time.time() - replica.started_at
@@ -635,6 +673,9 @@ class ServeController:
         with self._lock:
             dep.replicas[rid] = _ReplicaState(rid, handle)
             self._dirty = True
+        _events.record_event(
+            _events.REPLICA_START, deployment=dep.config.name, replica=rid,
+        )
 
     def _stop_replica(self, dep: _DeploymentState, rid: str):
         from .. import api
@@ -645,6 +686,10 @@ class ServeController:
                 return
             dep.version += 1
             self._dirty = True
+        _events.record_event(
+            _events.REPLICA_STOP,
+            deployment=dep.config.name, replica=rid, reason="stopped",
+        )
         try:
             api.get(
                 replica.handle.prepare_for_shutdown.remote(

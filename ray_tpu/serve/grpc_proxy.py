@@ -86,6 +86,18 @@ class GRPCProxy:
             app_name = envelope.get("application", "default")
             method = envelope.get("method", "__call__")
             payload = envelope.get("payload")
+            # trace mint/honor, the gRPC twin of HTTP's X-Trace-Id header:
+            # an envelope-supplied id joins the caller's trace; otherwise a
+            # fresh trace starts when this process traces
+            from ..util import tracing
+
+            trace_id = envelope.get("trace_id")
+            if trace_id:
+                trace_ctx = tracing.new_trace_context(str(trace_id)[:64])
+            elif tracing.is_tracing_enabled():
+                trace_ctx = tracing.new_trace_context()
+            else:
+                trace_ctx = None
             # per-request deadline: an explicit envelope field wins, else
             # the client's gRPC deadline (context.time_remaining()), else
             # the deployment's default (60 s out of the box)
@@ -98,11 +110,15 @@ class GRPCProxy:
                 if remaining is not None and remaining > 0:
                     timeout_s = remaining
             result = await asyncio.get_event_loop().run_in_executor(
-                None, self._call_ingress, app_name, method, payload, timeout_s
+                None, self._call_ingress, app_name, method, payload,
+                timeout_s, trace_ctx,
             )
             if isinstance(result, Exception):
                 return self._error_reply(result, context)
-            return json.dumps({"ok": True, "result": result}).encode()
+            reply = {"ok": True, "result": result}
+            if trace_ctx is not None:
+                reply["trace_id"] = trace_ctx["trace_id"]
+            return json.dumps(reply).encode()
         except Exception as e:  # noqa: BLE001
             return json.dumps({"ok": False, "error": repr(e)}).encode()
 
@@ -133,7 +149,9 @@ class GRPCProxy:
         return json.dumps(body).encode()
 
     def _call_ingress(self, app_name: str, method: str, payload,
-                      timeout_s: Optional[float] = None):
+                      timeout_s: Optional[float] = None,
+                      trace_ctx: Optional[dict] = None):
+        from ..util import tracing
         from .api import get_app_handle
 
         try:
@@ -147,7 +165,10 @@ class GRPCProxy:
                 handle = handle.options(timeout_s=float(timeout_s))
             # the handle's deadline (explicit or the deployment default)
             # bounds the wait — no hardcoded proxy-side 60 s
-            return handle.remote(payload).result()
+            with tracing.request_span(
+                "serve.grpc_proxy", trace_ctx, app=app_name, method=method
+            ):
+                return handle.remote(payload).result()
         except Exception as e:  # noqa: BLE001
             return e
 
@@ -161,15 +182,20 @@ class GRPCProxy:
 
 
 def grpc_call(address, payload, *, application="default", method="__call__",
-              timeout_s: float = 60.0):
+              timeout_s: float = 60.0, trace_id: Optional[str] = None):
     """Client helper: one RPC against a GRPCProxy from any process
-    (reference: generated stubs; here a generic bytes channel)."""
+    (reference: generated stubs; here a generic bytes channel).
+    ``trace_id`` joins the call to a caller-chosen trace (the envelope
+    twin of the HTTP X-Trace-Id header)."""
     import grpc
 
     host, port = address
-    envelope = json.dumps(
-        {"application": application, "method": method, "payload": payload}
-    ).encode()
+    envelope_dict = {
+        "application": application, "method": method, "payload": payload,
+    }
+    if trace_id:
+        envelope_dict["trace_id"] = trace_id
+    envelope = json.dumps(envelope_dict).encode()
     with grpc.insecure_channel(f"{host}:{port}") as channel:
         fn = channel.unary_unary(f"/{SERVICE_NAME}/Call")
         reply = json.loads(fn(envelope, timeout=timeout_s))

@@ -38,6 +38,8 @@ from ..exceptions import (
     RpcError,
     WorkerCrashedError,
 )
+from ..util import events as _events
+from ..util import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -139,14 +141,13 @@ class _RequestContext:
         if remaining is not None and remaining <= backoff:
             return None
         cause = _unwrap(raw_exc)
-        from ..util.metrics import record_serve_retry
-
-        record_serve_retry(self.deployment, type(cause).__name__)
         logger.info(
             "serve failover (%s attempt %d/%d): %s on replica %s; "
             "resubmitting", self.deployment, self.attempt, self.max_attempts,
             type(cause).__name__, self.replica_id,
         )
+        attempt_wall = time.time()
+        attempt_t0 = time.perf_counter()
         if backoff > 0:
             time.sleep(backoff)
         self.attempt += 1
@@ -161,8 +162,28 @@ class _RequestContext:
             )
         except Exception:
             return None
+        excluded = sorted(self.tried)
         self.replica_id = rid
         self.tried.add(rid)
+        from ..util.metrics import record_serve_retry
+
+        # the retry counter tags the OUTCOME replica (where the request
+        # went), so it counts only after the pick succeeds
+        record_serve_retry(self.deployment, type(cause).__name__, replica=rid)
+        _events.record_event(
+            _events.REQUEST_RETRY, deployment=self.deployment,
+            reason=type(cause).__name__, attempt=self.attempt,
+            replica=rid, excluded=excluded,
+        )
+        # sibling attempt span under the request's trace: one per failover,
+        # tagged with the replicas already excluded and the backoff burned
+        _tracing.emit_span(
+            "serve.attempt", (self.metadata or {}).get("trace_ctx"),
+            attempt_wall, time.perf_counter() - attempt_t0,
+            deployment=self.deployment, attempt=self.attempt,
+            reason=type(cause).__name__, replica=rid,
+            excluded=excluded, backoff_s=backoff,
+        )
         return _submit(replica, self)
 
 
@@ -190,6 +211,20 @@ class DeploymentResponse:
     def __init__(self, ref, ctx: Optional[_RequestContext] = None):
         self._ref = ref
         self._ctx = ctx
+
+    def replica_id(self) -> Optional[str]:
+        """The replica that served (or is serving) this request — AFTER
+        failover, the replica the final resubmission landed on, not the
+        one originally routed to. None for bare refs with no context."""
+        return self._ctx.replica_id if self._ctx is not None else None
+
+    def trace_id(self) -> Optional[str]:
+        """The request's trace id (joins caller-side latency with the
+        server-side spans); None when the request was not traced."""
+        if self._ctx is None:
+            return None
+        tctx = (self._ctx.metadata or {}).get("trace_ctx")
+        return tctx.get("trace_id") if tctx else None
 
     def result(self, timeout_s: Optional[float] = None):
         while True:
@@ -233,6 +268,17 @@ class DeploymentResponseGenerator:
         self._timeout_s = timeout_s
         self._ctx = ctx
         self._consumed = 0
+
+    def replica_id(self) -> Optional[str]:
+        """See DeploymentResponse.replica_id."""
+        return self._ctx.replica_id if self._ctx is not None else None
+
+    def trace_id(self) -> Optional[str]:
+        """See DeploymentResponse.trace_id."""
+        if self._ctx is None:
+            return None
+        tctx = (self._ctx.metadata or {}).get("trace_ctx")
+        return tctx.get("trace_id") if tctx else None
 
     def __iter__(self):
         return self
@@ -486,10 +532,24 @@ class DeploymentHandle:
         if timeout_s is None:
             timeout_s = router_cfg.get("default_timeout_s", 60.0)
         deadline_ts = time.time() + timeout_s if timeout_s else None
+        trace_ctx = _tracing.inject_context()  # None on the untraced path
+        route_wall = time.time()
+        route_t0 = time.perf_counter()
         rid, replica = router.pick(
             self._deployment, affinity, deadline_ts=deadline_ts
         )
+        if trace_ctx is not None:
+            _tracing.emit_span(
+                "serve.route", trace_ctx, route_wall,
+                time.perf_counter() - route_t0,
+                deployment=self._deployment, replica=rid,
+                affinity=affinity is not None,
+            )
         metadata: Dict[str, Any] = {}
+        if trace_ctx is not None:
+            # the trace rides the request: the replica adopts it so its
+            # admission/engine/kvcache spans join this caller's trace
+            metadata["trace_ctx"] = trace_ctx
         if self._multiplexed_model_id:
             metadata["multiplexed_model_id"] = self._multiplexed_model_id
         if affinity is not None:

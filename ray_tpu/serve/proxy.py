@@ -119,6 +119,22 @@ class HTTPProxy:
         return timeout_s if timeout_s > 0 else None
 
     @staticmethod
+    def _trace_context(request) -> Optional[dict]:
+        """Mint the request's trace at the ingress: honor an inbound
+        ``X-Trace-Id`` (caller-chosen id — loadgen/bench join their
+        records to server spans with it), else start a fresh trace when
+        this process traces. None on the untraced path — requests with no
+        header and tracing off cost nothing."""
+        from ..util import tracing
+
+        raw = request.headers.get("X-Trace-Id")
+        if raw:
+            return tracing.new_trace_context(raw.strip()[:64])
+        if tracing.is_tracing_enabled():
+            return tracing.new_trace_context()
+        return None
+
+    @staticmethod
     def _error_response(exc: Exception):
         """Map typed serve errors onto HTTP semantics: backpressure sheds
         are 503 + Retry-After (the client should back off and retry),
@@ -170,20 +186,30 @@ class HTTPProxy:
                 except json.JSONDecodeError:
                     body = raw.decode("utf-8", "replace")
         timeout_s = self._request_timeout_s(request)
+        trace_ctx = self._trace_context(request)
         if info.get("stream"):
             return await self._handle_stream(request, app_name, body,
-                                             timeout_s)
+                                             timeout_s, trace_ctx)
         # forward to the app's ingress deployment off-loop (the handle API
         # is blocking); one thread per in-flight request keeps the proxy
         # loop responsive
         result = await asyncio.get_event_loop().run_in_executor(
-            None, self._call_ingress, app_name, path, prefix, body, timeout_s
+            None, self._call_ingress, app_name, path, prefix, body, timeout_s,
+            trace_ctx,
+        )
+        # echo the trace id so callers can join their latency record with
+        # the server-side spans (`ray_tpu timeline`)
+        headers = (
+            {"X-Trace-Id": trace_ctx["trace_id"]} if trace_ctx else None
         )
         if isinstance(result, Exception):
-            return self._error_response(result)
+            resp = self._error_response(result)
+            if headers:
+                resp.headers.update(headers)
+            return resp
         if isinstance(result, (dict, list, int, float, str, bool)) or result is None:
-            return web.json_response({"result": result})
-        return web.Response(body=bytes(result))
+            return web.json_response({"result": result}, headers=headers)
+        return web.Response(body=bytes(result), headers=headers)
 
     _INGRESS_TTL_S = 5.0
 
@@ -219,18 +245,27 @@ class HTTPProxy:
         return handle
 
     def _call_ingress(self, app_name: str, path: str, prefix: str, body,
-                      timeout_s: Optional[float] = None):
+                      timeout_s: Optional[float] = None,
+                      trace_ctx: Optional[dict] = None):
         # the deadline rides through the handle into the replica; the
         # result() wait is bounded by it (default 60 s — no more hardcoded
         # proxy timeout disagreeing with the request's actual budget). The
         # handle absorbs replica deaths/drains (and sheds, per the
         # deployment's RequestRouterConfig); what still escapes maps to
         # typed HTTP statuses in _error_response.
+        from ..util import tracing
+
         try:
             handle = self._get_handle(app_name).options(
                 timeout_s=timeout_s
             ) if timeout_s is not None else self._get_handle(app_name)
-            return handle.remote(body).result()
+            # the proxy span is the trace's top: route/attempt/replica
+            # spans parent under it (this runs on an executor thread, so
+            # the task-context install inside is thread-safe)
+            with tracing.request_span(
+                "serve.proxy", trace_ctx, app=app_name, path=path
+            ):
+                return handle.remote(body).result()
         except Exception as e:  # noqa: BLE001
             return e
 
@@ -280,7 +315,8 @@ class HTTPProxy:
             stop.set()
 
     async def _handle_stream(self, request, app_name: str, body,
-                             timeout_s: Optional[float] = None):
+                             timeout_s: Optional[float] = None,
+                             trace_ctx: Optional[dict] = None):
         """Generator ingress -> chunked HTTP: newline-delimited JSON, or SSE
         when the client asks for text/event-stream (reference: proxy
         streaming of DeploymentResponseGenerator outputs). Teardown (client
@@ -292,13 +328,25 @@ class HTTPProxy:
         sse = "text/event-stream" in request.headers.get("Accept", "")
         resp = web.StreamResponse()
         resp.content_type = "text/event-stream" if sse else "application/x-ndjson"
+        if trace_ctx:
+            resp.headers["X-Trace-Id"] = trace_ctx["trace_id"]
         await resp.prepare(request)
 
         def make_gen():
+            from ..util import tracing
+
             opts = {"stream": True}
             if timeout_s is not None:
                 opts["timeout_s"] = timeout_s
-            return self._get_handle(app_name).options(**opts).remote(body)
+            handle = self._get_handle(app_name).options(**opts)
+            if trace_ctx is None:
+                return handle.remote(body)
+            # covers submission only (items stream on after it closes);
+            # the replica-side stream span covers the generation itself
+            with tracing.request_span(
+                "serve.proxy", trace_ctx, app=app_name, stream=True
+            ):
+                return handle.remote(body)
 
         from contextlib import aclosing
 

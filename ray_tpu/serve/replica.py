@@ -144,7 +144,12 @@ class Replica:
         shedding must cost milliseconds, not a timeout."""
         if self._draining:
             from ..exceptions import ReplicaDrainingError
+            from ..util import events as _events
 
+            _events.record_event(
+                _events.DRAIN_REJECTED, deployment=self._deployment_name,
+                replica=self._replica_id,
+            )
             raise ReplicaDrainingError(self._replica_id)
         self._check_doa(metadata)
         if self._ongoing < self._max_ongoing:
@@ -152,10 +157,16 @@ class Replica:
             return
         if self._queued >= self._max_queued:
             from ..exceptions import BackPressureError
+            from ..util import events as _events
             from ..util.metrics import record_serve_shed
 
             self._shed_total += 1
             record_serve_shed(self._deployment_name)
+            _events.record_event(
+                _events.REQUEST_SHED, deployment=self._deployment_name,
+                replica=self._replica_id, ongoing=self._ongoing,
+                queued=self._queued,
+            )
             raise BackPressureError(
                 replica_id=self._replica_id,
                 ongoing=self._ongoing,
@@ -172,7 +183,13 @@ class Replica:
             while True:
                 if self._draining:
                     from ..exceptions import ReplicaDrainingError
+                    from ..util import events as _events
 
+                    _events.record_event(
+                        _events.DRAIN_REJECTED,
+                        deployment=self._deployment_name,
+                        replica=self._replica_id, queued=True,
+                    )
                     raise ReplicaDrainingError(self._replica_id)
                 self._check_doa(metadata)
                 if self._ongoing < self._max_ongoing:
@@ -239,38 +256,74 @@ class Replica:
 
     async def handle_request(self, method: str, args: tuple, kwargs: dict,
                              metadata: Optional[dict] = None):
+        from ..util import tracing as _tracing
+        from ..util import watchdog as _watchdog
         from ..util.metrics import record_serve_ttft
 
+        tctx = (metadata or {}).get("trace_ctx")
         t0 = time.perf_counter()
-        await self._admit(metadata)
-        self._note_affinity(metadata)
+        wd_token = _watchdog.watch(
+            "serve.request", timeout_s=(metadata or {}).get("timeout_s"),
+            deployment=self._deployment_name, replica=self._replica_id,
+        )
         try:
-            fn, args, kwargs = await self._prepare_call(
-                method, args, kwargs, metadata
-            )
-            if inspect.iscoroutinefunction(fn):
-                result = await fn(*args, **kwargs)
-            else:
-                # sync user code must not block the worker's event loop (it
-                # services RPC + heartbeats); run it on the request pool. The
-                # context carries the multiplexed model id across the thread
-                # hop.
-                import contextvars
+            # adopt the caller's trace: every span below (and anything user
+            # code opens — the engine, kvcache) joins the request's trace
+            with _tracing.request_span(
+                "serve.replica", tctx, deployment=self._deployment_name,
+                replica=self._replica_id, method=method or "__call__",
+            ) as span_ctx:
+                admit_wall = time.time()
+                try:
+                    await self._admit(metadata)
+                except BaseException as exc:
+                    if span_ctx is not None:
+                        _tracing.emit_span(
+                            "serve.admission", span_ctx, admit_wall,
+                            time.perf_counter() - t0,
+                            rejected=type(exc).__name__,
+                        )
+                    raise
+                # admission span covers the bounded queue wait on purpose:
+                # that wait IS the stage a slow request spent here
+                if span_ctx is not None:
+                    _tracing.emit_span(
+                        "serve.admission", span_ctx, admit_wall,
+                        time.perf_counter() - t0,
+                        ongoing=self._ongoing, queued=self._queued,
+                    )
+                self._note_affinity(metadata)
+                try:
+                    fn, args, kwargs = await self._prepare_call(
+                        method, args, kwargs, metadata
+                    )
+                    if inspect.iscoroutinefunction(fn):
+                        result = await fn(*args, **kwargs)
+                    else:
+                        # sync user code must not block the worker's event
+                        # loop (it services RPC + heartbeats); run it on the
+                        # request pool. The context carries the multiplexed
+                        # model id AND the active trace context across the
+                        # thread hop.
+                        import contextvars
 
-                loop = asyncio.get_running_loop()
-                ctx = contextvars.copy_context()
-                result = await loop.run_in_executor(
-                    self._pool, lambda: ctx.run(fn, *args, **kwargs)
-                )
-            # unary TTFT = first (and only) output; queue wait is included
-            # on purpose — that is the latency the caller experiences and
-            # the signal the autoscaler scales on
-            record_serve_ttft(
-                self._deployment_name, time.perf_counter() - t0
-            )
-            return result
+                        loop = asyncio.get_running_loop()
+                        ctx = contextvars.copy_context()
+                        result = await loop.run_in_executor(
+                            self._pool, lambda: ctx.run(fn, *args, **kwargs)
+                        )
+                    # unary TTFT = first (and only) output; queue wait is
+                    # included on purpose — that is the latency the caller
+                    # experiences and the signal the autoscaler scales on
+                    record_serve_ttft(
+                        self._deployment_name, time.perf_counter() - t0,
+                        trace_id=span_ctx["trace_id"] if span_ctx else None,
+                    )
+                    return result
+                finally:
+                    self._release()
         finally:
-            self._release()
+            _watchdog.unwatch(wd_token)
 
     async def handle_request_stream(self, method: str, args: tuple,
                                     kwargs: dict,
@@ -280,63 +333,116 @@ class Replica:
         method must be a (sync or async) generator; every yielded item ships
         to the caller through the runtime's streaming-generator machinery as
         soon as it exists."""
+        from ..util import tracing as _tracing
+        from ..util import watchdog as _watchdog
         from ..util.metrics import record_serve_ttft
 
         _SENTINEL = object()
+        tctx = (metadata or {}).get("trace_ctx")
+        # async generator: a request_span set/reset token cannot bracket
+        # the yields (each step may run under a different caller context),
+        # so the stream span's identity is minted up front and recorded
+        # explicitly when the stream ends
+        span_ctx = _tracing.child_context(tctx)
         t0 = time.perf_counter()
+        wall0 = time.time()
         first_emitted = False
 
         def _note_first():
             nonlocal first_emitted
             if not first_emitted:
                 first_emitted = True
+                ttft = time.perf_counter() - t0
                 record_serve_ttft(
-                    self._deployment_name, time.perf_counter() - t0
+                    self._deployment_name, ttft,
+                    trace_id=span_ctx["trace_id"] if span_ctx else None,
                 )
+                if span_ctx is not None:
+                    # streaming first-token stage: admission to first item
+                    _tracing.emit_span(
+                        "serve.first_token", span_ctx, wall0, ttft,
+                        deployment=self._deployment_name,
+                        replica=self._replica_id,
+                    )
 
-        await self._admit(metadata)
-        self._note_affinity(metadata)
+        wd_token = _watchdog.watch(
+            "serve.request_stream",
+            timeout_s=(metadata or {}).get("timeout_s"),
+            deployment=self._deployment_name, replica=self._replica_id,
+        )
         try:
-            fn, args, kwargs = await self._prepare_call(
-                method, args, kwargs, metadata
-            )
-            if inspect.isasyncgenfunction(fn):
-                async for item in fn(*args, **kwargs):
+            admit_wall = time.time()
+            try:
+                await self._admit(metadata)
+            except BaseException as exc:
+                if span_ctx is not None:
+                    _tracing.emit_span(
+                        "serve.admission", span_ctx, admit_wall,
+                        time.perf_counter() - t0, rejected=type(exc).__name__,
+                    )
+                raise
+            if span_ctx is not None:
+                _tracing.emit_span(
+                    "serve.admission", span_ctx, admit_wall,
+                    time.perf_counter() - t0,
+                    ongoing=self._ongoing, queued=self._queued,
+                )
+            self._note_affinity(metadata)
+            try:
+                fn, args, kwargs = await self._prepare_call(
+                    method, args, kwargs, metadata
+                )
+                if inspect.isasyncgenfunction(fn):
+                    async for item in fn(*args, **kwargs):
+                        _note_first()
+                        yield item
+                    return
+                if inspect.iscoroutinefunction(fn):
+                    raise TypeError(
+                        f"stream=True requires a generator method; "
+                        f"{method!r} is a coroutine function"
+                    )
+                import contextvars
+
+                loop = asyncio.get_running_loop()
+                ctx = contextvars.copy_context()
+                if span_ctx is not None:
+                    # install the stream's span as the copied context's
+                    # task context: generator steps below run under ctx, so
+                    # engine/kvcache spans parent to this stream
+                    ctx.run(_tracing._task_context.set, span_ctx)
+                gen = await loop.run_in_executor(
+                    self._pool, lambda: ctx.run(fn, *args, **kwargs)
+                )
+                if not inspect.isgenerator(gen):
+                    raise TypeError(
+                        f"stream=True requires a generator method; {method!r} "
+                        f"returned {type(gen).__name__}"
+                    )
+                # drive the sync generator on the pool: each next() may block
+                # on user compute and must stay off the worker's event loop.
+                # Every step runs under the copied context — generator bodies
+                # see the context active at each next(), not at creation, so
+                # a bare next() would drop the multiplexed-model-id var.
+                while True:
+                    item = await loop.run_in_executor(
+                        self._pool, lambda: ctx.run(next, gen, _SENTINEL)
+                    )
+                    if item is _SENTINEL:
+                        return
                     _note_first()
                     yield item
-                return
-            if inspect.iscoroutinefunction(fn):
-                raise TypeError(
-                    f"stream=True requires a generator method; "
-                    f"{method!r} is a coroutine function"
-                )
-            import contextvars
-
-            loop = asyncio.get_running_loop()
-            ctx = contextvars.copy_context()
-            gen = await loop.run_in_executor(
-                self._pool, lambda: ctx.run(fn, *args, **kwargs)
-            )
-            if not inspect.isgenerator(gen):
-                raise TypeError(
-                    f"stream=True requires a generator method; {method!r} "
-                    f"returned {type(gen).__name__}"
-                )
-            # drive the sync generator on the pool: each next() may block on
-            # user compute and must stay off the worker's event loop. Every
-            # step runs under the copied context — generator bodies see the
-            # context active at each next(), not at creation, so a bare
-            # next() would drop the multiplexed-model-id var.
-            while True:
-                item = await loop.run_in_executor(
-                    self._pool, lambda: ctx.run(next, gen, _SENTINEL)
-                )
-                if item is _SENTINEL:
-                    return
-                _note_first()
-                yield item
+            finally:
+                self._release()
         finally:
-            self._release()
+            _watchdog.unwatch(wd_token)
+            if span_ctx is not None:
+                _tracing.emit_closed_span(
+                    "serve.replica_stream", span_ctx, tctx, wall0,
+                    time.perf_counter() - t0,
+                    deployment=self._deployment_name,
+                    replica=self._replica_id, method=method or "__call__",
+                )
 
     # -- control plane -------------------------------------------------------
 

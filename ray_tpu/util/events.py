@@ -1,0 +1,173 @@
+"""Flight recorder: always-on per-process ring buffer of structured events.
+
+Role-equivalent of Ray's export-event / state-transition logs, rebuilt for
+post-mortem forensics: every process keeps a bounded ring of cheap
+structured events (replica state transitions, autoscale decisions,
+collective epochs, admission blocks, drain rejections, watchdog stack
+captures) and a background thread streams the suffix to the GCS event
+store about once a second. Because the push is continuous, the GCS copy
+survives a SIGKILL of the recording process — post-mortem queries
+(``ray_tpu events`` / ``/api/events``) read the cluster store, not the
+dead process. ``dump_events()`` forces a synchronous flush for the
+graceful-crash path.
+
+Recording is unconditional (unlike spans, which are trace-gated): one
+dict append under a lock per event, a few events per state transition —
+cheap enough to never turn off.
+
+Event names are the taxonomy. Every name is an :class:`EventName`
+constant declared in THIS module, exactly once, in snake_case — enforced
+by the RT007 analysis rule (the flight-recorder twin of RT004's metrics
+registry), so ``ray_tpu events --name X`` and the docs' event table can't
+drift from the code.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()
+_events: List[dict] = []
+_events_cap = int(os.environ.get("RAY_TPU_EVENTS_CAP", "4096"))
+_flush_cursor = 0
+_flush_lock = threading.Lock()  # serializes read-push-trim in flush_events
+_pusher_started = False
+
+# -- event-name registry (RT007 home) ----------------------------------------
+
+_registry: Dict[str, "EventName"] = {}
+_registry_lock = threading.Lock()
+
+
+class EventName(str):
+    """A registered flight-recorder event name. Constructing one registers
+    it process-wide (keyed by name, like the metrics registry), and RT007
+    requires every construction to be a literal snake_case string in
+    util/events.py — the single place the event taxonomy lives."""
+
+    def __new__(cls, name: str) -> "EventName":
+        obj = super().__new__(cls, name)
+        with _registry_lock:
+            _registry[name] = obj
+        return obj
+
+
+def registered_event_names() -> List[str]:
+    """Sorted taxonomy, for the docs table and the registry tests."""
+    with _registry_lock:
+        return sorted(_registry)
+
+
+# The taxonomy. Emitters import these constants; a bare-string
+# record_event("typo_name", ...) still records (forensics must never
+# throw) but the name won't pass RT007 review at the emit site's import.
+REPLICA_STATE = EventName("replica_state")
+REPLICA_START = EventName("replica_start")
+REPLICA_STOP = EventName("replica_stop")
+AUTOSCALE_DECISION = EventName("autoscale_decision")
+COLLECTIVE_EPOCH = EventName("collective_epoch")
+ADMISSION_BLOCKED = EventName("admission_blocked")
+DRAIN_REJECTED = EventName("drain_rejected")
+REQUEST_RETRY = EventName("request_retry")
+REQUEST_SHED = EventName("request_shed")
+ENGINE_ADMISSION_BLOCKED = EventName("engine_admission_blocked")
+WORKER_DEATH = EventName("worker_death")
+WATCHDOG_STUCK = EventName("watchdog_stuck")
+WATCHDOG_RECOVERED = EventName("watchdog_recovered")
+
+
+# -- recording ----------------------------------------------------------------
+
+
+def record_event(name: str, **fields) -> None:
+    """Append one structured event to the ring. Always on; one locked
+    append per call. ``fields`` must be JSON-serializable (they travel
+    through the GCS RPC envelope)."""
+    ev = {"ts": time.time(), "pid": os.getpid(), "name": str(name)}
+    ev.update(fields)
+    global _flush_cursor
+    with _lock:
+        _events.append(ev)
+        if len(_events) > _events_cap:
+            # ring semantics: drop the oldest, keep the flush cursor
+            # aligned with the surviving suffix
+            drop = len(_events) - _events_cap
+            del _events[:drop]
+            _flush_cursor = max(0, _flush_cursor - drop)
+    _ensure_event_pusher()
+
+
+def get_events(name: Optional[str] = None) -> List[dict]:
+    with _lock:
+        out = list(_events)
+    if name is not None:
+        out = [e for e in out if e.get("name") == name]
+    return out
+
+
+def clear_events() -> None:
+    global _flush_cursor
+    with _lock:
+        _events.clear()
+        _flush_cursor = 0
+
+
+# -- streaming to the GCS event store ----------------------------------------
+
+
+def flush_events() -> None:
+    """Push events recorded since the last flush to the GCS event store.
+    Unlike tracing.flush_spans this does NOT trim flushed events — the
+    local ring stays intact (bounded by the cap) so in-process dumps and
+    the watchdog's recent-history checks keep working; the cursor just
+    advances past the pushed suffix. Mirrors flush_spans otherwise."""
+    global _flush_cursor
+    from .. import _worker_api
+
+    worker = _worker_api.maybe_get_core_worker()
+    if worker is None:
+        return
+    with _flush_lock:
+        with _lock:
+            batch = _events[_flush_cursor:]
+            cursor = len(_events)
+        if not batch:
+            return
+        try:
+            _worker_api.run_on_worker_loop(
+                worker.client_pool.get(*worker.gcs_address).call(
+                    "report_events", batch
+                ),
+                timeout=5,
+            )
+            with _lock:
+                _flush_cursor = max(_flush_cursor, min(cursor, len(_events)))
+        except Exception:
+            pass  # forensics are best-effort; never take down the caller
+
+
+def dump_events(reason: str = "") -> None:
+    """Synchronous flush for the graceful-crash path (actor death
+    handlers, atexit): record a marker, then push everything now rather
+    than waiting for the 1s pusher tick."""
+    if reason:
+        record_event(WORKER_DEATH, reason=reason, synthetic=False)
+    flush_events()
+
+
+def _ensure_event_pusher() -> None:
+    global _pusher_started
+    with _lock:
+        if _pusher_started:
+            return
+        _pusher_started = True
+
+    def _loop():
+        while True:
+            time.sleep(1.0)
+            flush_events()
+
+    threading.Thread(target=_loop, daemon=True, name="event-push").start()

@@ -85,16 +85,26 @@ class Histogram(Metric):
         self._boundaries = sorted(boundaries or [0.1, 1, 10, 100, 1000])
         self._counts: Dict[Tuple[str, ...], List[int]] = {}
         self._sums: Dict[Tuple[str, ...], float] = {}
+        # per-bucket exemplars (OpenMetrics-style): the last trace_id (and
+        # its value) observed in each bucket, so a bad p99 bucket links to
+        # a concrete trace in the span store instead of just a count
+        self._exemplars: Dict[Tuple[str, ...], Dict[int, dict]] = {}
 
-    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None,
+                exemplar: Optional[str] = None):
         key = self._tag_tuple(tags)
         with self._lock:
             counts = self._counts.setdefault(
                 key, [0] * (len(self._boundaries) + 1)
             )
-            counts[bisect.bisect_left(self._boundaries, value)] += 1
+            bucket = bisect.bisect_left(self._boundaries, value)
+            counts[bucket] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._values[key] = self._sums[key]
+            if exemplar:
+                self._exemplars.setdefault(key, {})[bucket] = {
+                    "trace_id": exemplar, "value": value, "ts": time.time(),
+                }
 
     def _snapshot(self) -> dict:
         snap = super()._snapshot()
@@ -103,6 +113,11 @@ class Histogram(Metric):
             snap["counts"] = {
                 json.dumps(k): v for k, v in self._counts.items()
             }
+            if self._exemplars:
+                snap["exemplars"] = {
+                    json.dumps(k): dict(v)
+                    for k, v in self._exemplars.items()
+                }
         return snap
 
 
@@ -678,7 +693,7 @@ def _ensure_serve_ft_metrics() -> dict:
                         "Handle-side failover resubmissions (replica "
                         "death, drain race, transport failure, or "
                         "retried backpressure)",
-                        tag_keys=("deployment", "reason"),
+                        tag_keys=("deployment", "reason", "replica"),
                     ),
                     "shed": Counter(
                         "serve_shed_total",
@@ -703,9 +718,12 @@ def _ensure_serve_ft_metrics() -> dict:
     return _serve_ft_metrics
 
 
-def record_serve_retry(deployment: str, reason: str):
+def record_serve_retry(deployment: str, reason: str, replica: str = ""):
+    """``replica`` is the OUTCOME replica the retry was resubmitted to —
+    tagging it answers "which replica absorbed the failover" without
+    joining against the span store."""
     _ensure_serve_ft_metrics()["retry"].inc(
-        1.0, {"deployment": deployment, "reason": reason}
+        1.0, {"deployment": deployment, "reason": reason, "replica": replica}
     )
 
 
@@ -1129,9 +1147,12 @@ def _ensure_serve_latency_metrics() -> dict:
     return _serve_latency_metrics
 
 
-def record_serve_ttft(deployment: str, seconds: float):
+def record_serve_ttft(deployment: str, seconds: float,
+                      trace_id: Optional[str] = None):
+    """``trace_id`` (when the request is traced) becomes the bucket's
+    exemplar, so a bad p99 bucket links to a concrete trace."""
     _ensure_serve_latency_metrics()["ttft"].observe(
-        seconds, {"deployment": deployment}
+        seconds, {"deployment": deployment}, exemplar=trace_id
     )
 
 
@@ -1178,6 +1199,36 @@ def serve_latency_summary(payloads: List[dict]) -> Dict[str, object]:
 def _scaled_quantile(m: dict, q: float, scale: float) -> Optional[float]:
     est = quantile_from_buckets(m["boundaries"], m["counts"], q)
     return None if est is None else est * scale
+
+
+# ---------------------------------------------------------------------------
+# Hang-watchdog plane (util/watchdog.py): how many watched units of work
+# (replica requests, collective epochs) are currently past their stuck
+# threshold in this process. A nonzero value is the "look at the flight
+# recorder's watchdog_stuck stack captures" signal.
+# ---------------------------------------------------------------------------
+
+_watchdog_metrics: Optional[dict] = None
+_watchdog_init_lock = threading.Lock()
+
+
+def _ensure_watchdog_metrics() -> dict:
+    global _watchdog_metrics
+    if _watchdog_metrics is None:
+        with _watchdog_init_lock:
+            if _watchdog_metrics is None:
+                _watchdog_metrics = {
+                    "stuck": Gauge(
+                        "stuck_requests",
+                        "Watched in-flight work currently past its hang "
+                        "threshold (deadline x watchdog multiple)",
+                    ),
+                }
+    return _watchdog_metrics
+
+
+def set_stuck_requests(count: int):
+    _ensure_watchdog_metrics()["stuck"].set(float(count))
 
 
 # ---------------------------------------------------------------------------

@@ -221,6 +221,16 @@ def autoscale_log(limit: int = 100) -> List[Dict[str, Any]]:
     return events[-max(0, limit):]
 
 
+def list_events(
+    limit: int = 1000, name: Optional[str] = None
+) -> List[Dict[str, Any]]:
+    """Most recent flight-recorder events from the GCS event store, oldest
+    first, optionally filtered by event name (`ray_tpu events`,
+    ``/api/events``). Because every process streams its ring continuously,
+    this works for SIGKILLed processes too — the post-mortem path."""
+    return _gcs_call("list_events", limit, name)
+
+
 def list_weights() -> List[Dict[str, Any]]:
     """Weight-plane registry rows: every published model with its head
     version, resident/pinned versions, tombstone count, and broadcast-tree

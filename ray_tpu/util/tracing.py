@@ -160,6 +160,98 @@ def task_execution_span(name: str, ctx: Optional[Dict[str, str]], **attrs):
         )
 
 
+def new_trace_context(trace_id: Optional[str] = None) -> Dict[str, str]:
+    """Mint a root request context (proxy ingress: honor an inbound
+    X-Trace-Id or start a fresh trace). The empty span_id marks it a trace
+    root; the first span opened under it becomes the top of the tree."""
+    return {"trace_id": trace_id or _new_id(), "span_id": ""}
+
+
+@contextmanager
+def request_span(name: str, ctx: Optional[Dict[str, str]],
+                 category: str = "serve", **attrs):
+    """Adopt a propagated request context (or mint one when this process
+    traces statically) around one serve-request stage, recording the stage
+    span. Yields the active span context so callers can read the trace_id
+    for histogram exemplars / response headers. Installed in the
+    coroutine-local task context, so nested ``.remote()`` submissions and
+    ``trace_span`` blocks opened downstream parent to this stage — the
+    serve-side twin of ``task_execution_span``.
+
+    ``ctx is None`` with static tracing off is the untraced hot path: no
+    allocation, no span, yields None.
+    """
+    if ctx is None and not _enabled:
+        yield None
+        return
+    span_ctx = {
+        "trace_id": (ctx or {}).get("trace_id") or _root_trace(),
+        "span_id": _new_id(),
+    }
+    token = _task_context.set(span_ctx)
+    start = time.perf_counter()
+    wall = time.time()
+    try:
+        yield span_ctx
+    finally:
+        _task_context.reset(token)
+        _record_span(
+            name, category, wall, time.perf_counter() - start,
+            span_ctx["trace_id"], span_ctx["span_id"],
+            (ctx or {}).get("span_id", ""), attrs,
+        )
+
+
+def child_context(ctx: Optional[Dict[str, str]]) -> Optional[Dict[str, str]]:
+    """Mint a child span context under ``ctx`` (or the root trace) WITHOUT
+    touching the coroutine-local task context — for async generators,
+    where a set/reset token pair cannot legally bracket the yields (each
+    step may run in a different caller context). Children parent to the
+    returned ctx as it streams; :func:`emit_closed_span` records the span
+    itself once the stream ends. None on the untraced path."""
+    if ctx is None and not _enabled:
+        return None
+    return {
+        "trace_id": (ctx or {}).get("trace_id") or _root_trace(),
+        "span_id": _new_id(),
+    }
+
+
+def emit_closed_span(name: str, span_ctx: Dict[str, str],
+                     parent_ctx: Optional[Dict[str, str]], start_wall: float,
+                     dur_s: float, category: str = "serve", **attrs) -> None:
+    """Record a span whose identity (:func:`child_context`) was minted
+    before it closed, so spans emitted while it was open could already
+    parent to it."""
+    _record_span(
+        name, category, start_wall, dur_s,
+        span_ctx["trace_id"], span_ctx["span_id"],
+        (parent_ctx or {}).get("span_id", ""), attrs,
+    )
+
+
+def emit_span(name: str, ctx: Optional[Dict[str, str]], start_wall: float,
+              dur_s: float, category: str = "serve",
+              **attrs) -> Optional[str]:
+    """Record one already-completed span against an explicit parent
+    context. For stages whose start and end happen on different threads
+    (the continuous-batching engine admits and retires requests under its
+    lock on whichever caller thread steps it), where no context manager
+    can bracket the interval. Returns the new span_id (usable as a parent
+    for follow-on stages), or None when the span was not recorded."""
+    if ctx is None:
+        if not _enabled:
+            return None
+        ctx = {"trace_id": _root_trace(), "span_id": ""}
+    span_id = _new_id()
+    _record_span(
+        name, category, start_wall, dur_s,
+        ctx.get("trace_id") or _root_trace(), span_id,
+        ctx.get("span_id", ""), attrs,
+    )
+    return span_id
+
+
 def _record_span(name, category, wall, dur_s, trace_id, span_id, parent_id,
                  attrs):
     span = {

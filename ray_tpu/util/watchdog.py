@@ -1,0 +1,149 @@
+"""Hang watchdog: detect in-flight work stuck past a deadline multiple.
+
+A per-process monitor. Request paths (serve replica requests, collective
+epochs) register a watch when work starts and drop it when work ends;
+a scan thread wakes about once a second and, for any watch whose elapsed
+time exceeds ``multiple x timeout``, captures every thread's Python stack
+(``sys._current_frames`` — the importable twin of ``faulthandler``'s
+output) into the flight recorder and raises the ``stuck_requests`` gauge.
+A watch that later completes emits a recovery event and lowers the gauge,
+so transient stalls are distinguishable from true hangs post-mortem.
+
+Tunables (env):
+- ``RAY_TPU_WATCHDOG_TIMEOUT_S``  default base timeout when the request
+  carries none (default 30).
+- ``RAY_TPU_WATCHDOG_MULTIPLE``   stuck threshold as a multiple of the
+  base timeout (default 3.0 — a request is "stuck", not merely slow,
+  only well past the point its caller gave up).
+- ``RAY_TPU_WATCHDOG_INTERVAL_S`` scan period (default 1.0).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, Optional
+
+from . import events
+
+_DEFAULT_TIMEOUT_S = float(os.environ.get("RAY_TPU_WATCHDOG_TIMEOUT_S", "30"))
+_DEFAULT_MULTIPLE = float(os.environ.get("RAY_TPU_WATCHDOG_MULTIPLE", "3.0"))
+_SCAN_INTERVAL_S = float(os.environ.get("RAY_TPU_WATCHDOG_INTERVAL_S", "1.0"))
+
+_lock = threading.Lock()
+_watches: Dict[int, dict] = {}
+_next_token = 0
+_scanner_started = False
+
+
+def watch(name: str, timeout_s: Optional[float] = None,
+          multiple: Optional[float] = None, **meta) -> int:
+    """Register in-flight work; returns a token for :func:`unwatch`.
+    ``timeout_s`` is the work's own deadline budget (request timeout,
+    collective timeout); the watch fires at ``multiple x timeout_s``."""
+    global _next_token
+    base = _DEFAULT_TIMEOUT_S if timeout_s is None else float(timeout_s)
+    mult = _DEFAULT_MULTIPLE if multiple is None else float(multiple)
+    entry = {
+        "name": name,
+        "start": time.monotonic(),
+        "deadline_s": max(base, 0.001) * max(mult, 1.0),
+        "meta": meta,
+        "stuck": False,
+    }
+    with _lock:
+        _next_token += 1
+        token = _next_token
+        _watches[token] = entry
+    _ensure_scanner()
+    return token
+
+
+def unwatch(token: int) -> None:
+    """Drop a watch (work finished — however it finished). Emits a
+    recovery event if the watch had already been reported stuck."""
+    with _lock:
+        entry = _watches.pop(token, None)
+    if entry is None:
+        return
+    if entry["stuck"]:
+        events.record_event(
+            events.WATCHDOG_RECOVERED,
+            watch=entry["name"],
+            elapsed_s=round(time.monotonic() - entry["start"], 3),
+            **entry["meta"],
+        )
+        _set_gauge()
+
+
+def stuck_count() -> int:
+    with _lock:
+        return sum(1 for e in _watches.values() if e["stuck"])
+
+
+def capture_stacks() -> str:
+    """Every thread's current Python stack as one formatted blob (what
+    faulthandler.dump_traceback prints, but capturable as a string)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    chunks = []
+    for tid, frame in sys._current_frames().items():
+        header = f"Thread {names.get(tid, '?')} ({tid}):"
+        chunks.append(
+            header + "\n" + "".join(traceback.format_stack(frame))
+        )
+    return "\n".join(chunks)
+
+
+def _scan_once() -> None:
+    now = time.monotonic()
+    newly_stuck = []
+    with _lock:
+        for entry in _watches.values():
+            if not entry["stuck"] and now - entry["start"] > entry["deadline_s"]:
+                entry["stuck"] = True
+                newly_stuck.append(entry)
+    if not newly_stuck:
+        return
+    # one stack capture per scan, shared by every watch that tripped this
+    # tick — capturing is the expensive part, and the stacks are identical
+    stacks = capture_stacks()
+    for entry in newly_stuck:
+        events.record_event(
+            events.WATCHDOG_STUCK,
+            watch=entry["name"],
+            elapsed_s=round(now - entry["start"], 3),
+            deadline_s=round(entry["deadline_s"], 3),
+            stacks=stacks,
+            **entry["meta"],
+        )
+    _set_gauge()
+
+
+def _set_gauge() -> None:
+    try:
+        from .metrics import set_stuck_requests
+
+        set_stuck_requests(stuck_count())
+    except Exception:
+        pass
+
+
+def _ensure_scanner() -> None:
+    global _scanner_started
+    with _lock:
+        if _scanner_started:
+            return
+        _scanner_started = True
+
+    def _loop():
+        while True:
+            time.sleep(_SCAN_INTERVAL_S)
+            try:
+                _scan_once()
+            except Exception:
+                pass  # the watchdog must never be the thing that hangs
+
+    threading.Thread(target=_loop, daemon=True, name="hang-watchdog").start()

@@ -296,12 +296,52 @@ def test_rt006_silent_with_reduce_or_default_init(tmp_path):
     assert result.findings == []
 
 
+# ---------------------------------------------------------------- RT007
+
+
+def test_rt007_flags_event_registry_violations(tmp_path):
+    result = _run(tmp_path, {
+        "util/events.py": """
+            class EventName(str):
+                pass
+
+            A = EventName("replica_state")
+            B = EventName("replica_state")
+            C = EventName("BadName")
+            D = EventName("dyn_" + "amic")
+        """,
+        "serve/mod.py": """
+            from ..util.events import EventName
+
+            E = EventName("stray_event")
+        """,
+    }, rules=["RT007"])
+    msgs = " | ".join(f.message for f in result.findings)
+    assert "declared 2 times" in msgs
+    assert "not snake_case" in msgs
+    assert "literal string" in msgs
+    assert "outside util/events.py" in msgs
+
+
+def test_rt007_ignores_unrelated_classes(tmp_path):
+    result = _run(tmp_path, {
+        "serve/mod.py": """
+            class EventName(str):
+                pass
+
+            local = EventName("Whatever Goes")
+        """,
+    }, rules=["RT007"])
+    # an unimported local class of the same name is not the registry
+    assert result.findings == []
+
+
 # ------------------------------------------------------------- framework
 
 
-def test_catalog_has_all_six_rules():
+def test_catalog_has_all_seven_rules():
     assert sorted(checker_catalog()) == [
-        "RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
+        "RT001", "RT002", "RT003", "RT004", "RT005", "RT006", "RT007",
     ]
 
 

@@ -174,3 +174,16 @@ def test_autoscale_endpoint(dashboard_cluster):
     summary = out["summary"]
     assert summary["scale_ups"] == 0.0 and summary["scale_downs"] == 0.0
     assert summary["decision_p50_s"] is None
+
+
+def test_events_endpoint(dashboard_cluster):
+    """/api/events serves the cluster flight recorder — the same GCS event
+    store `ray_tpu events` reads post-mortem."""
+    from ray_tpu.util import events
+
+    dash = dashboard_cluster
+    events.record_event(events.REPLICA_STATE, state="DASH_PROBE")
+    events.flush_events()  # deterministic: skip the 1s pusher tick
+    out = _get_json(dash.url + "/api/events")
+    assert isinstance(out["events"], list)
+    assert any(e.get("state") == "DASH_PROBE" for e in out["events"])

@@ -131,6 +131,74 @@ def test_tracing_disabled_overhead_guard(shutdown_only, monkeypatch):
     assert tracing.get_spans() == []  # plane fully dormant when disabled
 
 
+def test_serve_tracing_disabled_overhead_guard(shutdown_only, monkeypatch):
+    """The serve request path carries the same guarantee as tasks_sync:
+    with tracing off, handle round-trip throughput stays within 5% of a
+    baseline with the tracing hooks stubbed out, and the whole request
+    (handle -> replica) emits zero spans anywhere."""
+    import time as _time
+
+    monkeypatch.delenv("RAY_TPU_TRACE", raising=False)
+    from ray_tpu import serve
+    from ray_tpu.util import tracing
+
+    tracing._enabled = False
+    assert not tracing.is_tracing_enabled()
+    tracing.clear_spans()
+    ray_tpu.init(num_cpus=4)
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind(), name="perfguard", _proxy=False)
+    try:
+
+        def measure(n=40):
+            t0 = _time.perf_counter()
+            for i in range(n):
+                assert handle.remote(i).result(timeout_s=30) == i
+            return n / (_time.perf_counter() - t0)
+
+        measure(15)  # warm the router table + replica
+
+        real_enabled = tracing.is_tracing_enabled
+        real_inject = tracing.inject_context
+
+        def baseline_throughput():
+            tracing.is_tracing_enabled = lambda: False
+            tracing.inject_context = lambda: None
+            try:
+                return measure()
+            finally:
+                tracing.is_tracing_enabled = real_enabled
+                tracing.inject_context = real_inject
+
+        # interleave; pass when any attempt is within tolerance (single-box
+        # timing noise dwarfs the per-request None-check difference)
+        ratios = []
+        for _ in range(4):
+            base = baseline_throughput()
+            real = measure()
+            ratios.append(real / base)
+            if real >= 0.95 * base:
+                break
+        assert ratios[-1] >= 0.95, (
+            f"disabled-tracing serve path slower than baseline: {ratios}"
+        )
+        # zero spans: none recorded driver-side, none flushed from the
+        # replica to the GCS span store (its pusher runs on a 1s cadence)
+        assert tracing.get_spans() == []
+        _time.sleep(1.5)
+        cluster_spans = [
+            s for s in tracing.timeline() if s.get("span_id")
+        ]
+        assert cluster_spans == [], cluster_spans
+    finally:
+        serve.shutdown()
+
+
 def test_prefix_cache_prefill_computes_only_suffix():
     """Perf guard for the KV-cache plane (CPU-safe, counter-based): a
     repeated prompt must prefill ONLY the tokens past its cached prefix —
