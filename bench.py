@@ -885,6 +885,97 @@ def serve_autoscale():
         ray_tpu.shutdown()
 
 
+def chaos_soak():
+    """`python bench.py chaos_soak` — partition-chaos soak benchmark.
+
+    Replays the bundled ramp -> burst -> decay trace open loop against a
+    2-replica deployment while the rpc chaos mesh injects a 1% call
+    failure rate plus 25ms (+/-25ms jitter) of added latency on every
+    data-plane actor_task call leaving the driver. The handle's retry
+    envelope plus the retryable transport must absorb the faults: the
+    acceptance bar is >= 99.9% caller success with bounded tail
+    inflation. Reports outcomes, ttft p50/p99, and the serve_ft +
+    partition counter rollups. CPU backend: the transport path is
+    backend-independent."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import ray_tpu
+    from ray_tpu import loadgen, serve
+    from ray_tpu._internal import rpc as rt_rpc
+    from ray_tpu.util.metrics import partition_counters, serve_ft_counters
+
+    work_s, time_scale = 0.05, 0.5
+    chaos_spec = {
+        "seed": 7,
+        "rules": [{
+            "method": "actor_task", "fail": 0.01,
+            "delay_ms": 25, "jitter_ms": 25,
+        }],
+    }
+    ray_tpu.init(num_cpus=8)
+    try:
+        @serve.deployment(num_replicas=2, max_ongoing_requests=8,
+                          max_queued_requests=256)
+        class Worker:
+            def __call__(self, payload):
+                time.sleep(work_s)
+                return len(payload.get("token_ids", []))
+
+        handle = serve.run(Worker.bind(), name="soak", _proxy=False)
+        trace = loadgen.bundled_trace("ramp_burst_decay").scaled(time_scale)
+        passes = 3  # the bundled trace is short; soak it a few times over
+        rt_rpc.set_rpc_chaos(chaos_spec)
+        _log(
+            f"chaos mesh on (1% fail, 25ms +/- 25ms on actor_task); "
+            f"replaying {len(trace.requests)} requests x {passes} over "
+            f"{trace.duration_s:.1f}s each (time_scale={time_scale})"
+        )
+        gen = loadgen.LoadGenerator(
+            loadgen.HandleTarget(handle), max_inflight=64
+        )
+        runs = [gen.run(trace) for _ in range(passes)]
+        rt_rpc.set_rpc_chaos(None)
+        result = loadgen.LoadResult(
+            [r for run in runs for r in run.records], trace,
+            sum(run.wall_s for run in runs),
+        )
+
+        summary = result.summary()
+        failures = len(result.failures)
+        total = summary["requests"]
+        success = (total - failures) / total if total else 0.0
+        ft = serve_ft_counters()
+        partition = partition_counters()
+        _log(
+            f"{total} requests, {failures} failed; outcomes "
+            f"{summary['outcomes']}; handle retries {ft['retries']:.0f}, "
+            f"control-plane retries {partition['retries']:.0f}"
+        )
+        print(json.dumps({
+            "metric": "chaos_soak_success_rate",
+            "value": round(success, 4),
+            "unit": "fraction of requests completed under 1% injected rpc "
+                    "faults + 25ms jitter",
+            "requests": total,
+            "caller_failures": failures,
+            "outcomes": summary["outcomes"],
+            "ttft_p50_ms": summary.get("ttft_p50_ms"),
+            "ttft_p99_ms": summary.get("ttft_p99_ms"),
+            "max_lag_s": summary["max_lag_s"],
+            "handle_retries": ft["retries"],
+            "rpc_retry_total": partition["retries"],
+            "config": {
+                "trace": "ramp_burst_decay", "time_scale": time_scale,
+                "work_s": work_s, "chaos": chaos_spec, "backend": "cpu",
+            },
+        }))
+    finally:
+        rt_rpc.set_rpc_chaos(None)
+        ray_tpu.shutdown()
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "llm_prefix_cache":
         llm_prefix_cache()
@@ -894,6 +985,8 @@ if __name__ == "__main__":
         serve_churn()
     elif len(sys.argv) > 1 and sys.argv[1] == "serve_autoscale":
         serve_autoscale()
+    elif len(sys.argv) > 1 and sys.argv[1] == "chaos_soak":
+        chaos_soak()
     elif len(sys.argv) > 1:
         raise SystemExit(f"unknown bench mode {sys.argv[1]!r}")
     else:

@@ -82,6 +82,24 @@ class Config:
     # --- fault tolerance ---
     health_check_period_s: float = 1.0
     health_check_timeout_s: float = 10.0
+    # --- partition tolerance ---
+    # A node whose resource reports stop arriving is actively probed
+    # (raylet ping) once its report age exceeds this; a failed probe marks
+    # it SUSPECT (serve stops routing new replicas there) while the full
+    # health_check_timeout_s window still governs DEAD.
+    suspect_after_s: float = 3.0
+    # A raylet that hasn't completed a successful GCS report for this long
+    # self-fences: refuses new leases, replicas on the node reject work with
+    # NodeFencedError, collectives abort — preventing split-brain while the
+    # GCS re-schedules elsewhere. Unfences on the next successful report.
+    fence_after_s: float = 5.0
+    # How often every process re-reads the cluster chaos-mesh spec
+    # (CHAOS_NET_SPEC key) from the GCS.
+    chaos_poll_period_s: float = 1.0
+    # Per-link circuit breaker: consecutive transport failures before the
+    # circuit opens, and how long it stays open before a half-open probe.
+    rpc_breaker_threshold: int = 5
+    rpc_breaker_cooldown_s: float = 2.0
     # Owner-side liveness probe of registered borrowers while a free is
     # deferred on them (reference: WaitForRefRemoved long-poll,
     # reference_counter.h:44 — polled here so a crashed borrower cannot pin

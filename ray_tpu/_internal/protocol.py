@@ -142,6 +142,12 @@ class TaskSpec:
     # an old generation's seq to a fresh executor)
     sequence_number: int = 0
     sequence_incarnation: int = 0
+    # lowest seq the caller has NOT yet resolved at send time: every seq
+    # below it is done caller-side and will never be (re)sent, so the
+    # executor may skip such a seq that never arrived (a send dropped by a
+    # partition leaves a hole the in-order queue would otherwise wait on
+    # forever)
+    sequence_watermark: int = 0
     # placement group this task is bound to
     placement_group_id: Optional[PlacementGroupID] = None
     placement_group_bundle_index: int = -1

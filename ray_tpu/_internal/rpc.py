@@ -33,6 +33,7 @@ import logging
 import pickle
 import random
 import struct
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -207,6 +208,16 @@ class _FrameBatcher:
     def enqueue(self, parts: List[Any]) -> asyncio.Future:
         """Send one encoded frame; returns a future resolving once the
         write (and its coalesced drain, when one is needed) completed."""
+        if self._writer.is_closing():
+            # Reconnect race: the recv loop's teardown closed this writer
+            # while a caller already past _ensure_connected was still headed
+            # here. Fail fast — enqueueing would strand the caller's future
+            # forever (the dead connection delivers no response).
+            fut = asyncio.get_event_loop().create_future()
+            fut.set_exception(
+                ConnectionResetError("connection closing; frame not sent")
+            )
+            return fut
         if self._drain_fut is None:
             # quiet connection: write now
             loop = asyncio.get_event_loop()
@@ -299,24 +310,245 @@ async def _consume_auth_preamble(reader: asyncio.StreamReader) -> bool:
 
 # ---------------------------------------------------------------------------
 # Chaos injection (reference: rpc/rpc_chaos.h, RAY_testing_rpc_failure)
+#
+# Two spec formats:
+#   legacy flat  {"method": prob}            -> server-side raise in _dispatch
+#                                               (exactly the old semantics)
+#   structured   {"seed": int, "rules": [...]} -> client-side fault mesh
+#                                               applied in call/call_oneway
+# A structured rule models one link-fault class and matches on
+# (method, src, dst): {"method": "name-or-*", "src": "node-hex-prefix-or-*",
+# "dst": "host:port-or-*", "fail": p, "delay_ms": f, "jitter_ms": f,
+# "blackhole": bool, "disconnect": p}. src is the caller's node identity
+# (RpcClient.chaos_src), dst the literal connect target, so directional
+# partitions (A->B drops while B->A flows) are expressible. All rng draws are
+# from one seeded Random under a lock: deterministic and thread-safe.
 # ---------------------------------------------------------------------------
 
-_chaos: Dict[str, float] = {}
+_chaos_lock = threading.Lock()
+_chaos: Dict[str, float] = {}  # legacy flat spec — injected server-side
 _chaos_rng = random.Random(0)
 
+# Methods the mesh never touches: the chaos spec itself distributes through
+# chaos_fetch, so healing a partition must propagate through the partition.
+_CHAOS_EXEMPT = frozenset({"chaos_fetch", "__register__"})
+_BLACKHOLE_MAX_S = 3600.0
 
-def set_rpc_chaos(spec: Dict[str, float], seed: int = 0):
-    """Configure per-method failure probabilities for testing."""
-    global _chaos_rng
-    _chaos.clear()
-    _chaos.update(spec)
-    _chaos_rng = random.Random(seed)
+
+class _ChaosRule:
+    __slots__ = (
+        "method", "src", "dst", "fail", "delay_ms", "jitter_ms",
+        "blackhole", "disconnect",
+    )
+
+    def __init__(self, raw: Dict[str, Any]):
+        self.method = str(raw.get("method", "*"))
+        self.src = str(raw.get("src", "*"))
+        self.dst = str(raw.get("dst", "*"))
+        self.fail = float(raw.get("fail", 0.0))
+        self.delay_ms = float(raw.get("delay_ms", 0.0))
+        self.jitter_ms = float(raw.get("jitter_ms", 0.0))
+        self.blackhole = bool(raw.get("blackhole", False))
+        self.disconnect = float(raw.get("disconnect", 0.0))
+
+    def matches(self, method: str, src: Optional[str], dst: str) -> bool:
+        if self.method != "*" and self.method != method:
+            return False
+        if self.src != "*" and not (src or "").startswith(self.src):
+            return False
+        if self.dst != "*" and self.dst != dst:
+            return False
+        return True
+
+
+class _ChaosState:
+    __slots__ = ("rules", "rng", "seed")
+
+    def __init__(self, rules: List[_ChaosRule], seed: int):
+        self.rules = rules
+        self.rng = random.Random(seed)
+        self.seed = seed
+
+
+_chaos_state: Optional[_ChaosState] = None
+
+
+def set_rpc_chaos(spec: Optional[Dict[str, Any]], seed: int = 0):
+    """Configure fault injection for testing. Accepts the legacy flat
+    ``{"method": prob}`` dict (server-side raises, unchanged semantics) or a
+    structured ``{"seed": ..., "rules": [...]}`` mesh spec (client-side
+    delay/fail/blackhole/disconnect/partition). An empty/None spec clears
+    both."""
+    global _chaos_rng, _chaos_state
+    spec = spec or {}
+    with _chaos_lock:
+        _chaos.clear()
+        if "rules" in spec or "seed" in spec:
+            rules = [_ChaosRule(r) for r in spec.get("rules", ())]
+            _chaos_state = (
+                _ChaosState(rules, int(spec.get("seed", seed))) if rules else None
+            )
+        else:
+            _chaos.update(spec)
+            _chaos_state = None
+        _chaos_rng = random.Random(seed)
+
+
+def get_rpc_chaos_active() -> bool:
+    return bool(_chaos) or _chaos_state is not None
 
 
 def _maybe_inject_failure(method: str):
-    p = _chaos.get(method)
-    if p and _chaos_rng.random() < p:
-        raise RpcError(f"injected failure for {method}")
+    if not _chaos or method in _CHAOS_EXEMPT:
+        return
+    with _chaos_lock:
+        p = _chaos.get(method)
+        if p and _chaos_rng.random() < p:
+            raise RpcError(f"injected failure for {method}")
+
+
+def _chaos_plan(
+    method: str, src: Optional[str], dst: str
+) -> Tuple[float, Optional[str]]:
+    """Evaluate the mesh for one outgoing call. Returns (delay_s, action)
+    where action is None | "fail" | "blackhole" | "disconnect"."""
+    state = _chaos_state
+    if state is None or method in _CHAOS_EXEMPT:
+        return 0.0, None
+    delay = 0.0
+    action: Optional[str] = None
+    with _chaos_lock:
+        if _chaos_state is not state:  # swapped under us: skip this draw
+            return 0.0, None
+        for rule in state.rules:
+            if not rule.matches(method, src, dst):
+                continue
+            if rule.delay_ms or rule.jitter_ms:
+                delay += (
+                    rule.delay_ms + state.rng.random() * rule.jitter_ms
+                ) / 1000.0
+            if action is None and rule.blackhole:
+                action = "blackhole"
+            if action is None and rule.fail and state.rng.random() < rule.fail:
+                action = "fail"
+            if (
+                action is None
+                and rule.disconnect
+                and state.rng.random() < rule.disconnect
+            ):
+                action = "disconnect"
+    return delay, action
+
+
+# ---------------------------------------------------------------------------
+# Per-link circuit breaker + retryable calls
+# (reference: retryable_grpc_client.h — server_unavailable_timeout /
+# retry-with-backoff on transient channel errors)
+# ---------------------------------------------------------------------------
+
+_BREAKER_THRESHOLD = 5
+_BREAKER_COOLDOWN_S = 2.0
+
+# Transport-level failures: what the breaker counts and retry_call retries.
+# Application exceptions raised by the remote handler travel as pickled
+# payloads of *their own* types and deliberately do not match.
+_TRANSIENT_RPC_ERRORS = (RpcError, asyncio.TimeoutError, TimeoutError, OSError)
+
+
+def _transport_error(msg: str) -> RpcError:
+    """RpcError flagged as a *link* failure (vs a remote handler raising
+    RpcError itself, which proves the link is alive)."""
+    err = RpcError(msg)
+    err.transport_error = True
+    return err
+
+
+def _is_transport_failure(e: BaseException) -> bool:
+    if isinstance(e, (asyncio.TimeoutError, TimeoutError, OSError)):
+        return True
+    return isinstance(e, RpcError) and getattr(e, "transport_error", False)
+
+
+def configure_circuit_breaker(
+    threshold: Optional[int] = None, cooldown_s: Optional[float] = None
+):
+    """Process-wide breaker tuning (None keeps the current value)."""
+    global _BREAKER_THRESHOLD, _BREAKER_COOLDOWN_S
+    if threshold is not None:
+        _BREAKER_THRESHOLD = int(threshold)
+    if cooldown_s is not None:
+        _BREAKER_COOLDOWN_S = float(cooldown_s)
+
+
+_partition_hooks = None
+
+
+def _phooks():
+    """(record_retry, set_circuit_state) from util.metrics, lazily — metrics
+    must never break RPC, and this module stays import-light."""
+    global _partition_hooks
+    if _partition_hooks is None:
+        try:
+            from ..util.metrics import record_rpc_retry, set_rpc_circuit_state
+            _partition_hooks = (record_rpc_retry, set_rpc_circuit_state)
+        except Exception:  # pragma: no cover
+            _partition_hooks = (lambda method: None, lambda peer, state: None)
+    return _partition_hooks
+
+
+def _record_circuit_event(name: str, **fields):
+    try:
+        from ..util import events as _ev
+        _ev.record_event(getattr(_ev, name.upper(), name), **fields)
+    except Exception:  # pragma: no cover — events must never break RPC
+        pass
+
+
+async def retry_call(
+    client: "RpcClient",
+    method: str,
+    *args,
+    attempts: int = 3,
+    timeout: Optional[float] = None,
+    total_timeout: Optional[float] = None,
+    backoff_s: float = 0.05,
+    max_backoff_s: float = 2.0,
+    **kwargs,
+):
+    """Call with bounded retries on transport-level failures: jittered
+    exponential backoff, a per-attempt ``timeout``, and a ``total_timeout``
+    deadline budget inherited across attempts. Only for idempotent
+    control-plane RPCs — the callee may have executed a failed attempt."""
+    deadline = (
+        None if total_timeout is None else time.monotonic() + total_timeout
+    )
+    delay = backoff_s
+    last_exc: Optional[BaseException] = None
+    for attempt in range(max(1, attempts)):
+        per_attempt = timeout
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            per_attempt = (
+                remaining if per_attempt is None else min(per_attempt, remaining)
+            )
+        try:
+            return await client.call(method, *args, timeout=per_attempt, **kwargs)
+        except _TRANSIENT_RPC_ERRORS as e:
+            last_exc = e
+            if attempt + 1 >= max(1, attempts):
+                break
+            _phooks()[0](method)
+            sleep_s = min(delay, max_backoff_s) * (0.5 + 0.5 * random.random())
+            if deadline is not None:
+                sleep_s = min(sleep_s, max(0.0, deadline - time.monotonic()))
+            delay *= 2
+            if sleep_s > 0:
+                await asyncio.sleep(sleep_s)
+    if last_exc is None:
+        raise RpcError(f"{client.name}: retry budget exhausted for {method}")
+    raise last_exc
 
 
 # ---------------------------------------------------------------------------
@@ -545,9 +777,14 @@ class RpcClient:
         name: str = "client",
         register_meta: Optional[Dict[str, Any]] = None,
         connect_timeout: float = 10.0,
+        chaos_src: Optional[str] = None,
     ):
         self.host, self.port = host, port
         self.name = name
+        # Caller identity (node-id hex) for directional chaos rules, and the
+        # literal dst string those rules match against.
+        self.chaos_src = chaos_src
+        self._chaos_dst = f"{host}:{port}"
         self._register_meta = register_meta
         self._connect_timeout = connect_timeout
         self._reader: Optional[asyncio.StreamReader] = None
@@ -558,6 +795,58 @@ class RpcClient:
         self._recv_task: Optional[asyncio.Task] = None
         self._lock = asyncio.Lock()
         self._closed = False
+        # Per-link circuit breaker: closed -> open after _BREAKER_THRESHOLD
+        # consecutive transport failures -> half_open probe after cooldown.
+        self._breaker_state = "closed"
+        self._breaker_failures = 0
+        self._breaker_opened_at = 0.0
+
+    # -- circuit breaker ----------------------------------------------------
+
+    def _breaker_check(self):
+        """Fail fast while the circuit is open; transition to half_open (one
+        probe allowed through) once the cooldown elapsed."""
+        if self._breaker_state != "open":
+            return
+        if time.monotonic() - self._breaker_opened_at >= _BREAKER_COOLDOWN_S:
+            self._breaker_state = "half_open"
+            _phooks()[1](self._chaos_dst, 2)
+            return
+        raise RpcError(
+            f"{self.name}: circuit open to {self._chaos_dst} "
+            f"({self._breaker_failures} consecutive failures)"
+        )
+
+    def _breaker_record(self, ok: bool):
+        if ok:
+            if self._breaker_state != "closed":
+                self._breaker_state = "closed"
+                _phooks()[1](self._chaos_dst, 0)
+                _record_circuit_event(
+                    "circuit_close", peer=self._chaos_dst, client=self.name
+                )
+            self._breaker_failures = 0
+            return
+        self._breaker_failures += 1
+        opened = (
+            self._breaker_state == "half_open"
+            or (
+                self._breaker_state == "closed"
+                and self._breaker_failures >= _BREAKER_THRESHOLD
+            )
+        )
+        if opened:
+            was_half_open = self._breaker_state == "half_open"
+            self._breaker_state = "open"
+            self._breaker_opened_at = time.monotonic()
+            _phooks()[1](self._chaos_dst, 1)
+            if not was_half_open:
+                _record_circuit_event(
+                    "circuit_open",
+                    peer=self._chaos_dst,
+                    client=self.name,
+                    failures=self._breaker_failures,
+                )
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -565,7 +854,7 @@ class RpcClient:
 
     async def _ensure_connected(self):
         if self._closed:
-            raise RpcError(f"{self.name}: client is closed")
+            raise _transport_error(f"{self.name}: client is closed")
         if self._writer is not None and not self._writer.is_closing():
             return
         async with self._lock:
@@ -581,7 +870,7 @@ class RpcClient:
                     break
                 except OSError:
                     if asyncio.get_event_loop().time() > deadline or self._closed:
-                        raise RpcError(
+                        raise _transport_error(
                             f"{self.name}: cannot connect to {self.host}:{self.port}"
                         )
                     await asyncio.sleep(delay)
@@ -622,7 +911,9 @@ class RpcClient:
         except asyncio.CancelledError:
             return
         finally:
-            err = RpcError(f"{self.name}: connection to {self.host}:{self.port} lost")
+            err = _transport_error(
+                f"{self.name}: connection to {self.host}:{self.port} lost"
+            )
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(err)
@@ -632,7 +923,34 @@ class RpcClient:
                 self._writer = None
 
     async def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
-        await self._ensure_connected()
+        self._breaker_check()
+        action = None
+        if _chaos_state is not None:
+            delay, action = _chaos_plan(method, self.chaos_src, self._chaos_dst)
+            if delay:
+                await asyncio.sleep(delay)
+            if action == "blackhole":
+                # The link eats the request: hang for the caller's deadline
+                # (capped), then surface a typed error — never an unbounded
+                # silent hang.
+                await asyncio.sleep(
+                    min(timeout if timeout is not None else _BLACKHOLE_MAX_S,
+                        _BLACKHOLE_MAX_S)
+                )
+                self._breaker_record(False)
+                raise _transport_error(
+                    f"{self.name}: injected blackhole for {method}"
+                )
+            if action == "fail":
+                self._breaker_record(False)
+                raise _transport_error(
+                    f"{self.name}: injected failure for {method}"
+                )
+        try:
+            await self._ensure_connected()
+        except BaseException:
+            self._breaker_record(False)
+            raise
         req_id = next(self._req_ids)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
@@ -641,22 +959,54 @@ class RpcClient:
             await self._batcher.enqueue(
                 _encode_frame((req_id, method, args, kwargs))
             )
+            if action == "disconnect":
+                self._abort_transport()
             if timeout is None:
-                return await fut
-            return await asyncio.wait_for(fut, timeout)
-        except BaseException:
+                value = await fut
+            else:
+                value = await asyncio.wait_for(fut, timeout)
+            self._breaker_record(True)
+            return value
+        except BaseException as e:
             # timeout / write failure / cancellation: drop the orphaned entry
             # so a long-lived connection doesn't accumulate dead futures
             self._pending.pop(req_id, None)
+            self._breaker_record(not _is_transport_failure(e))
             raise
         finally:
             _recorder()(method, time.perf_counter() - t0)
 
     async def call_oneway(self, method: str, *args, **kwargs):
+        self._breaker_check()
+        action = None
+        if _chaos_state is not None:
+            delay, action = _chaos_plan(method, self.chaos_src, self._chaos_dst)
+            if delay:
+                await asyncio.sleep(delay)
+            if action == "blackhole":
+                return  # one-way send silently eaten by the link
+            if action == "fail":
+                self._breaker_record(False)
+                raise _transport_error(
+                    f"{self.name}: injected failure for {method}"
+                )
         await self._ensure_connected()
         t0 = time.perf_counter()
         await self._batcher.enqueue(_encode_frame((-1, method, args, kwargs)))
+        if action == "disconnect":
+            self._abort_transport()
         _recorder()(method, time.perf_counter() - t0)
+
+    def _abort_transport(self):
+        """Injected mid-call disconnect: hard-reset the connection with
+        requests in flight (exercises the reconnect/fail-pending path)."""
+        w = self._writer
+        if w is None:
+            return
+        try:
+            w.transport.abort()
+        except Exception:
+            pass
 
     async def close(self):
         self._closed = True
@@ -671,10 +1021,24 @@ class ClientPool:
     """Cache of RpcClients keyed by address (reference: rpc client pools in
     core_worker — CoreWorkerClientPool / RayletClientPool)."""
 
-    def __init__(self, name: str = "pool", register_meta: Optional[Dict] = None):
+    def __init__(
+        self,
+        name: str = "pool",
+        register_meta: Optional[Dict] = None,
+        chaos_src: Optional[str] = None,
+    ):
         self.name = name
         self._register_meta = register_meta
+        self.chaos_src = chaos_src
         self._clients: Dict[Tuple[str, int], RpcClient] = {}
+
+    def set_chaos_src(self, src: Optional[str]):
+        """Tag this pool's caller identity (node-id hex) for directional
+        chaos rules — applied to existing and future clients (a worker only
+        learns its node id after connect_to_raylet)."""
+        self.chaos_src = src
+        for client in self._clients.values():
+            client.chaos_src = src
 
     def get(self, host: str, port: int) -> RpcClient:
         key = (host, port)
@@ -683,6 +1047,7 @@ class ClientPool:
             client = RpcClient(
                 host, port, name=f"{self.name}->{host}:{port}",
                 register_meta=self._register_meta,
+                chaos_src=self.chaos_src,
             )
             self._clients[key] = client
         return client

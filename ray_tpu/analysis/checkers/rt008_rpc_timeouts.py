@@ -1,0 +1,100 @@
+"""RT008: control-plane RPCs must carry a bounded timeout.
+
+Incident this encodes: the PR 11 partition work. Under a directional
+partition (or a chaos-mesh blackhole) an un-deadlined ``client.call(...)``
+never returns — the awaiting coroutine parks forever, the caller's state
+machine wedges, and the hang watchdog is the first thing to notice. Every
+control-plane RPC on the GCS/raylet/serve planes must therefore bound its
+wait: either a ``timeout=`` kwarg on ``.call(...)``, a ``timeout=`` /
+``total_timeout=`` budget on ``retry_call(...)``, or an enclosing
+``asyncio.wait_for``. Data-plane fire-and-forget sends (``call_oneway``)
+never block on a reply, so they are exempt.
+
+Flags ``<expr>.call(...)`` and ``retry_call(...)`` sites on the control
+planes that carry none of ``timeout=`` / ``total_timeout=`` / ``deadline=``,
+are not wrapped in ``asyncio.wait_for``, and do not splat ``**kwargs``
+(a splat may forward a caller-supplied budget; static analysis can't see
+through it, so it gets the benefit of the doubt).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from ..core import Checker, register
+
+_PLANE_PREFIXES = (
+    "ray_tpu/runtime/gcs/",
+    "ray_tpu/runtime/raylet/",
+    "ray_tpu/serve/",
+)
+_PLANE_FILES = ("ray_tpu/runtime/node.py",)
+
+_BOUND_KWARGS = {"timeout", "total_timeout", "deadline"}
+
+
+def _is_rpc_call(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr in ("call", "retry_call")
+    if isinstance(func, ast.Name):
+        return func.id == "retry_call"
+    return False
+
+
+def _is_bounded(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg is None:  # **kwargs splat may forward a budget
+            return True
+        if kw.arg in _BOUND_KWARGS:
+            return True
+    return False
+
+
+def _wait_for_wrapped(tree: ast.AST) -> Set[int]:
+    """ids of Call nodes appearing as arguments to asyncio.wait_for."""
+    wrapped: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else getattr(
+            func, "id", ""
+        )
+        if name != "wait_for":
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Call):
+                wrapped.add(id(arg))
+    return wrapped
+
+
+@register
+class RpcTimeoutChecker(Checker):
+    RULE_ID = "RT008"
+    DESCRIPTION = (
+        "control-plane .call()/retry_call() without a bounded "
+        "timeout/deadline (hangs forever under partition)"
+    )
+
+    def applies_to(self, path: str) -> bool:
+        return path.startswith(_PLANE_PREFIXES) or path in _PLANE_FILES
+
+    def check_file(self, path, tree, source):
+        wrapped = _wait_for_wrapped(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not _is_rpc_call(node):
+                continue
+            if id(node) in wrapped or _is_bounded(node):
+                continue
+            func = node.func
+            name = (
+                func.attr if isinstance(func, ast.Attribute) else func.id
+            )
+            yield self.finding(
+                path, node,
+                f"control-plane {name}() without timeout=/total_timeout=/"
+                f"deadline= blocks forever under a network partition; "
+                f"bound it or wrap in asyncio.wait_for",
+            )

@@ -88,6 +88,11 @@ def init(
         from ._internal.rpc import set_rpc_chaos
 
         set_rpc_chaos(json.loads(config.testing_rpc_failure))
+    from ._internal.rpc import configure_circuit_breaker
+
+    configure_circuit_breaker(
+        config.rpc_breaker_threshold, config.rpc_breaker_cooldown_s
+    )
 
     node = None
     if address is None:
@@ -130,6 +135,22 @@ def init(
         WorkerMode.DRIVER, config, gcs_address, raylet_address, loop_thread.loop
     )
     loop_thread.run(worker.start(), timeout=30)
+    if address is not None and config.chaos_poll_period_s > 0:
+        # address-mode drivers have no raylet poller in-process: poll the
+        # cluster chaos-mesh spec themselves (local mode rides the raylet's)
+        import asyncio as _asyncio
+
+        from .util import chaosnet as _chaosnet
+
+        async def _start_chaos_poll():
+            _asyncio.ensure_future(
+                _chaosnet.poll_loop(
+                    worker.client_pool.get(*gcs_address),
+                    period_s=config.chaos_poll_period_s,
+                )
+            )
+
+        loop_thread.run(_start_chaos_poll(), timeout=5)
     loop_thread.run(worker.register_driver_job({"namespace": namespace}), timeout=30)
     # job-level default runtime env, merged under per-task envs (reference:
     # ray.init(runtime_env=...) becoming the JobConfig default)
@@ -222,8 +243,11 @@ def shutdown():
     # injected RPC chaos is process-global; it must not outlive the cluster
     # that configured it (later init()s in the same process would inherit it)
     from ._internal.rpc import set_rpc_chaos
+    from .util import chaosnet, fencing
 
     set_rpc_chaos({})
+    chaosnet.reset()
+    fencing.set_fenced(False)
     _worker_api.clear()
 
 

@@ -159,6 +159,13 @@ class GcsStoreGroup(BaseGroup):
         forced; an aborted group stays poisoned (fails fast forever)."""
         if self._aborted:
             raise CollectiveAbortedError(self.group_name, self.epoch)
+        # fence check FIRST (a process-local flag, no KV read): a fenced
+        # node's member can't reach the abort key anyway — blocking on the
+        # rate-limited KV poll would just burn the rendezvous timeout
+        from ..util import fencing
+
+        if fencing.is_fenced():
+            self._raise_aborted()
         now = time.monotonic()
         if not force and now - self._last_abort_check < _ABORT_CHECK_INTERVAL_S:
             return

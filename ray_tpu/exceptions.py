@@ -200,6 +200,25 @@ class ReplicaDrainingError(RayTpuError):
         return (type(self), (self.replica_id,))
 
 
+class NodeFencedError(RayTpuError):
+    """The node is fenced: its raylet lost contact with the GCS for longer
+    than the liveness window and stopped granting leases / admitting serve
+    work, so the cluster's view (which may have replaced this node's
+    actors/replicas elsewhere) cannot split-brain against local execution.
+    Retryable: the handle fails over to a replica on a healthy node, and the
+    node unfences itself when GCS contact resumes."""
+
+    def __init__(self, node_id: str = "", reason: str = "gcs unreachable"):
+        self.node_id = node_id
+        self.reason = reason
+        super().__init__(
+            f"node {node_id!r} is fenced ({reason}); rejecting new work"
+        )
+
+    def __reduce__(self):
+        return (type(self), (self.node_id, self.reason))
+
+
 class RpcError(RayTpuError):
     """Transport-level RPC failure."""
 

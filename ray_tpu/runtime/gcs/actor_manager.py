@@ -163,7 +163,7 @@ class GcsActorManager:
             try:
                 raylet = self._gcs.raylet_client(node_id)
                 worker_client = self._gcs.client_pool.get(*worker_addr)
-                await worker_client.call("create_actor", spec)
+                await worker_client.call("create_actor", spec, timeout=30.0)
             except Exception as e:
                 logger.warning("actor %s creation push failed: %s", info.actor_id, e)
                 try:

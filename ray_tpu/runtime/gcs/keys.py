@@ -121,11 +121,20 @@ TRAIN_STATE = KeyPrefix(
 SERVE = KeyPrefix(
     "serve", "serve control-plane records (controller_ckpt, autoscale_log)"
 )
+CHAOS_NET = KeyPrefix(
+    "chaosnet",
+    "cluster-wide network chaos-mesh spec (JSON rules), polled by every "
+    "process and applied client-side in the RPC layer",
+)
 
 # -- fixed keys under the serve prefix --------------------------------------
 
 SERVE_CONTROLLER_CKPT = SERVE.key("controller_ckpt")
 SERVE_AUTOSCALE_LOG = SERVE.key("autoscale_log")
+
+# -- fixed keys under the chaosnet prefix -----------------------------------
+
+CHAOS_NET_SPEC = CHAOS_NET.key("spec")
 
 # -- pubsub channel prefixes ------------------------------------------------
 

@@ -140,6 +140,7 @@ class GcsPlacementGroupManager:
                     info.placement_group_id,
                     bundle.bundle_index,
                     bundle.resources,
+                    timeout=10.0,
                 )
             except Exception as e:
                 logger.debug("prepare_bundle failed on %s: %s", node_id, e)
@@ -153,7 +154,8 @@ class GcsPlacementGroupManager:
             for bundle, node_id in prepared:
                 try:
                     await self._gcs.raylet_client(node_id).call(
-                        "return_bundle", info.placement_group_id, bundle.bundle_index
+                        "return_bundle", info.placement_group_id,
+                        bundle.bundle_index, timeout=10.0,
                     )
                 except Exception:
                     pass
@@ -161,7 +163,8 @@ class GcsPlacementGroupManager:
         # Phase 2: commit all.
         for bundle, node_id in prepared:
             await self._gcs.raylet_client(node_id).call(
-                "commit_bundle", info.placement_group_id, bundle.bundle_index
+                "commit_bundle", info.placement_group_id, bundle.bundle_index,
+                timeout=10.0,
             )
             bundle.node_id = node_id
         return True
@@ -275,7 +278,8 @@ class GcsPlacementGroupManager:
             if bundle.node_id is not None:
                 try:
                     await self._gcs.raylet_client(bundle.node_id).call(
-                        "return_bundle", pg_id, bundle.bundle_index
+                        "return_bundle", pg_id, bundle.bundle_index,
+                        timeout=10.0,
                     )
                 except Exception:
                     pass
@@ -296,7 +300,8 @@ class GcsPlacementGroupManager:
                 if bundle.node_id is not None and bundle.node_id != node_id:
                     try:
                         await self._gcs.raylet_client(bundle.node_id).call(
-                            "return_bundle", info.placement_group_id, bundle.bundle_index
+                            "return_bundle", info.placement_group_id,
+                            bundle.bundle_index, timeout=10.0,
                         )
                     except Exception:
                         pass

@@ -83,7 +83,9 @@ class SubscriberClient:
 
     async def subscribe(self, channel_pattern: str, callback: Callable):
         self._callbacks[channel_pattern] = callback
-        await self._client.call("subscribe", self.subscriber_id, channel_pattern)
+        await self._client.call(
+            "subscribe", self.subscriber_id, channel_pattern, timeout=10.0
+        )
         if self._task is None:
             self._task = asyncio.ensure_future(self._poll_loop())
 
@@ -97,7 +99,8 @@ class SubscriberClient:
                 try:
                     for pattern in list(self._callbacks):
                         await self._client.call(
-                            "subscribe", self.subscriber_id, pattern
+                            "subscribe", self.subscriber_id, pattern,
+                            timeout=10.0,
                         )
                     resubscribe = False
                 except asyncio.CancelledError:

@@ -37,6 +37,7 @@ from ..._internal.protocol import (
     TaskSpec,
 )
 from ..._internal.rpc import ClientPool, RpcClient, RpcServer
+from ...util.events import NODE_SUSPECT, record_event
 from . import keys as gcs_keys
 from .actor_manager import GcsActorManager
 from .placement_groups import GcsPlacementGroupManager
@@ -61,6 +62,11 @@ class GcsServer:
         self._nodes: Dict[NodeID, NodeInfo] = {}
         self._node_available: Dict[NodeID, Dict[str, float]] = {}
         self._node_last_seen: Dict[NodeID, float] = {}
+        # SUSPECT: reports stopped (age > suspect_after_s) and an active
+        # raylet probe ran — between ALIVE and DEAD. Suspect nodes get no
+        # new leases and serve replaces their replicas; the state clears on
+        # the node's next report. Value: when suspicion started.
+        self._node_suspect: Dict[NodeID, float] = {}
         # versioned delta sync (reference: RaySyncer ray_syncer.h:89): the
         # last applied per-raylet report version; a mismatched base on an
         # incoming delta triggers a resync (raylet re-sends a full snapshot)
@@ -194,6 +200,12 @@ class GcsServer:
         )
         for nid in candidates:
             node = nodes[nid]
+            if nid in self._node_suspect and len(candidates) > 1:
+                # A partitioned-but-not-yet-dead node must not receive the
+                # very replacements its suspicion triggered; with no other
+                # candidate it stays eligible (better a suspect lease than
+                # an unschedulable actor).
+                continue
             feasible = all(
                 node.resources_total.get(k, 0.0) >= v - 1e-9
                 for k, v in spec.resources.items()
@@ -218,6 +230,7 @@ class GcsServer:
     ):
         self._nodes[info.node_id] = info
         self._node_last_seen[info.node_id] = time.time()
+        self._node_suspect.pop(info.node_id, None)
         self._restored_nodes_pending.pop(info.node_id, None)
         self.publisher.publish("node", ("alive", info))
         # Re-registration after a GCS restart: name the actor workers this
@@ -255,6 +268,27 @@ class GcsServer:
     async def handle_get_all_nodes(self) -> List[NodeInfo]:
         return list(self._nodes.values())
 
+    async def handle_get_node_states(self) -> Dict[str, str]:
+        """Three-valued liveness per node: ALIVE | SUSPECT | DEAD, keyed by
+        node-id hex. SUSPECT (reports stopped, probe ran) is what the serve
+        controller keys replica replacement on before the full dead window
+        elapses."""
+        out: Dict[str, str] = {}
+        for node_id, node in self._nodes.items():
+            if not node.alive:
+                out[node_id.hex()] = "DEAD"
+            elif node_id in self._node_suspect:
+                out[node_id.hex()] = "SUSPECT"
+            else:
+                out[node_id.hex()] = "ALIVE"
+        return out
+
+    async def handle_chaos_fetch(self) -> Optional[bytes]:
+        """Raw chaos-mesh spec for pollers (util/chaosnet.py). The method
+        name is chaos-EXEMPT in the RPC layer on both sides: clearing a
+        partition must propagate through the partition being cleared."""
+        return self._kv.get(gcs_keys.CHAOS_NET_SPEC)
+
     async def handle_report_resources_delta(
         self,
         node_id: NodeID,
@@ -280,6 +314,8 @@ class GcsServer:
             # RegisterNodeAgain, node_manager.proto:426)
             return "unknown_node"
         self._node_last_seen[node_id] = time.time()
+        if self._node_suspect.pop(node_id, None) is not None:
+            logger.info("node %s reporting again; suspicion cleared", node_id)
         if base_version is None:
             # full snapshot
             avail = dict(changed or {})
@@ -358,8 +394,17 @@ class GcsServer:
             if not node.alive:
                 continue
             last = self._node_last_seen.get(node_id, now)
-            if now - last > self.config.health_check_timeout_s:
+            age = now - last
+            if age > self.config.health_check_timeout_s:
                 await self._mark_node_dead(node_id, "health check timed out")
+            elif (
+                age > self.config.suspect_after_s
+                and node_id not in self._node_suspect
+            ):
+                # reports stopped: probe the raylet actively instead of
+                # sitting out the rest of the dead window passively
+                self._node_suspect[node_id] = now
+                self.spawn(self._probe_node(node_id, age))
         # Nodes referenced by restored state that never re-registered: their
         # raylets died with the previous GCS — fail their actors/bundles.
         for node_id, deadline in list(self._restored_nodes_pending.items()):
@@ -389,11 +434,50 @@ class GcsServer:
                 await self.actor_manager.on_node_death(node_id)
                 await self.pg_manager.on_node_death(node_id)
 
+    async def _probe_node(self, node_id: NodeID, report_age_s: float):
+        """Active liveness probe of a node whose reports stopped (reference:
+        GcsHealthCheckManager's grpc health checks — ours layers on top of
+        the passive report age). Confirms the SUSPECT transition: if a
+        report raced in while probing, suspicion clears silently; otherwise
+        the node is recorded SUSPECT with the probe verdict (reachable =
+        control plane asymmetric, likely a directional partition; not
+        reachable = node fully gone, the dead window will catch it)."""
+        node = self._nodes.get(node_id)
+        if node is None or not node.alive:
+            self._node_suspect.pop(node_id, None)
+            return
+        reachable = False
+        try:
+            await self.client_pool.get(*node.address).call(
+                "ping", timeout=max(self.config.health_check_period_s, 1.0)
+            )
+            reachable = True
+        except Exception:
+            pass
+        if node_id not in self._node_suspect:
+            return  # a report landed while probing
+        age = time.time() - self._node_last_seen.get(node_id, 0.0)
+        if age <= self.config.suspect_after_s:
+            self._node_suspect.pop(node_id, None)
+            return
+        logger.warning(
+            "node %s SUSPECT: no report for %.1fs, raylet %s",
+            node_id, age, "reachable" if reachable else "unreachable",
+        )
+        record_event(
+            NODE_SUSPECT,
+            node=node_id.hex(),
+            report_age_s=round(report_age_s, 3),
+            reachable=reachable,
+        )
+        self.publisher.publish("node", ("suspect", node))
+
     async def _mark_node_dead(self, node_id: NodeID, reason: str):
         node = self._nodes.get(node_id)
         if node is None or not node.alive:
             return
         node.alive = False
+        self._node_suspect.pop(node_id, None)
         self._node_available.pop(node_id, None)
         # invalidate the delta-sync stream: if this raylet was only
         # partitioned and reports again, a base-version match would apply

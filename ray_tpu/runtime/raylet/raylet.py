@@ -37,8 +37,10 @@ from ..._internal.protocol import (
     SpreadSchedulingStrategy,
     TaskSpec,
 )
-from ..._internal.rpc import ClientPool, RpcServer
-from ...exceptions import ObjectStoreFullError
+from ..._internal.rpc import ClientPool, RpcServer, retry_call
+from ...exceptions import NodeFencedError, ObjectStoreFullError
+from ...util import chaosnet
+from ...util.events import NODE_FENCED, NODE_UNFENCED, record_event
 from ..gcs.pubsub import SubscriberClient
 from ..object_store import spill_storage
 from ..object_store.native_store import create_object_store
@@ -91,7 +93,11 @@ class Raylet:
         self.session_id = session_id
         self.is_head = is_head
         self.server = RpcServer(f"raylet-{self.node_id.hex()[:6]}")
-        self.client_pool = ClientPool("raylet-out")
+        # chaos_src tags every outgoing call with this node's identity so
+        # directional partition rules (src=<node-hex>) can match
+        self.client_pool = ClientPool(
+            "raylet-out", chaos_src=self.node_id.hex()
+        )
         self.resources = LocalResourceManager(resources, labels)
         self.store = create_object_store(
             object_store_memory or config.object_store_memory,
@@ -156,6 +162,12 @@ class Raylet:
         self._worker_job: Dict[int, str] = {}
         # lease ids with a revoke_lease RPC in flight to their owner
         self._revoking: set = set()
+        # split-brain fencing: set when GCS contact is lost past
+        # fence_after_s — new leases are refused (NodeFencedError) and
+        # resident workers are told to fence; cleared on the next
+        # successful report
+        self._fenced = False
+        self._last_gcs_ok = time.time()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -200,7 +212,8 @@ class Raylet:
         )
         gcs = self.client_pool.get(*self.gcs_address)
         info = self._node_info()
-        await gcs.call("register_node", info)
+        await retry_call(gcs, "register_node", info, attempts=3, timeout=10.0)
+        self._last_gcs_ok = time.time()
         self._cluster_nodes[self.node_id] = info
         # cluster view subscription
         self._subscriber = SubscriberClient(
@@ -213,6 +226,10 @@ class Raylet:
         self._runner.run_every(
             max(self.config.health_check_period_s / 2, 0.1), self._report_resources
         )
+        if self.config.chaos_poll_period_s > 0:
+            self._runner.run_every(
+                self.config.chaos_poll_period_s, self._poll_chaos
+            )
         self._runner.run_every(5.0, self._reap_idle_workers)
         if self.config.lease_ttl_s > 0:
             self._runner.run_every(
@@ -274,10 +291,23 @@ class Raylet:
             )
         try:
             reply = await gcs.call(
-                "report_resources_delta", self.node_id, **payload
+                "report_resources_delta", self.node_id, timeout=5.0, **payload
             )
         except Exception:
+            since_ok = time.time() - self._last_gcs_ok
+            if (
+                not self._fenced
+                and self.config.fence_after_s > 0
+                and since_ok > self.config.fence_after_s
+            ):
+                self._set_fenced(
+                    True,
+                    f"no successful GCS report for {since_ok:.1f}s",
+                )
             return
+        self._last_gcs_ok = time.time()
+        if self._fenced:
+            self._set_fenced(False, "")
         if reply == "unknown_node":
             # the GCS restarted and lost the node table: re-register,
             # reporting which workers are still alive so restored actor
@@ -292,6 +322,49 @@ class Raylet:
         self._needs_full_sync = False
         self._acked_avail = avail
         self._acked_demands = demands
+
+    def _set_fenced(self, fenced: bool, reason: str):
+        """Flip the split-brain fence. Fenced raylets refuse new leases and
+        tell their resident workers to fence (replica admission and
+        collective ticks read the worker-local flag); the GCS may already be
+        restarting this node's actors elsewhere, so running new work here
+        risks two live incarnations."""
+        self._fenced = fenced
+        if fenced:
+            logger.warning("node %s FENCED: %s", self.node_id, reason)
+            record_event(
+                NODE_FENCED, node=self.node_id.hex(), reason=reason
+            )
+            try:
+                from ...util.metrics import record_node_fenced
+
+                record_node_fenced(self.node_id.hex())
+            except Exception:
+                pass
+        else:
+            logger.warning(
+                "node %s unfenced: GCS contact restored", self.node_id
+            )
+            record_event(NODE_UNFENCED, node=self.node_id.hex())
+        self._bg.spawn(self._notify_workers_fenced(fenced, reason))
+
+    async def _notify_workers_fenced(self, fenced: bool, reason: str):
+        if self.worker_pool is None:
+            return
+        for handle in list(self.worker_pool._registered.values()):
+            try:
+                worker = self.client_pool.get(*handle.address)
+                await worker.call_oneway(
+                    "set_fenced", fenced, self.node_id.hex(), reason
+                )
+            except Exception:
+                pass  # best-effort; the worker may be mid-death
+
+    async def _poll_chaos(self):
+        """Pick up the cluster-wide chaos-mesh spec from the GCS KV. The
+        fetch rides the chaos-EXEMPT chaos_fetch RPC so clearing a partition
+        propagates through the partition it clears."""
+        await chaosnet.poll_once(self.client_pool.get(*self.gcs_address))
 
     def _node_info(self) -> NodeInfo:
         return NodeInfo(
@@ -324,8 +397,9 @@ class Raylet:
             if getattr(lease.spec, "actor_id", None) is not None
         }
         try:
-            reply = await gcs.call(
-                "register_node", self._node_info(), live_workers, actor_workers
+            reply = await retry_call(
+                gcs, "register_node", self._node_info(), live_workers,
+                actor_workers, attempts=3, timeout=10.0,
             )
         except Exception:
             logger.exception("re-registration with GCS failed; will retry")
@@ -465,6 +539,7 @@ class Raylet:
                     f"killed by memory monitor: node memory {used}/{total} "
                     f"exceeded threshold "
                     f"{self.memory_monitor.usage_threshold:.2f}",
+                    timeout=5.0,
                 )
             except Exception:
                 pass
@@ -473,9 +548,12 @@ class Raylet:
         kind, info = message
         if kind == "alive":
             self._cluster_nodes[info.node_id] = info
-        else:
+        elif kind == "dead":
             self._cluster_nodes.pop(info.node_id, None)
             self._cluster_available.pop(info.node_id, None)
+        # "suspect" keeps the node in the view: it may still recover, and
+        # evicting it here would orphan its entry forever (no re-"alive"
+        # publish follows a cleared suspicion)
 
     def _on_resource_view(self, channel, message):
         node_id, available = message
@@ -517,7 +595,10 @@ class Raylet:
         self._dispatch_wakeup.set()
         try:
             gcs = self.client_pool.get(*self.gcs_address)
-            await gcs.call("report_worker_death", worker_id, "connection lost")
+            await gcs.call(
+                "report_worker_death", worker_id, "connection lost",
+                timeout=5.0,
+            )
         except Exception:
             pass
 
@@ -528,6 +609,10 @@ class Raylet:
         """Grant a worker locally, queue, or spill to another node.
         ``reusable`` marks the grant as cacheable by the owner (lease reuse);
         the raylet may recall it later via revoke_lease."""
+        if self._fenced:
+            # split-brain guard: the GCS may be restarting this node's work
+            # elsewhere — granting here could produce two live incarnations
+            raise NodeFencedError(self.node_id.hex(), "raylet lost GCS contact")
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._queues[spec.scheduling_class()].append((spec, fut, reusable))
         self._dispatch_wakeup.set()
@@ -1171,7 +1256,9 @@ class Raylet:
     ) -> bool:
         try:
             owner = self.client_pool.get(*owner_address)
-            loc = await owner.call("get_object_locations", object_id)
+            loc = await owner.call(
+                "get_object_locations", object_id, timeout=10.0
+            )
         except Exception as e:
             logger.debug("pull: owner lookup failed for %s: %s", object_id, e)
             return False
@@ -1208,7 +1295,9 @@ class Raylet:
             try:
                 peer = self.client_pool.get(*node_address)
                 chunk_size = self.config.object_transfer_chunk_size
-                first = await peer.call("fetch_object", object_id, 0, chunk_size)
+                first = await peer.call(
+                    "fetch_object", object_id, 0, chunk_size, timeout=30.0
+                )
                 if first is None:
                     continue
                 total = first["total"]
@@ -1217,7 +1306,10 @@ class Raylet:
                 view[: len(first["data"])] = first["data"]
                 offset = len(first["data"])
                 while offset < total:
-                    part = await peer.call("fetch_object", object_id, offset, chunk_size)
+                    part = await peer.call(
+                        "fetch_object", object_id, offset, chunk_size,
+                        timeout=30.0,
+                    )
                     if part is None:
                         break
                     data = part["data"]
@@ -1307,7 +1399,7 @@ class Raylet:
     async def handle_drain(self):
         """Graceful drain (reference: HandleDrainRaylet node_manager.h:313)."""
         gcs = self.client_pool.get(*self.gcs_address)
-        await gcs.call("unregister_node", self.node_id)
+        await gcs.call("unregister_node", self.node_id, timeout=10.0)
         return True
 
 

@@ -84,7 +84,7 @@ class _ActorClientState:
 
     __slots__ = (
         "actor_id", "state", "address", "seq", "queue", "death_cause",
-        "incarnation", "reconciling", "creation_arg_pins",
+        "incarnation", "reconciling", "creation_arg_pins", "unresolved",
     )
 
     def __init__(self, actor_id: ActorID):
@@ -108,6 +108,12 @@ class _ActorClientState:
         # a GCS re-poll loop runs while calls are parked (missed/raced
         # pubsub edges must not strand the queue forever)
         self.reconciling = False
+        # call future -> (incarnation, seq) for every unresolved call; the
+        # min over the current incarnation is the sequence watermark sent
+        # with each push so the executor can skip seqs this client
+        # abandoned (dropped send + no resend = a hole its in-order queue
+        # would otherwise park behind forever)
+        self.unresolved: Dict[asyncio.Future, Tuple[int, int]] = {}
 
 
 class _StreamState:
@@ -230,6 +236,10 @@ class CoreWorker:
         self._caller_inflight: Dict[WorkerID, Dict[int, asyncio.Future]] = (
             defaultdict(dict)
         )
+        # highest sequence watermark seen per caller: every seq below it is
+        # resolved caller-side, so a sub-watermark seq that never arrived
+        # is never coming and must be skipped, not waited on
+        self._caller_watermark: Dict[WorkerID, int] = defaultdict(int)
         self._execution_lock = asyncio.Lock()
         self._exit_requested = False
 
@@ -299,6 +309,8 @@ class CoreWorker:
         s.register("actor_task", self._handle_actor_task)
         s.register("exit_worker", self._handle_exit_worker)
         s.register("ping", self._handle_ping)
+        # split-brain fence fan-out from this worker's raylet
+        s.register("set_fenced", self._handle_set_fenced)
         # raylet-initiated recall of a cached worker lease (resource
         # pressure / TTL backstop)
         s.register("revoke_lease", self._handle_revoke_lease)
@@ -315,6 +327,9 @@ class CoreWorker:
             os.environ.get("RAY_TPU_ENV_KEY", ""),
         )
         self.node_id = reply["node_id"]
+        # tag outgoing RPCs with this node's identity so directional chaos
+        # partition rules (src=<node-hex>) can match this worker's traffic
+        self.client_pool.set_chaos_src(self.node_id.hex())
         return reply
 
     async def register_driver_job(self, metadata: dict) -> JobID:
@@ -872,6 +887,15 @@ class CoreWorker:
     async def _handle_ping(self):
         return {"worker_id": self.worker_id}
 
+    async def _handle_set_fenced(self, fenced: bool, node_id: str = "",
+                                 reason: str = ""):
+        """Raylet fan-out of the split-brain fence: replica admission and
+        collective abort checks in this process read the flag locally."""
+        from ...util import fencing
+
+        fencing.set_fenced(fenced, node_id, reason)
+        return True
+
     # ------------------------------------------------------------------
     # task submission (reference: normal_task_submitter.h)
     # ------------------------------------------------------------------
@@ -1400,6 +1424,7 @@ class CoreWorker:
                 for i, (spec, _fut) in enumerate(state.queue):
                     spec.sequence_number = i
                     spec.sequence_incarnation = incarnation
+                    state.unresolved[_fut] = (incarnation, i)
                 state.seq = len(state.queue)
             asyncio.ensure_future(self._flush_actor_queue(state))
         elif info.state == ActorState.DEAD:
@@ -1473,6 +1498,10 @@ class CoreWorker:
         spec.sequence_incarnation = state.incarnation
         state.seq += 1
         fut: asyncio.Future = self.loop.create_future()
+        state.unresolved[fut] = (
+            spec.sequence_incarnation, spec.sequence_number
+        )
+        fut.add_done_callback(lambda f: state.unresolved.pop(f, None))
         if state.state == ActorState.DEAD:
             fut.set_exception(ActorDiedError(spec.actor_id, state.death_cause))
         elif state.address is None:
@@ -1502,6 +1531,14 @@ class CoreWorker:
                 self._ensure_actor_reconciler(state)
             return
         try:
+            # stamp at SEND time (not submit): resolutions between submit
+            # and a recover-resend must lift the watermark with them
+            cur = spec.sequence_incarnation
+            spec.sequence_watermark = min(
+                (s for f, (inc, s) in state.unresolved.items()
+                 if inc == cur and not f.done()),
+                default=spec.sequence_number,
+            )
             worker = self.client_pool.get(*addr)
             reply = await worker.call("actor_task", spec, timeout=None)
             if not fut.done():
@@ -1567,6 +1604,9 @@ class CoreWorker:
                     spec.sequence_number = state.seq
                     spec.sequence_incarnation = state.incarnation
                     state.seq += 1
+                    state.unresolved[fut] = (
+                        spec.sequence_incarnation, spec.sequence_number
+                    )
                     asyncio.ensure_future(
                         self._push_actor_task(state, spec, fut)
                     )
@@ -1949,6 +1989,23 @@ class CoreWorker:
         self._actor_spec = spec
         return True
 
+    def _release_runnable(self, caller) -> int:
+        """Advance the caller's expected seq past watermark-abandoned holes
+        (seqs the caller resolved without a resend — their sends were
+        dropped mid-flight and will never arrive) and wake the parked task
+        that becomes runnable, if any. Arrived tasks are never skipped:
+        they sit in the inflight map until they reply."""
+        expected = self._caller_expected_seq[caller]
+        wm = self._caller_watermark[caller]
+        inflight = self._caller_inflight[caller]
+        while expected < wm and expected not in inflight:
+            expected += 1
+        self._caller_expected_seq[caller] = expected
+        ev = self._caller_parked[caller].pop(expected, None)
+        if ev is not None:
+            ev.set()
+        return expected
+
     async def _handle_actor_task(self, spec: TaskSpec) -> TaskReply:
         """Per-caller in-order execution (reference: ActorSchedulingQueue
         sequencing by client seq-no). A retried call arrives with its
@@ -1965,7 +2022,10 @@ class CoreWorker:
             # prevent. shield(): this duplicate's cancellation must not
             # cancel the original execution.
             return await asyncio.shield(existing)
-        expected = self._caller_expected_seq[caller]
+        wm = getattr(spec, "sequence_watermark", 0)
+        if wm > self._caller_watermark[caller]:
+            self._caller_watermark[caller] = wm
+        expected = self._release_runnable(caller)
         if seq < expected:
             # duplicate delivery after completion: reply was lost in flight
             # (reference: the dedup the executor does by seq-no). Serve the
@@ -1991,10 +2051,11 @@ class CoreWorker:
                 await ev.wait()
 
             def _advance():
-                self._caller_expected_seq[caller] = seq + 1
-                nxt = self._caller_parked[caller].pop(seq + 1, None)
-                if nxt is not None:
-                    nxt.set()
+                # never rewind: with watermark skips in play, expected may
+                # already be past seq + 1 when this task finishes
+                if seq + 1 > self._caller_expected_seq[caller]:
+                    self._caller_expected_seq[caller] = seq + 1
+                self._release_runnable(caller)
 
             def _cache_reply(reply: TaskReply):
                 size = sum(

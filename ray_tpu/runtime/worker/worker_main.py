@@ -42,6 +42,11 @@ async def main(args):
         from ..._internal.rpc import set_rpc_chaos
 
         set_rpc_chaos(json.loads(config.testing_rpc_failure))
+    from ..._internal.rpc import configure_circuit_breaker
+
+    configure_circuit_breaker(
+        config.rpc_breaker_threshold, config.rpc_breaker_cooldown_s
+    )
     loop = asyncio.get_event_loop()
     gcs_address = (args.gcs_host, args.gcs_port)
     raylet_address = ("127.0.0.1", args.raylet_port)
@@ -69,6 +74,17 @@ async def main(args):
     from ... import _worker_api
 
     _worker_api.set_core_worker(worker, config)
+
+    # pick up the cluster-wide chaos-mesh spec from the GCS KV
+    if config.chaos_poll_period_s > 0:
+        from ...util import chaosnet
+
+        asyncio.ensure_future(
+            chaosnet.poll_loop(
+                worker.client_pool.get(*gcs_address),
+                period_s=config.chaos_poll_period_s,
+            )
+        )
 
     # Die with the raylet: keep a dedicated connection pinging it
     # (reference: workers exit when their raylet's socket closes).

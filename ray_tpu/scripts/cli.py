@@ -291,6 +291,12 @@ def cmd_chaos(args):
       must absorb it.
     - ``drain``: gracefully drain one serve replica through the
       controller's DRAINING state machine (rolling-restart injection).
+    - ``net``: cluster-wide network chaos mesh. Writes a structured spec
+      (seed + rules: fail/delay/jitter/blackhole/disconnect, optionally
+      scoped by ``--method``/``--src``/``--dst``) to the GCS KV; every
+      process polls it, so partitions apply — and heal — everywhere
+      within ~1 poll period. ``--clear`` removes it; with no spec flags
+      the current spec is printed.
     """
     _connected(args)
     from ..util import state
@@ -306,6 +312,47 @@ def cmd_chaos(args):
         client = worker.client_pool.get(*worker.gcs_address)
         return _worker_api.run_on_worker_loop(client.call(method, *cargs))
 
+    if args.chaos_action == "net":
+        from ..runtime.gcs import keys as gcs_keys
+
+        if args.clear:
+            _kv("kv_del", gcs_keys.CHAOS_NET_SPEC)
+            print("chaos-net spec cleared; processes heal within ~1 poll "
+                  "period")
+            return 0
+        spec = None
+        if args.spec:
+            spec = json.loads(args.spec)
+        elif args.spec_file:
+            with open(args.spec_file) as f:
+                spec = json.load(f)
+        elif any((args.fail, args.delay_ms, args.jitter_ms, args.blackhole,
+                  args.disconnect)):
+            rule = {"method": args.method, "src": args.src, "dst": args.dst}
+            if args.fail:
+                rule["fail"] = args.fail
+            if args.delay_ms:
+                rule["delay_ms"] = args.delay_ms
+            if args.jitter_ms:
+                rule["jitter_ms"] = args.jitter_ms
+            if args.blackhole:
+                rule["blackhole"] = True
+            if args.disconnect:
+                rule["disconnect"] = args.disconnect
+            spec = {"seed": args.seed, "rules": [rule]}
+        if spec is None:
+            raw = _kv("kv_get", gcs_keys.CHAOS_NET_SPEC)
+            if raw:
+                print(bytes(raw).decode("utf-8", "replace"))
+            else:
+                print("no chaos-net spec set")
+            return 0
+        _kv("kv_put", gcs_keys.CHAOS_NET_SPEC,
+            json.dumps(spec).encode(), True)
+        print(f"chaos-net spec set ({len(spec.get('rules', []))} rule(s), "
+              f"seed {spec.get('seed', 0)}); every process applies it "
+              f"within ~1 poll period")
+        return 0
     if args.chaos_action == "list":
         from ..testing import list_serve_replicas
 
@@ -406,7 +453,7 @@ def cmd_chaos(args):
 def cmd_lint(args):
     """`ray_tpu lint`: the project-invariant static-analysis pass.
 
-    Runs the RT001..RT007 checkers (ray_tpu/analysis/) over the package —
+    Runs the RT001..RT008 checkers (ray_tpu/analysis/) over the package —
     or the given paths — subtracts the committed baseline, and reports
     what's left. Exit codes: 0 clean, 1 findings (new or stale baseline),
     2 internal error. ``--baseline-update`` rewrites the baseline from the
@@ -627,12 +674,12 @@ def main(argv=None):
     p = sub.add_parser(
         "chaos",
         help="fault injection: kill ranks/replicas, abort/delay "
-             "collectives, drain replicas",
+             "collectives, drain replicas, network chaos mesh",
     )
     p.add_argument(
         "chaos_action",
         choices=["list", "kill-rank", "abort-group", "delay-collective",
-                 "kill-replica", "pause-replica", "drain"],
+                 "kill-replica", "pause-replica", "drain", "net"],
     )
     p.add_argument("--address", required=True, help="head host:port")
     p.add_argument("--run", default=None, help="train run name (kill-rank)")
@@ -659,11 +706,63 @@ def main(argv=None):
         "--seconds", type=float, default=0.0,
         help="per-op delay for delay-collective; 0 clears",
     )
+    p.add_argument(
+        "--spec", default=None,
+        help="chaos-net: full structured spec as inline JSON",
+    )
+    p.add_argument(
+        "--spec-file", default=None,
+        help="chaos-net: path to a JSON spec file",
+    )
+    p.add_argument(
+        "--clear", action="store_true",
+        help="chaos-net: remove the cluster spec (heal all partitions)",
+    )
+    p.add_argument(
+        "--method", default="*",
+        help="chaos-net single-rule: RPC method to match (default: all)",
+    )
+    p.add_argument(
+        "--src", default="*",
+        help="chaos-net single-rule: caller node-id hex prefix "
+             "(directional partition source; default: all)",
+    )
+    p.add_argument(
+        "--dst", default="*",
+        help="chaos-net single-rule: destination host:port (default: all)",
+    )
+    p.add_argument(
+        "--fail", type=float, default=0.0,
+        help="chaos-net single-rule: per-call failure probability",
+    )
+    p.add_argument(
+        "--delay-ms", type=float, default=0.0,
+        help="chaos-net single-rule: fixed per-call delay",
+    )
+    p.add_argument(
+        "--jitter-ms", type=float, default=0.0,
+        help="chaos-net single-rule: uniform extra delay on top of "
+             "--delay-ms",
+    )
+    p.add_argument(
+        "--blackhole", action="store_true",
+        help="chaos-net single-rule: calls hang until the caller's "
+             "deadline instead of erroring",
+    )
+    p.add_argument(
+        "--disconnect", type=float, default=0.0,
+        help="chaos-net single-rule: probability of mid-call transport "
+             "disconnect",
+    )
+    p.add_argument(
+        "--seed", type=int, default=0,
+        help="chaos-net: deterministic rng seed for the spec",
+    )
     p.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser(
         "lint",
-        help="run the RT001..RT007 static-analysis pass "
+        help="run the RT001..RT008 static-analysis pass "
              "(exit 0 clean / 1 findings / 2 internal error)",
     )
     p.add_argument(

@@ -41,6 +41,9 @@ class _ReplicaState:
         self.consecutive_health_failures = 0
         self.started_at = time.time()
         self.pid = 0  # captured from get_metrics; chaos CLI targets it
+        # hex node id captured from get_metrics: reconcile replaces replicas
+        # whose node the GCS marks SUSPECT/DEAD (partition failover)
+        self.node_id = ""
         # captured from get_metrics: distinct prefix-affinity keys recently
         # routed here (scale-down victim signal) and cold-start wall time
         self.affinity_keys = 0
@@ -118,7 +121,9 @@ class ServeController:
 
         worker = _worker_api.get_core_worker()
         return _worker_api.run_on_worker_loop(
-            worker.client_pool.get(*worker.gcs_address).call(method, *args)
+            worker.client_pool.get(*worker.gcs_address).call(
+                method, *args, timeout=10.0
+            )
         )
 
     def _checkpoint(self):
@@ -355,8 +360,10 @@ class ServeController:
                     payload_cache["p"] = []
             return payload_cache["p"]
 
+        node_states = self._fetch_node_states()
         for full_name, dep in items:
             self._poll_replicas(dep)
+            self._evict_partitioned(dep, node_states)
             self._reap_draining(dep)
             policy = getattr(dep.config, "autoscale_policy", None)
             if policy is not None:
@@ -375,6 +382,7 @@ class ServeController:
                 metrics = api.get(replica.handle.get_metrics.remote(), timeout=5)
                 replica.queue_len = metrics["queue_len"]
                 replica.pid = metrics.get("pid", replica.pid)
+                replica.node_id = metrics.get("node_id", replica.node_id)
                 replica.affinity_keys = int(metrics.get("affinity_keys", 0))
                 replica.warmup_s = float(
                     metrics.get("warmup_s", replica.warmup_s)
@@ -397,6 +405,50 @@ class ServeController:
                         api.kill(replica.handle)
                     except Exception:
                         pass
+
+    def _fetch_node_states(self) -> Dict[str, str]:
+        """node-hex -> ALIVE|SUSPECT|DEAD from the GCS, once per reconcile
+        tick. An unreachable GCS returns {} — reconcile must keep running on
+        health-probe evidence alone during a controller-side partition."""
+        try:
+            return self._kv_call("get_node_states") or {}
+        except Exception:
+            return {}
+
+    def _evict_partitioned(self, dep: _DeploymentState, node_states):
+        """Replace replicas on SUSPECT/DEAD nodes without waiting for three
+        health-probe failures: the GCS's liveness verdict is the faster,
+        cluster-wide signal during a partition. The partitioned node
+        self-fences, so the old replica rejects work instead of
+        double-serving next to its replacement."""
+        from .. import api
+
+        if not node_states:
+            return
+        for rid, replica in list(dep.replicas.items()):
+            if replica.state != "RUNNING" or not replica.node_id:
+                continue
+            state = node_states.get(replica.node_id, "ALIVE")
+            if state == "ALIVE":
+                continue
+            logger.warning(
+                "replica %s on %s node %s; replacing",
+                rid, state, replica.node_id,
+            )
+            _events.record_event(
+                _events.REPLICA_STATE,
+                deployment=dep.config.name, replica=rid,
+                state="UNHEALTHY", reason=f"node_{state.lower()}",
+                node=replica.node_id,
+            )
+            with self._lock:
+                dep.replicas.pop(rid, None)
+                dep.version += 1
+                self._dirty = True
+            try:
+                api.kill(replica.handle)
+            except Exception:
+                pass
 
     def _begin_drain(self, dep: _DeploymentState, rid: str):
         """Transition a RUNNING replica to DRAINING: routers stop picking it
@@ -764,6 +816,7 @@ class ServeController:
                         "replica_id": r.replica_id,
                         "state": r.state,
                         "pid": r.pid,
+                        "node_id": r.node_id,
                         "queue_len": r.queue_len,
                         "affinity_keys": r.affinity_keys,
                         "warmup_s": r.warmup_s,

@@ -34,6 +34,7 @@ from ..exceptions import (
     ActorDiedError,
     BackPressureError,
     DeadlineExceededError,
+    NodeFencedError,
     ReplicaDrainingError,
     RpcError,
     WorkerCrashedError,
@@ -75,7 +76,8 @@ def _unwrap(exc: BaseException) -> BaseException:
 
 
 _TYPED_SERVE_ERRORS = (
-    BackPressureError, DeadlineExceededError, ReplicaDrainingError,
+    BackPressureError, DeadlineExceededError, NodeFencedError,
+    ReplicaDrainingError,
 )
 
 
@@ -115,7 +117,7 @@ class _RequestContext:
 
     def _retryable(self, exc: BaseException) -> bool:
         if isinstance(exc, (ActorDiedError, WorkerCrashedError, RpcError,
-                            ReplicaDrainingError)):
+                            ReplicaDrainingError, NodeFencedError)):
             return True
         if isinstance(exc, BackPressureError):
             return self.retry_backpressure

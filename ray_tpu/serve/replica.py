@@ -142,6 +142,7 @@ class Replica:
         counts as accepted. Order matters: drain check first (stale routers
         get a retryable error), then DOA, then capacity. Raises fast —
         shedding must cost milliseconds, not a timeout."""
+        self._check_fenced()
         if self._draining:
             from ..exceptions import ReplicaDrainingError
             from ..util import events as _events
@@ -181,6 +182,7 @@ class Replica:
         self._queued += 1
         try:
             while True:
+                self._check_fenced()
                 if self._draining:
                     from ..exceptions import ReplicaDrainingError
                     from ..util import events as _events
@@ -207,6 +209,19 @@ class Replica:
                     pass
         finally:
             self._queued -= 1
+
+    def _check_fenced(self):
+        """Split-brain guard: this replica's node lost GCS contact, so the
+        controller may already be starting a replacement elsewhere. Reject
+        with a retryable typed error so routers fail over instead of
+        double-serving (or hanging on a partitioned node)."""
+        from ..util import fencing
+
+        if fencing.is_fenced():
+            from ..exceptions import NodeFencedError
+
+            _fenced, node_id, reason = fencing.fence_info()
+            raise NodeFencedError(node_id, reason or "gcs unreachable")
 
     def _release(self):
         self._ongoing -= 1
@@ -447,8 +462,16 @@ class Replica:
     # -- control plane -------------------------------------------------------
 
     def get_metrics(self) -> Dict[str, Any]:
+        from .. import _worker_api
+
+        try:
+            worker = _worker_api.get_core_worker()
+            node_id = worker.node_id.hex() if worker.node_id else ""
+        except Exception:
+            node_id = ""
         return {
             "replica_id": self._replica_id,
+            "node_id": node_id,
             "queue_len": self._ongoing + self._queued,
             "ongoing": self._ongoing,
             "queued": self._queued,

@@ -209,6 +209,39 @@ def kill_serve_replica(app_name: str = "default",
     return None, None
 
 
+def _gcs_kv(method, *args):
+    from . import _worker_api
+
+    worker = _worker_api.get_core_worker()
+    client = worker.client_pool.get(*worker.gcs_address)
+    return _worker_api.run_on_worker_loop(
+        client.call(method, *args, timeout=10.0)
+    )
+
+
+def set_network_chaos(spec: dict):
+    """Network-chaos primitive: publish a structured chaos-mesh spec (see
+    ``_internal.rpc.set_rpc_chaos``) to the GCS KV so every process in the
+    cluster — raylets, workers, drivers — applies it within ~1 poll period.
+    The programmatic twin of ``ray_tpu chaos net``."""
+    import json as _json
+
+    from .runtime.gcs import keys as gcs_keys
+
+    _gcs_kv(
+        "kv_put", gcs_keys.CHAOS_NET_SPEC,
+        _json.dumps(spec).encode(), True,
+    )
+
+
+def clear_network_chaos():
+    """Remove the cluster chaos-mesh spec; every process heals (reverts to
+    no injected faults) within ~1 poll period."""
+    from .runtime.gcs import keys as gcs_keys
+
+    _gcs_kv("kv_del", gcs_keys.CHAOS_NET_SPEC)
+
+
 class NodeKiller:
     """Removes random non-head nodes from a cluster_utils.Cluster at an
     interval (reference: NodeKillerBase killing raylets during chaos

@@ -77,6 +77,11 @@ ENGINE_ADMISSION_BLOCKED = EventName("engine_admission_blocked")
 WORKER_DEATH = EventName("worker_death")
 WATCHDOG_STUCK = EventName("watchdog_stuck")
 WATCHDOG_RECOVERED = EventName("watchdog_recovered")
+NODE_SUSPECT = EventName("node_suspect")
+NODE_FENCED = EventName("node_fenced")
+NODE_UNFENCED = EventName("node_unfenced")
+CIRCUIT_OPEN = EventName("circuit_open")
+CIRCUIT_CLOSE = EventName("circuit_close")
 
 
 # -- recording ----------------------------------------------------------------

@@ -799,6 +799,103 @@ def serve_ft_summary(payloads: List[dict]) -> Dict[str, object]:
 
 
 # ---------------------------------------------------------------------------
+# Partition-tolerance plane: control-plane retry counts (retry_call),
+# per-peer circuit-breaker state, and node self-fence transitions. Same
+# shape as the serve_ft section above: process-local partition_counters
+# back tests and bench, pushed snapshots roll up via partition_summary.
+# ---------------------------------------------------------------------------
+
+_partition_metrics: Optional[dict] = None
+_partition_init_lock = threading.Lock()
+
+
+def _ensure_partition_metrics() -> dict:
+    global _partition_metrics
+    if _partition_metrics is None:
+        with _partition_init_lock:
+            if _partition_metrics is None:
+                _partition_metrics = {
+                    "retry": Counter(
+                        "rpc_retry_total",
+                        "Control-plane RPC retries performed by retry_call "
+                        "after a transport-level failure",
+                        tag_keys=("method",),
+                    ),
+                    "circuit": Gauge(
+                        "rpc_circuit_state",
+                        "Per-peer circuit-breaker state: 0 closed, 1 open "
+                        "(failing fast), 2 half-open (probe in flight)",
+                        tag_keys=("peer",),
+                    ),
+                    "fenced": Counter(
+                        "node_fenced_total",
+                        "Raylet self-fence transitions (GCS unreachable "
+                        "past the liveness window)",
+                        tag_keys=("node",),
+                    ),
+                }
+    return _partition_metrics
+
+
+def record_rpc_retry(method: str):
+    _ensure_partition_metrics()["retry"].inc(1.0, {"method": method})
+
+
+def set_rpc_circuit_state(peer: str, state: int):
+    _ensure_partition_metrics()["circuit"].set(float(state), {"peer": peer})
+
+
+def record_node_fenced(node: str):
+    _ensure_partition_metrics()["fenced"].inc(1.0, {"node": node})
+
+
+def partition_counters() -> Dict[str, float]:
+    """Process-local totals (tests + bench): retries count in the calling
+    process, fence transitions in the raylet's process. circuits_open is
+    the number of peers whose breaker is currently not closed."""
+    m = _ensure_partition_metrics()
+    out: Dict[str, float] = {}
+    for label, metric in (("retries", m["retry"]), ("fenced", m["fenced"])):
+        with metric._lock:
+            out[label] = float(sum(metric._values.values()))
+    circuit = m["circuit"]
+    with circuit._lock:
+        out["circuits_open"] = float(
+            sum(1 for v in circuit._values.values() if v)
+        )
+    return out
+
+
+def partition_summary(payloads: List[dict]) -> Dict[str, object]:
+    """Cluster rollup of the partition-tolerance plane from every worker's
+    pushed snapshot (state.metrics_summary / dashboard)."""
+    out = {
+        "retries": 0.0,
+        "fenced": 0.0,
+        "circuits_open": 0.0,
+        "retry_methods": {},
+    }
+    for payload in payloads:
+        for snap in payload.get("metrics", []):
+            name = snap.get("name")
+            if name == "rpc_retry_total":
+                out["retries"] += sum(snap["values"].values())
+                for tag_json, value in snap["values"].items():
+                    tags = dict(zip(snap["tag_keys"], json.loads(tag_json)))
+                    method = tags.get("method", "?")
+                    out["retry_methods"][method] = (
+                        out["retry_methods"].get(method, 0.0) + value
+                    )
+            elif name == "node_fenced_total":
+                out["fenced"] += sum(snap["values"].values())
+            elif name == "rpc_circuit_state":
+                out["circuits_open"] += sum(
+                    1 for v in snap["values"].values() if v
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Device telemetry: per-device HBM used/limit gauges sampled from
 # jax.local_devices() memory stats, tagged by node and device. Sampled by
 # the metrics pusher whenever jax is already imported in this process (no

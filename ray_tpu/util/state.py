@@ -22,10 +22,16 @@ def _gcs_call(method: str, *args):
 
 
 def list_nodes() -> List[Dict[str, Any]]:
+    try:
+        states = _gcs_call("get_node_states") or {}
+    except Exception:
+        states = {}  # older GCS: fall back to the boolean alive flag
     return [
         {
             "node_id": n.node_id.hex(),
-            "state": "ALIVE" if n.alive else "DEAD",
+            "state": states.get(
+                n.node_id.hex(), "ALIVE" if n.alive else "DEAD"
+            ),
             "address": f"{n.address[0]}:{n.address[1]}",
             "resources_total": n.resources_total,
             "labels": n.labels,
@@ -119,6 +125,7 @@ def metrics_summary() -> Dict[str, Any]:
         device_rows,
         fetch_metric_payloads,
         kvcache_summary,
+        partition_summary,
         serve_ft_summary,
         serve_latency_summary,
         train_ft_summary,
@@ -181,6 +188,7 @@ def metrics_summary() -> Dict[str, Any]:
         "serve_ft": serve_ft_summary(payloads),
         "serve_latency": serve_latency_summary(payloads),
         "autoscale": autoscale_summary(payloads),
+        "partition": partition_summary(payloads),
     }
 
 

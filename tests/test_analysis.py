@@ -339,9 +339,10 @@ def test_rt007_ignores_unrelated_classes(tmp_path):
 # ------------------------------------------------------------- framework
 
 
-def test_catalog_has_all_seven_rules():
+def test_catalog_has_all_eight_rules():
     assert sorted(checker_catalog()) == [
         "RT001", "RT002", "RT003", "RT004", "RT005", "RT006", "RT007",
+        "RT008",
     ]
 
 
@@ -501,6 +502,7 @@ _EXC_INSTANCES = [
     exceptions.BackPressureError("replica-1", 4, 9, 0.25),
     exceptions.DeadlineExceededError("deploy", 1.5, 1.0, "handle"),
     exceptions.ReplicaDrainingError("replica-2"),
+    exceptions.NodeFencedError("node-3", "gcs unreachable"),
     exceptions.RpcError("connection reset"),
     exceptions.PendingCallsLimitExceeded("queue cap"),
 ]

@@ -5,6 +5,8 @@ each chunk must leave the publisher node exactly once — relayed peer-to-peer
 down the binomial tree — and co-located subscribers must dedupe through
 their node's store."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -95,6 +97,115 @@ def test_publisher_upload_is_o1_in_subscriber_nodes(bcast_cluster):
         assert total_serves.get(oid.hex(), 0) == N_SUB_NODES, (
             oid.hex(), total_serves
         )
+
+
+def test_broadcast_repair_under_directional_partition():
+    """Tree repair under a directional partition: the child node's route TO
+    its broadcast parent drops (parent->child still flows). The child's
+    parent-wait fails fast, falls back to an unconstrained pull (weights
+    still delivered, each chunk once per node — no retry storm), and
+    reports the fallback; two reports prune the parent from the tree and
+    the child is promoted to seed on its next plan."""
+    from ray_tpu import testing
+    from ray_tpu.util.state import _gcs_call
+
+    model = "repair/model"
+    cluster = Cluster(
+        head_node_args=dict(num_cpus=2),
+        _system_config={
+            "object_transfer_native_enabled": False,
+            "chaos_poll_period_s": 0.2,
+        },
+    )
+    try:
+        sub_nodes = [
+            cluster.add_node(num_cpus=1, resources={f"sub{i}": 4.0})
+            for i in range(2)
+        ]
+        cluster.connect()
+
+        @ray_tpu.remote(num_cpus=0)
+        class Sub:
+            def fetch(self, name):
+                from ray_tpu.weights import WeightSubscriber
+
+                sub = WeightSubscriber(name)
+                version, value = sub.get(timeout=60)
+                checksum = float(sum(value[k].sum() for k in value))
+                sub.release()
+                return version, checksum
+
+        seed_node, child_node = sub_nodes
+        seed_addr = tuple(seed_node.raylet.address)
+        child_addr = tuple(child_node.raylet.address)
+        # register positions in a known order: sub0 = seed, sub1 = child
+        assert _gcs_call("weights_plan", model, seed_addr)["position"] == 0
+        child_plan = _gcs_call("weights_plan", model, child_addr)
+        assert child_plan["position"] == 1
+        assert tuple(child_plan["parent"]) == seed_addr
+
+        actors = [
+            Sub.options(resources={"sub0": 1.0}).remote(),
+            Sub.options(resources={"sub1": 1.0}).remote(),
+        ]
+
+        # child -> parent drops; parent -> child (and everything else) flows
+        testing.set_network_chaos({
+            "seed": 3,
+            "rules": [{
+                "src": child_node.node_id.hex()[:12],
+                "dst": f"{seed_addr[0]}:{seed_addr[1]}",
+                "fail": 1.0,
+            }],
+        })
+        time.sleep(0.8)  # let every process poll the spec
+
+        pub = WeightPublisher(model, chunk_size=1 << 20)
+        params = {
+            f"l{i}": np.arange(125_000, dtype=np.float64) + i
+            for i in range(2)
+        }
+        v1 = pub.publish(params)
+        expected = float(sum(params[k].sum() for k in params))
+        results = ray_tpu.get(
+            [a.fetch.remote(model) for a in actors], timeout=300
+        )
+        assert results == [(v1, expected), (v1, expected)]
+
+        # one fallback report so far: the parent is not yet pruned
+        plan = _gcs_call("weights_plan", model, child_addr)
+        assert tuple(plan["parent"] or ()) == seed_addr
+
+        # each chunk moved exactly once per subscriber node (the child's
+        # fallback pulled from another holder, it did not retry-storm)
+        chunk_ids = pub._held_ids[v1]
+        total_serves = {}
+        for node in cluster.list_nodes():
+            for hex_id, n in _transfer_stats(node)["fetch_serves"].items():
+                total_serves[hex_id] = total_serves.get(hex_id, 0) + n
+        for oid in chunk_ids:
+            assert total_serves.get(oid.hex(), 0) == len(sub_nodes), (
+                oid.hex(), total_serves
+            )
+
+        # a second faulted fetch produces the second report -> prune
+        v2 = pub.publish({k: v + 1 for k, v in params.items()})
+        results = ray_tpu.get(
+            [a.fetch.remote(model) for a in actors], timeout=300
+        )
+        assert [r[0] for r in results] == [v2, v2]
+
+        plan = _gcs_call("weights_plan", model, child_addr)
+        assert plan["position"] == 0 and plan["parent"] is None, (
+            f"tree not repaired: {plan}"
+        )
+    finally:
+        try:
+            testing.clear_network_chaos()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+        cluster.shutdown()
 
 
 def test_tree_positions_span_nodes(bcast_cluster):
