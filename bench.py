@@ -976,6 +976,187 @@ def chaos_soak():
         ray_tpu.shutdown()
 
 
+def proxy_saturation():
+    """`python bench.py proxy_saturation` — multi-proxy ingress scaling.
+
+    For n in (1, 2, 4) HTTP proxies sharing ONE port via SO_REUSEPORT:
+    (a) closed-loop capacity — persistent-connection client threads
+    hammer the shared port and the sustained req/s is recorded (each
+    connection pins to whichever proxy the kernel accepted it on, so the
+    thread pool spreads across all listeners); (b) an open-loop burst at
+    ~10x one proxy's per-thread base rate replayed through fresh
+    connections for tail latency under saturation; (c) a prefix-affinity
+    agreement check — the same token-id prefix sent over fresh
+    connections must reach ONE serving replica regardless of which proxy
+    terminates each request, because every proxy computes the same
+    rendezvous-hash pick locally (no controller round-trip). Reports the
+    1 -> 2 -> 4 scaling curve. CPU backend: the ingress path is
+    backend-independent."""
+    import http.client
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import ray_tpu
+    from ray_tpu import loadgen, serve
+
+    port = 18411
+    client_threads = 24
+    capacity_s = 3.0
+    burst_s = 2.0
+    ray_tpu.init(num_cpus=8)
+
+    def measure_capacity(n_threads: int, duration_s: float):
+        stop_at = time.perf_counter() + duration_s
+        counts = [0] * n_threads
+        errors = [0] * n_threads
+        proxy_ids = set()
+        lock = threading.Lock()
+
+        def worker(k: int):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            # per-thread affinity prefix: load spreads across replicas
+            # while each thread's requests stay cache-warm
+            body = json.dumps({"token_ids": [k % 16] * 8}).encode()
+            headers = {"Content-Type": "application/json"}
+            seen = None
+            while time.perf_counter() < stop_at:
+                try:
+                    conn.request("POST", "/", body, headers)
+                    resp = conn.getresponse()
+                    resp.read()
+                except Exception:
+                    errors[k] += 1
+                    conn.close()
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=10
+                    )
+                    continue
+                if resp.status == 200:
+                    counts[k] += 1
+                else:
+                    errors[k] += 1
+                pid = resp.headers.get("X-Proxy-Id")
+                if pid != seen:
+                    seen = pid
+                    with lock:
+                        proxy_ids.add(pid)
+            conn.close()
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=worker, args=(k,), daemon=True)
+            for k in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return sum(counts) / wall, sum(errors), sorted(
+            p for p in proxy_ids if p
+        )
+
+    def affinity_check(samples: int = 16):
+        # fresh connection per request: the kernel re-picks the accepting
+        # proxy each time, so agreement across proxies is what's tested
+        body = json.dumps({"token_ids": [7] * 8}).encode()
+        serving_pids, via_proxies = set(), set()
+        for _ in range(samples):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            conn.request("POST", "/", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+            if resp.status == 200:
+                serving_pids.add(json.loads(data)["result"]["pid"])
+                via_proxies.add(resp.headers.get("X-Proxy-Id"))
+        return sorted(serving_pids), sorted(p for p in via_proxies if p)
+
+    results = {}
+    try:
+        for n in (1, 2, 4):
+            serve.shutdown()
+            serve.start(http_port=port, num_proxies=n)
+
+            @serve.deployment(num_replicas=2, max_ongoing_requests=32,
+                              max_queued_requests=4096,
+                              request_router_config=dict(
+                                  prefix_affinity_tokens=4))
+            class Echo:
+                def __call__(self, payload):
+                    import os as _os
+
+                    if isinstance(payload, (bytes, bytearray)):
+                        return {"pid": _os.getpid(), "n": len(payload)}
+                    return {
+                        "pid": _os.getpid(),
+                        "n": len(payload.get("token_ids", [])),
+                    }
+
+            serve.run(Echo.bind(), name="echo", route_prefix="/")
+            rps, errors, proxy_ids = measure_capacity(
+                client_threads, capacity_s
+            )
+            _log(f"n={n}: closed-loop {rps:.0f} req/s "
+                 f"({errors} errors) via proxies {proxy_ids}")
+
+            burst_rps = max(50.0, rps)
+            trace = loadgen.echo_trace(
+                int(burst_rps * burst_s), burst_rps, seed=n,
+            )
+            gen = loadgen.LoadGenerator(
+                loadgen.HTTPTarget(f"http://127.0.0.1:{port}/"),
+                max_inflight=256, dispatchers=4,
+            )
+            burst = gen.run(trace).summary()
+            _log(f"n={n}: burst {burst['offered_rps']} rps offered, "
+                 f"p99 {burst.get('latency_p99_ms')}ms, "
+                 f"outcomes {burst['outcomes']}")
+
+            pids, vias = affinity_check()
+            _log(f"n={n}: affinity prefix -> replicas {pids} "
+                 f"via proxies {vias}")
+            results[n] = {
+                "closed_loop_rps": round(rps, 1),
+                "client_errors": errors,
+                "proxies_seen": proxy_ids,
+                "burst_offered_rps": burst["offered_rps"],
+                "burst_p99_ms": burst.get("latency_p99_ms"),
+                "burst_outcomes": burst["outcomes"],
+                "burst_max_lag_s": burst["max_lag_s"],
+                "affinity_serving_replicas": len(pids),
+                "affinity_via_proxies": len(vias),
+            }
+        base = results[1]["closed_loop_rps"] or 1.0
+        scale2 = results[2]["closed_loop_rps"] / base
+        scale4 = results[4]["closed_loop_rps"] / base
+        _log(f"scaling: 1x -> {scale2:.2f}x (2 proxies) -> "
+             f"{scale4:.2f}x (4 proxies)")
+        print(json.dumps({
+            "metric": "proxy_saturation_scaling_x4",
+            "value": round(scale4, 2),
+            "unit": "closed-loop capacity ratio, 4 proxies vs 1 "
+                    "(one shared SO_REUSEPORT port)",
+            "scaling_x2": round(scale2, 2),
+            "per_proxy_count": results,
+            "config": {
+                "client_threads": client_threads,
+                "capacity_window_s": capacity_s,
+                "burst_window_s": burst_s,
+                "replicas": 2,
+                "prefix_affinity_tokens": 4,
+                "backend": "cpu",
+            },
+        }))
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "llm_prefix_cache":
         llm_prefix_cache()
@@ -985,6 +1166,8 @@ if __name__ == "__main__":
         serve_churn()
     elif len(sys.argv) > 1 and sys.argv[1] == "serve_autoscale":
         serve_autoscale()
+    elif len(sys.argv) > 1 and sys.argv[1] == "proxy_saturation":
+        proxy_saturation()
     elif len(sys.argv) > 1 and sys.argv[1] == "chaos_soak":
         chaos_soak()
     elif len(sys.argv) > 1:

@@ -54,6 +54,9 @@ _V2_BUFLEN = struct.Struct("<Q")
 # Buffers below this stay inline in the meta pickle; splitting tiny buffers
 # out-of-band costs more than it saves (mirrors serialization._OOB_THRESHOLD).
 _RPC_OOB_THRESHOLD = 1 * 1024
+# Public alias: payload producers (the ingress proxies) size-gate whether to
+# wrap bodies in bytearray so they ride the zero-copy out-of-band path.
+RPC_OOB_THRESHOLD = _RPC_OOB_THRESHOLD
 
 # Wire/framing counters for tests and the microbenchmark proof layer.
 _frame_stats = {
@@ -852,7 +855,11 @@ class RpcClient:
     def address(self) -> Tuple[str, int]:
         return (self.host, self.port)
 
-    async def _ensure_connected(self):
+    async def _ensure_connected(self, timeout: Optional[float] = None):
+        """``timeout`` caps the connect-retry window below the client's
+        ``connect_timeout``: a call that carries a deadline must not spend
+        longer than that deadline retrying a refused connect (a SIGKILLed
+        peer refuses instantly but used to be retried for the full window)."""
         if self._closed:
             raise _transport_error(f"{self.name}: client is closed")
         if self._writer is not None and not self._writer.is_closing():
@@ -860,7 +867,10 @@ class RpcClient:
         async with self._lock:
             if self._writer is not None and not self._writer.is_closing():
                 return
-            deadline = asyncio.get_event_loop().time() + self._connect_timeout
+            window = self._connect_timeout
+            if timeout is not None:
+                window = min(window, timeout)
+            deadline = asyncio.get_event_loop().time() + window
             delay = 0.02
             while True:
                 try:
@@ -947,7 +957,7 @@ class RpcClient:
                     f"{self.name}: injected failure for {method}"
                 )
         try:
-            await self._ensure_connected()
+            await self._ensure_connected(timeout)
         except BaseException:
             self._breaker_record(False)
             raise

@@ -199,6 +199,8 @@ class DashboardServer:
             # serve fault-tolerance rollup (failover retries, sheds,
             # DOA rejections, drain durations)
             ("GET", "/api/serve"): self._serve,
+            # ingress data plane: live proxy registry + per-proxy traffic
+            ("GET", "/api/proxies"): self._proxies,
             # SLO-autoscaler decision log + scale counters
             ("GET", "/api/autoscale"): self._autoscale,
             # flight recorder: cluster-wide structured events (state
@@ -287,6 +289,33 @@ class DashboardServer:
 
         return 200, {
             "fault_tolerance": serve_ft_summary(self._metric_payloads()),
+        }, None
+
+    def _proxies(self, body):
+        import json as _json
+
+        from ..util.metrics import ingress_summary
+
+        proxies = []
+        try:
+            for key in self._gcs("kv_keys", gcs_keys.SERVE_PROXY.scan) or []:
+                raw = self._gcs("kv_get", key)
+                if not raw:
+                    continue
+                try:
+                    rec = _json.loads(bytes(raw).decode())
+                except Exception:
+                    continue
+                rec.setdefault(
+                    "proxy_id", gcs_keys.SERVE_PROXY.strip(key)
+                )
+                proxies.append(rec)
+        except Exception:
+            pass
+        proxies.sort(key=lambda r: str(r.get("proxy_id")))
+        return 200, {
+            "proxies": proxies,
+            "traffic": ingress_summary(self._metric_payloads()),
         }, None
 
     def _autoscale(self, body):

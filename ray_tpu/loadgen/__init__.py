@@ -18,7 +18,7 @@ from .runner import (
     RequestResult,
 )
 from .trace import Trace, TraceRecord, bundled_trace
-from .workload import RequestClass, ZipfPrefixes, synthesize
+from .workload import RequestClass, ZipfPrefixes, echo_trace, synthesize
 
 __all__ = [
     "BurstyRampArrivals",
@@ -34,5 +34,6 @@ __all__ = [
     "TraceRecord",
     "ZipfPrefixes",
     "bundled_trace",
+    "echo_trace",
     "synthesize",
 ]

@@ -116,3 +116,25 @@ def synthesize(
         },
         requests=records,
     )
+
+
+def echo_trace(num_requests: int, rps: float, *, num_prefixes: int = 8,
+               prefix_tokens: int = 4, seed: int = 0) -> Trace:
+    """High-rate ingress workload: tiny ``echo``-class requests on a
+    uniform arrival grid at ``rps``. The payloads are deliberately near
+    free to serve (8 prompt tokens, no generation, no deadline) so a
+    replay measures the ingress path — proxy dispatch, routing pick,
+    framing — rather than replica compute. Prefix ids still Zipf-cycle so
+    the trace exercises prefix-affinity routing at rate."""
+    if num_requests < 1 or rps <= 0:
+        raise ValueError("need num_requests >= 1 and rps > 0")
+    arrivals = [i / float(rps) for i in range(int(num_requests))]
+    classes = [RequestClass(
+        "echo", weight=1.0, prompt_tokens=8, max_new_tokens=0,
+        deadline_s=None,
+    )]
+    prefixes = ZipfPrefixes(
+        num_prefixes=num_prefixes, alpha=1.1,
+        prefix_tokens=prefix_tokens, seed=seed,
+    )
+    return synthesize(arrivals, classes, prefixes, seed=seed)

@@ -126,6 +126,12 @@ CHAOS_NET = KeyPrefix(
     "cluster-wide network chaos-mesh spec (JSON rules), polled by every "
     "process and applied client-side in the RPC layer",
 )
+SERVE_PROXY = KeyPrefix(
+    "proxy",
+    "serve ingress proxy registry proxy:<proxy_id> → identity JSON (kind, "
+    "host, port, pid, node); written by the controller on register, "
+    "removed on drain/death so CLI/dashboard/chaos see live proxies only",
+)
 
 # -- fixed keys under the serve prefix --------------------------------------
 

@@ -55,6 +55,12 @@ from .worker_pool import WorkerHandle, WorkerPool
 
 logger = logging.getLogger(__name__)
 
+# Per-location connect bound for object pulls: long enough for a loaded
+# peer to accept a TCP connection, short enough that a dead holder does
+# not stall the get (the caller falls through to the next holder or to
+# lineage reconstruction).
+_PULL_CONNECT_PROBE_S = 2.0
+
 
 class Lease:
     __slots__ = (
@@ -1276,6 +1282,23 @@ class Raylet:
             return False
         for node_address in loc:
             if tuple(node_address) == tuple(self.address):
+                continue
+            # Reachability gate: a dead holder refuses connects instantly,
+            # but the client's connect-retry window would eat seconds per
+            # attempt (native probe + chunked fallback) before the caller
+            # can move on to reconstruction. Bound the connect here; the
+            # transfer itself stays unbounded (big objects take long
+            # legitimately).
+            try:
+                peer = self.client_pool.get(*node_address)
+                await asyncio.wait_for(
+                    peer._ensure_connected(), _PULL_CONNECT_PROBE_S
+                )
+            except Exception as e:
+                logger.debug(
+                    "pull of %s: holder %s unreachable (%s), trying next",
+                    object_id, node_address, e,
+                )
                 continue
             try:
                 if await self._native_pull(object_id, node_address):

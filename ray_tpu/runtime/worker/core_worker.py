@@ -73,6 +73,12 @@ from .memory_store import MemoryStore
 
 logger = logging.getLogger(__name__)
 
+# Connect bound when probing a spillback lease target (see
+# _acquire_lease_loop): long enough for a loaded raylet to accept a TCP
+# connection, short enough that a stale redirect to a dead raylet does
+# not stall the submission pipeline.
+_LEASE_CONNECT_PROBE_S = 2.0
+
 
 class WorkerMode(enum.Enum):
     DRIVER = 0
@@ -1150,6 +1156,25 @@ class CoreWorker:
     ) -> dict:
         while True:
             raylet = self.client_pool.get(*target)
+            if tuple(target) != tuple(self.raylet_address):
+                # A spillback redirect can point at a raylet that just
+                # died (the redirecting raylet's cluster view is stale).
+                # Probe reachability with a short bound instead of paying
+                # the full connect-retry window and burning a task retry
+                # attempt; the local raylet re-routes once its view
+                # catches up.
+                try:
+                    await asyncio.wait_for(
+                        raylet._ensure_connected(), _LEASE_CONNECT_PROBE_S
+                    )
+                except Exception:
+                    logger.debug(
+                        "lease for %s: spillback target %s unreachable, "
+                        "returning to local raylet", spec.task_id, target,
+                    )
+                    target = self.raylet_address
+                    await asyncio.sleep(0.5)
+                    continue
             reply = await raylet.call(
                 "request_worker_lease", spec, reusable, timeout=None
             )

@@ -273,6 +273,28 @@ def cmd_events(args):
     return 0
 
 
+def cmd_proxies(args):
+    """`ray_tpu proxies`: the ingress data plane — live proxy registry
+    (``proxy:*`` GCS records: kind, host:port, pid, node) joined with the
+    per-proxy traffic rollup (requests by outcome, inflight, latency
+    p50/p99) from the pushed metrics plane."""
+    _connected(args)
+    from ..util import state
+    from ..util.metrics import fetch_metric_payloads, ingress_summary
+
+    proxies = state.list_proxies()
+    try:
+        traffic = ingress_summary(
+            fetch_metric_payloads(state._gcs_call)
+        ).get("proxies", {})
+    except Exception:  # noqa: BLE001 — registry still prints without metrics
+        traffic = {}
+    for row in proxies:
+        row["traffic"] = traffic.get(row.get("proxy_id"), {})
+    print(json.dumps(proxies, indent=2, default=str))
+    return 0
+
+
 def cmd_chaos(args):
     """`ray_tpu chaos`: fault injection against a live cluster — the
     operator-facing face of the elastic-training chaos layer.
@@ -289,6 +311,10 @@ def cmd_chaos(args):
       replica process (same-host pids only) — replica-loss / stuck-replica
       injection; the handle retry envelope plus controller health polling
       must absorb it.
+    - ``kill-proxy``: SIGKILL one ingress proxy process (same-host pids
+      only) — front-end-loss injection; surviving proxies on the shared
+      SO_REUSEPORT listener keep accepting and the controller's health
+      poll deregisters the corpse.
     - ``drain``: gracefully drain one serve replica through the
       controller's DRAINING state machine (rolling-restart injection).
     - ``net``: cluster-wide network chaos mesh. Writes a structured spec
@@ -381,6 +407,17 @@ def cmd_chaos(args):
             return 1
         verb = "killed" if sig == signal.SIGKILL else "paused"
         print(f"{verb} replica {rid} (pid {pid}) of app {args.app!r}")
+        return 0
+    if args.chaos_action == "kill-proxy":
+        from ..testing import kill_serve_proxy
+
+        proxy_id, pid = kill_serve_proxy(args.proxy)
+        if proxy_id is None:
+            print("no matching live proxy (pids are same-host only; see "
+                  "`ray_tpu proxies`)", file=sys.stderr)
+            return 1
+        print(f"killed proxy {proxy_id} (pid {pid}); survivors on the "
+              f"shared listener keep serving")
         return 0
     if args.chaos_action == "drain":
         from .. import api
@@ -672,14 +709,23 @@ def main(argv=None):
     p.set_defaults(fn=cmd_events)
 
     p = sub.add_parser(
+        "proxies",
+        help="ingress data plane: live proxy registry + per-proxy "
+             "traffic rollup",
+    )
+    p.add_argument("--address", required=True, help="head host:port")
+    p.set_defaults(fn=cmd_proxies)
+
+    p = sub.add_parser(
         "chaos",
-        help="fault injection: kill ranks/replicas, abort/delay "
+        help="fault injection: kill ranks/replicas/proxies, abort/delay "
              "collectives, drain replicas, network chaos mesh",
     )
     p.add_argument(
         "chaos_action",
         choices=["list", "kill-rank", "abort-group", "delay-collective",
-                 "kill-replica", "pause-replica", "drain", "net"],
+                 "kill-replica", "pause-replica", "kill-proxy", "drain",
+                 "net"],
     )
     p.add_argument("--address", required=True, help="head host:port")
     p.add_argument("--run", default=None, help="train run name (kill-rank)")
@@ -695,6 +741,11 @@ def main(argv=None):
         "--replica", default=None,
         help="replica id (required for drain; optional filter for "
              "kill-replica/pause-replica)",
+    )
+    p.add_argument(
+        "--proxy", default=None,
+        help="proxy id (optional filter for kill-proxy; see "
+             "`ray_tpu proxies`)",
     )
     p.add_argument("--rank", type=int, default=0, help="world rank to kill")
     p.add_argument("--group", default=None, help="collective group name")

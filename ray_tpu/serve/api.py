@@ -24,7 +24,10 @@ from .config import (
 from .controller import CONTROLLER_NAME, ServeController
 from .handle import DeploymentHandle, DeploymentResponse
 
-_state: Dict[str, Any] = {"controller": None, "proxy": None, "ingress": {}}
+_state: Dict[str, Any] = {
+    "controller": None, "proxy": None, "proxies": [], "grpc_proxies": [],
+    "ingress": {},
+}
 
 
 class Application:
@@ -188,17 +191,39 @@ def ingress(asgi_app):
 # -- controller / proxy management -------------------------------------------
 
 
+def _default_num_proxies() -> int:
+    """One proxy per alive node (the reference's proxy placement); at
+    least one. Falls back to 1 when node state is unavailable."""
+    try:
+        return max(
+            1, sum(1 for n in ray_api.nodes() if n.get("Alive", True))
+        )
+    except Exception:
+        return 1
+
+
+def _register_proxy(controller, p, proxy_id: str):
+    """Fetch the proxy's identity and enter it into the controller's
+    inventory (GCS ``proxy:`` registry) so drains/chaos/CLI see it."""
+    info = ray_api.get(p.describe.remote())
+    ray_api.get(controller.register_proxy.remote(proxy_id, info, p))
+
+
 def start(
     *,
     http_host: str = "127.0.0.1",
     http_port: int = 8000,
     proxy: bool = True,
     grpc_port: Optional[int] = None,
+    num_proxies: Optional[int] = None,
+    num_grpc_proxies: int = 1,
 ):
     """Start (or connect to) the Serve control plane (reference:
-    serve.start): a detached-ish named controller actor plus one HTTP proxy
-    actor, and — with ``grpc_port`` — a gRPC ingress (reference: the gRPC
-    proxy, proxy.py:533; 0 picks a free port, see serve.grpc_proxy_address)."""
+    serve.start): a detached-ish named controller actor plus the ingress
+    data plane — ``num_proxies`` HTTP proxy actors (default: one per alive
+    node) sharing ``http_port`` via SO_REUSEPORT, and — with ``grpc_port``
+    — ``num_grpc_proxies`` gRPC proxies the same way (0 picks a free port,
+    see serve.grpc_proxy_address)."""
     if _state["controller"] is None:
         try:
             controller = ray_api.get_actor(CONTROLLER_NAME)
@@ -212,20 +237,47 @@ def start(
             controller = Controller.remote()
             ray_api.get(controller.ping.remote())
         _state["controller"] = controller
-    if proxy and _state["proxy"] is None:
+    if proxy and not _state["proxies"]:
         from .proxy import HTTPProxy
 
+        n = num_proxies if num_proxies else _default_num_proxies()
+        reuse = n > 1
         Proxy = ray_api.remote(num_cpus=0)(HTTPProxy)
-        p = Proxy.remote(_state["controller"], http_host, http_port)
-        ray_api.get(p.ping.remote())
-        _state["proxy"] = p
-    if grpc_port is not None and _state.get("grpc_proxy") is None:
+        started = []
+        for i in range(n):
+            proxy_id = f"http#{i}"
+            p = Proxy.remote(
+                _state["controller"], http_host, http_port, proxy_id, reuse
+            )
+            ray_api.get(p.ping.remote())
+            started.append((proxy_id, p))
+        for proxy_id, p in started:
+            _register_proxy(_state["controller"], p, proxy_id)
+        _state["proxies"] = [p for _, p in started]
+        _state["proxy"] = _state["proxies"][0]
+    if grpc_port is not None and not _state["grpc_proxies"]:
         from .grpc_proxy import GRPCProxy
 
+        n = max(1, int(num_grpc_proxies))
+        # port 0 means "pick free": listener sharing needs the REAL port,
+        # so the first proxy binds and the rest join its bound port
+        reuse = n > 1
         GProxy = ray_api.remote(num_cpus=0)(GRPCProxy)
-        gp = GProxy.remote(_state["controller"], http_host, grpc_port)
-        ray_api.get(gp.ping.remote())
-        _state["grpc_proxy"] = gp
+        started = []
+        bound_port = grpc_port
+        for i in range(n):
+            proxy_id = f"grpc#{i}"
+            gp = GProxy.remote(
+                _state["controller"], http_host, bound_port, proxy_id, reuse
+            )
+            ray_api.get(gp.ping.remote())
+            if i == 0 and n > 1:
+                bound_port = ray_api.get(gp.address.remote())[1]
+            started.append((proxy_id, gp))
+        for proxy_id, gp in started:
+            _register_proxy(_state["controller"], gp, proxy_id)
+        _state["grpc_proxies"] = [gp for _, gp in started]
+        _state["grpc_proxy"] = _state["grpc_proxies"][0]
     return _state["controller"]
 
 
@@ -368,14 +420,24 @@ def shutdown():
             ray_api.kill(controller)
         except Exception:
             pass
+    for p in (
+        list(_state.get("proxies") or [])
+        + list(_state.get("grpc_proxies") or [])
+    ):
+        try:
+            ray_api.kill(p)
+        except Exception:
+            pass
     for key in ("proxy", "grpc_proxy"):
         p = _state.get(key)
-        if p is not None:
+        if p is not None and p not in (_state.get("proxies") or []) \
+                and p not in (_state.get("grpc_proxies") or []):
             try:
                 ray_api.kill(p)
             except Exception:
                 pass
-    _state.update(controller=None, proxy=None, grpc_proxy=None, ingress={})
+    _state.update(controller=None, proxy=None, grpc_proxy=None,
+                  proxies=[], grpc_proxies=[], ingress={})
 
 
 def _require_controller():

@@ -50,12 +50,20 @@ class RequestRouterConfig:
     retried on another replica or surfaced to the caller immediately —
     proxies surface it (they own the 503/Retry-After contract), plain
     handles retry by default.
+
+    ``prefix_affinity_tokens`` > 0 turns on prefix-affinity routing for
+    EVERY router of this deployment — proxies included: each request's
+    leading prompt tokens hash onto the shared rendezvous ring
+    (serve/hash_ring.py), so all ingress processes send a given prefix to
+    the same warm replica without a controller round-trip. A handle-level
+    ``options(prefix_affinity_tokens=...)`` still overrides per call site.
     """
 
     max_attempts: int = 3
     backoff_s: float = 0.05
     default_timeout_s: float = 60.0
     retry_backpressure: bool = True
+    prefix_affinity_tokens: int = 0
 
     def as_dict(self) -> Dict[str, Any]:
         return {
@@ -63,6 +71,7 @@ class RequestRouterConfig:
             "backoff_s": self.backoff_s,
             "default_timeout_s": self.default_timeout_s,
             "retry_backpressure": self.retry_backpressure,
+            "prefix_affinity_tokens": self.prefix_affinity_tokens,
         }
 
 

@@ -103,6 +103,12 @@ class ServeController:
         # KV under AUTOSCALE_LOG_KEY so dashboard/CLI read it without an
         # actor handle; actor method autoscale_log serves it directly.
         self._autoscale_events: List[dict] = []
+        # ingress proxy inventory: proxy_id -> {info, handle, state,
+        # failures}. Mirrored to GCS under the proxy: prefix so CLI/
+        # dashboard/chaos see live proxies without an actor handle; health
+        # is polled from the reconcile loop like replicas.
+        self._proxies: Dict[str, dict] = {}
+        self._last_proxy_poll = 0.0
         try:
             self._recover_from_checkpoint()
         except Exception:
@@ -273,6 +279,17 @@ class ServeController:
             self._kv_call("kv_del", AUTOSCALE_LOG_KEY)
         except Exception:
             pass
+        # sweep the proxy registry (including keys from proxies this
+        # controller never saw — a crashed predecessor's leftovers)
+        with self._lock:
+            self._proxies.clear()
+        try:
+            for key in self._kv_call(
+                "kv_keys", gcs_keys.SERVE_PROXY.scan
+            ) or []:
+                self._kv_call("kv_del", key)
+        except Exception:
+            pass
         return True
 
     # -- deploy API ----------------------------------------------------------
@@ -361,6 +378,7 @@ class ServeController:
             return payload_cache["p"]
 
         node_states = self._fetch_node_states()
+        self._poll_proxies()
         for full_name, dep in items:
             self._poll_replicas(dep)
             self._evict_partitioned(dep, node_states)
@@ -822,6 +840,127 @@ class ServeController:
                         "warmup_s": r.warmup_s,
                     })
             return out
+
+    # -- proxy inventory ------------------------------------------------------
+
+    _PROXY_POLL_S = 2.0
+    _PROXY_MAX_FAILURES = 3
+
+    def register_proxy(self, proxy_id: str, info: dict, handle) -> bool:
+        """Add an ingress proxy to the inventory and mirror its identity to
+        the GCS ``proxy:`` prefix (what `ray_tpu proxies`, the dashboard
+        and chaos kill-proxy read)."""
+        import json as _json
+
+        info = dict(info)
+        info.setdefault("proxy_id", proxy_id)
+        with self._lock:
+            self._proxies[proxy_id] = {
+                "info": info, "handle": handle, "state": "RUNNING",
+                "failures": 0,
+            }
+        try:
+            self._kv_call(
+                "kv_put", gcs_keys.SERVE_PROXY.key(proxy_id),
+                _json.dumps(info).encode(), True,
+            )
+        except Exception:
+            logger.exception("proxy registry write failed for %s", proxy_id)
+        _events.record_event(
+            _events.PROXY_START, proxy_id=proxy_id,
+            kind=info.get("kind"), host=info.get("host"),
+            port=info.get("port"), pid=info.get("pid"),
+        )
+        return True
+
+    def deregister_proxy(self, proxy_id: str, reason: str = "stopped") -> bool:
+        with self._lock:
+            entry = self._proxies.pop(proxy_id, None)
+        if entry is None:
+            return False
+        try:
+            self._kv_call("kv_del", gcs_keys.SERVE_PROXY.key(proxy_id))
+        except Exception:
+            pass
+        _events.record_event(
+            _events.PROXY_STOP, proxy_id=proxy_id, reason=reason,
+        )
+        return True
+
+    def list_proxies(self) -> List[Dict[str, Any]]:
+        """Proxy inventory rows (identity + state, no actor handles) for
+        the CLI / dashboard / chaos kill-proxy."""
+        with self._lock:
+            return [
+                {**e["info"], "proxy_id": pid, "state": e["state"]}
+                for pid, e in sorted(self._proxies.items())
+            ]
+
+    def drain_proxy(self, proxy_id: str, timeout_s: float = 5.0) -> bool:
+        """Gracefully retire one proxy: it refuses new requests (503 +
+        Retry-After pushes clients to the survivors), finishes in-flight
+        work bounded by ``timeout_s``, then leaves the inventory."""
+        from .. import api
+
+        with self._lock:
+            entry = self._proxies.get(proxy_id)
+            if entry is None:
+                return False
+            entry["state"] = "DRAINING"
+        try:
+            ok = api.get(
+                entry["handle"].drain.remote(timeout_s),
+                timeout=timeout_s + 5,
+            )
+        except Exception:
+            ok = False
+        self.deregister_proxy(proxy_id, reason="drained")
+        return bool(ok)
+
+    def _poll_proxies(self):
+        """Reconcile-loop health pass over the proxy inventory: a proxy
+        whose actor died (SIGKILL chaos, node loss) is deregistered at
+        once; transient ping failures tolerate _PROXY_MAX_FAILURES
+        consecutive misses before eviction."""
+        from .. import api
+        from ..exceptions import ActorDiedError
+
+        now = time.time()
+        if now - self._last_proxy_poll < self._PROXY_POLL_S:
+            return
+        self._last_proxy_poll = now
+        with self._lock:
+            items = [
+                (pid, e) for pid, e in self._proxies.items()
+                if e["state"] == "RUNNING"
+            ]
+        probes = []
+        for pid, entry in items:
+            try:
+                probes.append((pid, entry, entry["handle"].ping.remote()))
+            except Exception:
+                probes.append((pid, entry, None))
+        for pid, entry, ref in probes:
+            dead = False
+            ok = False
+            if ref is not None:
+                try:
+                    api.get(ref, timeout=5)
+                    ok = True
+                except ActorDiedError:
+                    dead = True
+                except Exception:
+                    ok = False
+            if ok:
+                entry["failures"] = 0
+            else:
+                entry["failures"] += 1
+                if dead or entry["failures"] >= self._PROXY_MAX_FAILURES:
+                    logger.warning(
+                        "serve proxy %s unresponsive (dead=%s); "
+                        "deregistering", pid, dead,
+                    )
+                    self.deregister_proxy(pid, reason="dead")
 
     def get_ingress_info(self, app_name: str) -> Dict[str, Any]:
         """How the proxy should talk to the app root: plain request/response,

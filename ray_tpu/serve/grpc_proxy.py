@@ -15,7 +15,9 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import threading
+import time
 from typing import Dict, Optional
 
 logger = logging.getLogger(__name__)
@@ -24,16 +26,29 @@ SERVICE_NAME = "ray_tpu.serve.ServeAPI"
 
 
 class GRPCProxy:
-    """Actor: runs a grpc.aio server in a dedicated thread+loop."""
+    """Actor: runs a grpc.aio server in a dedicated thread+loop.
 
-    def __init__(self, controller, host: str = "127.0.0.1", port: int = 9000):
+    Same multi-proxy treatment as HTTPProxy: ``reuse_port=True`` sets
+    grpc.so_reuseport so N gRPC proxies share one port, and each instance
+    registers with the controller under its ``proxy_id``."""
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 9000,
+                 proxy_id: str = "grpc#0", reuse_port: bool = False):
         self._controller = controller
         self._host = host
         self._port = port
+        self._proxy_id = proxy_id
+        self._reuse_port = reuse_port
         self._bound_port: Optional[int] = None
         self._handles: Dict[str, object] = {}
         self._ready = threading.Event()
         self._error: Optional[str] = None
+        self._started_at = time.time()
+        self._draining = False
+        self._inflight = 0
+        from ..util.metrics import ingress_handles
+
+        self._m = ingress_handles(proxy_id)
         self._thread = threading.Thread(
             target=self._serve_forever, daemon=True, name="grpc-proxy"
         )
@@ -56,7 +71,12 @@ class GRPCProxy:
     async def _start_server(self):
         import grpc
 
-        server = grpc.aio.server()
+        options = []
+        if self._reuse_port:
+            # kernel-level listener sharing: every proxy binds the SAME
+            # port and accepted connections spread across them
+            options.append(("grpc.so_reuseport", 1))
+        server = grpc.aio.server(options=options or None)
         rpc_handlers = {
             "Call": grpc.unary_unary_rpc_method_handler(
                 self._handle_call,
@@ -81,6 +101,23 @@ class GRPCProxy:
         return b'{"status": "ok"}'
 
     async def _handle_call(self, request: bytes, context) -> bytes:
+        if self._draining:
+            self._m["drain"].inc()
+            return json.dumps(
+                {"ok": False, "error": "proxy draining", "retry_after_s": 1.0}
+            ).encode()
+        t0 = time.perf_counter()
+        self._inflight += 1
+        self._m["inflight"].set(self._inflight)
+        try:
+            reply = await self._call_body(request, context)
+        finally:
+            self._inflight -= 1
+            self._m["inflight"].set(self._inflight)
+            self._m["latency"].observe((time.perf_counter() - t0) * 1000.0)
+        return reply
+
+    async def _call_body(self, request: bytes, context) -> bytes:
         try:
             envelope = json.loads(request or b"{}")
             app_name = envelope.get("application", "default")
@@ -114,12 +151,28 @@ class GRPCProxy:
                 timeout_s, trace_ctx,
             )
             if isinstance(result, Exception):
+                from ..exceptions import (
+                    BackPressureError,
+                    DeadlineExceededError,
+                    GetTimeoutError,
+                )
+
+                cause = getattr(result, "cause", None) or result
+                if isinstance(cause, BackPressureError):
+                    self._m["shed"].inc()
+                elif isinstance(cause, (DeadlineExceededError,
+                                        GetTimeoutError)):
+                    self._m["timeout"].inc()
+                else:
+                    self._m["error"].inc()
                 return self._error_reply(result, context)
             reply = {"ok": True, "result": result}
             if trace_ctx is not None:
                 reply["trace_id"] = trace_ctx["trace_id"]
+            self._m["ok"].inc()
             return json.dumps(reply).encode()
         except Exception as e:  # noqa: BLE001
+            self._m["error"].inc()
             return json.dumps({"ok": False, "error": repr(e)}).encode()
 
     @staticmethod
@@ -165,6 +218,9 @@ class GRPCProxy:
                 handle = handle.options(timeout_s=float(timeout_s))
             # the handle's deadline (explicit or the deployment default)
             # bounds the wait — no hardcoded proxy-side 60 s
+            if trace_ctx is None and not tracing.is_tracing_enabled():
+                # untraced fast path: no span contextmanager allocation
+                return handle.remote(payload).result()
             with tracing.request_span(
                 "serve.grpc_proxy", trace_ctx, app=app_name, method=method
             ):
@@ -179,6 +235,39 @@ class GRPCProxy:
 
     def ping(self):
         return True
+
+    def describe(self) -> dict:
+        """Identity record for the controller's proxy inventory (GCS
+        ``proxy:`` prefix)."""
+        from ..util.metrics import _node_hex
+
+        return {
+            "kind": "grpc",
+            "proxy_id": self._proxy_id,
+            "host": self._host,
+            "port": self._bound_port or self._port,
+            "pid": os.getpid(),
+            "node": _node_hex(),
+            "started_at": self._started_at,
+        }
+
+    def stats(self) -> dict:
+        return {"proxy_id": self._proxy_id, "inflight": self._inflight,
+                "draining": self._draining}
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """See HTTPProxy.drain: refuse new calls, bounded wait on in-flight."""
+        from ..util import events as _events
+
+        self._draining = True
+        deadline = time.time() + timeout_s
+        while self._inflight > 0 and time.time() < deadline:
+            time.sleep(0.02)
+        _events.record_event(
+            _events.PROXY_DRAIN, proxy_id=self._proxy_id, kind="grpc",
+            inflight=self._inflight,
+        )
+        return self._inflight == 0
 
 
 def grpc_call(address, payload, *, application="default", method="__call__",

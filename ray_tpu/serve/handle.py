@@ -41,6 +41,7 @@ from ..exceptions import (
 )
 from ..util import events as _events
 from ..util import tracing as _tracing
+from .hash_ring import ReplicaRing
 
 logger = logging.getLogger(__name__)
 
@@ -336,6 +337,33 @@ class DeploymentResponseGenerator:
         return self._ref_gen
 
 
+class _DeploymentView:
+    """One deployment's routing snapshot, generation-stamped.
+
+    Built only when the controller-reported table ``version`` (replica
+    membership) changes; between generations a refresh just rewrites the
+    queue-length list in place. Replica rows are pre-split into parallel
+    tuples and the rendezvous ring is precomputed, so the per-request pick
+    is index arithmetic over immutable structure — no lock, no dict built,
+    no sort."""
+
+    __slots__ = ("generation", "ids", "handles", "queues", "ring",
+                 "router_config", "index_of")
+
+    def __init__(self, generation: int, replicas, router_config: dict):
+        rows = sorted(replicas, key=lambda r: str(r[0]))
+        self.generation = generation
+        self.ids = tuple(str(r[0]) for r in rows)
+        self.handles = tuple(r[1] for r in rows)
+        # the one mutable field: refreshed in place between generations
+        self.queues = [int(r[2]) for r in rows]
+        # ring ids == self.ids (both sorted), so a ring index indexes the
+        # parallel tuples directly
+        self.ring = ReplicaRing(self.ids)
+        self.router_config = router_config or {}
+        self.index_of = {rid: i for i, rid in enumerate(self.ids)}
+
+
 class Router:
     """Per-process replica picker for one application."""
 
@@ -345,17 +373,24 @@ class Router:
     def __init__(self, controller, app_name: str):
         self._controller = controller
         self._app_name = app_name
-        self._table: Dict[str, dict] = {}
+        # deployment -> _DeploymentView; whole-dict reference swapped
+        # atomically on refresh so pick() reads without the lock
+        self._views: Dict[str, _DeploymentView] = {}
         self._last_refresh = 0.0
         self._ever_refreshed = False
         self._last_stale_warn = 0.0
         self._lock = threading.Lock()
         self._rr = 0
+        # stats for the cross-proxy agreement tests and `ray_tpu proxies`:
+        # picks must proceed with NO controller round-trip between the
+        # periodic table polls
+        self.table_fetches = 0
+        self.picks = 0
 
     def _refresh(self, force: bool = False):
         """Pull the routing table from the controller. A slow or briefly
         unreachable controller must NOT fail the request path: on error we
-        keep serving from the cached (stale) table with a rate-limited
+        keep serving from the cached (stale) views with a rate-limited
         warning, and only raise if there has never been a successful
         refresh (nothing cached to fall back on)."""
         now = time.time()
@@ -383,22 +418,53 @@ class Router:
                     )
             return
         with self._lock:
-            self._table = table
+            old_views = self._views
+            views: Dict[str, _DeploymentView] = {}
+            for dep_name, entry in table.items():
+                replicas = entry.get("replicas") or []
+                generation = int(entry.get("version", 0))
+                old = old_views.get(dep_name)
+                if (
+                    old is not None
+                    and old.generation == generation
+                    and len(old.ids) == len(replicas)
+                ):
+                    # same membership generation: update queue lengths in
+                    # place, keep the ring and tuples (the common case —
+                    # membership changes are rare, queue drift is constant)
+                    for rid, _handle, queue_len in replicas:
+                        i = old.index_of.get(str(rid))
+                        if i is not None:
+                            old.queues[i] = int(queue_len)
+                    old.router_config = entry.get("router_config") \
+                        or old.router_config
+                    views[dep_name] = old
+                else:
+                    views[dep_name] = _DeploymentView(
+                        generation, replicas,
+                        entry.get("router_config") or {},
+                    )
+            self._views = views
             self._last_refresh = now
             self._ever_refreshed = True
+            self.table_fetches += 1
 
     def router_config(self, deployment: str) -> Dict[str, Any]:
         """The deployment's failover policy as distributed through the
         routing table; defaults when the table predates the field."""
         self._refresh()
-        with self._lock:
-            entry = self._table.get(deployment) or {}
-        cfg = entry.get("router_config")
+        view = self._views.get(deployment)
+        cfg = view.router_config if view is not None else None
         if not cfg:
             from .config import RequestRouterConfig
 
             cfg = RequestRouterConfig().as_dict()
         return cfg
+
+    def stats(self) -> Dict[str, int]:
+        """{picks, table_fetches}: the agreement tests assert picks advance
+        while table_fetches stays flat (no per-request controller RPC)."""
+        return {"picks": self.picks, "table_fetches": self.table_fetches}
 
     # an affine replica keeps winning until its queue runs this many
     # requests longer than the random alternative's — cache reuse is worth
@@ -412,55 +478,99 @@ class Router:
         """Power-of-two-choices on reported queue length; returns
         ``(replica_id, handle)``. With an ``affinity`` key (hash of the
         request's prompt prefix), the pick is biased: one candidate is
-        always the key's preferred replica, which wins unless its queue is
-        more than _AFFINITY_SLACK behind — so repeated prefixes land where
-        their KV blocks already live, and overload still spills to the rest
-        of the fleet. ``exclude`` drops replicas a failover already tried —
-        unless that would leave no candidate (a 1-replica deployment's
-        restart is still worth a retry)."""
+        always the key's rendezvous-ring replica (serve/hash_ring.py — the
+        SAME winner in every proxy/handle process, no controller round
+        trip), which wins unless its queue is more than _AFFINITY_SLACK
+        behind — so repeated prefixes land where their KV blocks already
+        live, and overload still spills to the rest of the fleet.
+        ``exclude`` drops replicas a failover already tried — unless that
+        would leave no candidate (a 1-replica deployment's restart is
+        still worth a retry)."""
         self._refresh(force=force_refresh)
+        self.picks += 1
+        view = self._views.get(deployment)
+        if view is not None and view.ids and not exclude:
+            return self._pick_fast(view, affinity)
+        return self._pick_slow(deployment, affinity, exclude, deadline_ts)
+
+    def _pick_fast(self, view: _DeploymentView, affinity: Optional[int]):
+        """The per-request hot path: index arithmetic over the view's
+        precomputed tuples. Deliberately allocates no dict (guarded by a
+        dis()-based perf-smoke test) — at proxy saturation this runs tens
+        of thousands of times a second per process."""
+        ids = view.ids
+        n = len(ids)
+        if n == 1:
+            return ids[0], view.handles[0]
+        queues = view.queues
+        if affinity is not None:
+            i = view.ring.lookup_index(affinity)
+            j = random.randrange(n - 1)
+            if j >= i:
+                j += 1
+            if queues[i] <= queues[j] + self._AFFINITY_SLACK:
+                return ids[i], view.handles[i]
+            return ids[j], view.handles[j]
+        # two random candidates, shorter controller-reported queue wins;
+        # round-robin counter breaks ties so equal queues still spread
+        a = random.randrange(n)
+        b = random.randrange(n - 1)
+        if b >= a:
+            b += 1
+        qa = queues[a]
+        qb = queues[b]
+        if qa == qb:
+            self._rr += 1
+            winner = a if self._rr % 2 else b
+        else:
+            winner = a if qa < qb else b
+        return ids[winner], view.handles[winner]
+
+    def _pick_slow(self, deployment: str, affinity: Optional[int],
+                   exclude: FrozenSet[str],
+                   deadline_ts: Optional[float]):
+        """Failover / cold paths: exclusion sets and empty views (waiting
+        for the first replica to come RUNNING, bounded by the request
+        deadline)."""
         deadline = time.time() + 30
         if deadline_ts is not None:
             deadline = min(deadline, deadline_ts)
         while True:
-            with self._lock:
-                entry = self._table.get(deployment)
-                replicas = entry["replicas"] if entry else []
-            if exclude:
-                kept = [r for r in replicas if r[0] not in exclude]
-                if kept:
-                    replicas = kept
-            if replicas:
-                break
+            view = self._views.get(deployment)
+            if view is not None and view.ids:
+                kept = [
+                    i for i in range(len(view.ids))
+                    if view.ids[i] not in exclude
+                ]
+                if not kept:
+                    # exclusion would leave no candidate: a 1-replica
+                    # deployment's restart is still worth a retry
+                    kept = list(range(len(view.ids)))
+                if len(kept) == 1:
+                    i = kept[0]
+                    return view.ids[i], view.handles[i]
+                if affinity is not None:
+                    i = view.ring.lookup_excluding(affinity, exclude)
+                    if i not in kept:
+                        i = random.choice(kept)
+                    j = random.choice([k for k in kept if k != i])
+                    if view.queues[i] <= view.queues[j] + self._AFFINITY_SLACK:
+                        return view.ids[i], view.handles[i]
+                    return view.ids[j], view.handles[j]
+                a, b = random.sample(kept, 2)
+                qa, qb = view.queues[a], view.queues[b]
+                if qa == qb:
+                    self._rr += 1
+                    winner = a if self._rr % 2 else b
+                else:
+                    winner = a if qa < qb else b
+                return view.ids[winner], view.handles[winner]
             if time.time() > deadline:
                 raise RuntimeError(
                     f"no running replicas for deployment {deployment!r}"
                 )
             time.sleep(0.1)
             self._refresh(force=True)
-        if len(replicas) == 1:
-            return replicas[0][0], replicas[0][1]
-        if affinity is not None:
-            # replica ids sorted so every process maps the key to the SAME
-            # preferred replica regardless of table ordering
-            ordered = sorted(replicas, key=lambda r: str(r[0]))
-            preferred = ordered[affinity % len(ordered)]
-            other = random.choice(
-                [r for r in ordered if r is not preferred]
-            )
-            if preferred[2] <= other[2] + self._AFFINITY_SLACK:
-                return preferred[0], preferred[1]
-            return other[0], other[1]
-        # two random candidates, shorter controller-reported queue wins;
-        # round-robin counter breaks ties so equal queues still spread
-        a, b = random.sample(replicas, 2)
-        qa, qb = a[2], b[2]
-        if qa == qb:
-            self._rr += 1
-            winner = a if self._rr % 2 else b
-        else:
-            winner = a if qa < qb else b
-        return winner[0], winner[1]
 
 
 class DeploymentHandle:
@@ -524,12 +634,17 @@ class DeploymentHandle:
         if self._router_holder[0] is None:
             self._router_holder[0] = Router(self._controller, self._app_name)
         router: Router = self._router_holder[0]
-        affinity = None
-        if self._prefix_affinity_tokens > 0:
-            affinity = _prefix_affinity_key(
-                args, kwargs, self._prefix_affinity_tokens
-            )
         router_cfg = router.router_config(self._deployment)
+        # handle-level options() wins; otherwise the deployment's
+        # RequestRouterConfig.prefix_affinity_tokens (distributed through
+        # the routing table) turns affinity on for every router — proxies
+        # included — with no per-call-site configuration
+        tokens = self._prefix_affinity_tokens or int(
+            router_cfg.get("prefix_affinity_tokens", 0) or 0
+        )
+        affinity = None
+        if tokens > 0:
+            affinity = _prefix_affinity_key(args, kwargs, tokens)
         timeout_s = self._timeout_s
         if timeout_s is None:
             timeout_s = router_cfg.get("default_timeout_s", 60.0)

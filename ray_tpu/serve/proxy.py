@@ -12,24 +12,47 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import threading
+import time
 from typing import Dict, Optional
+
+from .._internal.rpc import RPC_OOB_THRESHOLD as _RPC_OOB_THRESHOLD
 
 logger = logging.getLogger(__name__)
 
 
 class HTTPProxy:
-    """Actor: runs an aiohttp server in a dedicated thread+loop."""
+    """Actor: runs an aiohttp server in a dedicated thread+loop.
 
-    def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000):
+    Multi-proxy data plane: N HTTPProxy actors share ONE host:port via
+    SO_REUSEPORT (``reuse_port=True``) — the kernel spreads accepted
+    connections across the listeners, so ingress scales with proxy count
+    with no front-end balancer. Each proxy registers with the controller
+    under its ``proxy_id`` (GCS ``proxy:`` prefix) so drains, chaos kills
+    and the dashboard address individual proxies."""
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000,
+                 proxy_id: str = "http#0", reuse_port: bool = False):
         self._controller = controller
         self._host = host
         self._port = port
+        self._proxy_id = proxy_id
+        self._reuse_port = reuse_port
         self._routes: Dict[str, str] = {}
         self._handles: Dict[str, object] = {}
         self._ingress: Dict[str, dict] = {}
         self._ready = threading.Event()
         self._error: Optional[str] = None
+        self._started_at = time.time()
+        self._draining = False
+        self._inflight = 0
+        # pre-bound metric handles + pre-built hot response headers: the
+        # request loop must not build tag dicts or header dicts per request
+        from ..util.metrics import ingress_handles
+
+        self._m = ingress_handles(proxy_id)
+        self._hot_headers = {"X-Proxy-Id": proxy_id}
         self._thread = threading.Thread(
             target=self._serve_forever, daemon=True, name="http-proxy"
         )
@@ -58,7 +81,9 @@ class HTTPProxy:
         app.router.add_route("*", "/{tail:.*}", self._handle_request)
         runner = web.AppRunner(app)
         await runner.setup()
-        site = web.TCPSite(runner, self._host, self._port)
+        site = web.TCPSite(
+            runner, self._host, self._port, reuse_port=self._reuse_port
+        )
         await site.start()
         self._ready.set()
 
@@ -163,6 +188,41 @@ class HTTPProxy:
     async def _handle_request(self, request):
         from aiohttp import web
 
+        if self._draining:
+            self._m["drain"].inc()
+            return web.json_response(
+                {"error": "proxy draining", "retry_after_s": 1.0},
+                status=503,
+                headers={"Retry-After": "1", "X-Proxy-Id": self._proxy_id},
+            )
+        t0 = time.perf_counter()
+        self._inflight += 1
+        self._m["inflight"].set(self._inflight)
+        try:
+            resp = await self._dispatch(request)
+        except Exception as e:  # noqa: BLE001
+            resp = self._error_response(e)
+        finally:
+            self._inflight -= 1
+            self._m["inflight"].set(self._inflight)
+            self._m["latency"].observe((time.perf_counter() - t0) * 1000.0)
+        status = resp.status
+        if status < 400:
+            self._m["ok"].inc()
+        elif status == 503:
+            self._m["shed"].inc()
+        elif status == 504:
+            self._m["timeout"].inc()
+        else:
+            self._m["error"].inc()
+        if not resp.prepared:
+            # streaming/ASGI responses stamp the header pre-prepare
+            resp.headers.setdefault("X-Proxy-Id", self._proxy_id)
+        return resp
+
+    async def _dispatch(self, request):
+        from aiohttp import web
+
         path = "/" + request.match_info["tail"]
         match = self._resolve(path)
         if match is None:
@@ -181,10 +241,20 @@ class HTTPProxy:
         if request.body_exists:
             raw = await request.read()
             if raw:
-                try:
-                    body = json.loads(raw)
-                except json.JSONDecodeError:
-                    body = raw.decode("utf-8", "replace")
+                if request.content_type == "application/octet-stream":
+                    # binary fast path: no JSON decode, and large bodies are
+                    # wrapped in bytearray so the proxy→replica hop ships
+                    # them through the v2 framing's zero-copy out-of-band
+                    # buffer path instead of re-pickling the payload inline
+                    body = (
+                        bytearray(raw)
+                        if len(raw) >= _RPC_OOB_THRESHOLD else raw
+                    )
+                else:
+                    try:
+                        body = json.loads(raw)
+                    except json.JSONDecodeError:
+                        body = raw.decode("utf-8", "replace")
         timeout_s = self._request_timeout_s(request)
         trace_ctx = self._trace_context(request)
         if info.get("stream"):
@@ -197,19 +267,25 @@ class HTTPProxy:
             None, self._call_ingress, app_name, path, prefix, body, timeout_s,
             trace_ctx,
         )
-        # echo the trace id so callers can join their latency record with
-        # the server-side spans (`ray_tpu timeline`)
-        headers = (
-            {"X-Trace-Id": trace_ctx["trace_id"]} if trace_ctx else None
-        )
+        # untraced hot path reuses ONE prebuilt header dict (aiohttp copies
+        # it into the response's CIMultiDict); traced requests echo the
+        # trace id so callers can join their latency record with the
+        # server-side spans (`ray_tpu timeline`)
+        if trace_ctx is None:
+            headers = self._hot_headers
+        else:
+            headers = {"X-Proxy-Id": self._proxy_id,
+                       "X-Trace-Id": trace_ctx["trace_id"]}
         if isinstance(result, Exception):
             resp = self._error_response(result)
-            if headers:
-                resp.headers.update(headers)
+            resp.headers.update(headers)
             return resp
         if isinstance(result, (dict, list, int, float, str, bool)) or result is None:
             return web.json_response({"result": result}, headers=headers)
-        return web.Response(body=bytes(result), headers=headers)
+        return web.Response(
+            body=bytes(result), headers=headers,
+            content_type="application/octet-stream",
+        )
 
     _INGRESS_TTL_S = 5.0
 
@@ -259,6 +335,11 @@ class HTTPProxy:
             handle = self._get_handle(app_name).options(
                 timeout_s=timeout_s
             ) if timeout_s is not None else self._get_handle(app_name)
+            if trace_ctx is None and not tracing.is_tracing_enabled():
+                # untraced fast path: skip the span contextmanager entirely
+                # (even a no-op span allocates the generator + frame; the
+                # perf-smoke 5% guard fences this)
+                return handle.remote(body).result()
             # the proxy span is the trace's top: route/attempt/replica
             # spans parent under it (this runs on an executor thread, so
             # the task-context install inside is thread-safe)
@@ -328,6 +409,7 @@ class HTTPProxy:
         sse = "text/event-stream" in request.headers.get("Accept", "")
         resp = web.StreamResponse()
         resp.content_type = "text/event-stream" if sse else "application/x-ndjson"
+        resp.headers["X-Proxy-Id"] = self._proxy_id
         if trace_ctx:
             resp.headers["X-Trace-Id"] = trace_ctx["trace_id"]
         await resp.prepare(request)
@@ -459,3 +541,39 @@ class HTTPProxy:
 
     def ping(self):
         return True
+
+    def describe(self) -> dict:
+        """Identity record the controller writes under the GCS ``proxy:``
+        prefix — what `ray_tpu proxies`, the dashboard and chaos kill-proxy
+        see."""
+        from ..util.metrics import _node_hex
+
+        return {
+            "kind": "http",
+            "proxy_id": self._proxy_id,
+            "host": self._host,
+            "port": self._port,
+            "pid": os.getpid(),
+            "node": _node_hex(),
+            "started_at": self._started_at,
+        }
+
+    def stats(self) -> dict:
+        return {"proxy_id": self._proxy_id, "inflight": self._inflight,
+                "draining": self._draining}
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Stop accepting (new requests get 503 + Retry-After so clients
+        move to a surviving proxy), then wait — bounded — for in-flight
+        requests to finish. Returns True when the proxy drained clean."""
+        from ..util import events as _events
+
+        self._draining = True
+        deadline = time.time() + timeout_s
+        while self._inflight > 0 and time.time() < deadline:
+            time.sleep(0.02)
+        _events.record_event(
+            _events.PROXY_DRAIN, proxy_id=self._proxy_id, kind="http",
+            inflight=self._inflight,
+        )
+        return self._inflight == 0

@@ -282,63 +282,80 @@ class Replica:
             deployment=self._deployment_name, replica=self._replica_id,
         )
         try:
+            if tctx is None and not _tracing.is_tracing_enabled():
+                # untraced fast path: skip the span contextmanager entirely
+                # — at ingress saturation even a no-op span's generator +
+                # frame allocation shows up (the perf-smoke 5% guard)
+                return await self._run_request(
+                    method, args, kwargs, metadata, t0, None
+                )
             # adopt the caller's trace: every span below (and anything user
             # code opens — the engine, kvcache) joins the request's trace
             with _tracing.request_span(
                 "serve.replica", tctx, deployment=self._deployment_name,
                 replica=self._replica_id, method=method or "__call__",
             ) as span_ctx:
-                admit_wall = time.time()
-                try:
-                    await self._admit(metadata)
-                except BaseException as exc:
-                    if span_ctx is not None:
-                        _tracing.emit_span(
-                            "serve.admission", span_ctx, admit_wall,
-                            time.perf_counter() - t0,
-                            rejected=type(exc).__name__,
-                        )
-                    raise
-                # admission span covers the bounded queue wait on purpose:
-                # that wait IS the stage a slow request spent here
-                if span_ctx is not None:
-                    _tracing.emit_span(
-                        "serve.admission", span_ctx, admit_wall,
-                        time.perf_counter() - t0,
-                        ongoing=self._ongoing, queued=self._queued,
-                    )
-                self._note_affinity(metadata)
-                try:
-                    fn, args, kwargs = await self._prepare_call(
-                        method, args, kwargs, metadata
-                    )
-                    if inspect.iscoroutinefunction(fn):
-                        result = await fn(*args, **kwargs)
-                    else:
-                        # sync user code must not block the worker's event
-                        # loop (it services RPC + heartbeats); run it on the
-                        # request pool. The context carries the multiplexed
-                        # model id AND the active trace context across the
-                        # thread hop.
-                        import contextvars
-
-                        loop = asyncio.get_running_loop()
-                        ctx = contextvars.copy_context()
-                        result = await loop.run_in_executor(
-                            self._pool, lambda: ctx.run(fn, *args, **kwargs)
-                        )
-                    # unary TTFT = first (and only) output; queue wait is
-                    # included on purpose — that is the latency the caller
-                    # experiences and the signal the autoscaler scales on
-                    record_serve_ttft(
-                        self._deployment_name, time.perf_counter() - t0,
-                        trace_id=span_ctx["trace_id"] if span_ctx else None,
-                    )
-                    return result
-                finally:
-                    self._release()
+                return await self._run_request(
+                    method, args, kwargs, metadata, t0, span_ctx
+                )
         finally:
             _watchdog.unwatch(wd_token)
+
+    async def _run_request(self, method: str, args: tuple, kwargs: dict,
+                           metadata: Optional[dict], t0: float,
+                           span_ctx: Optional[dict]):
+        from ..util import tracing as _tracing
+        from ..util.metrics import record_serve_ttft
+
+        admit_wall = time.time()
+        try:
+            await self._admit(metadata)
+        except BaseException as exc:
+            if span_ctx is not None:
+                _tracing.emit_span(
+                    "serve.admission", span_ctx, admit_wall,
+                    time.perf_counter() - t0,
+                    rejected=type(exc).__name__,
+                )
+            raise
+        # admission span covers the bounded queue wait on purpose:
+        # that wait IS the stage a slow request spent here
+        if span_ctx is not None:
+            _tracing.emit_span(
+                "serve.admission", span_ctx, admit_wall,
+                time.perf_counter() - t0,
+                ongoing=self._ongoing, queued=self._queued,
+            )
+        self._note_affinity(metadata)
+        try:
+            fn, args, kwargs = await self._prepare_call(
+                method, args, kwargs, metadata
+            )
+            if inspect.iscoroutinefunction(fn):
+                result = await fn(*args, **kwargs)
+            else:
+                # sync user code must not block the worker's event
+                # loop (it services RPC + heartbeats); run it on the
+                # request pool. The context carries the multiplexed
+                # model id AND the active trace context across the
+                # thread hop.
+                import contextvars
+
+                loop = asyncio.get_running_loop()
+                ctx = contextvars.copy_context()
+                result = await loop.run_in_executor(
+                    self._pool, lambda: ctx.run(fn, *args, **kwargs)
+                )
+            # unary TTFT = first (and only) output; queue wait is
+            # included on purpose — that is the latency the caller
+            # experiences and the signal the autoscaler scales on
+            record_serve_ttft(
+                self._deployment_name, time.perf_counter() - t0,
+                trace_id=span_ctx["trace_id"] if span_ctx else None,
+            )
+            return result
+        finally:
+            self._release()
 
     async def handle_request_stream(self, method: str, args: tuple,
                                     kwargs: dict,

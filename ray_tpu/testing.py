@@ -209,6 +209,33 @@ def kill_serve_replica(app_name: str = "default",
     return None, None
 
 
+def kill_serve_proxy(proxy_id: Optional[str] = None,
+                     sig: int = signal.SIGKILL):
+    """Ingress-chaos primitive: SIGKILL one proxy process from the GCS
+    proxy registry — like losing a front-end host. Surviving proxies on
+    the shared SO_REUSEPORT listener keep accepting; the controller's
+    health poll deregisters the corpse. Returns (proxy_id, pid) or
+    (None, None) when nothing matched."""
+    from .util.state import list_proxies
+
+    for row in list_proxies():
+        if proxy_id is not None and row.get("proxy_id") != proxy_id:
+            continue
+        pid = row.get("pid")
+        if not pid:
+            continue
+        try:
+            os.kill(pid, sig)
+        except ProcessLookupError:
+            continue
+        logger.info(
+            "kill_serve_proxy: sent signal %s to proxy %s (pid %d)",
+            sig, row.get("proxy_id"), pid,
+        )
+        return row.get("proxy_id"), pid
+    return None, None
+
+
 def _gcs_kv(method, *args):
     from . import _worker_api
 

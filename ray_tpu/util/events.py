@@ -82,6 +82,9 @@ NODE_FENCED = EventName("node_fenced")
 NODE_UNFENCED = EventName("node_unfenced")
 CIRCUIT_OPEN = EventName("circuit_open")
 CIRCUIT_CLOSE = EventName("circuit_close")
+PROXY_START = EventName("proxy_start")
+PROXY_STOP = EventName("proxy_stop")
+PROXY_DRAIN = EventName("proxy_drain")
 
 
 # -- recording ----------------------------------------------------------------

@@ -60,17 +60,80 @@ class Metric:
             }
 
 
+class _BoundCounter:
+    """Counter pre-bound to one tag combination: the tag dict merge and
+    tuple build happen ONCE at bind time, so the per-request hot path
+    (e.g. the ingress proxy) is a lock + dict-slot add with zero
+    allocation. Obtain via ``Counter.bind(**tags)``."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: "Counter", key: Tuple[str, ...]):
+        self._metric = metric
+        self._key = key
+
+    def inc(self, value: float = 1.0):
+        m = self._metric
+        with m._lock:
+            m._values[self._key] = m._values.get(self._key, 0.0) + value
+
+
+class _BoundGauge:
+    """See _BoundCounter; obtain via ``Gauge.bind(**tags)``."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: "Gauge", key: Tuple[str, ...]):
+        self._metric = metric
+        self._key = key
+
+    def set(self, value: float):
+        m = self._metric
+        with m._lock:
+            m._values[self._key] = float(value)
+
+
+class _BoundHistogram:
+    """See _BoundCounter; obtain via ``Histogram.bind(**tags)``. No
+    exemplar support — exemplars belong to traced paths, and bound handles
+    exist for the untraced fast path."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: "Histogram", key: Tuple[str, ...]):
+        self._metric = metric
+        self._key = key
+
+    def observe(self, value: float):
+        m = self._metric
+        with m._lock:
+            counts = m._counts.get(self._key)
+            if counts is None:
+                counts = m._counts[self._key] = \
+                    [0] * (len(m._boundaries) + 1)
+            counts[bisect.bisect_left(m._boundaries, value)] += 1
+            total = m._sums.get(self._key, 0.0) + value
+            m._sums[self._key] = total
+            m._values[self._key] = total
+
+
 class Counter(Metric):
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
         key = self._tag_tuple(tags)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
 
+    def bind(self, **tags: str) -> _BoundCounter:
+        return _BoundCounter(self, self._tag_tuple(tags))
+
 
 class Gauge(Metric):
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
         with self._lock:
             self._values[self._tag_tuple(tags)] = float(value)
+
+    def bind(self, **tags: str) -> _BoundGauge:
+        return _BoundGauge(self, self._tag_tuple(tags))
 
 
 class Histogram(Metric):
@@ -105,6 +168,9 @@ class Histogram(Metric):
                 self._exemplars.setdefault(key, {})[bucket] = {
                     "trace_id": exemplar, "value": value, "ts": time.time(),
                 }
+
+    def bind(self, **tags: str) -> _BoundHistogram:
+        return _BoundHistogram(self, self._tag_tuple(tags))
 
     def _snapshot(self) -> dict:
         snap = super()._snapshot()
@@ -1296,6 +1362,114 @@ def serve_latency_summary(payloads: List[dict]) -> Dict[str, object]:
 def _scaled_quantile(m: dict, q: float, scale: float) -> Optional[float]:
     est = quantile_from_buckets(m["boundaries"], m["counts"], q)
     return None if est is None else est * scale
+
+
+# ---------------------------------------------------------------------------
+# Ingress plane: per-proxy request counters / inflight gauge / end-to-end
+# proxy latency, tagged proxy_id so the multi-proxy data plane shows per-
+# listener load spread. The proxies record through pre-bound handles
+# (ingress_handles) — at saturation the data plane runs thousands of
+# requests a second per proxy, and the per-call tag-dict merge is real
+# overhead there.
+# ---------------------------------------------------------------------------
+
+_INGRESS_LATENCY_BOUNDARIES_MS = [
+    0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
+]
+
+_ingress_metrics: Optional[dict] = None
+_ingress_init_lock = threading.Lock()
+
+
+def _ensure_ingress_metrics() -> dict:
+    global _ingress_metrics
+    if _ingress_metrics is None:
+        with _ingress_init_lock:
+            if _ingress_metrics is None:
+                _ingress_metrics = {
+                    "requests": Counter(
+                        "proxy_requests_total",
+                        "Requests completed by an ingress proxy, by "
+                        "outcome (ok/error/shed/timeout/drain)",
+                        tag_keys=("proxy_id", "outcome"),
+                    ),
+                    "inflight": Gauge(
+                        "proxy_inflight",
+                        "Requests currently being served by this proxy",
+                        tag_keys=("proxy_id",),
+                    ),
+                    "latency": Histogram(
+                        "proxy_request_latency_ms",
+                        "End-to-end proxy latency: request read to "
+                        "response write",
+                        boundaries=_INGRESS_LATENCY_BOUNDARIES_MS,
+                        tag_keys=("proxy_id",),
+                    ),
+                }
+    return _ingress_metrics
+
+
+def ingress_handles(proxy_id: str) -> dict:
+    """Pre-bound per-proxy metric handles for the proxy request loop:
+    {ok, error, shed, timeout, drain} counters plus {inflight, latency}.
+    Bind once at proxy start; each record is then a lock + slot update."""
+    m = _ensure_ingress_metrics()
+    req = m["requests"]
+    return {
+        "ok": req.bind(proxy_id=proxy_id, outcome="ok"),
+        "error": req.bind(proxy_id=proxy_id, outcome="error"),
+        "shed": req.bind(proxy_id=proxy_id, outcome="shed"),
+        "timeout": req.bind(proxy_id=proxy_id, outcome="timeout"),
+        "drain": req.bind(proxy_id=proxy_id, outcome="drain"),
+        "inflight": m["inflight"].bind(proxy_id=proxy_id),
+        "latency": m["latency"].bind(proxy_id=proxy_id),
+    }
+
+
+def ingress_summary(payloads: List[dict]) -> Dict[str, object]:
+    """Cluster rollup for state.metrics_summary()["ingress"]: per-proxy
+    request counts by outcome, current inflight, and latency p50/p99
+    (ms), plus fleet totals."""
+    proxies: Dict[str, dict] = {}
+
+    def row(proxy_id: str) -> dict:
+        return proxies.setdefault(
+            proxy_id, {"requests": {}, "inflight": 0.0}
+        )
+
+    for payload in payloads:
+        for snap in payload.get("metrics", []):
+            name = snap.get("name")
+            tag_keys = snap.get("tag_keys", ())
+            if name == "proxy_requests_total":
+                for tag_json, value in snap.get("values", {}).items():
+                    tags = dict(zip(tag_keys, json.loads(tag_json)))
+                    outcomes = row(tags.get("proxy_id", "?"))["requests"]
+                    outcome = tags.get("outcome", "?")
+                    outcomes[outcome] = outcomes.get(outcome, 0.0) + value
+            elif name == "proxy_inflight":
+                for tag_json, value in snap.get("values", {}).items():
+                    tags = dict(zip(tag_keys, json.loads(tag_json)))
+                    row(tags.get("proxy_id", "?"))["inflight"] = value
+    total_requests = 0.0
+    for proxy_id, entry in proxies.items():
+        entry["total"] = sum(entry["requests"].values())
+        total_requests += entry["total"]
+        m = merged_histogram(
+            payloads, "proxy_request_latency_ms", {"proxy_id": proxy_id}
+        )
+        if m and m["count"]:
+            entry["latency_ms"] = {
+                "count": m["count"],
+                "mean": m["sum"] / m["count"],
+                "p50": _scaled_quantile(m, 0.50, 1.0),
+                "p99": _scaled_quantile(m, 0.99, 1.0),
+            }
+    return {
+        "proxies": {k: proxies[k] for k in sorted(proxies)},
+        "num_proxies": len(proxies),
+        "requests_total": total_requests,
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -124,6 +124,7 @@ def metrics_summary() -> Dict[str, Any]:
         autoscale_summary,
         device_rows,
         fetch_metric_payloads,
+        ingress_summary,
         kvcache_summary,
         partition_summary,
         serve_ft_summary,
@@ -189,6 +190,7 @@ def metrics_summary() -> Dict[str, Any]:
         "serve_latency": serve_latency_summary(payloads),
         "autoscale": autoscale_summary(payloads),
         "partition": partition_summary(payloads),
+        "ingress": ingress_summary(payloads),
     }
 
 
@@ -210,6 +212,27 @@ def list_train_runs() -> List[Dict[str, Any]]:
         rec["name"] = gcs_keys.TRAIN_RUN.strip(key)
         out.append(rec)
     return out
+
+
+def list_proxies() -> List[Dict[str, Any]]:
+    """Live ingress-proxy registry (``proxy:*`` KV keys written by the
+    serve controller): kind, host:port, pid, node — the index `ray_tpu
+    proxies`, the dashboard and chaos kill-proxy use. Works from any
+    connected process without a controller actor handle."""
+    import json as _json
+
+    out = []
+    for key in _gcs_call("kv_keys", gcs_keys.SERVE_PROXY.scan) or []:
+        raw = _gcs_call("kv_get", key)
+        if not raw:
+            continue
+        try:
+            rec = _json.loads(bytes(raw).decode())
+        except Exception:
+            continue
+        rec.setdefault("proxy_id", gcs_keys.SERVE_PROXY.strip(key))
+        out.append(rec)
+    return sorted(out, key=lambda r: str(r.get("proxy_id")))
 
 
 def autoscale_log(limit: int = 100) -> List[Dict[str, Any]]:

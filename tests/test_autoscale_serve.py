@@ -141,6 +141,7 @@ def test_scale_down_victim_has_fewest_affinity_hits(cluster):
     import random
 
     from ray_tpu.serve.handle import _prefix_affinity_key
+    from ray_tpu.serve.hash_ring import ReplicaRing
 
     @serve.deployment(num_replicas=2)
     class Which:
@@ -153,18 +154,19 @@ def test_scale_down_victim_has_fewest_affinity_hits(cluster):
     rows = _wait_replicas("aff", 2)
     ordered = sorted(r["replica_id"] for r in rows)
 
-    # craft prompts whose affinity keys map to a chosen replica: index
-    # key % 2 into the sorted replica-id list (router invariant)
+    # craft prompts whose affinity keys map to a chosen replica via the
+    # rendezvous ring over the replica ids (the router invariant)
+    ring = ReplicaRing(ordered)
     rng = random.Random(0)
     hot_idx = 0
     hot_prompts, cold_prompt = [], None
     while len(hot_prompts) < 6 or cold_prompt is None:
         toks = [rng.randrange(1000) for _ in range(6)]
         payload = {"token_ids": toks, "max_new_tokens": 1}
-        idx = _prefix_affinity_key((payload,), {}, 4) % 2
-        if idx == hot_idx and len(hot_prompts) < 6:
+        rid = ring.lookup(_prefix_affinity_key((payload,), {}, 4))
+        if rid == ordered[hot_idx] and len(hot_prompts) < 6:
             hot_prompts.append(payload)
-        elif idx != hot_idx and cold_prompt is None:
+        elif rid != ordered[hot_idx] and cold_prompt is None:
             cold_prompt = payload
 
     affine = handle.options(prefix_affinity_tokens=4)

@@ -160,9 +160,13 @@ def test_dreamer_trains_cartpole(cluster):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_dreamer_continuous_actions(cluster):
     """Pendulum (Box actions): tanh-gaussian actor trains and the deployed
-    action is rescaled into the env's bounds like the rollout runners do."""
+    action is rescaled into the env's bounds like the rollout runners do.
+
+    slow: ~18s of training on the 1-core CI box; the discrete cartpole
+    train/checkpoint/runner tests keep dreamer covered in tier-1."""
     from ray_tpu.rllib.dreamer import DreamerV3Config
 
     cfg = (

@@ -103,9 +103,14 @@ def test_node_death_detection():
     from ray_tpu.cluster_utils import Cluster
     from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
 
-    cluster = Cluster(head_node_args=dict(num_cpus=2))
+    # the knob must go to Cluster(), not connect() — the GCS reads its
+    # config when the head node is created, before the driver attaches
+    cluster = Cluster(
+        head_node_args=dict(num_cpus=2),
+        _system_config={"health_check_timeout_s": 3.0},
+    )
     extra = cluster.add_node(num_cpus=2)
-    cluster.connect(_system_config={"health_check_timeout_s": 3.0})
+    cluster.connect()
     try:
         extra_id = extra.node_id.hex()
 

@@ -14,7 +14,13 @@ from ray_tpu.exceptions import ObjectLostError
 
 @pytest.fixture
 def two_node_cluster():
-    cluster = Cluster(head_node_args=dict(num_cpus=2))
+    # tight death-detection window: these tests block on the cluster
+    # noticing a killed node. Must go to Cluster(), not connect() — the
+    # GCS reads its config when the head node is created.
+    cluster = Cluster(
+        head_node_args=dict(num_cpus=2),
+        _system_config={"health_check_timeout_s": 3.0},
+    )
     cluster.add_node(resources={"side": 2.0}, num_cpus=2)
     cluster.connect()
     yield cluster

@@ -262,8 +262,12 @@ def test_appo_clipped_variant(cluster):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_sac_pendulum_updates(cluster):
-    """SAC on Pendulum: losses finite, alpha adapts, actions in bounds."""
+    """SAC on Pendulum: losses finite, alpha adapts, actions in bounds.
+
+    slow: ~10s of training on the 1-core CI box; PPO/DQN/IMPALA keep the
+    learner/checkpoint paths covered in tier-1."""
     from ray_tpu import rllib
 
     config = (
