@@ -285,9 +285,20 @@ class DashboardServer:
         }, None
 
     def _serve(self, body):
+        import json as _json
+
         from ..util.metrics import serve_ft_summary
 
+        replicas = []
+        try:
+            raw = self._gcs("kv_get", gcs_keys.SERVE_REPLICAS)
+            if raw:
+                replicas = _json.loads(bytes(raw).decode()).get("replicas", [])
+        except Exception:
+            pass
+        replicas.sort(key=lambda r: (str(r.get("app")), str(r.get("replica_id"))))
         return 200, {
+            "replicas": replicas,
             "fault_tolerance": serve_ft_summary(self._metric_payloads()),
         }, None
 

@@ -219,6 +219,15 @@ class NodeFencedError(RayTpuError):
         return (type(self), (self.node_id, self.reason))
 
 
+class MeshValidationError(RayTpuError, ValueError):
+    """A replica's parallelism config cannot map onto its devices or its
+    model: ``tensor_parallel_size`` not dividing the local device count or
+    the model's (kv-)head count, or a partition-rule table with no rule for
+    a parameter. Raised at deployment/validation time — before any jit —
+    so the operator sees the constraint instead of an opaque XLA shape
+    error from deep inside the first sharded prefill."""
+
+
 class RpcError(RayTpuError):
     """Transport-level RPC failure."""
 

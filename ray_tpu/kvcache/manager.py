@@ -59,12 +59,20 @@ class KVCacheLease:
 
 
 class KVCacheManager:
-    def __init__(self, num_blocks: int, block_size: int = 32):
+    def __init__(self, num_blocks: int, block_size: int = 32, plan=None):
         if block_size <= 0:
             raise ValueError(f"block_size must be positive, got {block_size}")
         self._block_size = int(block_size)
         self._alloc = BlockAllocator(num_blocks)
         self._index = PrefixIndex(self._block_size, self._alloc)
+        # tensor-parallel partition plan: pools are born sharded along the
+        # KV-heads axis (axis 1 of every pool), each device owning its
+        # heads-slice of EVERY block — per-device block pools behind one
+        # logical allocator, so prefix matching/refcounting stay global
+        # while commit/assemble run as single jitted programs over the
+        # sharded buffers
+        self._plan = plan
+        self._mesh_tag = plan.describe() if plan is not None else "tp=1"
         # device state, lazily shaped from the first committed cache row
         self._pools: Optional[List[jax.Array]] = None
         self._treedef = None
@@ -82,6 +90,20 @@ class KVCacheManager:
             "admission_blocked": 0,
         }
 
+    def adopt_plan(self, plan) -> None:
+        """Late plan wiring (the engine passes its plan at construction).
+        Must land before the first commit shapes the pools; afterwards the
+        layouts would disagree, so a late adopt is an error."""
+        if self._plan is plan or plan is None:
+            return
+        if self._pools is not None:
+            raise RuntimeError(
+                "adopt_plan() after the block pools were initialized; "
+                "construct the KVCacheManager with plan= instead"
+            )
+        self._plan = plan
+        self._mesh_tag = plan.describe()
+
     # -- accounting ----------------------------------------------------------
 
     @property
@@ -96,8 +118,8 @@ class KVCacheManager:
     def blocks_in_use(self) -> int:
         return self._alloc.num_allocated
 
-    def stats(self) -> Dict[str, int]:
-        out = dict(self._stats)
+    def stats(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = dict(self._stats)
         out.update(
             capacity=self._alloc.capacity,
             block_size=self._block_size,
@@ -105,8 +127,35 @@ class KVCacheManager:
             blocks_free=self._alloc.num_free,
             evictions=self._index.num_evictions,
             index_nodes=self._index.num_nodes,
+            mesh=self._mesh_tag,
+            num_devices=(
+                self._plan.num_devices if self._plan is not None else 1
+            ),
         )
+        out.update(self.pool_accounting())
         return out
+
+    def pool_accounting(self) -> Dict[str, Any]:
+        """Per-device block-pool accounting. Each device owns its
+        heads-slice of every block, so a device's pool is
+        ``total_bytes / num_devices`` and holds ``heads / tp`` heads —
+        the numbers an operator needs to size ``num_blocks`` against
+        per-chip HBM. Zeros before the first commit shapes the pools."""
+        if self._pools is None:
+            return {
+                "kv_pool_bytes_total": 0,
+                "kv_pool_bytes_per_device": 0,
+                "heads_per_device": 0,
+            }
+        total = sum(int(p.nbytes) for p in self._pools)
+        ndev = self._plan.num_devices if self._plan is not None else 1
+        heads = self._pools[0].shape[1] if self._pools[0].ndim >= 3 else 1
+        tp = self._plan.tp if self._plan is not None else 1
+        return {
+            "kv_pool_bytes_total": total,
+            "kv_pool_bytes_per_device": total // ndev,
+            "heads_per_device": heads // tp,
+        }
 
     # -- lease lifecycle -----------------------------------------------------
 
@@ -179,6 +228,7 @@ class KVCacheManager:
                 f"block_size {self._block_size} exceeds max_seq_len "
                 f"{self._max_seq_len}"
             )
+        kv_sh = self._plan.kv_sharding() if self._plan is not None else None
         self._pools = [
             jnp.zeros(
                 (self._alloc.capacity,)
@@ -189,6 +239,10 @@ class KVCacheManager:
             for kv, shape, dtype in self._leaf_meta
             if kv
         ]
+        if kv_sh is not None:
+            # pool layout (capacity, heads, block, d): heads is axis 1,
+            # the same axis the decode cache shards — place, don't copy
+            self._pools = [jax.device_put(p, kv_sh) for p in self._pools]
         bs = self._block_size
 
         def commit_impl(pools, kv_row, bid, off):
@@ -214,9 +268,17 @@ class KVCacheManager:
             ]
 
         # block id / token offset are traced scalars: ONE compiled program
-        # each, reused for every commit and COW copy
-        self._jit_commit = jax.jit(commit_impl, donate_argnums=(0,))
-        self._jit_copy = jax.jit(copy_impl, donate_argnums=(0,))
+        # each, reused for every commit and COW copy. Under a plan the
+        # outputs are pinned to the pool sharding so the buffers stay
+        # sharded through every donation cycle (inference would keep them
+        # sharded too, but pinning makes drift impossible).
+        out_sh = [kv_sh] * len(self._pools) if kv_sh is not None else None
+        self._jit_commit = jax.jit(
+            commit_impl, donate_argnums=(0,), out_shardings=out_sh
+        )
+        self._jit_copy = jax.jit(
+            copy_impl, donate_argnums=(0,), out_shardings=out_sh
+        )
 
     def assemble(self, lease: KVCacheLease):
         """Gather the lease's matched chain into a dense (1, ..., S, d)
@@ -254,6 +316,13 @@ class KVCacheManager:
                 out.append(jnp.pad(g, pad)[None])  # (1, ..., S, d)
             return out
 
+        if self._plan is not None:
+            # assembled rows feed straight back into the sharded decode
+            # program: keep them in the KV layout (heads over tp)
+            return jax.jit(
+                impl,
+                out_shardings=[self._plan.kv_sharding()] * len(self._pools),
+            )
         return jax.jit(impl)
 
     # -- commit --------------------------------------------------------------
@@ -372,7 +441,9 @@ class KVCacheManager:
         try:
             from ..util.metrics import record_kvcache_prefill
 
-            record_kvcache_prefill(hit_tokens, computed_tokens)
+            record_kvcache_prefill(
+                hit_tokens, computed_tokens, mesh=self._mesh_tag
+            )
         except Exception:
             pass
         self._update_gauges()
@@ -381,7 +452,7 @@ class KVCacheManager:
         try:
             from ..util.metrics import record_kvcache_blocked
 
-            record_kvcache_blocked()
+            record_kvcache_blocked(mesh=self._mesh_tag)
         except Exception:
             pass
         try:
@@ -399,7 +470,7 @@ class KVCacheManager:
         try:
             from ..util.metrics import record_kvcache_eviction
 
-            record_kvcache_eviction(n)
+            record_kvcache_eviction(n, mesh=self._mesh_tag)
         except Exception:
             pass
 
@@ -407,6 +478,9 @@ class KVCacheManager:
         try:
             from ..util.metrics import set_kvcache_blocks
 
-            set_kvcache_blocks(self._alloc.num_allocated, self._alloc.capacity)
+            set_kvcache_blocks(
+                self._alloc.num_allocated, self._alloc.capacity,
+                mesh=self._mesh_tag,
+            )
         except Exception:
             pass

@@ -23,6 +23,14 @@ class LLMConfig:
     # parallelism (reference: engine_kwargs tensor_parallel_size / pp)
     tensor_parallel_size: int = 1
     sequence_parallel_size: int = 1
+    # replica mesh shape, e.g. {"tp": 4} or {"tp": 2, "sp": 2}: the
+    # declarative form of the two sizes above (and the one the docs
+    # lead with — LLMConfig(mesh={"tp": 4})). When set it WINS over
+    # tensor_parallel_size/sequence_parallel_size; unknown axes raise
+    # MeshValidationError at construction, divisibility against the
+    # local device count / model head count is checked at deployment
+    # (PartitionPlan.for_model) before any jit.
+    mesh: Optional[Dict[str, int]] = None
     # serving
     num_replicas: int = 1
     # queue-depth replica autoscaling (BASELINE configs[4]: "Llama-2-7B
@@ -54,6 +62,30 @@ class LLMConfig:
     # leading prompt tokens hashed for prefix-affinity replica routing
     # (serve handle pow2 bias); 0 disables
     prefix_affinity_tokens: int = 16
+
+    def __post_init__(self):
+        if self.mesh is not None:
+            from ..exceptions import MeshValidationError
+
+            unknown = set(self.mesh) - {"tp", "sp"}
+            if unknown:
+                raise MeshValidationError(
+                    f"LLMConfig.mesh axes {sorted(unknown)} not supported "
+                    "for serving replicas; use 'tp' (tensor parallel) "
+                    "and/or 'sp' (sequence parallel)"
+                )
+            for axis, size in self.mesh.items():
+                if not isinstance(size, int) or size < 1:
+                    raise MeshValidationError(
+                        f"LLMConfig.mesh[{axis!r}] must be a positive "
+                        f"int, got {size!r}"
+                    )
+
+    def effective_parallelism(self) -> tuple:
+        """(tp, sp) with ``mesh`` winning over the scalar fields."""
+        if self.mesh is not None:
+            return (self.mesh.get("tp", 1), self.mesh.get("sp", 1))
+        return (self.tensor_parallel_size, self.sequence_parallel_size)
 
     def build_model_config(self):
         if self.model_family == "llama":

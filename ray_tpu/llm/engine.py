@@ -44,13 +44,37 @@ def _resolve_seed(seed: Optional[int]) -> int:
     return int.from_bytes(os.urandom(4), "little")
 
 
-def _record_ttft(seconds: float, hit: bool) -> None:
+def _record_ttft(seconds: float, hit: bool, mesh: str = "tp=1") -> None:
     try:
         from ..util.metrics import record_kvcache_ttft
 
-        record_kvcache_ttft(seconds, hit)
+        record_kvcache_ttft(seconds, hit, mesh=mesh)
     except Exception:
         pass
+
+
+def host_sync(x) -> np.ndarray:
+    """The ONE audited device->host materialization point on the serving
+    hot path. Everything the engines move to the host — sampled token ids,
+    nothing else — funnels through here, so the RT009 lint rule can forbid
+    ad-hoc ``jax.device_get``/``np.asarray(jnp...)``/``float(jnp...)``
+    round-trips everywhere else in engine/kvcache code (each one is a
+    device sync that stalls the decode pipeline)."""
+    return np.asarray(x)
+
+
+def _sample_impl(logits, temps, key):
+    """Fused device-side sampling: greedy where temps == 0, temperature
+    categorical elsewhere — ONE program and one host transfer per step
+    (the old form materialized argmax AND categorical separately)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps[:, None], 1e-6)
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temps == 0.0, greedy, sampled)
+
+
+_fused_sample = jax.jit(_sample_impl)
+_greedy_sample = jax.jit(lambda logits: jnp.argmax(logits, axis=-1))
 
 
 @dataclasses.dataclass
@@ -72,15 +96,44 @@ class _DecodeModelBase:
     """Shared jitted prefill/decode programs over the cached Llama
     (both engines compile the identical two programs)."""
 
-    def __init__(self, model_config, params, mesh=None):
+    def __init__(self, model_config, params, mesh=None, plan=None):
         from ..models.llama import Llama
 
         self._cfg = model_config
-        self._params = params
         self._mesh = mesh
+        # tensor-parallel plan: explicit, or derived from a non-trivial
+        # mesh so `mesh=` alone wires TP through either engine
+        if plan is None and mesh is not None and mesh.shape.get("tp", 1) > 1:
+            from ..parallel.plan import PartitionPlan
+
+            plan = PartitionPlan(mesh)
+        self._plan = plan
+        self._mesh_tag = plan.describe() if plan is not None else "tp=1"
         self._model = Llama(model_config, mesh, decode=True)
-        self._prefill = jax.jit(self._prefill_impl)
-        self._decode = jax.jit(self._decode_impl)
+        if plan is not None:
+            # compile-with-plan: params live sharded; both programs pin
+            # their outputs (replicated logits for host sampling, the
+            # decode cache sharded along the KV-heads axis) so GSPMD
+            # inserts one psum per attention/MLP and the cache never
+            # gathers. The cache *structure* is length-independent, so one
+            # eval_shape fixes the out_shardings for every shape bucket.
+            self._params = plan.shard_params(params)
+            cache_shape = jax.eval_shape(
+                self._prefill_impl, self._params,
+                jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            )[1]
+            cache_sh = plan.cache_shardings(cache_shape)
+            rep = plan.replicated()
+            self._prefill = jax.jit(
+                self._prefill_impl, out_shardings=(rep, cache_sh)
+            )
+            self._decode = jax.jit(
+                self._decode_impl, out_shardings=(rep, cache_sh)
+            )
+        else:
+            self._params = params
+            self._prefill = jax.jit(self._prefill_impl)
+            self._decode = jax.jit(self._decode_impl)
 
     def _prefill_impl(self, params, tokens):
         logits, vars_out = self._model.apply(
@@ -97,19 +150,21 @@ class _DecodeModelBase:
     def swap_params(self, params):
         """Hot weight reload: the jitted prefill/decode programs close over
         shapes only (params are traced arguments), so swapping the pytree
-        retunes nothing — the next prefill simply reads the new weights."""
+        retunes nothing — the next prefill simply reads the new weights.
+        Under a partition plan the fresh pytree is re-placed into the
+        sharded layout first (each device takes only its shard)."""
+        if self._plan is not None:
+            params = self._plan.shard_params(params)
         self._params = params
 
-    @staticmethod
-    def _sample_tokens(logits, temps: np.ndarray, key) -> np.ndarray:
+    def _sample_tokens(self, logits, temps: np.ndarray, key) -> np.ndarray:
         """Greedy where temps==0, temperature-categorical elsewhere — the
-        one sampling rule both engines use everywhere."""
-        greedy = np.asarray(jnp.argmax(logits, axis=-1))
-        if np.all(temps == 0.0):
-            return greedy
-        scaled = logits / jnp.maximum(jnp.asarray(temps)[:, None], 1e-6)
-        sampled = np.asarray(jax.random.categorical(key, scaled, axis=-1))
-        return np.where(temps == 0.0, greedy, sampled)
+        one sampling rule both engines use everywhere. All-greedy batches
+        skip the categorical entirely; mixed batches run the fused sampler
+        (one program, one transfer)."""
+        if temps.any():
+            return host_sync(_fused_sample(logits, jnp.asarray(temps), key))
+        return host_sync(_greedy_sample(logits))
 
 
 class LLMEngine(_DecodeModelBase):
@@ -120,8 +175,9 @@ class LLMEngine(_DecodeModelBase):
         mesh=None,
         max_batch_size: int = 8,
         seed: Optional[int] = None,
+        plan=None,
     ):
-        super().__init__(model_config, params, mesh)
+        super().__init__(model_config, params, mesh, plan=plan)
         self._max_batch = max_batch_size
         self._rng = jax.random.PRNGKey(_resolve_seed(seed))
 
@@ -288,8 +344,9 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         num_slots: int = 8,
         kv_cache=None,
         seed: Optional[int] = None,
+        plan=None,
     ):
-        super().__init__(model_config, params, mesh)
+        super().__init__(model_config, params, mesh, plan=plan)
         self._num_slots = num_slots
         self._slots: Dict[int, _Slot] = {}  # slot index -> active request
         self._pending: List[tuple] = []  # (request_id, GenerationRequest)
@@ -302,6 +359,10 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         # longest cached prefix, prefills only the suffix, and blocks
         # admission when the pool is out of blocks (backpressure, not OOM)
         self._kv = kv_cache
+        if kv_cache is not None and self._plan is not None:
+            # the manager's block pools must live in the same sharded
+            # layout as the decode cache they exchange rows with
+            kv_cache.adopt_plan(self._plan)
         # serve replicas call sync methods from a thread pool: every public
         # entry point serializes on this (reentrant: step() inside generate)
         self._lock = threading.RLock()
@@ -399,6 +460,7 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                         category="engine", request_id=slot.request_id,
                         tokens=len(slot.generated),
                         finished=result.finished_reason,
+                        mesh=self._mesh_tag,
                     )
                 self._retire_slot(si)
         return finished
@@ -563,6 +625,7 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                     request_id=rid, cached_tokens=cached,
                     computed_tokens=len(req.token_ids) - cached,
                     hit=cached > 0,
+                    mesh=self._mesh_tag,
                 )
             first = int(
                 self._sample_tokens(
@@ -576,7 +639,10 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                 cached = lease.num_cached_tokens
                 self._kv.record_prefill(cached, len(req.token_ids) - cached)
                 if ts is not None:
-                    _record_ttft(time.monotonic() - ts, hit=cached > 0)
+                    _record_ttft(
+                        time.monotonic() - ts, hit=cached > 0,
+                        mesh=self._mesh_tag,
+                    )
                 # commit the prompt's full blocks while the prefilled row
                 # is at hand; reserved blocks are consumed here
                 cm_t0 = time.time() if tr else 0.0
@@ -644,13 +710,21 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         return logits, row
 
     def _empty_cache(self, solo_cache):
-        """Pooled cache with num_slots rows, shaped from a solo prefill."""
+        """Pooled cache with num_slots rows, shaped from a solo prefill.
+        Under a plan the pool is *born* sharded (KV heads over tp — the
+        slot axis simply replaces the batch axis, so the same spec holds);
+        a replicated pool would silently gather every insert."""
         def widen(x):
             return jnp.zeros(
                 (self._num_slots,) + tuple(x.shape[1:]), x.dtype
             )
 
-        return jax.tree.map(widen, solo_cache)
+        pooled = jax.tree.map(widen, solo_cache)
+        if self._plan is not None:
+            pooled = jax.tree.map(
+                jax.device_put, pooled, self._plan.cache_shardings(pooled)
+            )
+        return pooled
 
     def _sample_rows(self, logits) -> np.ndarray:
         temps = np.zeros(self._num_slots, np.float32)

@@ -39,24 +39,33 @@ class _LLMReplica:
                  weights_name: Optional[str] = None):
         import jax
 
-        from ..parallel.mesh import make_mesh
+        from ..parallel.plan import PartitionPlan
         from ..parallel.sharding import unbox_params
 
         self._config = llm_config
         model_config = llm_config.build_model_config()
+        tp, sp = llm_config.effective_parallelism()
+        plan = None
         mesh = None
-        if llm_config.tensor_parallel_size > 1:
-            mesh = make_mesh(
-                tp=llm_config.tensor_parallel_size,
-                sp=llm_config.sequence_parallel_size,
-                fsdp=1,
-                dp=-1,
-            )
+        if tp > 1 or sp > 1:
+            # validates tp against the local device count and the model's
+            # head counts (typed MeshValidationError, before any jit) and
+            # builds the replica's mesh with tp on the fastest axis
+            plan = PartitionPlan.for_model(model_config, tp, sp)
+            mesh = plan.mesh
+        self._plan = plan
         self._mesh = mesh
         self._weights_name = weights_name
         self._weights_sub = None
         self._weights_version = None
         self._weights_resolve_s = 0.0
+        # weight-plane consumers resolve manifest chunks directly into the
+        # sharded layout: the plan's name-matched rules become a callable
+        # sharding (resolved against the assembled tree), so each device
+        # pulls only its shard bytes and each chunk is fetched once
+        self._weights_sharding = (
+            plan.param_shardings if plan is not None else None
+        )
         if weights_name is not None:
             # hot-reloadable weights from the weight plane: the replica
             # subscribes to the named model and serves its head version;
@@ -72,7 +81,7 @@ class _LLMReplica:
             t0 = _time.perf_counter()
             self._weights_sub = WeightSubscriber(weights_name)
             self._weights_version, params = self._weights_sub.get(
-                timeout=60.0
+                timeout=60.0, sharding=self._weights_sharding
             )
             self._weights_resolve_s = _time.perf_counter() - t0
         elif params_blob is not None:
@@ -94,12 +103,14 @@ class _LLMReplica:
             self._kv_cache = KVCacheManager(
                 num_blocks=llm_config.kv_cache_blocks,
                 block_size=llm_config.kv_block_size,
+                plan=plan,
             )
             self._engine = ContinuousBatchingEngine(
                 model_config, params, mesh,
                 num_slots=llm_config.max_batch_size,
                 kv_cache=self._kv_cache,
                 seed=llm_config.seed,
+                plan=plan,
             )
         else:
             self._kv_cache = None
@@ -107,6 +118,7 @@ class _LLMReplica:
                 model_config, params, mesh,
                 max_batch_size=llm_config.max_batch_size,
                 seed=llm_config.seed,
+                plan=plan,
             )
         self._tokenizer = None
         if tokenizer_name:
@@ -141,7 +153,9 @@ class _LLMReplica:
                 "replica was not deployed with weights_name; hot reload "
                 "needs the weight plane"
             )
-        new_version, params = self._weights_sub.get(version, timeout=60.0)
+        new_version, params = self._weights_sub.get(
+            version, timeout=60.0, sharding=self._weights_sharding
+        )
         if new_version != self._weights_version:
             self._engine.swap_params(params)
             self._weights_version = new_version
@@ -158,6 +172,45 @@ class _LLMReplica:
             "weights_version" in user_config
         ) and self._weights_sub is not None:
             self.reload_weights(user_config["weights_version"])
+
+    def mesh_info(self) -> Dict[str, Any]:
+        """The replica's mesh ownership card — polled into the serve
+        controller's replica inventory (``ray_tpu list replicas``,
+        dashboard ``/api/serve``): mesh shape, device count, per-device
+        HBM in use where the backend reports it (CPU meshes report None),
+        and the per-device KV block-pool footprint."""
+        import jax
+
+        if self._plan is None:
+            devices = jax.devices()[:1]
+            info: Dict[str, Any] = {
+                "mesh": {}, "tag": "tp=1", "num_devices": 1,
+            }
+        else:
+            devices = list(self._plan.mesh.devices.flat)
+            info = {
+                "mesh": self._plan.mesh_shape(),
+                "tag": self._plan.describe(),
+                "num_devices": self._plan.num_devices,
+            }
+        hbm = []
+        for d in devices:
+            try:
+                stats = d.memory_stats()
+                hbm.append(
+                    int(stats["bytes_in_use"])
+                    if stats and "bytes_in_use" in stats else None
+                )
+            except Exception:
+                hbm.append(None)
+        info["per_device_hbm_bytes"] = hbm
+        if self._kv_cache is not None:
+            info["kv_pool_bytes_per_device"] = self._kv_cache.pool_accounting()[
+                "kv_pool_bytes_per_device"
+            ]
+        if self._weights_sub is not None:
+            info["weight_chunk_pulls"] = self._weights_sub.chunk_pulls
+        return info
 
     def kvcache_stats(self) -> Optional[Dict[str, Any]]:
         """Replica-local KV-cache stats (None on the dense engine); routed

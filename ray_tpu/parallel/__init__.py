@@ -1,0 +1,24 @@
+"""Parallelism plane: device meshes, param sharding, partition planning.
+
+Submodules import jax; the re-exports below resolve lazily (PEP 562) so
+that merely importing ``ray_tpu.parallel`` stays cheap for tooling that
+only wants the names.
+"""
+
+_PLAN_EXPORTS = (
+    "PartitionPlan",
+    "DEFAULT_LLM_RULES",
+    "KV_SPEC",
+    "match_partition_rules",
+    "validate_mesh_for_model",
+)
+
+__all__ = list(_PLAN_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _PLAN_EXPORTS:
+        from . import plan
+
+        return getattr(plan, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -137,6 +137,10 @@ SERVE_PROXY = KeyPrefix(
 
 SERVE_CONTROLLER_CKPT = SERVE.key("controller_ckpt")
 SERVE_AUTOSCALE_LOG = SERVE.key("autoscale_log")
+# replica inventory mirror (JSON rows incl. mesh ownership + per-device
+# HBM), refreshed every reconcile tick; read by `ray_tpu list replicas`
+# and the dashboard /api/serve without a controller round-trip
+SERVE_REPLICAS = SERVE.key("replicas")
 
 # -- fixed keys under the chaosnet prefix -----------------------------------
 

@@ -162,6 +162,7 @@ def cmd_list(args):
         "placement-groups": state.list_placement_groups,
         "objects": state.list_objects,
         "weights": state.list_weights,
+        "replicas": state.list_replicas,
     }[args.what]
     rows = fn()
     print(json.dumps(rows, indent=2, default=str))
@@ -873,7 +874,7 @@ def main(argv=None):
         "what",
         choices=[
             "nodes", "actors", "tasks", "jobs", "placement-groups",
-            "objects", "weights",
+            "objects", "weights", "replicas",
         ],
     )
     p.add_argument("--address", required=True, help="head host:port")

@@ -48,6 +48,10 @@ class _ReplicaState:
         # routed here (scale-down victim signal) and cold-start wall time
         self.affinity_keys = 0
         self.warmup_s = 0.0
+        # mesh ownership card from get_metrics (None = single device):
+        # {"mesh": {"tp": 2}, "tag": "tp=2", "num_devices": 2,
+        #  "per_device_hbm_bytes": [...], ...}
+        self.mesh = None
         # drain bookkeeping (state == "DRAINING"): the in-flight drain()
         # call and the hard deadline after which the replica is killed
         # whether or not it acked
@@ -109,6 +113,10 @@ class ServeController:
         # is polled from the reconcile loop like replicas.
         self._proxies: Dict[str, dict] = {}
         self._last_proxy_poll = 0.0
+        # replica-inventory KV mirror throttle: the snapshot only feeds
+        # read-side surfaces (CLI/dashboard), so a 2 s cadence is plenty
+        # and keeps the 0.25 s reconcile tick free of a per-tick kv_put
+        self._last_replica_mirror = 0.0
         try:
             self._recover_from_checkpoint()
         except Exception:
@@ -389,6 +397,35 @@ class ServeController:
             elif dep.config.autoscaling_config:
                 self._autoscale(dep)
             self._converge(full_name, dep)
+        self._mirror_replica_inventory()
+
+    def _mirror_replica_inventory(self):
+        """Mirror the replica inventory (incl. mesh ownership cards) to the
+        GCS KV each tick, the proxy-registry pattern: `ray_tpu list
+        replicas` and the dashboard read the KV snapshot instead of a
+        controller round-trip, so inventory stays visible even while the
+        controller is busy converging."""
+        import json as _json
+
+        now = time.time()
+        if now - self._last_replica_mirror < 2.0:
+            return
+        self._last_replica_mirror = now
+        rows = []
+        with self._lock:
+            app_names = list(self._apps)
+        for app in app_names:
+            for row in self.list_replica_info(app):
+                row["app"] = app
+                rows.append(row)
+        try:
+            self._kv_call(
+                "kv_put", gcs_keys.SERVE_REPLICAS,
+                _json.dumps({"ts": time.time(), "replicas": rows}).encode(),
+                True,
+            )
+        except Exception:
+            logger.debug("replica inventory mirror failed", exc_info=True)
 
     def _poll_replicas(self, dep: _DeploymentState):
         from .. import api
@@ -405,6 +442,7 @@ class ServeController:
                 replica.warmup_s = float(
                     metrics.get("warmup_s", replica.warmup_s)
                 )
+                replica.mesh = metrics.get("mesh", replica.mesh)
                 replica.consecutive_health_failures = 0
             except Exception:
                 replica.consecutive_health_failures += 1
@@ -838,6 +876,7 @@ class ServeController:
                         "queue_len": r.queue_len,
                         "affinity_keys": r.affinity_keys,
                         "warmup_s": r.warmup_s,
+                        "mesh": r.mesh,
                     })
             return out
 

@@ -499,7 +499,21 @@ class Replica:
             "pid": os.getpid(),
             "affinity_keys": self._live_affinity_keys(),
             "warmup_s": round(self._warmup_s, 6),
+            "mesh": self._mesh_info(),
         }
+
+    def _mesh_info(self):
+        """Mesh ownership card from the user callable (LLM replicas expose
+        mesh_info(): mesh shape, per-device HBM, KV pool footprint). None
+        for callables without a mesh — the controller then reports the
+        replica as single-device."""
+        fn = getattr(self._callable, "mesh_info", None)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:
+            return None
 
     def check_health(self) -> bool:
         user_check = getattr(self._callable, "check_health", None)

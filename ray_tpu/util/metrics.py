@@ -1047,66 +1047,77 @@ def _ensure_kvcache_metrics() -> dict:
     if _kvcache_metrics is None:
         with _kvcache_init_lock:
             if _kvcache_metrics is None:
+                # every kvcache metric carries the replica's mesh shape
+                # ("tp=1", "tp=2", ...) so sharded and single-device
+                # replicas separate cleanly in one cluster rollup
                 _kvcache_metrics = {
                     "hit_tokens": Counter(
                         "kvcache_prefix_hit_tokens_total",
                         "Prompt tokens served from the prefix cache "
                         "instead of prefilled",
+                        tag_keys=("mesh",),
                     ),
                     "prefill_tokens": Counter(
                         "kvcache_prefill_tokens_total",
                         "Prompt tokens actually computed at admission",
+                        tag_keys=("mesh",),
                     ),
                     "evictions": Counter(
                         "kvcache_evictions_total",
                         "KV blocks LRU-evicted from the prefix index",
+                        tag_keys=("mesh",),
                     ),
                     "blocked": Counter(
                         "kvcache_admission_blocked_total",
                         "Admissions deferred: block pool exhausted "
                         "(backpressure, not OOM)",
+                        tag_keys=("mesh",),
                     ),
                     "blocks_in_use": Gauge(
                         "kvcache_blocks_in_use",
                         "Allocated KV blocks in this engine's pool",
+                        tag_keys=("mesh",),
                     ),
                     "blocks_capacity": Gauge(
                         "kvcache_blocks_capacity",
                         "Total KV blocks in this engine's pool",
+                        tag_keys=("mesh",),
                     ),
                     "ttft": Histogram(
                         "kvcache_ttft_ms",
                         "Time to first token (ms) by prefix-cache outcome",
                         boundaries=_KVCACHE_TTFT_BOUNDARIES_MS,
-                        tag_keys=("cache",),
+                        tag_keys=("cache", "mesh"),
                     ),
                 }
     return _kvcache_metrics
 
 
-def record_kvcache_prefill(hit_tokens: int, computed_tokens: int):
+def record_kvcache_prefill(
+    hit_tokens: int, computed_tokens: int, mesh: str = "tp=1"
+):
     m = _ensure_kvcache_metrics()
-    m["hit_tokens"].inc(float(hit_tokens))
-    m["prefill_tokens"].inc(float(computed_tokens))
+    m["hit_tokens"].inc(float(hit_tokens), {"mesh": mesh})
+    m["prefill_tokens"].inc(float(computed_tokens), {"mesh": mesh})
 
 
-def record_kvcache_eviction(n: int = 1):
-    _ensure_kvcache_metrics()["evictions"].inc(float(n))
+def record_kvcache_eviction(n: int = 1, mesh: str = "tp=1"):
+    _ensure_kvcache_metrics()["evictions"].inc(float(n), {"mesh": mesh})
 
 
-def record_kvcache_blocked():
-    _ensure_kvcache_metrics()["blocked"].inc(1.0)
+def record_kvcache_blocked(mesh: str = "tp=1"):
+    _ensure_kvcache_metrics()["blocked"].inc(1.0, {"mesh": mesh})
 
 
-def set_kvcache_blocks(in_use: int, capacity: int):
+def set_kvcache_blocks(in_use: int, capacity: int, mesh: str = "tp=1"):
     m = _ensure_kvcache_metrics()
-    m["blocks_in_use"].set(float(in_use))
-    m["blocks_capacity"].set(float(capacity))
+    m["blocks_in_use"].set(float(in_use), {"mesh": mesh})
+    m["blocks_capacity"].set(float(capacity), {"mesh": mesh})
 
 
-def record_kvcache_ttft(seconds: float, hit: bool):
+def record_kvcache_ttft(seconds: float, hit: bool, mesh: str = "tp=1"):
     _ensure_kvcache_metrics()["ttft"].observe(
-        seconds * 1000.0, {"cache": "hit" if hit else "miss"}
+        seconds * 1000.0, {"cache": "hit" if hit else "miss", "mesh": mesh}
     )
 
 

@@ -235,6 +235,29 @@ def list_proxies() -> List[Dict[str, Any]]:
     return sorted(out, key=lambda r: str(r.get("proxy_id")))
 
 
+def list_replicas(app: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Serve replica inventory rows from the controller's GCS KV mirror
+    (``serve:replicas``, refreshed every reconcile tick): app, replica id,
+    state, node, and — for sharded LLM replicas — mesh ownership plus
+    per-device HBM/KV-pool accounting. Works from any connected process
+    without a controller actor handle (`ray_tpu list replicas`,
+    dashboard ``/api/serve``)."""
+    import json as _json
+
+    raw = _gcs_call("kv_get", gcs_keys.SERVE_REPLICAS)
+    if not raw:
+        return []
+    try:
+        rows = _json.loads(bytes(raw).decode()).get("replicas", [])
+    except Exception:
+        return []
+    if app is not None:
+        rows = [r for r in rows if r.get("app") == app]
+    return sorted(
+        rows, key=lambda r: (str(r.get("app")), str(r.get("replica_id")))
+    )
+
+
 def autoscale_log(limit: int = 100) -> List[Dict[str, Any]]:
     """Most recent SLO-autoscaler decision events, oldest first, read from
     the controller's GCS KV mirror (``serve:autoscale_log``) — works from

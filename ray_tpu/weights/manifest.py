@@ -96,13 +96,20 @@ def assemble_pytree(
 
 def reshard(value: Any, sharding: Any):
     """``jax.device_put`` every leaf onto ``sharding`` — one sharding for
-    the whole tree, or a matching pytree of per-leaf shardings. None is a
-    no-op (host arrays pass through)."""
+    the whole tree, a matching pytree of per-leaf shardings, or a
+    *callable* ``value -> sharding pytree`` (resolved here, against the
+    assembled tree — how a partition plan's name-matched rules apply to a
+    pytree whose paths only exist after assembly). None is a no-op (host
+    arrays pass through)."""
     if sharding is None:
         return value
     import jax
 
     is_sharding = lambda s: hasattr(s, "device_set") or hasattr(s, "devices")
+    if callable(sharding) and not is_sharding(sharding):
+        sharding = sharding(value)
+        if sharding is None:
+            return value
     try:
         shardings_flat = jax.tree_util.tree_leaves(sharding, is_leaf=is_sharding)
     except Exception:

@@ -68,6 +68,13 @@ class WeightSubscriber:
         # version -> prefetched (pinned, assembled) result awaiting adoption
         self._prefetched: Dict[int, _PinnedVersion] = {}
         self._prefetch_future = None
+        # transfer accounting: manifest chunks pulled through the broadcast
+        # tree and their byte total. A tp=N replica resolves chunks
+        # straight into its sharded layout, so each chunk is pulled ONCE
+        # per process (never once per device) and a repeat get() of the
+        # pinned version pulls zero — tests counter-assert both.
+        self.chunk_pulls = 0
+        self.bytes_pulled = 0
 
     # -- resolution --------------------------------------------------------
 
@@ -163,12 +170,17 @@ class WeightSubscriber:
             metrics.set_weights_staleness(self.name, head_version - v)
             return v, self._maybe_reshard(current.value, sharding)
         if pinned is None:
-            pinned = self._fetch_version(v, resolved["manifest"])
+            pinned = self._fetch_version(v, resolved["manifest"], sharding)
+            self._adopt(pinned)
+            metrics.set_weights_staleness(self.name, head_version - v)
+            return v, pinned.value
         self._adopt(pinned)
         metrics.set_weights_staleness(self.name, head_version - v)
         return v, self._maybe_reshard(pinned.value, sharding)
 
-    def _fetch_version(self, version: int, manifest_blob: bytes) -> _PinnedVersion:
+    def _fetch_version(
+        self, version: int, manifest_blob: bytes, sharding: Any = None
+    ) -> _PinnedVersion:
         worker = _worker_api.get_core_worker()
         t0 = time.perf_counter()
         # pin FIRST: a pinned version cannot tombstone mid-fetch
@@ -195,7 +207,15 @@ class WeightSubscriber:
             local_pins = _worker_api.run_on_worker_loop(
                 broadcast.pin_local_chunks(worker, manifest.chunks)
             )
-            value = assemble_pytree(manifest.treedef_blob, chunk_values)
+            # resolve chunks DIRECTLY into the consumer's (possibly
+            # sharded) layout: the host leaves take one device_put per
+            # leaf, so under a partition plan each device materializes
+            # only its shard — no replicated staging copy in device memory
+            value = assemble_pytree(
+                manifest.treedef_blob, chunk_values, sharding
+            )
+            self.chunk_pulls += len(manifest.chunks)
+            self.bytes_pulled += manifest.total_bytes
             metrics.record_weights_fetch(
                 self.name, time.perf_counter() - t0, manifest.total_bytes
             )

@@ -336,13 +336,61 @@ def test_rt007_ignores_unrelated_classes(tmp_path):
     assert result.findings == []
 
 
+# ---------------------------------------------------------------- RT009
+
+
+def test_rt009_flags_hot_path_host_roundtrips(tmp_path):
+    result = _run(tmp_path, {
+        "llm/engine.py": """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            def decode_step(logits, x):
+                a = jax.device_get(logits)
+                logits.block_until_ready()
+                b = np.asarray(jnp.argmax(logits, axis=-1))
+                c = float(jnp.max(logits))
+                return a, b, c
+        """,
+    }, rules=["RT009"])
+    assert _rules(result) == ["RT009"] * 4
+    msgs = " ".join(f.message for f in result.findings)
+    assert "host_sync" in msgs
+
+
+def test_rt009_host_sync_chokepoint_and_host_values_exempt(tmp_path):
+    result = _run(tmp_path, {
+        "kvcache/manager.py": """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def host_sync(x):
+                return np.asarray(x.block_until_ready())
+
+            def admit(token_ids, row):
+                ids = np.asarray(token_ids, np.int32)  # host list: fine
+                tok = int(row[0])                      # host array: fine
+                dev = jnp.asarray(ids)                 # host->device: fine
+                return ids, tok, dev
+        """,
+        "serve/router.py": """
+            import jax
+
+            def off_hot_path(x):
+                return jax.device_get(x)  # out of scope for RT009
+        """,
+    }, rules=["RT009"])
+    assert result.findings == []
+
+
 # ------------------------------------------------------------- framework
 
 
-def test_catalog_has_all_eight_rules():
+def test_catalog_has_all_nine_rules():
     assert sorted(checker_catalog()) == [
         "RT001", "RT002", "RT003", "RT004", "RT005", "RT006", "RT007",
-        "RT008",
+        "RT008", "RT009",
     ]
 
 
@@ -503,6 +551,7 @@ _EXC_INSTANCES = [
     exceptions.DeadlineExceededError("deploy", 1.5, 1.0, "handle"),
     exceptions.ReplicaDrainingError("replica-2"),
     exceptions.NodeFencedError("node-3", "gcs unreachable"),
+    exceptions.MeshValidationError("tp=3 does not divide 8 devices"),
     exceptions.RpcError("connection reset"),
     exceptions.PendingCallsLimitExceeded("queue cap"),
 ]
