@@ -600,6 +600,174 @@ def tp_serving():
     }))
 
 
+def _quantized_grad_loop(config):
+    """Data-parallel MLP smoke syncing bf16 gradients through the run's
+    collective group; the last epoch reports the process's collective byte
+    counters so the driver can compute wire bytes/step per mode."""
+    import ml_dtypes
+    import numpy as np
+
+    from ray_tpu import train as t
+
+    ctx = t.get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+    rng = np.random.default_rng(rank)
+    w = rng.standard_normal((64, 64)).astype(np.float32) * 0.1
+    x = rng.standard_normal((128, 64)).astype(np.float32)
+    y = rng.standard_normal((128, 64)).astype(np.float32)
+    epochs = config["epochs"]
+    for epoch in range(epochs):
+        grad = (2.0 / len(x)) * x.T @ (x @ w - y)
+        summed = t.collective.allreduce(grad.astype(ml_dtypes.bfloat16))
+        w = w - 0.01 * np.asarray(summed, np.float32) / world
+        loss = float(np.mean((x @ w - y) ** 2))
+        out = {"loss": loss, "epoch": epoch, "rank": rank}
+        if epoch == epochs - 1:
+            from ray_tpu.util import metrics
+
+            row = metrics.collective_summary().get("allreduce", {})
+            out["allreduce_bytes"] = row.get("bytes", 0.0)
+            out["allreduce_wire_bytes"] = row.get("wire_bytes", 0.0)
+        t.report(out)
+
+
+def quantized_broadcast():
+    """`python bench.py quantized_broadcast` — fp vs int8 transport A/B.
+
+    Three measurements on a local CPU cluster, ONE JSON line:
+      1. weight-plane publish/subscribe with the raw vs int8 chunk codec —
+         publish seconds, cross-process cold-fetch seconds (a fresh
+         subscriber actor: the weight-plane-warmed scale-up path a new
+         serve replica takes, i.e. the weights-resolution component of
+         serve_replica_warmup_seconds), logical vs wire bytes;
+      2. collective wire bytes/step on a bf16-gradient train smoke, fp vs
+         quantized groups (the halved-wire contract: int8+scales is ~0.51x
+         of bf16), plus final-loss parity between the two runs;
+      3. codec throughput in-process (encode+decode GB/s, no cluster).
+    On this 1-core box every byte moves through loopback/shared store, so
+    wire-byte ratios are exact while the *seconds* deltas understate what a
+    real NIC/ICI-bound cluster gains; treat times as plumbing-overhead
+    checks, ratios as the result."""
+    import jax  # noqa: F401  (forces backend init off the clock)
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import train as rt_train
+    from ray_tpu._internal.quantization import dequantize_np, quantize_np
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        # -- 1: weight plane publish/subscribe A/B --------------------------
+        from ray_tpu.weights import WeightPublisher
+
+        rng = np.random.default_rng(0)
+        tree = {
+            f"layer{i}": rng.standard_normal(2_000_000).astype(np.float32)
+            for i in range(8)  # 64 MB f32
+        }
+        logical = sum(v.nbytes for v in tree.values())
+
+        @ray_tpu.remote
+        class Fetcher:
+            def cold_fetch(self, name):
+                import time as _t
+
+                from ray_tpu.weights import WeightSubscriber
+
+                sub = WeightSubscriber(name)
+                t0 = _t.perf_counter()
+                sub.get(timeout=120.0)
+                dt = _t.perf_counter() - t0
+                out = (dt, sub.bytes_pulled, sub.wire_bytes_pulled)
+                sub.release()
+                return out
+
+        plane = {}
+        for codec, quant in (("raw", False), ("int8", True)):
+            pub = WeightPublisher(f"bench/q-{codec}")
+            t0 = time.perf_counter()
+            pub.publish(tree, quantized=quant)
+            publish_s = time.perf_counter() - t0
+            fetcher = Fetcher.remote()  # fresh process per arm (cold cache)
+            fetch_s, pulled, wire = ray_tpu.get(
+                fetcher.cold_fetch.remote(f"bench/q-{codec}"), timeout=180
+            )
+            del fetcher
+            plane[codec] = {
+                "publish_s": round(publish_s, 3),
+                "publish_gbps": round(logical / publish_s / 1e9, 3),
+                "cold_fetch_s": round(fetch_s, 3),
+                "fetch_gbps": round(logical / fetch_s / 1e9, 3),
+                "logical_bytes": pulled,
+                "wire_bytes": wire,
+            }
+            _log(f"weights {codec}: publish={publish_s:.3f}s "
+                 f"cold_fetch={fetch_s:.3f}s wire={wire}")
+        wire_ratio = plane["int8"]["wire_bytes"] / plane["raw"]["wire_bytes"]
+
+        # -- 2: train smoke wire bytes/step, fp vs quantized ----------------
+        epochs = 6
+        smoke = {}
+        for mode, quant in (("fp", False), ("int8", True)):
+            result = rt_train.JaxTrainer(
+                _quantized_grad_loop,
+                train_loop_config={"epochs": epochs},
+                scaling_config=rt_train.ScalingConfig(num_workers=2),
+                run_config=rt_train.RunConfig(name=f"qbench-{mode}"),
+                quantized=quant,
+            ).fit()
+            assert result.error is None, result.error
+            last = [m for m in result.metrics_history
+                    if m["rank"] == 0 and "allreduce_wire_bytes" in m][0]
+            smoke[mode] = {
+                "final_loss": round(last["loss"], 6),
+                "wire_bytes_per_step": last["allreduce_wire_bytes"] / epochs,
+                "logical_bytes_per_step": last["allreduce_bytes"] / epochs,
+            }
+            _log(f"train {mode}: loss={last['loss']:.6f} "
+                 f"wire/step={smoke[mode]['wire_bytes_per_step']:.0f}")
+        step_ratio = (smoke["int8"]["wire_bytes_per_step"]
+                      / smoke["fp"]["wire_bytes_per_step"])
+        loss_delta = abs(smoke["int8"]["final_loss"]
+                         - smoke["fp"]["final_loss"])
+
+        # -- 3: raw codec throughput (in-process) ---------------------------
+        big = rng.standard_normal(8_000_000).astype(np.float32)
+        t0 = time.perf_counter()
+        qa = quantize_np(big)
+        enc_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        dequantize_np(qa)
+        dec_s = time.perf_counter() - t0
+
+        print(json.dumps({
+            "metric": "quantized_wire_bytes_per_step_ratio",
+            "value": round(step_ratio, 4),
+            "unit": "x (int8 / fp wire bytes per train step, bf16 grads)",
+            "train_smoke": smoke,
+            "final_loss_delta": round(loss_delta, 6),
+            "weight_plane": plane,
+            "weight_plane_wire_ratio": round(wire_ratio, 4),
+            "warmup_weights_resolve_s": {
+                "raw": plane["raw"]["cold_fetch_s"],
+                "int8": plane["int8"]["cold_fetch_s"],
+            },
+            "codec_gbps": {
+                "encode": round(big.nbytes / enc_s / 1e9, 2),
+                "decode": round(big.nbytes / dec_s / 1e9, 2),
+            },
+            "config": {
+                "tree_mb": round(logical / 1e6, 1),
+                "train_grad_bytes": 64 * 64 * 2,
+                "epochs": epochs,
+                "workers": 2,
+                "note": "1-core box: ratios exact, seconds loopback-bound",
+            },
+        }))
+    finally:
+        ray_tpu.shutdown()
+
+
 def _elastic_train_loop(config):
     """Paced data-parallel loop resuming from the weight plane (the same
     shape tier-1's test_elastic_resume_after_rank_kill drives)."""
@@ -1302,6 +1470,8 @@ if __name__ == "__main__":
         proxy_saturation()
     elif len(sys.argv) > 1 and sys.argv[1] == "chaos_soak":
         chaos_soak()
+    elif len(sys.argv) > 1 and sys.argv[1] == "quantized_broadcast":
+        quantized_broadcast()
     elif len(sys.argv) > 1:
         raise SystemExit(f"unknown bench mode {sys.argv[1]!r}")
     else:

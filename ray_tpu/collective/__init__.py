@@ -53,17 +53,21 @@ def create_collective_group(
     ranks: List[int],
     backend: str = "gcs",
     group_name: str = "default",
+    **kwargs,
 ):
     """Declarative init: make every actor in ``actors`` join the group
     (reference: collective.py:222). Uses the executor's reserved
     ``__init_collective__`` actor-task hook, so actor classes need no
-    special method."""
+    special method. Extra kwargs (``epoch=``, ``quantized=``,
+    ``quant_block=``...) forward to every member's backend constructor —
+    config like the quantized wire format must be group-uniform, so it is
+    set here once rather than per member."""
     from .. import api
     from ..actor import ActorMethod
 
     refs = [
         ActorMethod(actor, "__init_collective__", {}).remote(
-            world_size, rank, backend, group_name
+            world_size, rank, backend, group_name, **kwargs
         )
         for actor, rank in zip(actors, ranks)
     ]

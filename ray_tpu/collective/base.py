@@ -12,7 +12,7 @@ from __future__ import annotations
 import enum
 import time
 from abc import ABC, abstractmethod
-from typing import Any, List
+from typing import Any, List, Optional
 
 
 class ReduceOp(enum.Enum):
@@ -47,7 +47,8 @@ class BaseGroup(ABC):
     backend = "base"
 
     def __init__(self, world_size: int, rank: int, group_name: str,
-                 epoch: int = 0):
+                 epoch: int = 0, quantized: bool = False,
+                 quant_block: int = 0):
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
@@ -56,16 +57,30 @@ class BaseGroup(ABC):
         # re-formed group never reads an aborted epoch's keys, and an abort
         # signal targets every epoch <= its value.
         self.epoch = epoch
+        # int8 transport: float payloads of allreduce/allgather/
+        # reducescatter ship as per-block int8 + f32 scales
+        # (_internal/quantization.py); reductions carry an error-feedback
+        # residual per (op, shape, dtype) so the accumulated quantization
+        # error stays bounded across rounds. Must be set identically on
+        # every member — the wire format is part of the group contract.
+        from .._internal.quantization import DEFAULT_BLOCK
 
-    def _record_op(self, op: str, nbytes: int, start: float):
+        self.quantized = quantized
+        self.quant_block = quant_block or DEFAULT_BLOCK
+        self._ef_residuals: dict = {}
+
+    def _record_op(self, op: str, nbytes: int, start: float,
+                   wire_nbytes: Optional[int] = None):
         """Record one finished op into the collective bytes/latency/
         bandwidth metrics (util/metrics); ``start`` is the perf_counter
-        taken before the op."""
+        taken before the op. ``nbytes`` is the logical payload size;
+        ``wire_nbytes`` the encoded on-the-wire size when they differ
+        (quantized transport) — None means wire == logical."""
         from ..util import metrics
 
         metrics.record_collective(
             op, self.backend, self.group_name, nbytes,
-            time.perf_counter() - start,
+            time.perf_counter() - start, wire_nbytes=wire_nbytes,
         )
 
     @abstractmethod

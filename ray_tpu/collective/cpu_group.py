@@ -33,6 +33,13 @@ import numpy as np
 
 from .. import _worker_api
 from .._internal import serialization
+from .._internal.quantization import (
+    QuantizedArray,
+    dequantize_np,
+    ef_quantize_np,
+    is_quantizable,
+    quantize_np,
+)
 from ..exceptions import CollectiveAbortedError
 from ..runtime.gcs import keys as gcs_keys
 from .base import BaseGroup, ReduceOp, tensor_nbytes
@@ -90,8 +97,10 @@ class GcsStoreGroup(BaseGroup):
     backend = "gcs_store"
 
     def __init__(self, world_size: int, rank: int, group_name: str, *,
-                 epoch: int = 0):
-        super().__init__(world_size, rank, group_name, epoch=epoch)
+                 epoch: int = 0, quantized: bool = False,
+                 quant_block: int = 0):
+        super().__init__(world_size, rank, group_name, epoch=epoch,
+                         quantized=quantized, quant_block=quant_block)
         self._seq = 0
         # point-to-point ops use per-(src,dst) counters so they don't
         # desynchronize the group-wide collective sequence
@@ -236,35 +245,73 @@ class GcsStoreGroup(BaseGroup):
 
     # -- ops ---------------------------------------------------------------
 
-    def _allreduce_impl(self, tensor, op: ReduceOp):
+    def _allreduce_impl(self, tensor, op: ReduceOp, ef_op: str = ""):
+        """Exchange + reduce; returns (reduced, wire_nbytes) where
+        wire_nbytes is None on the full-width path. Quantized mode ships
+        float payloads as int8+scales and reduces over the dequantized
+        f32 contributions; SUM additionally carries the error-feedback
+        residual (keyed per op/shape/dtype) into the next round so the
+        accumulated error stays bounded — MIN/MAX/PRODUCT are order
+        statistics/products where additive compensation is meaningless,
+        so they quantize without feedback."""
         seq = self._next_seq()
         arr = np.asarray(tensor)
+        if self.quantized and is_quantizable(arr):
+            if op is ReduceOp.SUM and ef_op:
+                key = (ef_op, arr.shape, str(arr.dtype))
+                qa, self._ef_residuals[key] = ef_quantize_np(
+                    arr, self._ef_residuals.get(key), self.quant_block
+                )
+            else:
+                qa = quantize_np(arr, self.quant_block)
+            self._put(seq, "d", qa)
+            gathered = [
+                dequantize_np(v, dtype="float32")
+                if isinstance(v, QuantizedArray) else np.asarray(v)
+                for v in self._gather_all(seq, "d")
+            ]
+            return _REDUCERS[op](gathered).astype(arr.dtype), qa.wire_nbytes
         self._put(seq, "d", arr)
-        return _REDUCERS[op](self._gather_all(seq, "d"))
+        return _REDUCERS[op](self._gather_all(seq, "d")), None
 
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
         start = time.perf_counter()
-        out = self._allreduce_impl(tensor, op)
-        self._record_op("allreduce", tensor_nbytes(out), start)
+        out, wire = self._allreduce_impl(tensor, op, ef_op="allreduce")
+        self._record_op("allreduce", tensor_nbytes(out), start,
+                        wire_nbytes=wire)
         return out
 
     def allgather(self, tensor) -> List[Any]:
         # arbitrary python objects allowed (control-plane data), not just
-        # tensors — objects round-trip unchanged
+        # tensors — objects round-trip unchanged. Quantized mode encodes
+        # float arrays (no error feedback: allgather replicates values,
+        # nothing accumulates) and decodes every gathered entry.
         start = time.perf_counter()
         seq = self._next_seq()
-        self._put(seq, "d", tensor)
-        out = self._gather_all(seq, "d")
-        self._record_op("allgather", tensor_nbytes(tensor), start)
+        wire = None
+        payload = tensor
+        if self.quantized and is_quantizable(tensor):
+            payload = quantize_np(np.asarray(tensor), self.quant_block)
+            wire = payload.wire_nbytes
+        self._put(seq, "d", payload)
+        out = [
+            dequantize_np(v) if isinstance(v, QuantizedArray) else v
+            for v in self._gather_all(seq, "d")
+        ]
+        self._record_op("allgather", tensor_nbytes(tensor), start,
+                        wire_nbytes=wire)
         return out
 
     def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
         start = time.perf_counter()
         # inner impl, not allreduce(): one op records one metric sample
-        reduced = self._allreduce_impl(tensor, op)
+        reduced, wire = self._allreduce_impl(
+            tensor, op, ef_op="reducescatter"
+        )
         shards = np.array_split(reduced, self.world_size, axis=0)
         out = shards[self.rank]
-        self._record_op("reducescatter", tensor_nbytes(reduced), start)
+        self._record_op("reducescatter", tensor_nbytes(reduced), start,
+                        wire_nbytes=wire)
         return out
 
     def broadcast(self, tensor, src_rank: int = 0):

@@ -34,6 +34,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..runtime.gcs import keys as gcs_keys
 from .base import BaseGroup, ReduceOp, tensor_nbytes
 from .._internal.jax_compat import shard_map
+from .._internal.quantization import (
+    dequantize_jax,
+    quantize_jax,
+    quantized_wire_nbytes,
+)
 
 _LAX_REDUCERS = {
     ReduceOp.SUM: jax.lax.psum,
@@ -88,8 +93,11 @@ class XlaGroup(BaseGroup):
         bootstrap_distributed: bool = False,
         devices: Optional[List] = None,
         epoch: int = 0,
+        quantized: bool = False,
+        quant_block: int = 0,
     ):
-        super().__init__(world_size, rank, group_name, epoch=epoch)
+        super().__init__(world_size, rank, group_name, epoch=epoch,
+                         quantized=quantized, quant_block=quant_block)
         self._host = None
         if bootstrap_distributed and world_size > 1:
             coord = _rendezvous_coordinator(group_name, rank, world_size)
@@ -145,21 +153,118 @@ class XlaGroup(BaseGroup):
 
         self._reducescatter = _reducescatter
 
+        # -- quantized programs (EQuARX-style): quantize → exchange int8 +
+        # scales → dequantize → reduce is ONE jitted computation per input
+        # aval — the compressed payload is what crosses ICI, and nothing
+        # round-trips through the host between the encode and the reduce.
+        # The error-feedback residual rides as a device-array input/output
+        # of the same program (f32, sharded like the operand), so carrying
+        # it costs no extra transfer either.
+        block = self.quant_block
+
+        @jax.jit
+        def _qallreduce(x, residual):
+            def body(s, r):
+                comp = s.astype(jnp.float32) + r
+                q, scales = quantize_jax(comp, block)
+                qg = jax.lax.all_gather(q, "g")
+                sg = jax.lax.all_gather(scales, "g")
+                total = dequantize_jax(
+                    qg, sg, comp.shape, jnp.float32
+                ).sum(axis=0)
+                own = dequantize_jax(q, scales, comp.shape, jnp.float32)
+                return total.astype(s.dtype), comp - own
+
+            return shard_map(
+                body, mesh=self.mesh, in_specs=(spec, spec),
+                out_specs=(rep, spec), check_vma=False,
+            )(x, residual)
+
+        self._qallreduce = _qallreduce
+
+        @jax.jit
+        def _qallgather(x):
+            def body(s):
+                q, scales = quantize_jax(s, block)
+                qg = jax.lax.all_gather(q, "g")
+                sg = jax.lax.all_gather(scales, "g")
+                out = dequantize_jax(qg, sg, s.shape, s.dtype)
+                # tiled concat along the shard axis, like the fp program
+                return out.reshape((-1,) + s.shape[1:])
+
+            return shard_map(
+                body, mesh=self.mesh, in_specs=spec, out_specs=rep,
+                check_vma=False,
+            )(x)
+
+        self._qallgather = _qallgather
+
+        @jax.jit
+        def _qreducescatter(x, residual):
+            def body(xfull, r):
+                comp = xfull.astype(jnp.float32) + r
+                q, scales = quantize_jax(comp, block)
+                qg = jax.lax.all_gather(q, "g")
+                sg = jax.lax.all_gather(scales, "g")
+                total = dequantize_jax(
+                    qg, sg, comp.shape, jnp.float32
+                ).sum(axis=0)
+                own = dequantize_jax(q, scales, comp.shape, jnp.float32)
+                idx = jax.lax.axis_index("g")
+                shard_len = total.shape[0] // n
+                shard = jax.lax.dynamic_slice_in_dim(
+                    total, idx * shard_len, shard_len, 0
+                )
+                return shard.astype(xfull.dtype), comp - own
+
+            return shard_map(
+                body, mesh=self.mesh, in_specs=(rep, rep),
+                out_specs=(spec, rep), check_vma=False,
+            )(x, residual)
+
+        self._qreducescatter = _qreducescatter
+
     def _device_shard(self, tensor):
         """Shard a host array over the group axis (leading dim)."""
         return jax.device_put(tensor, NamedSharding(self.mesh, P("g")))
 
     backend = "xla"
 
-    def _timed(self, op_name: str, tensor, fn):
+    def _timed(self, op_name: str, tensor, fn, wire_nbytes=None):
         """Run an eager collective under the bytes/latency instrumentation;
         block_until_ready so the recorded latency covers the ICI transfer,
         not just the async dispatch (the eager surface is synchronizing
         anyway — in-graph lax collectives stay untouched)."""
         start = time.perf_counter()
         out = jax.block_until_ready(fn())
-        self._record_op(op_name, tensor_nbytes(tensor), start)
+        self._record_op(op_name, tensor_nbytes(tensor), start,
+                        wire_nbytes=wire_nbytes)
         return out
+
+    def _use_quantized(self, x, op: Optional[ReduceOp] = None) -> bool:
+        """Quantized transport applies to float operands; reductions only
+        for SUM (MIN/MAX order statistics have no meaningful additive
+        error feedback, and their fp programs stay exact)."""
+        from .._internal.quantization import is_quantizable
+
+        return (
+            self.quantized
+            and is_quantizable(x)
+            and (op is None or op is ReduceOp.SUM)
+        )
+
+    def _residual_for(self, op_name: str, x, replicated: bool = False):
+        """The carried error-feedback residual for this (op, aval) —
+        an f32 device array born zero, sharded like the operand so the
+        jitted program consumes it without a relayout."""
+        key = (op_name, tuple(x.shape), str(x.dtype))
+        res = self._ef_residuals.get(key)
+        if res is None or res.shape != x.shape:
+            res = jax.device_put(
+                jnp.zeros(x.shape, jnp.float32),
+                NamedSharding(self.mesh, P() if replicated else P("g")),
+            )
+        return key, res
 
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
         # each device's shard is summed: for the eager API the input is the
@@ -169,10 +274,26 @@ class XlaGroup(BaseGroup):
                 "PRODUCT has no XLA collective; use the cpu backend"
             )
         x = self._device_shard(tensor)
+        if self._use_quantized(x, op):
+            key, res = self._residual_for("allreduce", x)
+
+            def run():
+                out, self._ef_residuals[key] = self._qallreduce(x, res)
+                return out
+
+            return self._timed(
+                "allreduce", x, run,
+                wire_nbytes=quantized_wire_nbytes(x.size, self.quant_block),
+            )
         return self._timed("allreduce", x, lambda: self._reduce(x, op.value))
 
     def allgather(self, tensor) -> Any:
         x = self._device_shard(tensor)
+        if self._use_quantized(x):
+            return self._timed(
+                "allgather", x, lambda: self._qallgather(x),
+                wire_nbytes=quantized_wire_nbytes(x.size, self.quant_block),
+            )
         return self._timed("allgather", x, lambda: self._allgather(x))
 
     def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
@@ -181,6 +302,19 @@ class XlaGroup(BaseGroup):
                 "XLA psum_scatter only reduces with SUM; use the cpu backend"
             )
         x = jnp.asarray(tensor)
+        if self._use_quantized(x, op) and x.shape[0] % len(self.devices) == 0:
+            key, res = self._residual_for(
+                "reducescatter", x, replicated=True
+            )
+
+            def run():
+                out, self._ef_residuals[key] = self._qreducescatter(x, res)
+                return out
+
+            return self._timed(
+                "reducescatter", x, run,
+                wire_nbytes=quantized_wire_nbytes(x.size, self.quant_block),
+            )
         return self._timed("reducescatter", x, lambda: self._reducescatter(x))
 
     def _host_group(self):

@@ -16,7 +16,7 @@ from .engine import (
     GenerationResult,
     LLMEngine,
 )
-from .serving import build_llm_deployment
+from .serving import build_llm_deployment, publish_llm_weights
 from .batch import LLMPredictor
 
 __all__ = [
@@ -26,5 +26,6 @@ __all__ = [
     "GenerationRequest",
     "GenerationResult",
     "build_llm_deployment",
+    "publish_llm_weights",
     "LLMPredictor",
 ]

@@ -62,6 +62,12 @@ class LLMConfig:
     # leading prompt tokens hashed for prefix-affinity replica routing
     # (serve handle pow2 bias); 0 disables
     prefix_affinity_tokens: int = 16
+    # int8 chunk codec for weight-plane publishes feeding this deployment
+    # (serving.publish_llm_weights): every broadcast-tree hop — and the
+    # replica warm-up pull that gates RUNNING — carries ~2x (bf16) / ~4x
+    # (f32) fewer bytes; replicas dequantize at assembly straight into
+    # their sharded layout
+    quantized: bool = False
 
     def __post_init__(self):
         if self.mesh is not None:

@@ -210,6 +210,9 @@ class _LLMReplica:
             ]
         if self._weights_sub is not None:
             info["weight_chunk_pulls"] = self._weights_sub.chunk_pulls
+            info["weight_wire_bytes_pulled"] = (
+                self._weights_sub.wire_bytes_pulled
+            )
         return info
 
     def kvcache_stats(self) -> Optional[Dict[str, Any]]:
@@ -226,6 +229,14 @@ class _LLMReplica:
             "resolve_s": self._weights_resolve_s,
             "staleness": (
                 self._weights_sub.staleness()
+                if self._weights_sub is not None
+                else None
+            ),
+            # chunk codec of the resolved version ("raw" / "int8") — how
+            # operators confirm a quantized publisher actually reached
+            # this replica compressed
+            "codec": (
+                self._weights_sub.current_codec
                 if self._weights_sub is not None
                 else None
             ),
@@ -334,3 +345,26 @@ def build_llm_deployment(
         options["num_replicas"] = llm_config.num_replicas
     dep = serve.deployment(_LLMReplica, **options)
     return dep.bind(llm_config, params_blob, tokenizer_name, weights_name)
+
+
+def publish_llm_weights(
+    llm_config: LLMConfig,
+    params,
+    *,
+    weights_name: Optional[str] = None,
+    meta: Optional[dict] = None,
+):
+    """Publish one weight-plane version for a deployment's replicas,
+    honoring ``llm_config.quantized`` (int8 chunk codec — the broadcast
+    tree, the per-node store copies, and each replica's warm-up pull all
+    carry the compressed form). Defaults the model name to
+    ``llm/<model_id>``; pass the same ``weights_name`` the deployment was
+    built with when it differs."""
+    from .. import weights
+
+    return weights.publish(
+        weights_name or f"llm/{llm_config.model_id}",
+        params,
+        meta=meta,
+        quantized=getattr(llm_config, "quantized", False),
+    )

@@ -34,6 +34,12 @@ class AlgorithmConfig:
         # broadcast tree, per-node chunk dedup, versioned registry)
         self.use_weight_plane = False
         self.weight_plane_name: Optional[str] = None
+        # int8 chunk codec for weight-plane publishes: every broadcast-tree
+        # hop carries ~4x (f32) / ~2x (bf16) fewer bytes; runners dequantize
+        # at assembly. Policy weights tolerate the ~0.4% per-block rounding
+        # (acting is already stochastic); only meaningful with
+        # use_weight_plane=True
+        self.quantized_weight_sync = False
 
     def environment(self, env, env_config: Optional[dict] = None):
         self.env_spec = env
@@ -88,12 +94,17 @@ class AlgorithmConfig:
         self,
         use_weight_plane: Optional[bool] = None,
         weight_plane_name: Optional[str] = None,
+        quantized: Optional[bool] = None,
     ):
-        """Configure how fresh params reach env-runners each iteration."""
+        """Configure how fresh params reach env-runners each iteration.
+        ``quantized=True`` publishes versions with the int8 chunk codec
+        (compressed broadcast; see weights/manifest.py)."""
         if use_weight_plane is not None:
             self.use_weight_plane = use_weight_plane
         if weight_plane_name is not None:
             self.weight_plane_name = weight_plane_name
+        if quantized is not None:
+            self.quantized_weight_sync = quantized
         return self
 
     def debugging(self, seed: Optional[int] = None):

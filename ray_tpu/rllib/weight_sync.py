@@ -26,10 +26,16 @@ from typing import Any, Optional
 
 class ParamsBroadcaster:
     def __init__(
-        self, use_weight_plane: bool = False, name: Optional[str] = None
+        self,
+        use_weight_plane: bool = False,
+        name: Optional[str] = None,
+        quantized: bool = False,
     ):
         self._use_weight_plane = use_weight_plane
         self._name = name or "rllib/params"
+        # int8 chunk codec on weight-plane publishes — the broadcast tree
+        # moves the compressed form; no effect in ObjectRef mode
+        self._quantized = quantized
         self._cached: Any = None
         self._handle: Any = None
 
@@ -41,7 +47,9 @@ class ParamsBroadcaster:
         if self._use_weight_plane:
             from .. import weights
 
-            self._handle = weights.publish(self._name, params)
+            self._handle = weights.publish(
+                self._name, params, quantized=self._quantized
+            )
         else:
             from .. import api
 
@@ -61,6 +69,7 @@ def broadcaster_for(config) -> ParamsBroadcaster:
         use_weight_plane=getattr(config, "use_weight_plane", False),
         name=getattr(config, "weight_plane_name", None)
         or f"rllib/{type(config).__name__.removesuffix('Config').lower()}",
+        quantized=getattr(config, "quantized_weight_sync", False),
     )
 
 

@@ -357,6 +357,11 @@ class GcsWeightRegistry:
                     "tree_depth": _tree_depth(len(model.subscriber_nodes)),
                     "total_bytes": head_meta.get("total_bytes"),
                     "num_chunks": head_meta.get("num_chunks"),
+                    # chunk codec + encoded size of the head version: how
+                    # `ray_tpu list weights` shows whether a model rides
+                    # the wire compressed (wire < total => int8 codec)
+                    "codec": head_meta.get("codec", "raw"),
+                    "wire_bytes": head_meta.get("wire_bytes"),
                 }
             )
         return out

@@ -64,6 +64,7 @@ class TrainController:
         datasets: Optional[Dict[str, Any]] = None,
         poll_interval: float = 0.1,
         callbacks: Optional[List[Any]] = None,
+        quantized: bool = False,
     ):
         self._train_fn = train_fn
         self._train_fn_config = train_fn_config
@@ -93,6 +94,9 @@ class TrainController:
         self._epoch = 0
         self._resizes = 0
         self._restart_t0: Optional[float] = None
+        # int8+error-feedback transport for the run's collective group and
+        # train-state publishes; threaded into every worker's TrainContext
+        self._quantized = quantized
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -206,6 +210,7 @@ class TrainController:
             run_dir=self._run_config.run_dir,
             collective_group=self._group_name(),
             collective_epoch=self._epoch,
+            collective_quantized=self._quantized,
         )
 
     def _group_name(self) -> str:

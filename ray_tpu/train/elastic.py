@@ -46,13 +46,19 @@ def publish_train_state(
     *,
     name: Optional[str] = None,
     meta: Optional[dict] = None,
+    quantized: Optional[bool] = None,
 ):
     """Publish the run's resumable state to the weight plane. Rank 0 only —
     other ranks no-op (SPMD state is replicated) and return None. Returns
-    the published :class:`WeightHandle` on rank 0."""
+    the published :class:`WeightHandle` on rank 0. ``quantized`` defaults
+    to the run's transport setting (``JaxTrainer(quantized=True)``): a
+    quantized run resumes from int8-coded state, halving resize recovery
+    bytes the same way its gradient collectives are halved."""
     ctx = get_context()
     if ctx.world_rank != 0:
         return None
+    if quantized is None:
+        quantized = ctx.collective_quantized
     from .. import weights
 
     payload = {
@@ -65,7 +71,9 @@ def publish_train_state(
     full_meta = {"step": int(step), "world_size": ctx.world_size}
     if meta:
         full_meta.update(meta)
-    return weights.publish(_state_name(name), payload, meta=full_meta)
+    return weights.publish(
+        _state_name(name), payload, meta=full_meta, quantized=quantized
+    )
 
 
 def restore_train_state(

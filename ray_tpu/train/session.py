@@ -41,6 +41,10 @@ class TrainContext:
     # collective rendezvous keys so a re-formed gang never reads an
     # aborted epoch's state
     collective_epoch: int = 0
+    # int8-with-error-feedback collectives for this run's group, and the
+    # default codec for publish_train_state — must be gang-uniform, so it
+    # rides in the context rather than per-call arguments
+    collective_quantized: bool = False
     latest_checkpoint: Optional[Checkpoint] = None
     dataset_shards: Dict[str, Any] = field(default_factory=dict)
 

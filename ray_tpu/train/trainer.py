@@ -32,6 +32,7 @@ class DataParallelTrainer:
         run_config: Optional[RunConfig] = None,
         backend_config: Optional[BackendConfig] = None,
         datasets: Optional[Dict[str, Any]] = None,
+        quantized: bool = False,
     ):
         self._train_loop = train_loop_per_worker
         self._train_loop_config = train_loop_config
@@ -39,6 +40,11 @@ class DataParallelTrainer:
         self.run_config = run_config or RunConfig()
         self.backend_config = backend_config or BackendConfig()
         self.datasets = datasets
+        # quantized transport plane: int8+error-feedback collectives for
+        # the run's gang and the int8 chunk codec for train-state publishes
+        # (halves bf16 gradient/weight bytes on the wire; loss parity is
+        # maintained by error feedback — see docs/ARCHITECTURE.md §16)
+        self.quantized = quantized
 
     def _default_callbacks(self):
         return []
@@ -55,6 +61,7 @@ class DataParallelTrainer:
             self.backend_config,
             datasets=self.datasets,
             callbacks=callbacks,
+            quantized=self.quantized,
         )
         return controller.run()
 

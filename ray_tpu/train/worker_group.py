@@ -73,6 +73,7 @@ class TrainWorker:
                 backend="gcs",
                 group_name=self._ctx.collective_group,
                 epoch=self._ctx.collective_epoch,
+                quantized=self._ctx.collective_quantized,
             )
         return True
 

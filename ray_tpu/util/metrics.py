@@ -371,8 +371,24 @@ def _ensure_weights_metrics() -> dict:
                     ),
                     "broadcast_bytes": Counter(
                         "weights_broadcast_bytes_total",
-                        "Weight bytes moved by direction (publish | fetch)",
+                        "Logical weight bytes moved by direction "
+                        "(publish | fetch) — raw leaf bytes, pre-codec",
                         tag_keys=("model", "direction"),
+                    ),
+                    # wire vs logical split: with the int8 chunk codec the
+                    # store/broadcast bytes are ~2-4x smaller than the leaf
+                    # bytes; conflating them would silently hide (or
+                    # double-count) the compression win
+                    "wire_bytes": Counter(
+                        "weights_wire_bytes_total",
+                        "Encoded on-the-wire weight bytes by direction "
+                        "(publish | fetch)",
+                        tag_keys=("model", "direction"),
+                    ),
+                    "codec_publishes": Counter(
+                        "weights_codec_publish_total",
+                        "Published versions by chunk codec (raw | int8)",
+                        tag_keys=("model", "codec"),
                     ),
                     "tree_depth": Gauge(
                         "weights_broadcast_tree_depth",
@@ -388,19 +404,30 @@ def _ensure_weights_metrics() -> dict:
     return _weights_metrics
 
 
-def record_weights_publish(model: str, latency_s: float, nbytes: int):
+def record_weights_publish(
+    model: str, latency_s: float, nbytes: int,
+    wire_nbytes: Optional[int] = None, codec: str = "raw",
+):
     m = _ensure_weights_metrics()
+    tags = {"model": model, "direction": "publish"}
     m["publish_latency"].observe(latency_s * 1000.0, {"model": model})
-    m["broadcast_bytes"].inc(
-        float(nbytes), {"model": model, "direction": "publish"}
+    m["broadcast_bytes"].inc(float(nbytes), tags)
+    m["wire_bytes"].inc(
+        float(wire_nbytes if wire_nbytes is not None else nbytes), tags
     )
+    m["codec_publishes"].inc(1.0, {"model": model, "codec": codec})
 
 
-def record_weights_fetch(model: str, latency_s: float, nbytes: int):
+def record_weights_fetch(
+    model: str, latency_s: float, nbytes: int,
+    wire_nbytes: Optional[int] = None,
+):
     m = _ensure_weights_metrics()
+    tags = {"model": model, "direction": "fetch"}
     m["fetch_latency"].observe(latency_s * 1000.0, {"model": model})
-    m["broadcast_bytes"].inc(
-        float(nbytes), {"model": model, "direction": "fetch"}
+    m["broadcast_bytes"].inc(float(nbytes), tags)
+    m["wire_bytes"].inc(
+        float(wire_nbytes if wire_nbytes is not None else nbytes), tags
     )
 
 
@@ -454,12 +481,20 @@ def _ensure_collective_metrics() -> dict:
                     ),
                     "bytes": Counter(
                         "collective_bytes_total",
-                        "Bytes moved through collective ops",
+                        "Logical bytes moved through collective ops "
+                        "(operand bytes, pre-codec)",
+                        tag_keys=("op", "backend", "group"),
+                    ),
+                    "wire_bytes": Counter(
+                        "collective_wire_bytes_total",
+                        "Encoded on-the-wire bytes of collective ops "
+                        "(== logical when transport is full-width)",
                         tag_keys=("op", "backend", "group"),
                     ),
                     "bandwidth": Gauge(
                         "collective_bandwidth_gb_s",
-                        "Achieved bandwidth of the last collective op (GB/s)",
+                        "Achieved wire bandwidth of the last collective "
+                        "op (GB/s, encoded bytes / wall time)",
                         tag_keys=("op", "backend", "group"),
                     ),
                 }
@@ -467,15 +502,21 @@ def _ensure_collective_metrics() -> dict:
 
 
 def record_collective(
-    op: str, backend: str, group: str, nbytes: int, latency_s: float
+    op: str, backend: str, group: str, nbytes: int, latency_s: float,
+    wire_nbytes: Optional[int] = None,
 ):
-    """Called from every collective backend op (hot path — keep cheap)."""
+    """Called from every collective backend op (hot path — keep cheap).
+    ``nbytes`` is the logical operand size; ``wire_nbytes`` the encoded
+    size when the transport compresses (None: wire == logical). The
+    bandwidth gauge is wire-basis — it reports what the link carried."""
     m = _ensure_collective_metrics()
     tags = {"op": op, "backend": backend, "group": group}
+    wire = wire_nbytes if wire_nbytes is not None else nbytes
     m["latency"].observe(latency_s * 1000.0, tags)
     m["bytes"].inc(float(nbytes), tags)
+    m["wire_bytes"].inc(float(wire), tags)
     if latency_s > 0:
-        m["bandwidth"].set(nbytes / latency_s / 1e9, tags)
+        m["bandwidth"].set(wire / latency_s / 1e9, tags)
 
 
 def collective_seconds_total() -> float:
@@ -503,6 +544,9 @@ def collective_summary() -> Dict[str, Dict[str, float]]:
     with m["bytes"]._lock:
         for key, v in m["bytes"]._values.items():
             out.setdefault(key[0], {})["bytes"] = v
+    with m["wire_bytes"]._lock:
+        for key, v in m["wire_bytes"]._values.items():
+            out.setdefault(key[0], {})["wire_bytes"] = v
     return out
 
 
@@ -1615,6 +1659,57 @@ def autoscale_summary(payloads: List[dict]) -> Dict[str, object]:
         )
         out["decision_p99_s"] = quantile_from_buckets(
             m["boundaries"], m["counts"], 0.99
+        )
+    return out
+
+
+def weights_summary(payloads: List[dict]) -> Dict[str, object]:
+    """Cluster rollup of weight-plane traffic with the logical/wire byte
+    split (state.metrics_summary()["weights"]): per direction
+    (publish | fetch) the raw leaf bytes, the encoded bytes that actually
+    crossed the store/broadcast tree, and their ratio — the compression
+    win the int8 chunk codec is buying — plus publish counts by codec
+    and a per-model breakdown."""
+    out: Dict[str, object] = {
+        "publish": {"logical_bytes": 0.0, "wire_bytes": 0.0},
+        "fetch": {"logical_bytes": 0.0, "wire_bytes": 0.0},
+        "publishes_by_codec": {},
+        "by_model": {},
+    }
+    by_codec: Dict[str, float] = out["publishes_by_codec"]  # type: ignore[assignment]
+    by_model: Dict[str, dict] = out["by_model"]  # type: ignore[assignment]
+    for payload in payloads:
+        for snap in payload.get("metrics", []):
+            name = snap.get("name", "")
+            field = {
+                "weights_broadcast_bytes_total": "logical_bytes",
+                "weights_wire_bytes_total": "wire_bytes",
+            }.get(name)
+            if field is not None:
+                for tag_json, value in snap["values"].items():
+                    tags = dict(
+                        zip(snap.get("tag_keys", ()), json.loads(tag_json))
+                    )
+                    direction = tags.get("direction", "?")
+                    if direction in ("publish", "fetch"):
+                        out[direction][field] += value  # type: ignore[index]
+                    row = by_model.setdefault(
+                        tags.get("model", "?"),
+                        {"logical_bytes": 0.0, "wire_bytes": 0.0},
+                    )
+                    row[field] += value
+            elif name == "weights_codec_publish_total":
+                for tag_json, value in snap["values"].items():
+                    tags = dict(
+                        zip(snap.get("tag_keys", ()), json.loads(tag_json))
+                    )
+                    codec = tags.get("codec", "?")
+                    by_codec[codec] = by_codec.get(codec, 0.0) + value
+    for direction in ("publish", "fetch"):
+        row = out[direction]  # type: ignore[index]
+        row["compression_ratio"] = (
+            row["logical_bytes"] / row["wire_bytes"]
+            if row["wire_bytes"] else None
         )
     return out
 

@@ -130,6 +130,7 @@ def metrics_summary() -> Dict[str, Any]:
         serve_ft_summary,
         serve_latency_summary,
         train_ft_summary,
+        weights_summary,
     )
 
     payloads = fetch_metric_payloads(_gcs_call)
@@ -147,6 +148,15 @@ def metrics_summary() -> Dict[str, Any]:
                         tags.get("op", "?"), {"bytes": 0.0, "ops": 0.0}
                     )
                     row["bytes"] += value
+            elif name == "collective_wire_bytes_total":
+                # encoded bytes the links actually carried (== "bytes"
+                # unless the group runs the int8 quantized transport)
+                for tag_json, value in snap["values"].items():
+                    tags = dict(zip(snap["tag_keys"], _json.loads(tag_json)))
+                    row = collective.setdefault(
+                        tags.get("op", "?"), {"bytes": 0.0, "ops": 0.0}
+                    )
+                    row["wire_bytes"] = row.get("wire_bytes", 0.0) + value
             elif name == "collective_op_latency_ms":
                 for tag_json, counts in snap.get("counts", {}).items():
                     tags = dict(zip(snap["tag_keys"], _json.loads(tag_json)))
@@ -191,6 +201,7 @@ def metrics_summary() -> Dict[str, Any]:
         "autoscale": autoscale_summary(payloads),
         "partition": partition_summary(payloads),
         "ingress": ingress_summary(payloads),
+        "weights": weights_summary(payloads),
     }
 
 

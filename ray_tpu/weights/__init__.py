@@ -27,11 +27,21 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
-from .manifest import ChunkInfo, Manifest, assemble_pytree, chunk_pytree, reshard
+from .manifest import (
+    CODEC_INT8,
+    CODEC_RAW,
+    ChunkInfo,
+    Manifest,
+    assemble_pytree,
+    chunk_pytree,
+    reshard,
+)
 from .publisher import WeightPublisher
 from .subscriber import WeightSubscriber
 
 __all__ = [
+    "CODEC_INT8",
+    "CODEC_RAW",
     "ChunkInfo",
     "Manifest",
     "WeightHandle",
@@ -77,10 +87,18 @@ def _subscriber(name: str) -> WeightSubscriber:
         return sub
 
 
-def publish(name: str, pytree: Any, meta: Optional[dict] = None) -> WeightHandle:
+def publish(
+    name: str,
+    pytree: Any,
+    meta: Optional[dict] = None,
+    quantized: Optional[bool] = None,
+) -> WeightHandle:
     """Publish one version through this process's cached publisher; returns
-    a handle pinned to the assigned version."""
-    version = _publisher(name).publish(pytree, meta)
+    a handle pinned to the assigned version. ``quantized=True`` stores the
+    version with the int8 chunk codec (~2x bf16 / ~4x f32 smaller store
+    objects and broadcast hops; subscribers dequantize at assembly); None
+    keeps the publisher's default."""
+    version = _publisher(name).publish(pytree, meta, quantized=quantized)
     return WeightHandle(name, version)
 
 

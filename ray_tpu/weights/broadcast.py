@@ -116,6 +116,22 @@ async def fetch_version_chunks(
     return values
 
 
+def version_wire_bytes(chunks: List[ChunkInfo]) -> int:
+    """Encoded bytes one full-version pull moves down the tree (sum of
+    packed chunk sizes — with the int8 codec this is the compressed
+    total, NOT the logical leaf bytes in ``Manifest.total_bytes``)."""
+    return sum(c.size for c in chunks)
+
+
+def version_logical_bytes(chunks: List[ChunkInfo]) -> int:
+    """Raw leaf bytes the same pull represents (0-filled ``logical_size``
+    fields — manifests from pre-codec publishers — fall back to the
+    packed size, which equals it to within framing overhead)."""
+    return sum(
+        getattr(c, "logical_size", 0) or c.size for c in chunks
+    )
+
+
 async def pin_local_chunks(worker, chunks: List[ChunkInfo]) -> List:
     """Weight-pin every chunk's local copy (eviction/spill exemption for the
     subscribe's lifetime); returns the object ids actually pinned."""

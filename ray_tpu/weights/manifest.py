@@ -10,6 +10,17 @@ one store segment). Assembly is the inverse: concatenate the per-chunk leaf
 lists in order and unflatten with the treedef, optionally ``jax.device_put``
 -ing each leaf onto a consumer-supplied sharding (publisher and subscriber
 meshes need not match).
+
+Chunk codecs: with ``codec="int8"`` every quantizable float leaf is
+encoded as per-block int8 + f32 scales (_internal/quantization.py) before
+packing, so the store objects — and therefore every broadcast-tree hop —
+carry the compressed form; non-float and tiny leaves stay raw inside the
+same chunks. Decoding happens once per subscriber at assembly, right
+before the leaf's ``device_put``, so a sharded consumer dequantizes
+straight into its own layout (the PR 13 callable-reshard path) with no
+full-width staging copy crossing the wire. Byte accounting is split:
+``total_bytes`` stays the *logical* (raw leaf) size, ``ChunkInfo.size``
+and ``Manifest.wire_bytes`` are what actually moves.
 """
 
 from __future__ import annotations
@@ -19,6 +30,16 @@ from typing import Any, List, Optional, Tuple
 
 from .._internal import serialization
 from .._internal.ids import ObjectID
+from .._internal.quantization import (
+    QuantizedArray,
+    dequantize_np,
+    is_quantizable,
+    quantize_np,
+)
+
+#: chunk codec tags (ChunkInfo.codec / Manifest.codec)
+CODEC_RAW = "raw"
+CODEC_INT8 = "int8"
 
 
 @dataclass(frozen=True)
@@ -27,6 +48,10 @@ class ChunkInfo:
     owner_address: Tuple[str, int]
     size: int          # packed (wire) size in the store
     num_leaves: int    # leaves carried by this chunk, in flatten order
+    # codec the chunk's leaves were encoded with and their raw (logical)
+    # byte total — defaults keep manifests from older publishers readable
+    codec: str = CODEC_RAW
+    logical_size: int = 0
 
 
 @dataclass
@@ -38,6 +63,8 @@ class Manifest:
     total_bytes: int = 0            # sum of raw leaf bytes (pre-framing)
     publisher_node: Optional[Tuple[str, int]] = None  # raylet address
     created_at: float = 0.0
+    codec: str = CODEC_RAW          # chunk codec of this version
+    wire_bytes: int = 0             # sum of packed chunk sizes in the store
 
     def to_blob(self) -> bytes:
         return serialization.dumps(self)
@@ -47,26 +74,66 @@ class Manifest:
         return serialization.loads(blob)
 
 
-def chunk_pytree(pytree: Any, chunk_size: int):
+def leaf_logical_nbytes(leaf: Any) -> int:
+    """Raw (pre-codec) byte size of a chunk leaf."""
+    if isinstance(leaf, QuantizedArray):
+        return leaf.logical_nbytes
+    return int(getattr(leaf, "nbytes", 0))
+
+
+def leaf_wire_nbytes(leaf: Any) -> int:
+    """Encoded byte size of a chunk leaf — what packing budgets against."""
+    if isinstance(leaf, QuantizedArray):
+        return leaf.wire_nbytes
+    return int(getattr(leaf, "nbytes", 0))
+
+
+def chunk_logical_bytes(values: List[Any]) -> int:
+    """Raw leaf-byte total of one chunk's payload list (ChunkInfo.
+    logical_size — the denominator of the wire/logical split)."""
+    return sum(leaf_logical_nbytes(v) for v in values)
+
+
+def decode_leaf(leaf: Any):
+    """Inverse of the chunk codec: quantized leaves densify back to their
+    original dtype/shape; raw leaves pass through."""
+    if isinstance(leaf, QuantizedArray):
+        return dequantize_np(leaf)
+    return leaf
+
+
+def chunk_pytree(pytree: Any, chunk_size: int, codec: str = CODEC_RAW):
     """Flatten to host arrays and group into chunk-sized leaf runs.
 
     Returns (treedef_blob, chunk_values, total_bytes) where each element of
-    ``chunk_values`` is the list of numpy arrays for one chunk. Leaves are
+    ``chunk_values`` is the list of leaf payloads for one chunk and
+    ``total_bytes`` is the logical (raw leaf) total. Leaves are
     materialized on host (``np.asarray``) — a publish moves device weights
     to host exactly once, and every downstream copy is store-to-store.
+    With ``codec="int8"`` quantizable float leaves are encoded here, so
+    greedy packing budgets *wire* bytes and the chunk count shrinks with
+    the payload.
     """
     import jax
     import numpy as np
 
+    if codec not in (CODEC_RAW, CODEC_INT8):
+        raise ValueError(f"unknown weights chunk codec {codec!r}")
     leaves, treedef = jax.tree_util.tree_flatten(pytree)
-    host_leaves = [np.asarray(leaf) for leaf in leaves]
+    host_leaves: List[Any] = []
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        if codec == CODEC_INT8 and is_quantizable(arr):
+            host_leaves.append(quantize_np(arr))
+        else:
+            host_leaves.append(arr)
     chunk_values: List[list] = []
     current: list = []
     current_bytes = 0
     total = 0
     for arr in host_leaves:
-        nbytes = arr.nbytes
-        total += nbytes
+        nbytes = leaf_wire_nbytes(arr)
+        total += leaf_logical_nbytes(arr)
         if current and current_bytes + nbytes > chunk_size:
             chunk_values.append(current)
             current, current_bytes = [], 0
@@ -80,16 +147,19 @@ def chunk_pytree(pytree: Any, chunk_size: int):
 def assemble_pytree(
     treedef_blob: bytes, chunk_values: List[list], sharding: Any = None
 ):
-    """Unflatten fetched chunk leaf-lists back into the pytree. With a
-    ``sharding`` (a single sharding, or a pytree of shardings matching the
-    value), each leaf is ``jax.device_put`` onto it — the consumer-side
-    reshard for subscriber meshes that differ from the publisher's."""
+    """Unflatten fetched chunk leaf-lists back into the pytree, decoding
+    any codec-encoded leaves first (dequantize-on-assemble: the dense
+    array exists only on the consumer, immediately before its per-leaf
+    ``device_put``). With a ``sharding`` (a single sharding, a pytree of
+    shardings matching the value, or a callable ``value -> shardings``),
+    each leaf is ``jax.device_put`` onto it — the consumer-side reshard
+    for subscriber meshes that differ from the publisher's."""
     import jax
 
     treedef = serialization.loads(treedef_blob)
     leaves: list = []
     for chunk in chunk_values:
-        leaves.extend(chunk)
+        leaves.extend(decode_leaf(v) for v in chunk)
     value = jax.tree_util.tree_unflatten(treedef, leaves)
     return reshard(value, sharding)
 

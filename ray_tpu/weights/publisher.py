@@ -20,28 +20,45 @@ from .. import _worker_api
 from .._internal import serialization
 from ..object_ref import ObjectRef
 from ..util import metrics
-from .manifest import ChunkInfo, Manifest, chunk_pytree
+from .manifest import (
+    CODEC_INT8,
+    CODEC_RAW,
+    ChunkInfo,
+    Manifest,
+    chunk_logical_bytes,
+    chunk_pytree,
+)
 
 logger = logging.getLogger(__name__)
 
 
 class WeightPublisher:
-    def __init__(self, name: str, chunk_size: Optional[int] = None):
+    def __init__(self, name: str, chunk_size: Optional[int] = None,
+                 quantized: bool = False):
         self.name = name
         worker = _worker_api.get_core_worker()
         self._chunk_size = chunk_size or worker.config.weights_chunk_size
+        # int8 chunk codec by default for this publisher's versions; a
+        # per-publish override rides on publish(quantized=...)
+        self._quantized = quantized
         # version -> chunk refs held until the registry releases the version
         self._held: Dict[int, List[ObjectRef]] = {}
         self._held_ids: Dict[int, list] = {}
 
     # -- publish -----------------------------------------------------------
 
-    def publish(self, pytree: Any, meta: Optional[dict] = None) -> int:
-        """Store + register one new version; returns the assigned version."""
+    def publish(self, pytree: Any, meta: Optional[dict] = None,
+                quantized: Optional[bool] = None) -> int:
+        """Store + register one new version; returns the assigned version.
+        ``quantized=True`` encodes float leaves as int8-per-block chunks
+        (the store — and every broadcast hop — carries the compressed
+        form); None inherits the publisher default."""
         worker = _worker_api.get_core_worker()
         t0 = time.perf_counter()
+        use_quant = self._quantized if quantized is None else quantized
+        codec = CODEC_INT8 if use_quant else CODEC_RAW
         treedef_blob, chunk_values, total_bytes = chunk_pytree(
-            pytree, self._chunk_size
+            pytree, self._chunk_size, codec=codec
         )
 
         async def _store():
@@ -65,11 +82,14 @@ class WeightPublisher:
                         owner_address=tuple(worker.address),
                         size=size,
                         num_leaves=len(value),
+                        codec=codec,
+                        logical_size=chunk_logical_bytes(value),
                     )
                 )
             return infos, refs
 
         infos, refs = _worker_api.run_on_worker_loop(_store())
+        wire_bytes = sum(c.size for c in infos)
         manifest = Manifest(
             name=self.name,
             version=None,
@@ -78,6 +98,8 @@ class WeightPublisher:
             total_bytes=total_bytes,
             publisher_node=tuple(worker.raylet_address),
             created_at=time.time(),
+            codec=codec,
+            wire_bytes=wire_bytes,
         )
         reply = _worker_api.run_on_worker_loop(
             worker.client_pool.get(*worker.gcs_address).call(
@@ -86,6 +108,8 @@ class WeightPublisher:
                 manifest.to_blob(),
                 {
                     "total_bytes": total_bytes,
+                    "wire_bytes": wire_bytes,
+                    "codec": codec,
                     "num_chunks": len(infos),
                     **(meta or {}),
                 },
@@ -100,7 +124,8 @@ class WeightPublisher:
         # freed here instead of accreting for the whole training run.
         self._reconcile(reply)
         metrics.record_weights_publish(
-            self.name, time.perf_counter() - t0, total_bytes
+            self.name, time.perf_counter() - t0, total_bytes,
+            wire_nbytes=wire_bytes, codec=codec,
         )
         return version
 

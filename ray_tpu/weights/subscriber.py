@@ -69,12 +69,16 @@ class WeightSubscriber:
         self._prefetched: Dict[int, _PinnedVersion] = {}
         self._prefetch_future = None
         # transfer accounting: manifest chunks pulled through the broadcast
-        # tree and their byte total. A tp=N replica resolves chunks
+        # tree and their byte totals. A tp=N replica resolves chunks
         # straight into its sharded layout, so each chunk is pulled ONCE
         # per process (never once per device) and a repeat get() of the
         # pinned version pulls zero — tests counter-assert both.
+        # ``bytes_pulled`` is the LOGICAL (raw leaf) total;
+        # ``wire_bytes_pulled`` is the encoded store bytes that actually
+        # crossed the tree — smaller under the int8 chunk codec.
         self.chunk_pulls = 0
         self.bytes_pulled = 0
+        self.wire_bytes_pulled = 0
 
     # -- resolution --------------------------------------------------------
 
@@ -101,6 +105,15 @@ class WeightSubscriber:
     @property
     def version(self) -> Optional[int]:
         return self._current.version if self._current else None
+
+    @property
+    def current_codec(self) -> Optional[str]:
+        """Chunk codec of the adopted version ("raw" | "int8"), or None
+        before the first get(). getattr-guarded: manifests published
+        before the codec field existed decode as raw."""
+        if self._current is None:
+            return None
+        return getattr(self._current.manifest, "codec", "raw")
 
     # -- fetch -------------------------------------------------------------
 
@@ -214,10 +227,13 @@ class WeightSubscriber:
             value = assemble_pytree(
                 manifest.treedef_blob, chunk_values, sharding
             )
+            wire_bytes = sum(c.size for c in manifest.chunks)
             self.chunk_pulls += len(manifest.chunks)
             self.bytes_pulled += manifest.total_bytes
+            self.wire_bytes_pulled += wire_bytes
             metrics.record_weights_fetch(
-                self.name, time.perf_counter() - t0, manifest.total_bytes
+                self.name, time.perf_counter() - t0, manifest.total_bytes,
+                wire_nbytes=wire_bytes,
             )
             return _PinnedVersion(version, value, manifest, local_pins)
         except Exception:
