@@ -1,0 +1,83 @@
+"""RT010: train-loop gradient reduction goes through the scheduler.
+
+Incident class this encodes: the overlapped-collectives work (PR 16).
+A bare blocking ``group.allreduce(grads)`` at the step boundary of a train
+loop exposes the whole collective on the critical path — exactly the time
+the bucketized async scheduler (collective/scheduler.py) exists to hide —
+and silently bypasses the exposed-vs-overlapped StepBreakdown split, so the
+regression doesn't even show up in the metrics. Inside ``ray_tpu/train/``
+gradient reduction must route through ``GradientReduceScheduler`` (or its
+session-level wrapper ``train.collective.reduce_gradients``): the scheduler
+degrades to the blocking path when ``overlap=False``, so there is no
+"simple case" that justifies calling the group directly.
+
+Flags, in ``ray_tpu/train/`` modules:
+
+- attribute calls ``X.allreduce(...)`` / ``X.reducescatter(...)`` — a
+  direct blocking collective on a group object;
+- bare ``allreduce(...)`` / ``reducescatter(...)`` name calls (the
+  module-level ``ray_tpu.collective`` wrappers imported into a loop).
+
+The body of a function literally named ``allreduce`` is exempt: that is
+the sanctioned small-host-value control-plane wrapper
+(``train/collective.py``) — scalar consensus (loss averaging, early-stop
+votes), not gradient traffic. Scheduler internals are out of scope by
+construction (they live in ``collective/``, not ``train/``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from ..core import Checker, register
+
+_REDUCE_OPS = {"allreduce", "reducescatter"}
+
+
+def _wrapper_spans(tree: ast.AST) -> Set[int]:
+    """ids of all nodes inside a FunctionDef named allreduce (the
+    sanctioned control-plane wrapper)."""
+    exempt: Set[int] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "allreduce"
+        ):
+            for sub in ast.walk(node):
+                exempt.add(id(sub))
+    return exempt
+
+
+@register
+class SchedulerReduceChecker(Checker):
+    RULE_ID = "RT010"
+    DESCRIPTION = (
+        "blocking gradient reduction in train/ hot paths; route it "
+        "through GradientReduceScheduler / train.collective.reduce_gradients"
+    )
+
+    def applies_to(self, path: str) -> bool:
+        parts = path.split("/")
+        return "train" in parts[:-1]
+
+    def check_file(self, path, tree, source):
+        exempt = _wrapper_spans(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or id(node) in exempt:
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in _REDUCE_OPS:
+                yield self.finding(
+                    path, node,
+                    f".{func.attr}() directly on a collective group in "
+                    "train/ blocks the step on the full reduce; use "
+                    "GradientReduceScheduler (train.collective."
+                    "reduce_gradients) so it can overlap",
+                )
+            elif isinstance(func, ast.Name) and func.id in _REDUCE_OPS:
+                yield self.finding(
+                    path, node,
+                    f"bare {func.id}() in train/ bypasses the overlapped "
+                    "scheduler; use train.collective.reduce_gradients",
+                )

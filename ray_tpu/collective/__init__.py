@@ -13,7 +13,14 @@ from typing import Dict, List, Optional
 from ..exceptions import CollectiveAbortedError
 from ..util import events as _events
 from .base import BaseGroup, ReduceOp
+from .bucketizer import DEFAULT_BUCKET_BYTES, BucketSpec, GradientBucketizer
 from .cpu_group import GcsStoreGroup
+from .hierarchical import HierarchicalGroup
+from .scheduler import (
+    AsyncHandle,
+    GradientReduceScheduler,
+    PendingReduce,
+)
 from .xla_group import XlaGroup
 
 _groups: Dict[str, BaseGroup] = {}
@@ -22,6 +29,8 @@ _BACKENDS = {
     "gcs": GcsStoreGroup,  # host tensors through the GCS KV (gloo role)
     "cpu": GcsStoreGroup,
     "xla": XlaGroup,  # device tensors over ICI (nccl role)
+    # two-tier intra-slice/inter-slice composition (requires slice_size=)
+    "hier": HierarchicalGroup,
 }
 
 
@@ -145,6 +154,9 @@ def barrier(group_name: str = "default"):
 
 __all__ = [
     "BaseGroup", "ReduceOp", "GcsStoreGroup", "XlaGroup",
+    "HierarchicalGroup",
+    "AsyncHandle", "PendingReduce", "GradientReduceScheduler",
+    "GradientBucketizer", "BucketSpec", "DEFAULT_BUCKET_BYTES",
     "CollectiveAbortedError",
     "init_collective_group", "create_collective_group",
     "destroy_collective_group", "abort_collective_group",

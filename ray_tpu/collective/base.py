@@ -68,6 +68,7 @@ class BaseGroup(ABC):
         self.quantized = quantized
         self.quant_block = quant_block or DEFAULT_BLOCK
         self._ef_residuals: dict = {}
+        self._async_dispatcher = None
 
     def _record_op(self, op: str, nbytes: int, start: float,
                    wire_nbytes: Optional[int] = None):
@@ -86,6 +87,35 @@ class BaseGroup(ABC):
     @abstractmethod
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
         ...
+
+    def allreduce_async(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        """Dispatch an allreduce without blocking; returns an
+        :class:`~ray_tpu.collective.scheduler.AsyncHandle` whose ``wait()``
+        yields the reduced tensor (or raises CollectiveAbortedError if the
+        group was aborted mid-flight).
+
+        Default implementation runs the blocking ``allreduce`` on the
+        group's single background dispatcher thread — FIFO, so every rank's
+        async ops hit the rendezvous in submission order and sequence
+        numbers stay aligned (the host-backend correctness contract).
+        Backends with natively asynchronous dispatch (XLA) override this.
+        """
+        return self._dispatcher().submit(lambda: self.allreduce(tensor, op))
+
+    def _dispatcher(self):
+        if self._async_dispatcher is None:
+            from .scheduler import OpDispatcher
+
+            self._async_dispatcher = OpDispatcher(self.group_name)
+        return self._async_dispatcher
+
+    def _shutdown_async(self):
+        """Stop the background dispatcher, if one was ever started.
+        Subclass ``destroy`` overrides don't all chain to super, so group
+        teardown paths call this explicitly."""
+        if self._async_dispatcher is not None:
+            self._async_dispatcher.shutdown()
+            self._async_dispatcher = None
 
     @abstractmethod
     def allgather(self, tensor) -> List[Any]:
@@ -112,4 +142,4 @@ class BaseGroup(ABC):
         ...
 
     def destroy(self):
-        pass
+        self._shutdown_async()

@@ -1,0 +1,197 @@
+"""Gradient bucketizer: deterministic leaf->bucket assignment over a pytree.
+
+The overlapped-reduction scheduler (collective/scheduler.py) ships gradients
+bucket-by-bucket so the first buckets' allreduce runs while the rest of the
+backward (or the host-side tail of the step) is still producing values — the
+pipelining the TPU-concurrency paper attributes pod-scale efficiency to.
+Buckets must satisfy two contracts:
+
+1. **Deterministic across ranks.** Every rank concatenates the same leaves
+   into the same bucket in the same order, or the allreduce sums garbage.
+   Assignment therefore depends only on the tree's *structure* (sorted leaf
+   paths + shapes + dtypes), never on dict insertion order, rank, or any
+   per-process state. An elastic re-form at epoch+1 rebuilds byte-identical
+   buckets from the same model for the same reason.
+
+2. **Size-targeted.** ``bucket_bytes`` balances dispatch overhead (too many
+   tiny collectives) against lost overlap (one giant collective can't start
+   until the last leaf exists). Leaves are greedily packed in sorted-path
+   order until a bucket reaches the target; a single leaf at or above the
+   target gets its own bucket. Buckets are dtype-homogeneous so each packs
+   into ONE flat array with no casting on the wire.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Sequence, Tuple
+
+import numpy as np
+
+#: default size target — big enough to amortize rendezvous/dispatch
+#: overhead, small enough that early buckets reduce well before the step's
+#: tail compute finishes (same order as torch DDP's 25MB, scaled down for
+#: the model sizes this repo's smokes run)
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+
+@dataclass(frozen=True)
+class BucketSpec:
+    """One bucket's immutable assignment (identical on every rank)."""
+
+    index: int
+    #: leaf path strings, in pack order
+    paths: Tuple[str, ...]
+    #: per-leaf shapes/sizes, in pack order (unpack splits by these)
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtype: str
+    nbytes: int
+
+
+def _path_str(key_path) -> str:
+    """Render a jax KeyPath deterministically ('layer0/kernel' style)."""
+    parts = []
+    for entry in key_path:
+        # DictKey('a') -> 'a', SequenceKey(0) -> '0', GetAttrKey(x) -> 'x'
+        for attr in ("key", "idx", "name"):
+            if hasattr(entry, attr):
+                parts.append(str(getattr(entry, attr)))
+                break
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+class GradientBucketizer:
+    """Assign a pytree's leaves to size-targeted buckets; pack/unpack trees.
+
+    Built once per (tree structure, bucket_bytes); ``pack`` turns a
+    same-structured tree into one flat array per bucket and ``unpack``
+    inverts it. The assignment is a pure function of the sorted leaf paths,
+    shapes, and dtypes — see the module docstring for why.
+    """
+
+    def __init__(self, tree: Any, bucket_bytes: int = DEFAULT_BUCKET_BYTES):
+        import jax
+
+        if bucket_bytes <= 0:
+            raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+        self.bucket_bytes = int(bucket_bytes)
+        leaves_with_path, self._treedef = jax.tree_util.tree_flatten_with_path(
+            tree
+        )
+        infos = []
+        for flat_idx, (key_path, leaf) in enumerate(leaves_with_path):
+            arr = np.asarray(leaf) if not hasattr(leaf, "dtype") else leaf
+            infos.append(
+                (
+                    _path_str(key_path),
+                    flat_idx,
+                    tuple(int(d) for d in arr.shape),
+                    str(arr.dtype),
+                    int(np.prod(arr.shape, dtype=np.int64))
+                    * np.dtype(str(arr.dtype)).itemsize,
+                )
+            )
+        # sorted-path order IS the pack order: stable under dict insertion
+        # order, rank, and re-forms (jax already sorts dict keys, this makes
+        # the contract explicit and covers registered custom nodes too)
+        infos.sort(key=lambda t: t[0])
+        #: flat-leaf index (tree_flatten order) per sorted position
+        self._flat_order: List[int] = [t[1] for t in infos]
+        self._num_leaves = len(infos)
+
+        self.buckets: List[BucketSpec] = []
+        #: per-bucket list of sorted positions (indices into _flat_order)
+        self._bucket_members: List[List[int]] = []
+        current: List[int] = []
+        cur_bytes = 0
+        cur_dtype = None
+
+        def _close():
+            nonlocal current, cur_bytes, cur_dtype
+            if not current:
+                return
+            self.buckets.append(
+                BucketSpec(
+                    index=len(self.buckets),
+                    paths=tuple(infos[i][0] for i in current),
+                    shapes=tuple(infos[i][2] for i in current),
+                    dtype=cur_dtype,
+                    nbytes=cur_bytes,
+                )
+            )
+            self._bucket_members.append(list(current))
+            current, cur_bytes, cur_dtype = [], 0, None
+
+        for pos, (_path, _flat, _shape, dtype, nbytes) in enumerate(infos):
+            if current and (dtype != cur_dtype or cur_bytes >= self.bucket_bytes):
+                _close()
+            current.append(pos)
+            cur_bytes += nbytes
+            cur_dtype = dtype
+            if cur_bytes >= self.bucket_bytes:
+                _close()
+        _close()
+
+    # -- identity ----------------------------------------------------------
+
+    def signature(self) -> tuple:
+        """Structure fingerprint: two trees with equal signatures get the
+        identical bucket assignment (the elastic re-form invariant)."""
+        return tuple(
+            (b.paths, b.shapes, b.dtype) for b in self.buckets
+        ) + (self.bucket_bytes,)
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.buckets)
+
+    # -- pack / unpack -----------------------------------------------------
+
+    def pack(self, tree: Any) -> List[Any]:
+        """One flat 1-D array per bucket, concatenating the bucket's leaves
+        in assignment order. jax-array leaves concatenate with jnp (staying
+        on device for the XLA dispatch path); host leaves with numpy."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+        if len(leaves) != self._num_leaves:
+            raise ValueError(
+                f"tree has {len(leaves)} leaves, bucketizer was built for "
+                f"{self._num_leaves}"
+            )
+        out = []
+        for members in self._bucket_members:
+            parts = [leaves[self._flat_order[pos]] for pos in members]
+            if any(isinstance(p, jax.Array) for p in parts):
+                import jax.numpy as jnp
+
+                out.append(jnp.concatenate([jnp.ravel(p) for p in parts]))
+            else:
+                out.append(
+                    np.concatenate([np.ravel(np.asarray(p)) for p in parts])
+                )
+        return out
+
+    def unpack(self, bucket_arrays: Sequence[Any]) -> Any:
+        """Invert ``pack``: split each flat bucket back into its leaves and
+        rebuild the original tree structure."""
+        import jax
+
+        if len(bucket_arrays) != len(self.buckets):
+            raise ValueError(
+                f"got {len(bucket_arrays)} bucket arrays for "
+                f"{len(self.buckets)} buckets"
+            )
+        flat: List[Any] = [None] * self._num_leaves
+        for spec, members, arr in zip(
+            self.buckets, self._bucket_members, bucket_arrays
+        ):
+            offset = 0
+            for shape, pos in zip(spec.shapes, members):
+                size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                leaf = arr[offset:offset + size].reshape(shape)
+                flat[self._flat_order[pos]] = leaf
+                offset += size
+        return jax.tree_util.tree_unflatten(self._treedef, flat)

@@ -98,9 +98,13 @@ class GcsStoreGroup(BaseGroup):
 
     def __init__(self, world_size: int, rank: int, group_name: str, *,
                  epoch: int = 0, quantized: bool = False,
-                 quant_block: int = 0):
+                 quant_block: int = 0, parent_group: Optional[str] = None):
         super().__init__(world_size, rank, group_name, epoch=epoch,
                          quantized=quantized, quant_block=quant_block)
+        # sub-groups of a HierarchicalGroup also honor the PARENT's abort
+        # key: an abort targets the logical group name the controller knows,
+        # and must unblock members stuck in any constituent sub-group poll
+        self._parent_group = parent_group
         self._seq = 0
         # point-to-point ops use per-(src,dst) counters so they don't
         # desynchronize the group-wide collective sequence
@@ -180,6 +184,11 @@ class GcsStoreGroup(BaseGroup):
             return
         self._last_abort_check = now
         if read_abort_epoch(self.group_name) >= self.epoch:
+            self._raise_aborted()
+        if (
+            self._parent_group is not None
+            and read_abort_epoch(self._parent_group) >= self.epoch
+        ):
             self._raise_aborted()
 
     def _maybe_delay(self):
@@ -378,6 +387,7 @@ class GcsStoreGroup(BaseGroup):
         self._record_op("barrier", 0, start)
 
     def destroy(self):
+        self._shutdown_async()
         try:
             _kv_call(
                 "kv_del", member_key(self.group_name, self.epoch, self.rank)

@@ -1,0 +1,184 @@
+"""Hierarchical multi-slice collective group.
+
+A TPU pod's network is not flat: ICI within a slice is an order of
+magnitude faster than DCN between slices (the MLPerf-on-TPU-pods topology).
+A flat W-rank reduce puts every rank's full payload on the slow tier;
+the hierarchical schedule ships it twice over the fast tier and once over
+the slow one:
+
+    1. intra-slice reduce   (all `slice_size` members of each slice)
+    2. inter-slice reduce   (one leader per slice, `num_slices` ranks)
+    3. intra-slice broadcast (leader fans the global result back out)
+
+:class:`HierarchicalGroup` composes those phases from the existing
+backends — intra-slice ``XlaGroup`` psum (or ``GcsStoreGroup`` where no
+per-slice device mesh exists, e.g. emulated topologies in tests) and
+inter-slice ``GcsStoreGroup`` reduce — behind the unchanged
+:class:`~ray_tpu.collective.base.BaseGroup` interface. The overlapped
+scheduler (collective/scheduler.py) therefore drives it exactly like a flat
+group: ``allreduce_async`` inherits the dispatcher-thread default, and each
+bucket's three phases pipeline behind one another in FIFO order.
+
+Naming/abort contract: sub-groups are ``<name>:s<slice>`` (intra),
+``<name>:x`` (inter leaders) and ``<name>:p2p`` (flat point-to-point), all
+carrying ``parent_group=<name>`` so an abort written against the logical
+group name unblocks a member stuck in ANY phase. Metrics are recorded by
+the sub-groups under their own names — the hierarchical wrapper records
+nothing itself, so collective_seconds_total() never double-counts a phase.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from .base import BaseGroup, ReduceOp
+from .cpu_group import GcsStoreGroup
+
+#: intra-slice backend choices; "xla" needs a per-slice device mesh
+_INTRA_BACKENDS = ("gcs", "xla")
+
+
+class HierarchicalGroup(BaseGroup):
+    backend = "hier"
+
+    def __init__(
+        self,
+        world_size: int,
+        rank: int,
+        group_name: str,
+        *,
+        slice_size: int,
+        epoch: int = 0,
+        quantized: bool = False,
+        quant_block: int = 0,
+        intra_backend: str = "gcs",
+    ):
+        super().__init__(world_size, rank, group_name, epoch=epoch,
+                         quantized=quantized, quant_block=quant_block)
+        if slice_size <= 0:
+            raise ValueError(f"slice_size must be positive, got {slice_size}")
+        if world_size % slice_size != 0:
+            raise ValueError(
+                f"world_size={world_size} not divisible by "
+                f"slice_size={slice_size}"
+            )
+        if intra_backend not in _INTRA_BACKENDS:
+            raise ValueError(
+                f"intra_backend must be one of {_INTRA_BACKENDS}, "
+                f"got {intra_backend!r}"
+            )
+        self.slice_size = slice_size
+        self.num_slices = world_size // slice_size
+        self.slice_id = rank // slice_size
+        self.intra_rank = rank % slice_size
+        self.is_leader = self.intra_rank == 0
+
+        sub_kwargs = dict(
+            epoch=epoch, quantized=quantized, quant_block=quant_block,
+        )
+        if intra_backend == "xla":
+            from .xla_group import XlaGroup
+
+            # device mesh fast path; its host fallbacks already rendezvous
+            # under "<intra-name>:host"
+            self._intra = XlaGroup(
+                slice_size, self.intra_rank,
+                f"{group_name}:s{self.slice_id}", **sub_kwargs,
+            )
+        else:
+            self._intra = GcsStoreGroup(
+                slice_size, self.intra_rank,
+                f"{group_name}:s{self.slice_id}",
+                parent_group=group_name, **sub_kwargs,
+            )
+        # the inter-slice tier is the slow/DCN tier: host rendezvous through
+        # the GCS KV, leaders only (non-leaders never touch it)
+        self._inter: Optional[GcsStoreGroup] = None
+        if self.is_leader:
+            self._inter = GcsStoreGroup(
+                self.num_slices, self.slice_id, f"{group_name}:x",
+                parent_group=group_name, **sub_kwargs,
+            )
+        self._p2p: Optional[GcsStoreGroup] = None
+
+    # -- phase composition -------------------------------------------------
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        """reduce-within, reduce-across, fan back out. Only the slice sums
+        (num_slices contributions, not world_size) cross the slow tier."""
+        partial = self._intra.allreduce(tensor, op)
+        if self.num_slices == 1:
+            return partial
+        if self.is_leader:
+            total = self._inter.allreduce(partial, op)
+        else:
+            total = partial  # placeholder; overwritten by the fan-out
+        return self._intra.broadcast(total, src_rank=0)
+
+    def allgather(self, tensor) -> List[Any]:
+        """Gather within the slice, concatenate slice lists across leaders,
+        fan the world-ordered list back out (global rank order: slices by
+        slice_id, members by intra rank — exactly rank = slice*size+intra)."""
+        local = self._intra.allgather(tensor)
+        if self.num_slices == 1:
+            return list(local)
+        if self.is_leader:
+            nested = self._inter.allgather(list(local))
+            flat = [item for slice_items in nested for item in slice_items]
+        else:
+            flat = None
+        return list(self._intra.broadcast(flat, src_rank=0))
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        reduced = self.allreduce(tensor, op)
+        shards = np.array_split(np.asarray(reduced), self.world_size, axis=0)
+        return shards[self.rank]
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        src_slice, src_intra = divmod(src_rank, self.slice_size)
+        value = tensor
+        if self.slice_id == src_slice and src_intra != 0:
+            # hoist the payload to the source slice's leader first
+            value = self._intra.broadcast(value, src_rank=src_intra)
+        if self.num_slices > 1 and self.is_leader:
+            value = self._inter.broadcast(value, src_rank=src_slice)
+        if self.slice_size > 1:
+            value = self._intra.broadcast(value, src_rank=0)
+        return value
+
+    def barrier(self):
+        self._intra.barrier()
+        if self.num_slices > 1:
+            if self.is_leader:
+                self._inter.barrier()
+            # second intra pass so non-leaders also wait out the slow tier
+            self._intra.barrier()
+
+    # -- point-to-point ----------------------------------------------------
+
+    def _p2p_group(self) -> GcsStoreGroup:
+        """Flat world-spanning sub-group for send/recv: point-to-point has
+        no hierarchy to exploit, and a dedicated group keeps its sequence
+        numbers out of the phase groups' rendezvous."""
+        if self._p2p is None:
+            self._p2p = GcsStoreGroup(
+                self.world_size, self.rank, f"{self.group_name}:p2p",
+                parent_group=self.group_name, epoch=self.epoch,
+            )
+        return self._p2p
+
+    def send(self, tensor, dst_rank: int):
+        return self._p2p_group().send(tensor, dst_rank)
+
+    def recv(self, src_rank: int):
+        return self._p2p_group().recv(src_rank)
+
+    def destroy(self):
+        self._shutdown_async()
+        for sub in (self._intra, self._inter, self._p2p):
+            if sub is not None:
+                sub.destroy()
+        self._inter = None
+        self._p2p = None

@@ -1,0 +1,328 @@
+"""Overlapped gradient-reduction scheduler: the ONE scheduling layer both
+collective backends sit behind.
+
+Synchronous ``group.allreduce(grads)`` at the step boundary exposes the
+whole collective on the critical path — exactly the time StepBreakdown's
+compute/collective split measures being lost. This module hides it:
+
+- :class:`AsyncHandle` — the completion handle ``allreduce_async`` returns.
+  Dispatch never blocks; ``wait()`` does, and raises
+  :class:`~ray_tpu.exceptions.CollectiveAbortedError` if the group was
+  aborted while the op was in flight (a mid-flight bucket must fail fast,
+  not hang the survivor).
+- :class:`OpDispatcher` — one background rendezvous thread per group for
+  backends whose ops are host-blocking (the GCS path). A FIFO queue keeps
+  the group's op sequence identical on every rank, which is the GCS
+  backend's correctness contract. The XLA path doesn't need it: jit
+  dispatch is already asynchronous, so its handles wrap the not-yet-ready
+  device array directly (see ``XlaGroup.allreduce_async``).
+- :class:`GradientReduceScheduler` — bucketizes a gradient pytree
+  (collective/bucketizer.py) and dispatches one async allreduce per bucket,
+  so early buckets reduce while the caller computes the rest of the step.
+  ``stale_grad=1`` goes further: ``step()`` returns the *previous* step's
+  reduced gradients immediately, letting step N+1's forward overlap step
+  N's tail reduce (one-step-delayed update — safe for SGD-family
+  optimizers at small staleness; see docs/ARCHITECTURE.md §17).
+
+Every wait records the exposed-vs-overlapped split into util/metrics, which
+is what makes the win measurable rather than asserted.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from .bucketizer import DEFAULT_BUCKET_BYTES, GradientBucketizer
+
+#: upper bound on one bucket's completion wait — comfortably above the
+#: backends' own 120 s rendezvous timeout so the underlying op (or the
+#: abort plane) always fires first
+_HANDLE_TIMEOUT_S = 180.0
+
+
+class AsyncHandle:
+    """Completion handle for one async-dispatched collective op.
+
+    After ``wait()`` returns (or raises), ``exposed_s`` is the wall time the
+    caller actually blocked and ``overlapped_s`` the part of the op's
+    latency that ran under the caller's compute — the two halves of the
+    StepBreakdown split.
+    """
+
+    def __init__(self):
+        self.dispatched_at = time.perf_counter()
+        self.completed_at: Optional[float] = None
+        self.exposed_s = 0.0
+        self.overlapped_s = 0.0
+
+    def done(self) -> bool:
+        raise NotImplementedError
+
+    def wait(self, timeout: float = _HANDLE_TIMEOUT_S):
+        raise NotImplementedError
+
+    def _split(self, wait_start: float, wait_end: float):
+        """Attribute this op's latency: blocked wait = exposed, the rest of
+        dispatch->completion ran under compute = overlapped."""
+        self.exposed_s = max(0.0, wait_end - wait_start)
+        total = (self.completed_at or wait_end) - self.dispatched_at
+        self.overlapped_s = max(0.0, total - self.exposed_s)
+
+
+class CompletedHandle(AsyncHandle):
+    """Pre-completed op (the non-overlapped fallback path): the blocking
+    call already happened at dispatch, so its whole duration is exposed."""
+
+    def __init__(self, result: Any, blocked_s: float):
+        super().__init__()
+        self._result = result
+        self.completed_at = self.dispatched_at
+        self.exposed_s = max(0.0, blocked_s)
+        self.overlapped_s = 0.0
+
+    def done(self) -> bool:
+        return True
+
+    def wait(self, timeout: float = _HANDLE_TIMEOUT_S):
+        return self._result
+
+
+class FutureHandle(AsyncHandle):
+    """Thread-completed op (OpDispatcher / the GCS backend)."""
+
+    def __init__(self):
+        super().__init__()
+        self._event = threading.Event()
+        self._result: Any = None
+        self._exception: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _complete(self, result: Any = None,
+                  exception: Optional[BaseException] = None):
+        self._result = result
+        self._exception = exception
+        self.completed_at = time.perf_counter()
+        self._event.set()
+
+    def wait(self, timeout: float = _HANDLE_TIMEOUT_S):
+        start = time.perf_counter()
+        if not self._event.wait(timeout):
+            raise TimeoutError("async collective op did not complete")
+        self._split(start, time.perf_counter())
+        if self._exception is not None:
+            raise self._exception
+        return self._result
+
+
+class DeviceHandle(AsyncHandle):
+    """XLA-dispatched op: the program is already in flight on the device
+    stream; ``wait`` is block_until_ready plus the deferred metrics record
+    (the dispatch path must not block, so the op's bytes/latency sample is
+    recorded here, at completion)."""
+
+    def __init__(self, value: Any,
+                 on_ready: Optional[Callable[[float], None]] = None):
+        super().__init__()
+        self._value = value
+        self._on_ready = on_ready
+        self._waited = False
+
+    def done(self) -> bool:
+        if self._waited:
+            return True
+        is_ready = getattr(self._value, "is_ready", None)
+        try:
+            return bool(is_ready()) if callable(is_ready) else False
+        except Exception:
+            return False
+
+    def wait(self, timeout: float = _HANDLE_TIMEOUT_S):
+        import jax
+
+        start = time.perf_counter()
+        out = jax.block_until_ready(self._value)
+        end = time.perf_counter()
+        if not self._waited:
+            self._waited = True
+            self.completed_at = end
+            self._split(start, end)
+            if self._on_ready is not None:
+                self._on_ready(end - self.dispatched_at)
+        return out
+
+
+class OpDispatcher:
+    """One background rendezvous thread per group.
+
+    Ops submitted here run strictly FIFO: as long as every rank dispatches
+    its buckets in the same (deterministic, bucketizer-given) order, the
+    group's rendezvous sequence numbers stay aligned across ranks — the
+    same contract the synchronous path gets for free from the caller's
+    program order. An exception (including CollectiveAbortedError from the
+    abort plane) completes the handle exceptionally and the thread moves
+    on; once a group is poisoned every queued op fails fast the same way.
+    """
+
+    def __init__(self, name: str):
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"col-dispatch:{name}"
+        )
+        self._thread.start()
+
+    def submit(self, fn: Callable[[], Any]) -> FutureHandle:
+        handle = FutureHandle()
+        self._queue.put((fn, handle))
+        return handle
+
+    def _run(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            fn, handle = item
+            try:
+                handle._complete(result=fn())
+            except BaseException as e:  # noqa: BLE001 — handed to waiter
+                handle._complete(exception=e)
+
+    def shutdown(self, timeout: float = 2.0):
+        self._queue.put(None)
+        self._thread.join(timeout=timeout)
+
+
+class PendingReduce:
+    """All of one gradient tree's in-flight buckets; ``wait`` returns the
+    reduced tree and records the exposed/overlapped split."""
+
+    def __init__(self, handles: List[AsyncHandle],
+                 bucketizer: GradientBucketizer, group_name: str):
+        self._handles = handles
+        self._bucketizer = bucketizer
+        self._group_name = group_name
+
+    def done(self) -> bool:
+        return all(h.done() for h in self._handles)
+
+    def wait(self) -> Any:
+        from ..util import metrics
+
+        results = []
+        error: Optional[BaseException] = None
+        for h in self._handles:
+            try:
+                results.append(h.wait())
+            except BaseException as e:  # noqa: BLE001
+                # drain the remaining handles (they fail fast once the
+                # group is poisoned) so no dispatcher state leaks, then
+                # surface the first failure
+                if error is None:
+                    error = e
+        exposed = sum(h.exposed_s for h in self._handles)
+        overlapped = sum(h.overlapped_s for h in self._handles)
+        metrics.record_collective_overlap(self._group_name, exposed, overlapped)
+        if error is not None:
+            raise error
+        return self._bucketizer.unpack(results)
+
+
+class GradientReduceScheduler:
+    """Bucketized, overlap-capable gradient allreduce over ANY BaseGroup.
+
+    ``reduce(tree)`` dispatches one async allreduce per bucket and returns a
+    :class:`PendingReduce` immediately — call ``.wait()`` after the step's
+    remaining compute. ``step(tree)`` is the drop-in loop API honoring
+    ``stale_grad``:
+
+    - ``stale_grad=0``: dispatch + wait (still overlapped bucket-to-bucket:
+      bucket k reduces while bucket k+1 packs/dispatches); result is
+      bit-identical to the synchronous path.
+    - ``stale_grad=1``: returns the PREVIOUS step's reduced tree (None on
+      the first call) and leaves this step's buckets reducing under the
+      next step's forward.
+
+    ``overlap=False`` degrades to eager blocking per-bucket ops (the sync
+    A/B baseline) without changing the call surface.
+    """
+
+    def __init__(
+        self,
+        group,
+        bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+        overlap: bool = True,
+        stale_grad: int = 0,
+    ):
+        if stale_grad not in (0, 1):
+            raise ValueError(f"stale_grad must be 0 or 1, got {stale_grad}")
+        self.group = group
+        self.bucket_bytes = int(bucket_bytes)
+        self.overlap = bool(overlap)
+        self.stale_grad = int(stale_grad)
+        self._bucketizer: Optional[GradientBucketizer] = None
+        self._structure_key: Optional[tuple] = None
+        self._pending: Optional[PendingReduce] = None
+
+    # -- bucketizer lifecycle ---------------------------------------------
+
+    def _structure_of(self, tree: Any) -> tuple:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        return (
+            treedef,
+            tuple(
+                (tuple(getattr(v, "shape", ())), str(getattr(v, "dtype", "")))
+                for v in leaves
+            ),
+        )
+
+    def bucketizer_for(self, tree: Any) -> GradientBucketizer:
+        """The (cached) deterministic assignment for this tree structure;
+        rebuilt only when the structure changes — an elastic re-form with
+        the same model reuses (or rebuilds identically) the same buckets."""
+        key = self._structure_of(tree)
+        if self._bucketizer is None or key != self._structure_key:
+            self._bucketizer = GradientBucketizer(tree, self.bucket_bytes)
+            self._structure_key = key
+        return self._bucketizer
+
+    # -- reduce ------------------------------------------------------------
+
+    def reduce(self, tree: Any, op=None) -> PendingReduce:
+        """Dispatch every bucket's allreduce without blocking."""
+        from .base import ReduceOp
+
+        reduce_op = op if op is not None else ReduceOp.SUM
+        bucketizer = self.bucketizer_for(tree)
+        handles: List[AsyncHandle] = []
+        for flat in bucketizer.pack(tree):
+            if self.overlap:
+                handles.append(self.group.allreduce_async(flat, reduce_op))
+            else:
+                t0 = time.perf_counter()
+                out = self.group.allreduce(flat, reduce_op)
+                handles.append(
+                    CompletedHandle(out, time.perf_counter() - t0)
+                )
+        return PendingReduce(handles, bucketizer, self.group.group_name)
+
+    def step(self, tree: Any) -> Optional[Any]:
+        """Loop API: reduced gradients for this step, or — at
+        ``stale_grad=1`` — the previous step's (None on the first call,
+        where the caller skips the update)."""
+        pending = self.reduce(tree)
+        if self.stale_grad == 0:
+            return pending.wait()
+        prev, self._pending = self._pending, pending
+        return prev.wait() if prev is not None else None
+
+    def flush(self) -> Optional[Any]:
+        """Wait out the delayed tail (the stale_grad pipeline's last step);
+        returns its reduced tree, or None if nothing was pending."""
+        prev, self._pending = self._pending, None
+        return prev.wait() if prev is not None else None

@@ -287,6 +287,39 @@ class XlaGroup(BaseGroup):
             )
         return self._timed("allreduce", x, lambda: self._reduce(x, op.value))
 
+    def allreduce_async(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        """Dispatch-without-block: launch the jitted (possibly quantized)
+        reduce program and hand back the not-yet-ready device array. jit
+        dispatch is asynchronous, so no helper thread is needed — the
+        program runs on the device stream while the caller keeps going;
+        the handle's ``wait`` is block_until_ready. Metrics for the op are
+        recorded at completion (on_ready), not dispatch."""
+        from .scheduler import DeviceHandle
+
+        if op == ReduceOp.PRODUCT:
+            raise NotImplementedError(
+                "PRODUCT has no XLA collective; use the cpu backend"
+            )
+        x = self._device_shard(tensor)
+        nbytes = tensor_nbytes(x)
+        if self._use_quantized(x, op):
+            key, res = self._residual_for("allreduce", x)
+            out, self._ef_residuals[key] = self._qallreduce(x, res)
+            wire = quantized_wire_nbytes(x.size, self.quant_block)
+        else:
+            out = self._reduce(x, op.value)
+            wire = None
+
+        def on_ready(latency_s: float):
+            from ..util import metrics
+
+            metrics.record_collective(
+                "allreduce", self.backend, self.group_name, nbytes,
+                latency_s, wire_nbytes=wire,
+            )
+
+        return DeviceHandle(out, on_ready=on_ready)
+
     def allgather(self, tensor) -> Any:
         x = self._device_shard(tensor)
         if self._use_quantized(x):
@@ -356,6 +389,7 @@ class XlaGroup(BaseGroup):
         self._record_op("barrier", 0, start)
 
     def destroy(self):
+        self._shutdown_async()
         if self._host is not None:
             self._host.destroy()
             self._host = None

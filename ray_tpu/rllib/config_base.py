@@ -40,6 +40,12 @@ class AlgorithmConfig:
         # (acting is already stochastic); only meaningful with
         # use_weight_plane=True
         self.quantized_weight_sync = False
+        # overlapped learner-group gradient sync (collective/scheduler.py):
+        # multi-learner setups reduce gradients through the bucketized
+        # async path so the reduce hides under remaining backward compute;
+        # bucket_bytes tunes the dispatch-overhead/overlap tradeoff
+        self.overlap_grad_sync = False
+        self.grad_sync_bucket_bytes: Optional[int] = None
 
     def environment(self, env, env_config: Optional[dict] = None):
         self.env_spec = env
@@ -95,16 +101,25 @@ class AlgorithmConfig:
         use_weight_plane: Optional[bool] = None,
         weight_plane_name: Optional[str] = None,
         quantized: Optional[bool] = None,
+        overlap: Optional[bool] = None,
+        bucket_bytes: Optional[int] = None,
     ):
         """Configure how fresh params reach env-runners each iteration.
         ``quantized=True`` publishes versions with the int8 chunk codec
-        (compressed broadcast; see weights/manifest.py)."""
+        (compressed broadcast; see weights/manifest.py). ``overlap=True``
+        routes multi-learner gradient reduction through the bucketized
+        async scheduler (``bucket_bytes`` sizes the buckets; see
+        rllib/weight_sync.py grad_scheduler_for)."""
         if use_weight_plane is not None:
             self.use_weight_plane = use_weight_plane
         if weight_plane_name is not None:
             self.weight_plane_name = weight_plane_name
         if quantized is not None:
             self.quantized_weight_sync = quantized
+        if overlap is not None:
+            self.overlap_grad_sync = overlap
+        if bucket_bytes is not None:
+            self.grad_sync_bucket_bytes = bucket_bytes
         return self
 
     def debugging(self, seed: Optional[int] = None):

@@ -73,6 +73,23 @@ def broadcaster_for(config) -> ParamsBroadcaster:
     )
 
 
+def grad_scheduler_for(config, group):
+    """Learner-side gradient scheduler from an AlgorithmConfig's
+    weight-sync fields, mirroring ``broadcaster_for``: ``group`` is the
+    learner gang's collective group (any BaseGroup backend). With
+    ``overlap_grad_sync`` off the scheduler still bucketizes but blocks
+    per bucket — call surface identical, A/B by config alone."""
+    from ..collective.bucketizer import DEFAULT_BUCKET_BYTES
+    from ..collective.scheduler import GradientReduceScheduler
+
+    return GradientReduceScheduler(
+        group,
+        bucket_bytes=getattr(config, "grad_sync_bucket_bytes", None)
+        or DEFAULT_BUCKET_BYTES,
+        overlap=getattr(config, "overlap_grad_sync", False),
+    )
+
+
 def resolve_params(params: Any) -> Any:
     """Runner-side inverse of ``ParamsBroadcaster.handle`` for the
     weight-plane mode: a WeightHandle fetches its pinned version over the

@@ -44,3 +44,31 @@ def allreduce(value, op=None):
 
 def allgather(value) -> list:
     return _collective.allgather(value, group_name=_group())
+
+
+def gradient_scheduler():
+    """This run's :class:`~ray_tpu.collective.GradientReduceScheduler`,
+    built lazily from the context's gang-uniform knobs (overlap /
+    bucket_bytes / stale_grad set on the trainer) and cached on the
+    context — a re-formed gang's fresh context rebuilds it over the new
+    epoch's group."""
+    from ..collective.bucketizer import DEFAULT_BUCKET_BYTES
+    from ..collective.scheduler import GradientReduceScheduler
+
+    ctx = get_context()
+    if ctx._grad_scheduler is None:
+        ctx._grad_scheduler = GradientReduceScheduler(
+            _collective.get_group(_group()),
+            bucket_bytes=ctx.collective_bucket_bytes or DEFAULT_BUCKET_BYTES,
+            overlap=ctx.collective_overlap,
+            stale_grad=ctx.collective_stale_grad,
+        )
+    return ctx._grad_scheduler
+
+
+def reduce_gradients(grads: Any):
+    """Sum a gradient pytree across the gang through the overlapped
+    scheduler — the sanctioned gradient-reduction path in train loops
+    (analysis rule RT010). Returns the summed tree; at ``stale_grad=1``
+    the PREVIOUS step's (None on the first step — skip the update)."""
+    return gradient_scheduler().step(grads)

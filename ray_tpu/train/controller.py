@@ -65,6 +65,10 @@ class TrainController:
         poll_interval: float = 0.1,
         callbacks: Optional[List[Any]] = None,
         quantized: bool = False,
+        overlap: bool = False,
+        bucket_bytes: Optional[int] = None,
+        stale_grad: int = 0,
+        slice_size: Optional[int] = None,
     ):
         self._train_fn = train_fn
         self._train_fn_config = train_fn_config
@@ -97,6 +101,13 @@ class TrainController:
         # int8+error-feedback transport for the run's collective group and
         # train-state publishes; threaded into every worker's TrainContext
         self._quantized = quantized
+        # overlapped-reduction knobs (trainer.py docs them); all four ride
+        # the same _run_fields -> TrainContext path as quantized, so a
+        # resize/restart re-forms the gang with identical settings
+        self._overlap = overlap
+        self._bucket_bytes = bucket_bytes
+        self._stale_grad = stale_grad
+        self._slice_size = slice_size
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -211,6 +222,10 @@ class TrainController:
             collective_group=self._group_name(),
             collective_epoch=self._epoch,
             collective_quantized=self._quantized,
+            collective_overlap=self._overlap,
+            collective_bucket_bytes=self._bucket_bytes,
+            collective_stale_grad=self._stale_grad,
+            collective_slice_size=self._slice_size,
         )
 
     def _group_name(self) -> str:

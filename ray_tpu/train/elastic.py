@@ -84,6 +84,12 @@ def restore_train_state(
     nothing has been published yet (fresh start)."""
     from .. import weights
 
+    # a resumed loop must start with a clean reduction pipeline: any
+    # delayed (stale_grad) gradients still pending belong to the aborted
+    # epoch's group and would poison the first step after the re-form
+    ctx = get_context()
+    ctx._grad_scheduler = None
+
     try:
         version, payload = weights.fetch(_state_name(name), sharding=sharding)
     except KeyError:

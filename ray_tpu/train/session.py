@@ -45,6 +45,16 @@ class TrainContext:
     # default codec for publish_train_state — must be gang-uniform, so it
     # rides in the context rather than per-call arguments
     collective_quantized: bool = False
+    # overlapped gradient reduction (collective/scheduler.py): when True,
+    # train.collective.reduce_gradients() dispatches bucketized async
+    # allreduces instead of one blocking op. All gang-uniform for the same
+    # reason quantized is — every rank must bucketize and dispatch
+    # identically or the rendezvous sequence desyncs.
+    collective_overlap: bool = False
+    collective_bucket_bytes: Optional[int] = None
+    collective_stale_grad: int = 0
+    # hierarchical topology: ranks per slice (None = flat group)
+    collective_slice_size: Optional[int] = None
     latest_checkpoint: Optional[Checkpoint] = None
     dataset_shards: Dict[str, Any] = field(default_factory=dict)
 
@@ -55,6 +65,9 @@ class TrainContext:
     # report-to-report step telemetry (compute/collective split +
     # scaling-efficiency gauge; util/metrics.StepBreakdown)
     _step_breakdown: Any = None
+    # lazily-built GradientReduceScheduler for this run's group (one per
+    # context: the re-formed gang's context rebuilds it at the new epoch)
+    _grad_scheduler: Any = None
 
     # -- user-facing accessors (reference: TrainContext methods) ----------
 

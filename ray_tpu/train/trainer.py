@@ -33,6 +33,10 @@ class DataParallelTrainer:
         backend_config: Optional[BackendConfig] = None,
         datasets: Optional[Dict[str, Any]] = None,
         quantized: bool = False,
+        overlap: bool = False,
+        bucket_bytes: Optional[int] = None,
+        stale_grad: int = 0,
+        slice_size: Optional[int] = None,
     ):
         self._train_loop = train_loop_per_worker
         self._train_loop_config = train_loop_config
@@ -45,6 +49,17 @@ class DataParallelTrainer:
         # (halves bf16 gradient/weight bytes on the wire; loss parity is
         # maintained by error feedback — see docs/ARCHITECTURE.md §16)
         self.quantized = quantized
+        # overlapped gradient reduction: the worker loop's
+        # train.collective.reduce_gradients() bucketizes the grad tree and
+        # dispatches async allreduces under the step's remaining compute
+        # (docs/ARCHITECTURE.md §17). stale_grad=1 additionally defers the
+        # update one step so the tail reduce hides under the next forward.
+        # slice_size switches the gang to the hierarchical ("hier")
+        # backend: intra-slice reduce + inter-slice leader reduce.
+        self.overlap = overlap
+        self.bucket_bytes = bucket_bytes
+        self.stale_grad = stale_grad
+        self.slice_size = slice_size
 
     def _default_callbacks(self):
         return []
@@ -62,6 +77,10 @@ class DataParallelTrainer:
             datasets=self.datasets,
             callbacks=callbacks,
             quantized=self.quantized,
+            overlap=self.overlap,
+            bucket_bytes=self.bucket_bytes,
+            stale_grad=self.stale_grad,
+            slice_size=self.slice_size,
         )
         return controller.run()
 

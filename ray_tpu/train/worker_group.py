@@ -67,13 +67,25 @@ class TrainWorker:
         if self._ctx.collective_group:
             from .. import collective
 
-            collective.init_collective_group(
-                self._ctx.world_size,
-                self._ctx.world_rank,
-                backend="gcs",
+            kwargs = dict(
                 group_name=self._ctx.collective_group,
                 epoch=self._ctx.collective_epoch,
                 quantized=self._ctx.collective_quantized,
+            )
+            slice_size = self._ctx.collective_slice_size
+            if slice_size and self._ctx.world_size % slice_size == 0:
+                # two-tier topology: intra-slice + inter-slice leader reduce
+                backend = "hier"
+                kwargs["slice_size"] = slice_size
+            else:
+                # flat group; also the fallback when an elastic resize
+                # leaves a world size the slice shape no longer divides
+                backend = "gcs"
+            collective.init_collective_group(
+                self._ctx.world_size,
+                self._ctx.world_rank,
+                backend=backend,
+                **kwargs,
             )
         return True
 

@@ -550,6 +550,92 @@ def collective_summary() -> Dict[str, Dict[str, float]]:
     return out
 
 
+# -- overlap split: the overlapped-reduction scheduler
+# (collective/scheduler.py) attributes every async op's latency to either
+# "exposed" (caller blocked in wait) or "overlapped" (ran under compute).
+# collective_seconds_total above keeps recording FULL op latencies — under
+# overlap that clock overstates critical-path cost, and this split is the
+# number that actually proves the win.
+
+_overlap_metrics: Optional[dict] = None
+_overlap_init_lock = threading.Lock()
+
+
+def _ensure_overlap_metrics() -> dict:
+    global _overlap_metrics
+    if _overlap_metrics is None:
+        with _overlap_init_lock:
+            if _overlap_metrics is None:
+                _overlap_metrics = {
+                    "exposed": Counter(
+                        "collective_exposed_seconds_total",
+                        "Async collective time the caller actually "
+                        "blocked on (critical-path cost)",
+                        tag_keys=("group",),
+                    ),
+                    "overlapped": Counter(
+                        "collective_overlapped_seconds_total",
+                        "Async collective time hidden under the "
+                        "caller's compute",
+                        tag_keys=("group",),
+                    ),
+                    "fraction": Gauge(
+                        "collective_overlap_fraction",
+                        "Hidden fraction of the last gradient "
+                        "reduction's collective time (1.0 = fully "
+                        "overlapped, 0.0 = fully exposed)",
+                        tag_keys=("group",),
+                    ),
+                }
+    return _overlap_metrics
+
+
+def record_collective_overlap(group: str, exposed_s: float,
+                              overlapped_s: float):
+    """One gradient reduction's exposure split, summed over its buckets
+    (called from PendingReduce.wait on every path, including sync mode
+    where overlapped_s is 0 — the A/B baseline shows fraction 0.0)."""
+    m = _ensure_overlap_metrics()
+    tags = {"group": group}
+    exposed_s = max(exposed_s, 0.0)
+    overlapped_s = max(overlapped_s, 0.0)
+    m["exposed"].inc(exposed_s, tags)
+    m["overlapped"].inc(overlapped_s, tags)
+    total = exposed_s + overlapped_s
+    if total > 0:
+        m["fraction"].set(overlapped_s / total, tags)
+
+
+def collective_exposed_seconds_total() -> float:
+    metric = _ensure_overlap_metrics()["exposed"]
+    with metric._lock:
+        return float(sum(metric._values.values()))
+
+
+def collective_overlapped_seconds_total() -> float:
+    metric = _ensure_overlap_metrics()["overlapped"]
+    with metric._lock:
+        return float(sum(metric._values.values()))
+
+
+def collective_overlap_summary() -> Dict[str, Dict[str, float]]:
+    """Process-local snapshot: group -> {exposed_s, overlapped_s,
+    overlap_fraction} (tests + bench + CLI)."""
+    m = _ensure_overlap_metrics()
+    out: Dict[str, Dict[str, float]] = {}
+    for label, metric in (("exposed_s", m["exposed"]),
+                          ("overlapped_s", m["overlapped"])):
+        with metric._lock:
+            for key, v in metric._values.items():
+                out.setdefault(key[0], {})[label] = v
+    for group, entry in out.items():
+        total = entry.get("exposed_s", 0.0) + entry.get("overlapped_s", 0.0)
+        entry["overlap_fraction"] = (
+            entry.get("overlapped_s", 0.0) / total if total > 0 else 0.0
+        )
+    return out
+
+
 _step_metrics: Optional[dict] = None
 _step_init_lock = threading.Lock()
 
@@ -577,19 +663,29 @@ def _ensure_step_metrics() -> dict:
 
 
 def record_step_breakdown(
-    role: str, compute_s: float, collective_s: float, idle_s: float
+    role: str, compute_s: float, collective_s: float, idle_s: float,
+    exposed_s: Optional[float] = None, overlapped_s: Optional[float] = None,
 ):
+    """``collective_s`` is the full-latency collective clock delta (the
+    pre-overlap decomposition). When the step ran under the overlapped
+    scheduler, ``exposed_s``/``overlapped_s`` additionally split that time
+    into critical-path vs hidden-under-compute components."""
     m = _ensure_step_metrics()
     compute_s = max(compute_s, 0.0)
     collective_s = max(collective_s, 0.0)
     idle_s = max(idle_s, 0.0)
     total = compute_s + collective_s + idle_s
-    for component, value in (
+    components = [
         ("compute", compute_s),
         ("collective", collective_s),
         ("idle", idle_s),
         ("total", total),
-    ):
+    ]
+    if exposed_s is not None:
+        components.append(("collective_exposed", max(exposed_s, 0.0)))
+    if overlapped_s is not None:
+        components.append(("collective_overlapped", max(overlapped_s, 0.0)))
+    for component, value in components:
         m["seconds"].set(value, {"role": role, "component": component})
     if total > 0:
         m["efficiency"].set(compute_s / total, {"role": role})
@@ -616,11 +712,15 @@ class StepBreakdown:
         self.role = role
         self._last_end: Optional[float] = None
         self._last_coll: Optional[float] = None
+        self._last_exposed: Optional[float] = None
+        self._last_overlapped: Optional[float] = None
 
     @contextmanager
     def step(self):
         start = time.perf_counter()
         coll0 = collective_seconds_total()
+        exp0 = collective_exposed_seconds_total()
+        ovl0 = collective_overlapped_seconds_total()
         try:
             yield
         finally:
@@ -630,17 +730,36 @@ class StepBreakdown:
                 start - self._last_end if self._last_end is not None else 0.0
             )
             self._last_end = end
-            record_step_breakdown(self.role, (end - start) - coll, coll, idle)
+            # under the overlapped scheduler only the EXPOSED share of the
+            # collective clock actually left the critical path's compute —
+            # the overlapped share ran under it and stays counted as compute
+            exposed = collective_exposed_seconds_total() - exp0
+            overlapped = collective_overlapped_seconds_total() - ovl0
+            critical_coll = min(coll, exposed) if overlapped > 0 else coll
+            record_step_breakdown(
+                self.role, (end - start) - critical_coll, critical_coll,
+                idle, exposed_s=exposed, overlapped_s=overlapped,
+            )
 
     def mark(self):
         now = time.perf_counter()
         coll_now = collective_seconds_total()
+        exp_now = collective_exposed_seconds_total()
+        ovl_now = collective_overlapped_seconds_total()
         if self._last_end is not None:
             total = now - self._last_end
             coll = coll_now - (self._last_coll or 0.0)
-            record_step_breakdown(self.role, total - coll, coll, 0.0)
+            exposed = exp_now - (self._last_exposed or 0.0)
+            overlapped = ovl_now - (self._last_overlapped or 0.0)
+            critical_coll = min(coll, exposed) if overlapped > 0 else coll
+            record_step_breakdown(
+                self.role, total - critical_coll, critical_coll, 0.0,
+                exposed_s=exposed, overlapped_s=overlapped,
+            )
         self._last_end = now
         self._last_coll = coll_now
+        self._last_exposed = exp_now
+        self._last_overlapped = ovl_now
 
 
 # ---------------------------------------------------------------------------
@@ -756,6 +875,9 @@ def train_ft_summary(payloads: List[dict]) -> Dict[str, object]:
         "aborts": 0.0,
         "recoveries": 0.0,
         "recovery_mean_s": 0.0,
+        "collective_exposed_s": 0.0,
+        "collective_overlapped_s": 0.0,
+        "overlap_fraction": 0.0,
     }
     recovery_sum = 0.0
     for payload in payloads:
@@ -771,8 +893,21 @@ def train_ft_summary(payloads: List[dict]) -> Dict[str, object]:
                 for counts in snap.get("counts", {}).values():
                     out["recoveries"] += float(sum(counts))
                 recovery_sum += sum(snap.get("values", {}).values())
+            elif name == "collective_exposed_seconds_total":
+                out["collective_exposed_s"] += sum(snap["values"].values())
+            elif name == "collective_overlapped_seconds_total":
+                out["collective_overlapped_s"] += sum(
+                    snap["values"].values()
+                )
     if out["recoveries"]:
         out["recovery_mean_s"] = recovery_sum / out["recoveries"]
+    overlap_total = (
+        out["collective_exposed_s"] + out["collective_overlapped_s"]
+    )
+    if overlap_total > 0:
+        out["overlap_fraction"] = (
+            out["collective_overlapped_s"] / overlap_total
+        )
     return out
 
 

@@ -384,13 +384,58 @@ def test_rt009_host_sync_chokepoint_and_host_values_exempt(tmp_path):
     assert result.findings == []
 
 
+# ---------------------------------------------------------------- RT010
+
+
+def test_rt010_flags_direct_reduce_in_train(tmp_path):
+    result = _run(tmp_path, {
+        "train/loop.py": """
+            from ray_tpu.collective import allreduce, reducescatter
+
+            def train_step(group, grads, tensor):
+                summed = group.allreduce(grads)
+                shard = group.reducescatter(tensor)
+                also = allreduce(grads)
+                scattered = reducescatter(tensor)
+                return summed, shard, also, scattered
+        """,
+    }, rules=["RT010"])
+    assert _rules(result) == ["RT010"] * 4
+    msgs = " ".join(f.message for f in result.findings)
+    assert "reduce_gradients" in msgs
+
+
+def test_rt010_wrapper_and_non_train_exempt(tmp_path):
+    result = _run(tmp_path, {
+        "train/collective.py": """
+            from .. import collective as _collective
+
+            def allreduce(value, op=None):
+                kwargs = {} if op is None else {"op": op}
+                return _collective.allreduce(value, **kwargs)
+
+            def reduce_gradients(grads):
+                return gradient_scheduler().step(grads)
+        """,
+        "collective/scheduler.py": """
+            def reduce(self, group, flat):
+                return group.allreduce(flat)  # scheduler internals: fine
+        """,
+        "rllib/learner.py": """
+            def sync(group, grads):
+                return group.allreduce(grads)  # not train/: out of scope
+        """,
+    }, rules=["RT010"])
+    assert result.findings == []
+
+
 # ------------------------------------------------------------- framework
 
 
-def test_catalog_has_all_nine_rules():
+def test_catalog_has_all_ten_rules():
     assert sorted(checker_catalog()) == [
         "RT001", "RT002", "RT003", "RT004", "RT005", "RT006", "RT007",
-        "RT008", "RT009",
+        "RT008", "RT009", "RT010",
     ]
 
 

@@ -155,14 +155,16 @@ def test_timeline_endpoint_and_ui_panels(dashboard_cluster):
 
 def test_train_endpoint(dashboard_cluster):
     """/api/train serves live run records plus the cluster fault-tolerance
-    rollup (resizes/restarts/aborts/recovery)."""
+    rollup (resizes/restarts/aborts/recovery + collective overlap split)."""
     dash = dashboard_cluster
     out = _get_json(dash.url + "/api/train")
     assert out["runs"] == []  # nothing training in this cluster
     ft = out["fault_tolerance"]
     assert set(ft) == {
-        "resizes", "restarts", "aborts", "recoveries", "recovery_mean_s"
+        "resizes", "restarts", "aborts", "recoveries", "recovery_mean_s",
+        "collective_exposed_s", "collective_overlapped_s", "overlap_fraction",
     }
+    assert ft["overlap_fraction"] == 0.0  # no overlapped collectives yet
 
 
 def test_autoscale_endpoint(dashboard_cluster):
