@@ -1612,6 +1612,203 @@ def overlap_train():
         ray_tpu.shutdown()
 
 
+def disagg_serve():
+    """`python bench.py disagg_serve` — cluster KV tier + disaggregated
+    serving A/B under a shared-prefix Zipf trace.
+
+    Two paged engines share one in-process tier backend (the REAL
+    GcsKVTierRegistry protocol over an inline chunk store): a warm
+    replica serves a Zipf(1.1) trace first (populating the tier), then a
+    fresh "scale-up" replica serves a second trace slice with every
+    request classified by where its prefix came from — local radix,
+    peer pull through the tier, or miss/recompute. Shipments use the
+    int8 codec over an f32 KV cache so the wire/logical split shows the
+    real compression. Prints ONE JSON line for BENCH_LOG.md. CPU-safe
+    (RAY_TPU_BENCH_CPU=1 forces the CPU backend)."""
+    if os.environ.get("RAY_TPU_BENCH_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import dataclasses
+    import random as _random
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.kvcache import KVCacheManager
+    from ray_tpu.kvtier import KVShipment, KVTierClient, LocalTierBackend
+    from ray_tpu.llm.engine import ContinuousBatchingEngine, GenerationRequest
+    from ray_tpu.loadgen import ZipfPrefixes
+    from ray_tpu.models.llama import LlamaConfig, init_params
+    from ray_tpu.parallel.sharding import unbox_params
+    from ray_tpu.util.metrics import kvcache_counters, kvtier_counters
+
+    # long prefix: the regime disaggregation targets — prefill compute
+    # scales with prefix length (attention quadratically), a peer pull
+    # scales only with the block bytes
+    block_size, prefix_tokens, prompt_tokens, new_tokens = 8, 192, 208, 8
+    requests_per_phase = 24
+    # f32 KV: int8 shipment = 1B codes + 4B/256-elem scales ~= 0.26x;
+    # bf16 would read ~0.52x and hide the codec
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(max_seq_len=256), dtype=jnp.float32
+    )
+    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
+    backend = LocalTierBackend()
+    _log(f"devices={jax.devices()}")
+
+    def make_replica(holder):
+        tier = KVTierClient(
+            model="llama-tiny", backend=backend, block_size=block_size,
+            codec="int8", holder_id=holder,
+        )
+        kv = KVCacheManager(num_blocks=256, block_size=block_size)
+        eng = ContinuousBatchingEngine(
+            cfg, params, num_slots=4, kv_cache=kv, seed=0, kv_tier=tier
+        )
+        return eng, tier
+
+    zipf = ZipfPrefixes(
+        num_prefixes=12, alpha=1.1, prefix_tokens=prefix_tokens,
+        seed=7, vocab_size=cfg.vocab_size - 4,
+    )
+    rng = _random.Random(99)
+
+    def make_prompt(prefix_id, req_i):
+        # shift out of the pad/bos/eos ids, pad with per-request suffix
+        prefix = [3 + t for t in zipf.tokens(prefix_id)]
+        suffix = [rng.randrange(3, cfg.vocab_size - 1)
+                  for _ in range(prompt_tokens - prefix_tokens)]
+        return prefix + suffix
+
+    def timed_request(eng, prompt):
+        req = GenerationRequest(
+            token_ids=list(prompt), max_new_tokens=new_tokens,
+            temperature=0.0,
+        )
+        t0 = time.perf_counter()
+        ttft = None
+        for item in eng.generate_stream(req):
+            if isinstance(item, int) and ttft is None:
+                ttft = time.perf_counter() - t0
+        return ttft
+
+    warm, _ = make_replica("warm-replica")
+    # compile every program shape off the clock on a throwaway prompt
+    scratch = [3 + (i % (cfg.vocab_size - 4)) for i in range(prompt_tokens)]
+    timed_request(warm, scratch)
+    timed_request(warm, scratch)
+
+    warm_ids = [zipf.sample(rng) for _ in range(requests_per_phase)]
+    for i, pid in enumerate(warm_ids):
+        timed_request(warm, make_prompt(pid, i))
+    warm_prefixes = set(warm_ids)
+    _log(f"warm phase: {len(warm_prefixes)} distinct prefixes registered")
+
+    # fresh scale-up replica. Its FIRST warm-prefix request — the
+    # exact-match pull of the tier-warm scratch prompt — doubles as the
+    # zero-prefill acceptance check, then two more off-the-clock requests
+    # compile the partial-pull and full-miss program shapes so the timed
+    # loop measures steady-state serving, not tracing (each engine
+    # instance jits its own programs).
+    scale, scale_tier = make_replica("scale-up")
+    k0 = kvcache_counters()
+    timed_request(scale, scratch)
+    first_warm_computed = (kvcache_counters()["prefill_tokens_computed"]
+                           - k0["prefill_tokens_computed"])
+    timed_request(scale, make_prompt(sorted(warm_prefixes)[0], 9000))
+    novel = [3 + ((7 * i) % (cfg.vocab_size - 4))
+             for i in range(prompt_tokens)]
+    timed_request(scale, novel)
+
+    by_tier = {"local": [], "peer": [], "miss": []}
+    for i in range(requests_per_phase):
+        pid = zipf.sample(rng)
+        prompt = make_prompt(pid, 1000 + i)
+        t0 = kvtier_counters()
+        ttft = timed_request(scale, prompt)
+        t1 = kvtier_counters()
+        if t1["peer_pull"] > t0["peer_pull"]:
+            tier_tag = "peer"
+        elif t1["recompute"] > t0["recompute"]:
+            tier_tag = "miss"
+        else:
+            tier_tag = "local"
+        by_tier[tier_tag].append(ttft * 1e3)
+
+    tc = kvtier_counters()
+    wire_ratio = (tc["transfer_wire_bytes"] / tc["transfer_logical_bytes"]
+                  if tc["transfer_logical_bytes"] else None)
+
+    # directed prefill->decode handoff parity (the roles path's engine
+    # half): ship the whole prompt, decode with zero prefill tokens
+    pre, _ = make_replica("handoff-pre")
+    dec, dec_tier = make_replica("handoff-dec")
+    prompt = make_prompt(0, 5000)
+    shipment = pre.prefill_only(GenerationRequest(
+        token_ids=prompt, max_new_tokens=new_tokens, temperature=0.0))
+    shipment = KVShipment.from_blob(shipment.to_blob())
+    payload = dec_tier.fetch_shipment(shipment)
+    k0 = kvcache_counters()
+    disagg_out = dec.generate_one(
+        GenerationRequest(token_ids=prompt, max_new_tokens=new_tokens,
+                          temperature=0.0),
+        shipment=(shipment, payload),
+    )
+    k1 = kvcache_counters()
+    handoff_computed = (k1["prefill_tokens_computed"]
+                        - k0["prefill_tokens_computed"])
+    fused_out = warm.generate_one(GenerationRequest(
+        token_ids=prompt, max_new_tokens=new_tokens, temperature=0.0))
+    parity = disagg_out.token_ids == fused_out.token_ids
+
+    def pct(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))], 1)
+
+    ttft_split = {
+        tier: {"n": len(xs), "p50_ms": pct(xs, 0.50),
+               "p99_ms": pct(xs, 0.99)}
+        for tier, xs in by_tier.items()
+    }
+    peer_p99 = ttft_split["peer"]["p99_ms"]
+    miss_p99 = ttft_split["miss"]["p99_ms"]
+    _log(f"ttft split: {ttft_split}")
+    _log(f"int8 wire/logical={wire_ratio:.3f} "
+         f"scale-up first warm prefill computed={first_warm_computed} "
+         f"handoff computed={handoff_computed} parity={parity}")
+    assert first_warm_computed == 0, first_warm_computed
+    assert handoff_computed == 0, handoff_computed
+    assert parity, "disagg handoff diverged from fused decode"
+    assert wire_ratio is not None and wire_ratio <= 0.51, wire_ratio
+    if peer_p99 is not None and miss_p99 is not None:
+        assert peer_p99 < miss_p99, (peer_p99, miss_p99)
+    print(json.dumps({
+        "metric": "disagg_serve_peer_vs_miss_ttft_p99",
+        "value": (round(miss_p99 / peer_p99, 2)
+                  if peer_p99 and miss_p99 else None),
+        "unit": "x (miss TTFT p99 / peer-pull TTFT p99, scale-up replica)",
+        "ttft_ms_by_tier": ttft_split,
+        "int8_wire_over_logical": round(wire_ratio, 3),
+        "scale_up_first_warm_prefill_tokens": first_warm_computed,
+        "handoff_prefill_tokens": handoff_computed,
+        "disagg_vs_fused_parity": "exact" if parity else "DIVERGED",
+        "tier_counters": {k: v for k, v in tc.items()},
+        "registry": backend.registry.stats(),
+        "config": {
+            "model": "llama-tiny", "kv_dtype": "float32",
+            "block_size": block_size, "prefix_tokens": prefix_tokens,
+            "prompt_tokens": prompt_tokens, "max_new_tokens": new_tokens,
+            "zipf_alpha": 1.1, "num_prefixes": 12,
+            "requests_per_phase": requests_per_phase,
+            "ship_codec": "int8",
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "llm_prefix_cache":
         llm_prefix_cache()
@@ -1631,6 +1828,8 @@ if __name__ == "__main__":
         quantized_broadcast()
     elif len(sys.argv) > 1 and sys.argv[1] == "overlap_train":
         overlap_train()
+    elif len(sys.argv) > 1 and sys.argv[1] == "disagg_serve":
+        disagg_serve()
     elif len(sys.argv) > 1:
         raise SystemExit(f"unknown bench mode {sys.argv[1]!r}")
     else:

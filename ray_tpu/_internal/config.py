@@ -56,6 +56,15 @@ class Config:
     # staleness(); 0 disables expiry.
     weights_pin_lease_s: float = 600.0
 
+    # --- KV prefix tier (ray_tpu.kvtier) ---
+    # Cap on registered prefix entries cluster-wide; LRU unleased entries
+    # past the cap are evicted and their holders notified (collect drain)
+    # so pinned shipment chunks don't accrete host RAM forever.
+    kvtier_max_entries: int = 4096
+    # Pull-lease lifetime: a resolve-side lease not released within this
+    # window is reaped, so a crashed puller cannot block eviction.
+    kvtier_lease_s: float = 60.0
+
     # --- scheduling ---
     # Hybrid policy: prefer local node until utilization exceeds this, then
     # spread over top-k remote candidates (reference: hybrid_scheduling_policy.h).

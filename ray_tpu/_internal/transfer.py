@@ -1,0 +1,185 @@
+"""Shared pinned-buffer chunk transfer layer.
+
+Every plane that moves bulk tensor bytes between processes — the weight
+plane's versioned broadcast AND the KV tier's prefill→decode block
+shipping — uses the same three primitives, extracted here from
+``weights/broadcast.py`` / ``weights/publisher.py`` so they cannot drift:
+
+- ``put_chunks``: serialize values into the local plasma store
+  (``force_plasma`` so zero-copy out-of-band buffers survive), weight-pin
+  each object at its source (spill/evict exemption while in flight), and
+  return ``(object_id, size)`` pairs for the caller's manifest/registry.
+- ``fetch_chunk``: pull one chunk into the local store and deserialize it,
+  with ``prefer_source`` steering (a parent in a broadcast tree, or a KV
+  holder replica), a bounded wait for that source to actually hold the
+  object, and — critically — a **2 s reachability probe** of the source
+  before committing to the pull: a SIGKILLed holder must cost the probe
+  bound, not the 10 s connect window (the PR 12 dead-peer lesson,
+  ``_PULL_CONNECT_PROBE_S`` in the raylet pull path).
+- ``pin_chunks`` / ``unpin_chunks``: eviction/spill exemption for the
+  lifetime of a lease (weight subscription, KV-tier hold).
+
+Callers pass any chunk record exposing ``object_id``, ``owner_address``
+and ``size`` (the weight plane's ``ChunkInfo`` and the KV tier's
+``ShipChunk`` both qualify); this module stays dependency-free of either
+plane. All coroutines run on the worker's event loop.
+
+RT011 enforces the other direction: KV block pool bytes may only cross
+process boundaries through this module — ad-hoc ``store_put`` of pool
+buffers bypasses pinning, prefer-source and the wire/logical accounting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from ..object_ref import ObjectRef
+from . import serialization
+
+# Bound on probing a preferred source's transport before a pull commits to
+# it. Mirrors the raylet's _PULL_CONNECT_PROBE_S: long enough for a live
+# but busy peer to accept, far below the connect timeout a dead peer burns.
+HOLDER_PROBE_S = 2.0
+
+
+class DeadHolderError(Exception):
+    """The designated holder of a chunk failed its reachability probe.
+
+    Raised only when the caller asked for ``require_source=True`` (KV tier
+    peer pulls, where the correct fallback is *recompute*, not an
+    unconstrained pull that would hit the same dead owner's 10 s window).
+    """
+
+
+async def probe_reachable(worker, address: Tuple[str, int],
+                          timeout_s: float = HOLDER_PROBE_S) -> bool:
+    """True iff a transport to ``address`` connects within ``timeout_s``."""
+    try:
+        client = worker.client_pool.get(*address)
+        await asyncio.wait_for(client._ensure_connected(), timeout_s)
+        return True
+    except Exception:
+        return False
+
+
+async def put_chunks(worker, values: Sequence, *, pin: bool = True) -> List[Tuple[bytes, int]]:
+    """Store each value as one pinned plasma object; return (oid, size) pairs.
+
+    The caller owns the resulting objects (wrap them in ``ObjectRef`` to
+    keep them alive); ``pin=True`` additionally weight-pins each at the
+    source so mid-broadcast/mid-ship chunks can't be evicted or spilled.
+    """
+    raylet = worker.client_pool.get(*worker.raylet_address)
+    out = []
+    for value in values:
+        meta_b, bufs = serialization.serialize(value)
+        oid, size = await worker.put_serialized(meta_b, bufs, force_plasma=True)
+        if pin:
+            try:
+                await raylet.call("store_pin_weight", oid)
+            except Exception:
+                pass
+        out.append((oid, size))
+    return out
+
+
+async def fetch_chunk(
+    worker,
+    chunk,
+    source: Optional[Tuple[str, int]],
+    *,
+    wait_s: float = 0.0,
+    fellback: Optional[list] = None,
+    probe_source: bool = False,
+    require_source: bool = False,
+):
+    """Fetch one chunk into the local store and return its deserialized value.
+
+    ``source`` is the preferred holder (broadcast-tree parent, KV holder
+    replica). When the object is not already local:
+
+    - ``probe_source=True`` first bounds a reachability probe of ``source``
+      at :data:`HOLDER_PROBE_S`; an unreachable source either degrades to
+      an owner-directed pull (default) or raises :class:`DeadHolderError`
+      (``require_source=True`` — the KV-tier contract, where recompute
+      beats a doomed pull).
+    - ``wait_s > 0`` polls the source until it holds the object (tree
+      ordering), falling back past the deadline; ``fellback`` is a
+      one-element flag list set True when that wait was abandoned.
+    """
+    raylet = worker.client_pool.get(*worker.raylet_address)
+    ref = ObjectRef(chunk.object_id, tuple(chunk.owner_address))
+    prefer = None
+    local = await raylet.call("store_contains", chunk.object_id)
+    if not local and source is not None \
+            and tuple(source) != tuple(worker.raylet_address):
+        if probe_source and not await probe_reachable(worker, tuple(source)):
+            if require_source:
+                raise DeadHolderError(
+                    f"chunk holder {tuple(source)} unreachable within "
+                    f"{HOLDER_PROBE_S:g}s"
+                )
+            if fellback is not None:
+                fellback[0] = True
+            source = None
+        if source is not None:
+            if wait_s > 0:
+                prefer = await wait_for_holder(worker, chunk.object_id,
+                                               tuple(source), wait_s)
+                if prefer is None and fellback is not None:
+                    fellback[0] = True
+            else:
+                prefer = tuple(source)
+    if not local and require_source and prefer is None and source is not None:
+        # The holder answered the probe but no longer has the bytes (evicted
+        # between resolve and pull): same contract, recompute wins.
+        raise DeadHolderError(
+            f"chunk holder {tuple(source)} no longer holds "
+            f"{chunk.object_id!r}"
+        )
+    return await worker._read_plasma(ref, chunk.size, prefer_source=prefer)
+
+
+async def wait_for_holder(worker, object_id, holder: Tuple[str, int],
+                          wait_s: float) -> Optional[Tuple[str, int]]:
+    """Poll ``holder`` until it reports the object local; None past the
+    deadline or on an unreachable holder (caller falls back to any
+    source)."""
+    deadline = time.monotonic() + wait_s
+    client = worker.client_pool.get(*holder)
+    delay = 0.01
+    while True:
+        try:
+            if await client.call("store_contains", object_id):
+                return tuple(holder)
+        except Exception:
+            return None  # holder unreachable: fall back to any source
+        if time.monotonic() >= deadline:
+            return None
+        await asyncio.sleep(delay)
+        delay = min(delay * 2, 0.25)
+
+
+async def pin_chunks(worker, object_ids: Sequence) -> List:
+    """Weight-pin local copies (eviction/spill exemption for a lease's
+    lifetime); returns the object ids actually pinned."""
+    raylet = worker.client_pool.get(*worker.raylet_address)
+    pinned = []
+    for oid in object_ids:
+        try:
+            if await raylet.call("store_pin_weight", oid):
+                pinned.append(oid)
+        except Exception:
+            pass
+    return pinned
+
+
+async def unpin_chunks(worker, object_ids: Sequence):
+    raylet = worker.client_pool.get(*worker.raylet_address)
+    for oid in object_ids:
+        try:
+            await raylet.call_oneway("store_unpin_weight", oid)
+        except Exception:
+            pass

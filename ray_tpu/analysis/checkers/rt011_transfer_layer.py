@@ -1,0 +1,74 @@
+"""RT011: KV block bytes cross processes only via the transfer layer.
+
+Incident class this encodes: the disaggregated serving work (PR 17).
+KV shipments and peer prefix pulls move multi-megabyte block payloads
+between replicas; the shared pinned-buffer transfer layer
+(``ray_tpu/_internal/transfer.py``) is the one place that knows how to
+chunk them, pin the source buffers for zero-copy pulls, probe a holder
+before fetching (the 2s dead-peer probe), and account logical vs wire
+bytes for the int8 codec. A direct ``worker.put_serialized(...)`` or a
+raw GCS ``call("store_put", ...)`` in the serving plane bypasses all of
+that: the bytes land unpinned (a peer pull then copies), unprobed (a
+dead holder hangs the puller for the full RPC timeout), and invisible
+to the ``kvtier_transfer_bytes_total`` split.
+
+Flags, in ``ray_tpu/kvtier/``, ``ray_tpu/kvcache/`` and ``ray_tpu/llm/``:
+
+- any ``X.put_serialized(...)`` attribute call — the object-plane raw
+  put primitive;
+- any ``X.call("store_put", ...)`` — the same primitive reached through
+  a GCS/raylet RPC client.
+
+``_internal/transfer.py`` itself is outside the scanned paths: that IS
+the chokepoint. Route new KV byte movement through ``put_chunks`` /
+``fetch_chunk`` there so pinning, probing and byte accounting stay in
+one audited place.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, register
+
+
+@register
+class TransferLayerChecker(Checker):
+    RULE_ID = "RT011"
+    DESCRIPTION = (
+        "raw object-plane put in the serving KV path (kvtier/kvcache/llm); "
+        "route KV bytes through _internal/transfer.py"
+    )
+
+    def applies_to(self, path: str) -> bool:
+        parts = path.split("/")
+        return any(p in ("kvtier", "kvcache", "llm") for p in parts[:-1])
+
+    def check_file(self, path, tree, source):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr == "put_serialized":
+                yield self.finding(
+                    path, node,
+                    "direct put_serialized() in the serving KV path "
+                    "bypasses pinning, dead-peer probing and wire-byte "
+                    "accounting; route KV bytes through "
+                    "_internal/transfer.py (put_chunks/fetch_chunk)",
+                )
+                continue
+            if (
+                func.attr == "call"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "store_put"
+            ):
+                yield self.finding(
+                    path, node,
+                    'raw call("store_put", ...) in the serving KV path '
+                    "bypasses the transfer layer; route KV bytes through "
+                    "_internal/transfer.py (put_chunks/fetch_chunk)",
+                )

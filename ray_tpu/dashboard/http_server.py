@@ -193,6 +193,9 @@ class DashboardServer:
             ("GET", "/api/devices"): self._devices,
             # KV-cache plane rollup (prefix hits, block pool, TTFT)
             ("GET", "/api/kvcache"): self._kvcache,
+            # cluster KV-tier rollup (hit/peer_pull/recompute outcomes,
+            # logical vs wire shipment bytes, TTFT by tier)
+            ("GET", "/api/kvtier"): self._kvtier,
             # train fault-tolerance rollup (resizes/restarts/aborts/
             # recovery time) + live run records for chaos tooling
             ("GET", "/api/train"): self._train,
@@ -259,6 +262,11 @@ class DashboardServer:
         from ..util.metrics import kvcache_summary
 
         return 200, kvcache_summary(self._metric_payloads()), None
+
+    def _kvtier(self, body):
+        from ..util.metrics import kvtier_summary
+
+        return 200, kvtier_summary(self._metric_payloads()), None
 
     def _train(self, body):
         import json as _json
@@ -393,6 +401,7 @@ _INDEX_HTML = """<!doctype html>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Devices (HBM)</h2><table id="devices"></table>
 <h2>KV cache</h2><table id="kvcache"></table>
+<h2>KV tier</h2><table id="kvtier"></table>
 <h2>Autoscale</h2><table id="autoscale"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Placement groups</h2><table id="pgs"></table>
@@ -509,6 +518,16 @@ async function refresh() {
       evictions: kv.evictions, blocked: kv.admission_blocked,
       ttft_hit: fmtTtft(ttft.hit), ttft_miss: fmtTtft(ttft.miss),
     }], ["hit_tokens", "computed_tokens", "blocks", "evictions", "blocked", "ttft_hit", "ttft_miss"]);
+    const tier = await j("/api/kvtier");
+    const tierTtft = tier.ttft_ms_by_tier || {};
+    const xfer = tier.transfer_bytes || {};
+    fill("kvtier", [{
+      hit: tier.hit, peer_pull: tier.peer_pull, recompute: tier.recompute,
+      logical_mb: ((xfer.logical || 0) / 1048576).toFixed(2),
+      wire_mb: ((xfer.wire || 0) / 1048576).toFixed(2),
+      ttft_local: fmtTtft(tierTtft.local), ttft_peer: fmtTtft(tierTtft.peer),
+      ttft_miss: fmtTtft(tierTtft.miss),
+    }], ["hit", "peer_pull", "recompute", "logical_mb", "wire_mb", "ttft_local", "ttft_peer", "ttft_miss"]);
     const asc = await j("/api/autoscale");
     const ascSum = asc.summary || {};
     fill("autoscale", (asc.events || []).slice(-10).reverse().map(ev => ({

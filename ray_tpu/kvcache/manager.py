@@ -81,6 +81,11 @@ class KVCacheManager:
         self._assemble_fns: Dict[int, Any] = {}  # block count -> jitted gather
         self._jit_commit = None
         self._jit_copy = None
+        self._jit_adopt = None
+        # (nblocks, tail_len) -> jitted extract / build programs for the
+        # KV-tier shipment paths; bounded like the assemble bucket set
+        self._extract_fns: Dict[tuple, Any] = {}
+        self._build_fns: Dict[tuple, Any] = {}
         self._stats: Dict[str, int] = {
             "requests": 0,
             "hits": 0,
@@ -88,6 +93,7 @@ class KVCacheManager:
             "prefix_hit_tokens": 0,
             "prefill_tokens_computed": 0,
             "admission_blocked": 0,
+            "adopted_blocks": 0,
         }
 
     def adopt_plan(self, plan) -> None:
@@ -109,6 +115,21 @@ class KVCacheManager:
     @property
     def block_size(self) -> int:
         return self._block_size
+
+    @property
+    def ready(self) -> bool:
+        """True once the block pools have been shaped (first commit /
+        initialize); adopt_blocks and build_row require this."""
+        return self._pools is not None
+
+    def cached_blocks(self, token_ids: Sequence[int]) -> int:
+        """Leading full blocks the LOCAL index already holds for this
+        prompt (capped like acquire: the last prompt token is never
+        matched). Takes no references — the tier consult uses this to skip
+        peer pulls that could not beat the local radix."""
+        plen = len(token_ids)
+        max_blocks = (plen - 1) // self._block_size if plen else 0
+        return len(self._index.match(token_ids, max_blocks))
 
     @property
     def capacity(self) -> int:
@@ -267,17 +288,29 @@ class KVCacheManager:
                 for p in pools
             ]
 
+        def adopt_impl(pools, blk_leaves, bid):
+            # blk_leaves: one (..., block_size, d) host block per pool —
+            # a shipped block landing directly in its pool slot
+            return [
+                jax.lax.dynamic_update_index_in_dim(p, blk, bid, axis=0)
+                for p, blk in zip(pools, blk_leaves)
+            ]
+
         # block id / token offset are traced scalars: ONE compiled program
-        # each, reused for every commit and COW copy. Under a plan the
-        # outputs are pinned to the pool sharding so the buffers stay
-        # sharded through every donation cycle (inference would keep them
-        # sharded too, but pinning makes drift impossible).
+        # each, reused for every commit, COW copy and adopted shipment
+        # block. Under a plan the outputs are pinned to the pool sharding
+        # so the buffers stay sharded through every donation cycle
+        # (inference would keep them sharded too, but pinning makes drift
+        # impossible).
         out_sh = [kv_sh] * len(self._pools) if kv_sh is not None else None
         self._jit_commit = jax.jit(
             commit_impl, donate_argnums=(0,), out_shardings=out_sh
         )
         self._jit_copy = jax.jit(
             copy_impl, donate_argnums=(0,), out_shardings=out_sh
+        )
+        self._jit_adopt = jax.jit(
+            adopt_impl, donate_argnums=(0,), out_shardings=out_sh
         )
 
     def assemble(self, lease: KVCacheLease):
@@ -429,6 +462,166 @@ class KVCacheManager:
             self._record_eviction(1)
             bid = self._alloc.allocate()
         return bid
+
+    # -- tier shipment interop ----------------------------------------------
+    #
+    # The KV tier ships committed prefixes between replicas as a payload
+    # pytree: {"blocks": [per-KV-leaf (nblocks, ..., block_size, d)],
+    # "tail": [per-KV-leaf (..., tail_len, d)] or None}. extract_ builds
+    # that payload from a request's dense cache row, adopt_ lands shipped
+    # blocks in the pool + radix index (so later LOCAL requests hit them),
+    # and build_row turns a full payload back into a dense slot row so the
+    # decode engine starts without re-running prefill.
+
+    def extract_row_payload(self, cache_row, ntokens: int):
+        """Slice the first ``ntokens`` tokens of KV out of a dense
+        ``(1, ..., S, d)`` cache row as a shipment payload of host arrays."""
+        if self._pools is None:
+            self.initialize(cache_row)
+        nblocks = ntokens // self._block_size
+        tail_len = ntokens - nblocks * self._block_size
+        fn = self._extract_fns.get((nblocks, tail_len))
+        if fn is None:
+            fn = self._make_extract(nblocks, tail_len)
+            self._extract_fns[(nblocks, tail_len)] = fn
+        kv_row = [
+            leaf
+            for leaf, (kv, _, _) in zip(
+                jax.tree_util.tree_leaves(cache_row), self._leaf_meta
+            )
+            if kv
+        ]
+        blocks, tail = fn(kv_row)
+        from ..llm.engine import host_sync
+
+        return {
+            "blocks": [host_sync(b) for b in blocks],
+            "tail": [host_sync(t) for t in tail] if tail else None,
+        }
+
+    def _make_extract(self, nblocks: int, tail_len: int):
+        bs = self._block_size
+
+        def impl(kv_row):
+            blocks, tail = [], []
+            for r in kv_row:
+                x = r[0]  # (..., S, d)
+                if nblocks:
+                    g = jax.lax.slice_in_dim(x, 0, nblocks * bs, axis=-2)
+                    g = g.reshape(
+                        g.shape[:-2] + (nblocks, bs, g.shape[-1])
+                    )
+                    blocks.append(jnp.moveaxis(g, -3, 0))
+                else:
+                    blocks.append(
+                        jnp.zeros((0,) + x.shape[:-2] + (bs, x.shape[-1]),
+                                  x.dtype)
+                    )
+                if tail_len:
+                    tail.append(
+                        jax.lax.slice_in_dim(
+                            x, nblocks * bs, nblocks * bs + tail_len,
+                            axis=-2,
+                        )
+                    )
+            return blocks, tail
+
+        return jax.jit(impl)
+
+    def adopt_blocks(self, token_ids: Sequence[int], block_leaves,
+                     nblocks: int) -> int:
+        """Admit shipped blocks into the pool + radix index. Walks the
+        first ``nblocks`` full-block keys of ``token_ids``: blocks the
+        index already holds are just touched (COW-safe — a shipped copy
+        never overwrites a live shared block), missing ones get a fresh
+        pool slot. Allocation failure stops the walk — partial adoption in
+        chain order keeps the prefix property, and the un-adopted suffix
+        is simply recomputed (admission backpressure, not an error).
+        Returns how many leading blocks the index holds afterwards."""
+        if self._pools is None:
+            raise RuntimeError(
+                "adopt_blocks() before the pools are initialized"
+            )
+        present = 0
+        adopted = 0
+        node = self._index.root
+        for i in range(nblocks):
+            key = tuple(
+                int(t)
+                for t in token_ids[
+                    i * self._block_size : (i + 1) * self._block_size
+                ]
+            )
+            child = self._index.child(node, key)
+            if child is None:
+                bid = self._allocate_or_evict()
+                if bid is None:
+                    break
+                self._pools = list(
+                    self._jit_adopt(
+                        self._pools,
+                        [leaf[i] for leaf in block_leaves],
+                        jnp.asarray(bid, jnp.int32),
+                    )
+                )
+                child = self._index.insert_child(node, key, bid)
+                adopted += 1
+            else:
+                self._index.touch(child)
+            present += 1
+            node = child
+        if adopted:
+            self._stats["adopted_blocks"] += adopted
+        self._update_gauges()
+        return present
+
+    def build_row(self, payload, ntokens: int):
+        """Turn a FULL shipment payload (blocks + tail covering exactly
+        ``ntokens``) back into a dense cache row with the write position
+        set past the whole prompt — the zero-prefill decode entry point."""
+        if self._pools is None:
+            raise RuntimeError("build_row() before the pools are initialized")
+        nblocks = ntokens // self._block_size
+        tail_len = ntokens - nblocks * self._block_size
+        fn = self._build_fns.get((nblocks, tail_len))
+        if fn is None:
+            fn = self._make_build(nblocks, tail_len)
+            self._build_fns[(nblocks, tail_len)] = fn
+        kv_out = list(fn(payload["blocks"], payload["tail"]))
+        leaves = []
+        for kv, shape, dtype in self._leaf_meta:
+            if kv:
+                leaves.append(kv_out.pop(0))
+            else:
+                leaves.append(jnp.full(shape, ntokens, dtype))
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def _make_build(self, nblocks: int, tail_len: int):
+        bs = self._block_size
+        seq_len = self._max_seq_len
+
+        def impl(blocks, tail):
+            out = []
+            for i, b in enumerate(blocks):
+                g = jnp.moveaxis(b, 0, -3)  # (..., nblocks, bs, d)
+                g = g.reshape(g.shape[:-3] + (nblocks * bs, g.shape[-1]))
+                if tail_len:
+                    g = jnp.concatenate([g, tail[i]], axis=-2)
+                pad = [(0, 0)] * (g.ndim - 2) + [
+                    (0, seq_len - nblocks * bs - tail_len),
+                    (0, 0),
+                ]
+                out.append(jnp.pad(g, pad)[None])  # (1, ..., S, d)
+            return out
+
+        if self._plan is not None:
+            # built rows feed the sharded decode program directly: land
+            # them in the KV layout (heads over tp), not replicated
+            return jax.jit(
+                impl,
+                out_shardings=[self._plan.kv_sharding()] * len(self._pools),
+            )
+        return jax.jit(impl)
 
     # -- metrics -------------------------------------------------------------
 

@@ -1,0 +1,34 @@
+"""Cluster-wide KV prefix tier: ship committed KV blocks between replicas.
+
+The per-replica radix :class:`~ray_tpu.kvcache.prefix_index.PrefixIndex`
+makes repeated prefixes cheap *on one replica*; this package makes them
+cheap on EVERY replica. A replica that commits a cacheable prefix exports
+the blocks through the shared pinned-buffer transfer layer
+(``_internal/transfer.py``) and registers a fingerprint chain with the GCS
+tier registry; any replica — including a fresh autoscale scale-up that has
+computed nothing — resolves a warm prefix **local-hit → peer-pull →
+recompute**, in that order. The same shipment machinery carries the
+directed prefill→decode handoff of disaggregated serving, where the decode
+replica adopts the shipped blocks (plus the tail fragment and the first
+sampled token) and starts decoding with zero prefill-computed tokens.
+"""
+
+from .fingerprint import block_fingerprints
+from .shipping import KVShipment, decode_payload, encode_payload
+from .tier import (
+    GcsTierBackend,
+    KVTierClient,
+    LocalTierBackend,
+    PulledPrefix,
+)
+
+__all__ = [
+    "KVShipment",
+    "KVTierClient",
+    "GcsTierBackend",
+    "LocalTierBackend",
+    "PulledPrefix",
+    "block_fingerprints",
+    "encode_payload",
+    "decode_payload",
+]

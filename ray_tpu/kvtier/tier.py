@@ -1,0 +1,565 @@
+"""KVTierClient: replica-side access to the cluster KV prefix tier.
+
+Holder side: ``export_and_register`` encodes a committed prefix payload,
+stores the chunks as pinned plasma objects through the shared transfer
+layer, and registers the fingerprint chain with the GCS tier registry.
+The client holds the chunk refs until the registry's LRU evicts the entry
+(notice drained on the next register/collect) — the weight-publisher
+held-refs contract, applied to KV.
+
+Puller side: ``pull`` resolves a prompt's fingerprint chain longest-first,
+leases the winning entry against eviction, probes the holder's
+reachability (2 s bound — a SIGKILLed holder costs the probe, not the
+10 s connect window) and fetches the payload with ``prefer_source``
+pinned at the holder. Every failure mode — resolve miss, lease conflict,
+dead holder, vanished chunks — degrades to ``None``, which the engine
+treats as *recompute*; a tier problem can slow a request but never fail
+one.
+
+Two backends: :class:`GcsTierBackend` (cluster mode — GCS registry +
+plasma chunks) and :class:`LocalTierBackend` (clusterless tests/bench —
+the REAL :class:`~ray_tpu.runtime.gcs.kvtier_registry.GcsKVTierRegistry`
+logic over an in-process shim, with an inline chunk store and a
+``kill_holder`` switch that simulates a SIGKILLed peer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from .._internal.transfer import DeadHolderError
+from .fingerprint import block_fingerprints
+from .shipping import (
+    DEFAULT_CHUNK_SIZE,
+    KVShipment,
+    decode_payload,
+    encode_payload,
+)
+
+
+def _record_outcome(outcome: str) -> None:
+    try:
+        from ..util.metrics import record_kvtier
+
+        record_kvtier(outcome)
+    except Exception:
+        pass
+
+
+def _record_transfer(logical: int, wire: int) -> None:
+    try:
+        from ..util.metrics import record_kvtier_transfer
+
+        record_kvtier_transfer(logical, wire)
+    except Exception:
+        pass
+
+
+@dataclasses.dataclass
+class PulledPrefix:
+    """Result of a successful peer pull: the decoded payload plus how much
+    of OUR prompt it covers. ``exact`` means the shipment covers the whole
+    prompt token-for-token and carries the first sampled token — the
+    zero-prefill fast path."""
+
+    shipment: KVShipment
+    payload: Any
+    matched_blocks: int
+    exact: bool
+
+
+class KVTierClient:
+    def __init__(self, model: str, backend, block_size: int,
+                 codec: str = "raw",
+                 chunk_size: int = DEFAULT_CHUNK_SIZE,
+                 holder_id: Optional[str] = None):
+        self.model = model
+        self.backend = backend
+        self.block_size = int(block_size)
+        self.codec = codec
+        self.chunk_size = chunk_size
+        self.holder_id = holder_id or uuid.uuid4().hex[:12]
+        # tail fingerprint -> entry_id: what this replica already shipped
+        # (re-registering an identical prefix would churn the registry)
+        self._registered: Dict[str, int] = {}
+        self._exports: Dict[int, Any] = {}  # entry_id -> backend handle
+        # unregistered directed-handoff exports: a bounded FIFO so the
+        # chunks outlive the prefill->decode fetch without an extra
+        # release RPC; overflow drops the oldest (the fetch happens
+        # immediately after the handoff, so the window is generous)
+        self._direct: List[Any] = []
+        self._direct_max = 64
+
+    # -- holder side -------------------------------------------------------
+
+    def should_export(self, token_ids, nblocks: int) -> bool:
+        """Cheap pre-check: would export_and_register register anything?
+        Lets the engine skip the device->host extraction for prefixes this
+        replica already shipped."""
+        if nblocks <= 0:
+            return False
+        fps = block_fingerprints(token_ids, self.block_size)[:nblocks]
+        return bool(fps) and fps[-1] not in self._registered
+
+    def _encode(self, token_ids, payload, nblocks: int,
+                first_token: Optional[int]):
+        covered = [int(t) for t in token_ids]
+        treedef_blob, values, logical, wire = encode_payload(
+            payload, self.codec, self.chunk_size
+        )
+        shipment = KVShipment(
+            model=self.model,
+            token_ids=covered,
+            block_size=self.block_size,
+            nblocks=nblocks,
+            codec=self.codec,
+            treedef_blob=treedef_blob,
+            chunks=[],
+            first_token=first_token,
+            logical_bytes=logical,
+            wire_bytes=wire,
+        )
+        return shipment, values
+
+    def _register(self, shipment: KVShipment, handle, tail_fp: str) -> None:
+        reply = self.backend.register(shipment, self.holder_id)
+        entry_id = int(reply["entry_id"])
+        self._registered[tail_fp] = entry_id
+        self._exports[entry_id] = handle
+        try:
+            from ..util import events
+
+            events.record_event(
+                events.KV_SHIPPED,
+                model=self.model, entry_id=entry_id,
+                nblocks=shipment.nblocks, ntokens=shipment.ntokens,
+                codec=self.codec,
+                logical_bytes=shipment.logical_bytes,
+                wire_bytes=shipment.wire_bytes,
+                first_token=shipment.first_token is not None,
+            )
+        except Exception:
+            pass
+        self._drain(reply.get("released") or ())
+
+    def export_and_register(self, token_ids, payload, nblocks: int,
+                            first_token: Optional[int] = None
+                            ) -> Optional[KVShipment]:
+        """Ship a committed prefix into the tier; returns the shipment, or
+        None when nothing registrable (no full blocks / already shipped)."""
+        if nblocks <= 0:
+            return None
+        fps = block_fingerprints(token_ids, self.block_size)[:nblocks]
+        if not fps or fps[-1] in self._registered:
+            return None
+        shipment, values = self._encode(
+            token_ids, payload, nblocks, first_token
+        )
+        shipment, handle = self.backend.export(
+            shipment, values, self.holder_id
+        )
+        self._register(shipment, handle, fps[-1])
+        return shipment
+
+    def ship_direct(self, token_ids, payload, nblocks: int,
+                    first_token: Optional[int] = None) -> KVShipment:
+        """Directed prefill->decode handoff: ALWAYS export (the consumer
+        needs this request's tail + first token even when the prefix entry
+        already exists); register as a tier entry too when the fingerprint
+        chain is new, otherwise park the chunks in the bounded direct
+        FIFO."""
+        shipment, values = self._encode(
+            token_ids, payload, nblocks, first_token
+        )
+        shipment, handle = self.backend.export(
+            shipment, values, self.holder_id
+        )
+        fps = shipment.fingerprints()
+        if fps and fps[-1] not in self._registered:
+            self._register(shipment, handle, fps[-1])
+        else:
+            self._direct.append(handle)
+            while len(self._direct) > self._direct_max:
+                self.backend.drop(self._direct.pop(0))
+        return shipment
+
+    def collect(self) -> int:
+        """Drain pending eviction notices (register also drains); returns
+        the number of entries dropped."""
+        reply = self.backend.collect(self.holder_id)
+        return self._drain(reply.get("released") or ())
+
+    def _drain(self, released) -> int:
+        n = 0
+        for entry_id in released:
+            handle = self._exports.pop(int(entry_id), None)
+            if handle is None:
+                continue
+            self.backend.drop(handle)
+            n += 1
+            try:
+                from ..util import events
+
+                events.record_event(
+                    events.KVTIER_EVICT,
+                    model=self.model, entry_id=int(entry_id),
+                    holder_id=self.holder_id,
+                )
+            except Exception:
+                pass
+        if n:
+            self._registered = {
+                fp: eid for fp, eid in self._registered.items()
+                if eid in self._exports
+            }
+        return n
+
+    def close(self):
+        """Deregister + free everything this replica shipped."""
+        if self._exports:
+            try:
+                self.backend.evict(list(self._exports), self.holder_id)
+            except Exception:
+                pass
+            for handle in self._exports.values():
+                try:
+                    self.backend.drop(handle)
+                except Exception:
+                    pass
+            self._exports.clear()
+            self._registered.clear()
+        while self._direct:
+            try:
+                self.backend.drop(self._direct.pop())
+            except Exception:
+                pass
+
+    # -- puller side -------------------------------------------------------
+
+    def pull(self, token_ids,
+             min_blocks: int = 0) -> Optional[PulledPrefix]:
+        """local-miss path: resolve → lease → probe+fetch → decode.
+        ``min_blocks`` is how many leading blocks the caller's LOCAL index
+        already covers — an entry no deeper than that is a local hit, not
+        a tier event, so it is skipped without counters or transfer.
+        None == serve locally / recompute (every failure mode lands here;
+        the counters record which)."""
+        fps = block_fingerprints(token_ids, self.block_size)
+        if not fps:
+            return None
+        resolved = self.backend.resolve(self.model, list(reversed(fps)))
+        if resolved is None:
+            _record_outcome("recompute")
+            return None
+        if resolved.get("holder_id") == self.holder_id:
+            # our own entry: the local radix index is the fast path for
+            # these; a pull through the store would be a pointless copy
+            return None
+        matched = fps.index(resolved["fp"]) + 1
+        if matched <= min_blocks:
+            return None  # local index already covers it: not a tier event
+        _record_outcome("hit")
+        shipment = KVShipment.from_blob(resolved["blob"])
+        lease_id = uuid.uuid4().hex[:12]
+        entry_id = int(resolved["entry_id"])
+        if not self.backend.lease(entry_id, lease_id):
+            _record_outcome("recompute")
+            return None
+        try:
+            payload = self.backend.fetch_payload(
+                shipment, tuple(resolved["holder"])
+            )
+        except DeadHolderError:
+            _record_outcome("recompute")
+            return None
+        except Exception:
+            _record_outcome("recompute")
+            return None
+        finally:
+            try:
+                self.backend.release_lease(entry_id, lease_id)
+            except Exception:
+                pass
+        _record_outcome("peer_pull")
+        _record_transfer(shipment.logical_bytes, shipment.wire_bytes)
+        prompt = [int(t) for t in token_ids]
+        exact = (
+            matched == len(fps)
+            and shipment.first_token is not None
+            and shipment.ntokens == len(prompt)
+            and list(shipment.token_ids) == prompt
+        )
+        return PulledPrefix(
+            shipment=shipment, payload=payload,
+            matched_blocks=matched, exact=exact,
+        )
+
+    def fetch_shipment(self, shipment: KVShipment) -> Optional[Any]:
+        """Directed handoff (ingress prefill→decode): fetch a known
+        shipment's payload from its holder. None == recompute."""
+        try:
+            payload = self.backend.fetch_payload(
+                shipment, self.backend.holder_of(shipment)
+            )
+        except Exception:
+            _record_outcome("recompute")
+            return None
+        _record_outcome("peer_pull")
+        _record_transfer(shipment.logical_bytes, shipment.wire_bytes)
+        return payload
+
+    def stats(self) -> dict:
+        out = {
+            "holder_id": self.holder_id,
+            "exported_entries": len(self._exports),
+        }
+        try:
+            out["registry"] = self.backend.stats()
+        except Exception:
+            pass
+        return out
+
+
+# -- backends ----------------------------------------------------------------
+
+
+class GcsTierBackend:
+    """Cluster backend: GCS registry RPCs + plasma chunks through the
+    shared transfer layer. Must run inside a worker process."""
+
+    def _worker(self):
+        from .. import _worker_api
+
+        return _worker_api.get_core_worker()
+
+    def _call(self, method: str, *args):
+        from .. import _worker_api
+
+        worker = self._worker()
+        return _worker_api.run_on_worker_loop(
+            worker.client_pool.get(*worker.gcs_address).call(method, *args)
+        )
+
+    def export(self, shipment: KVShipment, chunk_values: List[list],
+               holder_id: str) -> Tuple[KVShipment, Any]:
+        from .. import _worker_api
+        from .._internal import transfer
+        from ..object_ref import ObjectRef
+        from ..weights.manifest import ChunkInfo, chunk_logical_bytes
+
+        worker = self._worker()
+
+        async def _store():
+            return await transfer.put_chunks(worker, chunk_values, pin=True)
+
+        stored = _worker_api.run_on_worker_loop(_store())
+        infos, refs, oids = [], [], []
+        for value, (oid, size) in zip(chunk_values, stored):
+            refs.append(ObjectRef(oid, worker.address))
+            oids.append(oid)
+            infos.append(ChunkInfo(
+                object_id=oid,
+                owner_address=tuple(worker.address),
+                size=size,
+                num_leaves=len(value),
+                codec=shipment.codec,
+                logical_size=chunk_logical_bytes(value),
+            ))
+        shipment.chunks = infos
+        return shipment, (refs, oids)
+
+    def register(self, shipment: KVShipment, holder_id: str) -> dict:
+        worker = self._worker()
+        return self._call(
+            "kvtier_register", shipment.model, shipment.fingerprints(),
+            holder_id, tuple(worker.raylet_address), shipment.to_blob(),
+            {
+                "nblocks": shipment.nblocks,
+                "wire_bytes": shipment.wire_bytes,
+                "logical_bytes": shipment.logical_bytes,
+            },
+        )
+
+    def resolve(self, model: str, fps: List[str]) -> Optional[dict]:
+        return self._call("kvtier_resolve", model, fps)
+
+    def lease(self, entry_id: int, lease_id: str) -> bool:
+        return bool(self._call("kvtier_lease", entry_id, lease_id))
+
+    def release_lease(self, entry_id: int, lease_id: str) -> None:
+        self._call("kvtier_release", entry_id, lease_id)
+
+    def evict(self, entry_ids: List[int], holder_id: str) -> None:
+        self._call("kvtier_evict", list(entry_ids), holder_id)
+
+    def collect(self, holder_id: str) -> dict:
+        return self._call("kvtier_collect", holder_id)
+
+    def fetch_payload(self, shipment: KVShipment, holder) -> Any:
+        from .. import _worker_api
+        from .._internal import transfer
+
+        worker = self._worker()
+
+        async def _fetch():
+            import asyncio
+
+            return list(await asyncio.gather(*[
+                transfer.fetch_chunk(
+                    worker, chunk, tuple(holder),
+                    probe_source=True, require_source=True,
+                )
+                for chunk in shipment.chunks
+            ]))
+
+        values = _worker_api.run_on_worker_loop(_fetch())
+        return decode_payload(shipment.treedef_blob, values)
+
+    def holder_of(self, shipment: KVShipment):
+        # chunk owner == the exporting worker; its raylet serves the pull
+        return tuple(shipment.chunks[0].owner_address) if shipment.chunks \
+            else None
+
+    def drop(self, handle) -> None:
+        from .. import _worker_api
+        from .._internal import transfer
+
+        refs, oids = handle
+        worker = self._worker()
+        try:
+            _worker_api.run_on_worker_loop(
+                transfer.unpin_chunks(worker, oids)
+            )
+        except Exception:
+            pass
+        refs.clear()  # dropping the refs is the actual free
+
+    def stats(self) -> dict:
+        return self._call("kvtier_stats")
+
+
+class _LocalGcsShim:
+    """Just enough of GcsServer for GcsKVTierRegistry to run in-process."""
+
+    class _NullPublisher:
+        def publish(self, *_a, **_k):
+            pass
+
+    def __init__(self, max_entries: int, lease_s: float):
+        import types
+
+        self._kv: Dict[str, bytes] = {}
+        self.config = types.SimpleNamespace(
+            kvtier_max_entries=max_entries, kvtier_lease_s=lease_s
+        )
+        self.publisher = self._NullPublisher()
+
+
+class LocalTierBackend:
+    """Clusterless backend: the real registry logic + an inline chunk
+    store. Shared by every engine in one process (tests, bench), so two
+    in-proc "replicas" exercise the identical register/resolve/lease/evict
+    protocol the cluster runs — only the byte transport is inline."""
+
+    def __init__(self, max_entries: int = 4096, lease_s: float = 60.0):
+        from ..runtime.gcs.kvtier_registry import GcsKVTierRegistry
+
+        self._lock = threading.Lock()
+        self.registry = GcsKVTierRegistry(
+            _LocalGcsShim(max_entries, lease_s)
+        )
+        self._store: Dict[bytes, list] = {}  # oid -> chunk leaf values
+        self._chunk_holder: Dict[bytes, str] = {}
+        self._dead: set = set()  # holder_ids "SIGKILLed" by the test
+
+    def kill_holder(self, holder_id: str) -> None:
+        """Simulate a SIGKILLed holder: its chunks vanish, its registry
+        entries remain (stale — exactly the state a real kill leaves until
+        the death sweep runs), so pulls hit the dead-holder path."""
+        with self._lock:
+            self._dead.add(holder_id)
+            for oid, hid in list(self._chunk_holder.items()):
+                if hid == holder_id:
+                    self._store.pop(oid, None)
+
+    def export(self, shipment: KVShipment, chunk_values: List[list],
+               holder_id: str) -> Tuple[KVShipment, Any]:
+        from ..weights.manifest import ChunkInfo, chunk_logical_bytes
+
+        infos, oids = [], []
+        with self._lock:
+            for value in chunk_values:
+                oid = uuid.uuid4().bytes[:8]
+                self._store[oid] = value
+                self._chunk_holder[oid] = holder_id
+                oids.append(oid)
+                infos.append(ChunkInfo(
+                    object_id=oid,
+                    owner_address=("local", 0),
+                    size=chunk_logical_bytes(value),
+                    num_leaves=len(value),
+                    codec=shipment.codec,
+                    logical_size=chunk_logical_bytes(value),
+                ))
+        shipment.chunks = infos
+        return shipment, oids
+
+    def register(self, shipment: KVShipment, holder_id: str) -> dict:
+        with self._lock:
+            return self.registry.register(
+                shipment.model, shipment.fingerprints(), holder_id,
+                ("local", 0), shipment.to_blob(),
+                {
+                    "nblocks": shipment.nblocks,
+                    "wire_bytes": shipment.wire_bytes,
+                    "logical_bytes": shipment.logical_bytes,
+                },
+            )
+
+    def resolve(self, model: str, fps: List[str]) -> Optional[dict]:
+        with self._lock:
+            return self.registry.resolve(model, fps)
+
+    def lease(self, entry_id: int, lease_id: str) -> bool:
+        with self._lock:
+            return self.registry.lease(entry_id, lease_id)
+
+    def release_lease(self, entry_id: int, lease_id: str) -> None:
+        with self._lock:
+            self.registry.release(entry_id, lease_id)
+
+    def evict(self, entry_ids: List[int], holder_id: str) -> None:
+        with self._lock:
+            self.registry.evict(list(entry_ids), holder_id)
+
+    def collect(self, holder_id: str) -> dict:
+        with self._lock:
+            return self.registry.collect(holder_id)
+
+    def fetch_payload(self, shipment: KVShipment, holder) -> Any:
+        with self._lock:
+            values = []
+            for chunk in shipment.chunks:
+                hid = self._chunk_holder.get(chunk.object_id)
+                if hid in self._dead or chunk.object_id not in self._store:
+                    raise DeadHolderError(
+                        f"holder of chunk {chunk.object_id!r} is gone"
+                    )
+                values.append(self._store[chunk.object_id])
+        return decode_payload(shipment.treedef_blob, values)
+
+    def holder_of(self, shipment: KVShipment):
+        return ("local", 0)
+
+    def drop(self, handle) -> None:
+        with self._lock:
+            for oid in handle:
+                self._store.pop(oid, None)
+                self._chunk_holder.pop(oid, None)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return self.registry.stats()

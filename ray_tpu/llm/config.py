@@ -68,6 +68,25 @@ class LLMConfig:
     # (f32) fewer bytes; replicas dequantize at assembly straight into
     # their sharded layout
     quantized: bool = False
+    # disaggregated prefill/decode serving: roles={"prefill": N,
+    # "decode": M} splits the deployment into N prefill replicas (run
+    # admission prefill only, ship committed KV) and M decode replicas
+    # (adopt shipped blocks, decode without re-running prefill) behind an
+    # ingress that routes the handoff. Requires kv_cache_blocks. None
+    # keeps the fused single-role deployment.
+    roles: Optional[Dict[str, int]] = None
+    # join the cluster-wide KV prefix tier (ray_tpu.kvtier): replicas
+    # register computed prefixes and resolve warm ones local-hit →
+    # peer-pull → recompute. Implied for role replicas (the handoff rides
+    # the same machinery); set True to let a fused deployment share
+    # prefixes across replicas and autoscale scale-ups. Requires
+    # kv_cache_blocks.
+    kv_tier: bool = False
+    # chunk codec for KV shipments ("raw" | "int8"): int8 halves (bf16) /
+    # quarters (f32) the prefill→decode and peer-pull wire bytes, paid
+    # with a bounded per-block quantization error (same codec as the
+    # quantized weight plane)
+    kv_ship_codec: str = "raw"
 
     def __post_init__(self):
         if self.mesh is not None:
@@ -86,6 +105,30 @@ class LLMConfig:
                         f"LLMConfig.mesh[{axis!r}] must be a positive "
                         f"int, got {size!r}"
                     )
+        if self.kv_ship_codec not in ("raw", "int8"):
+            raise ValueError(
+                f"LLMConfig.kv_ship_codec must be 'raw' or 'int8', got "
+                f"{self.kv_ship_codec!r}"
+            )
+        if self.roles is not None:
+            unknown = set(self.roles) - {"prefill", "decode"}
+            if unknown:
+                raise ValueError(
+                    f"LLMConfig.roles keys {sorted(unknown)} not "
+                    "supported; use 'prefill' and 'decode'"
+                )
+            for role_name in ("prefill", "decode"):
+                count = self.roles.get(role_name)
+                if not isinstance(count, int) or count < 1:
+                    raise ValueError(
+                        f"LLMConfig.roles[{role_name!r}] must be a "
+                        f"positive int, got {count!r}"
+                    )
+        if (self.roles is not None or self.kv_tier) and not self.kv_cache_blocks:
+            raise ValueError(
+                "disaggregated roles / kv_tier need the paged engine: "
+                "set kv_cache_blocks"
+            )
 
     def effective_parallelism(self) -> tuple:
         """(tp, sp) with ``mesh`` winning over the scalar fields."""

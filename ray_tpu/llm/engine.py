@@ -44,11 +44,15 @@ def _resolve_seed(seed: Optional[int]) -> int:
     return int.from_bytes(os.urandom(4), "little")
 
 
-def _record_ttft(seconds: float, hit: bool, mesh: str = "tp=1") -> None:
+def _record_ttft(seconds: float, hit: bool, mesh: str = "tp=1",
+                 tier: str = "local") -> None:
+    """tier: where the prefix KV came from — "local" (this replica's radix
+    index), "peer" (pulled/shipped through the KV tier), "miss" (computed
+    from scratch)."""
     try:
         from ..util.metrics import record_kvcache_ttft
 
-        record_kvcache_ttft(seconds, hit, mesh=mesh)
+        record_kvcache_ttft(seconds, hit, mesh=mesh, tier=tier)
     except Exception:
         pass
 
@@ -345,11 +349,14 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         kv_cache=None,
         seed: Optional[int] = None,
         plan=None,
+        kv_tier=None,
     ):
         super().__init__(model_config, params, mesh, plan=plan)
         self._num_slots = num_slots
         self._slots: Dict[int, _Slot] = {}  # slot index -> active request
-        self._pending: List[tuple] = []  # (request_id, GenerationRequest)
+        # (request_id, GenerationRequest, shipment-or-None): the third
+        # element carries a directed prefill->decode handoff
+        self._pending: List[tuple] = []
         self._next_id = 0
         self._rng = jax.random.PRNGKey(_resolve_seed(seed))
         self._step_count = 0
@@ -363,6 +370,12 @@ class ContinuousBatchingEngine(_DecodeModelBase):
             # the manager's block pools must live in the same sharded
             # layout as the decode cache they exchange rows with
             kv_cache.adopt_plan(self._plan)
+        # cluster KV prefix tier (ray_tpu.kvtier.KVTierClient) or None.
+        # With a tier, admission resolves warm prefixes local-hit ->
+        # peer-pull -> recompute, adopted blocks land in the paged pool,
+        # and computed prefixes are exported for the rest of the cluster.
+        # Requires a kv_cache (the tier ships paged blocks).
+        self._tier = kv_tier
         # serve replicas call sync methods from a thread pool: every public
         # entry point serializes on this (reentrant: step() inside generate)
         self._lock = threading.RLock()
@@ -397,7 +410,12 @@ class ContinuousBatchingEngine(_DecodeModelBase):
 
     # -- public API ----------------------------------------------------------
 
-    def add_request(self, request: GenerationRequest) -> int:
+    def add_request(self, request: GenerationRequest,
+                    shipment=None) -> int:
+        """``shipment`` is an optional directed KV handoff: a
+        ``(KVShipment, payload)`` pair from a prefill replica (fetched by
+        the caller through the tier backend). Admission adopts the shipped
+        blocks instead of re-running prefill."""
         if len(request.token_ids) + request.max_new_tokens > self._cfg.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
         tr = None
@@ -406,7 +424,7 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         with self._lock:
             rid = self._next_id
             self._next_id += 1
-            self._pending.append((rid, request))
+            self._pending.append((rid, request, shipment))
             self._enqueue_ts[rid] = time.monotonic()
             if tr is not None:
                 self._req_trace[rid] = tr
@@ -526,6 +544,20 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                         self._finished_buf[frid] = res
         return [out[rid] for rid in rids]
 
+    def generate_one(self, request: GenerationRequest,
+                     shipment=None) -> GenerationResult:
+        """generate() for ONE request, with an optional directed KV
+        shipment (see add_request) — the decode-role entry point."""
+        rid = self.add_request(request, shipment=shipment)
+        while True:
+            with self._lock:
+                if rid in self._finished_buf:
+                    return self._finished_buf.pop(rid)
+                for frid, res in self._step_locked():
+                    if frid == rid:
+                        return res
+                    self._finished_buf[frid] = res
+
     def generate_stream(self, request: GenerationRequest):
         """Streaming API matching LLMEngine.generate_stream: yields each
         token of ONE request as the shared pool produces it, then the
@@ -576,19 +608,48 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         request goes back to the HEAD of the pending queue and admission
         stops, preserving FIFO order, until a retiring request releases
         blocks. Cached prefixes are gathered into the slot row and only the
-        uncached suffix is prefilled."""
+        uncached suffix is prefilled.
+
+        With a KV tier on top, resolution is local-hit → peer-pull →
+        recompute: a prompt the local radix can't cover consults the tier
+        and adopts pulled blocks before acquiring. A directed shipment
+        (disaggregated decode) or an exact tier hit that carries the whole
+        prompt plus the first sampled token takes the zero-prefill fast
+        path — the shipped payload becomes the slot row outright."""
         finished: List[tuple] = []
         free = [i for i in range(self._num_slots) if i not in self._slots]
         while free and self._pending:
             si = free.pop(0)
-            rid, req = self._pending.pop(0)
+            rid, req, ship = self._pending.pop(0)
             tr = self._req_trace.get(rid)
+            plen = len(req.token_ids)
+            pulled = None
+            if self._kv is not None:
+                if ship is not None:
+                    pulled = self._as_pulled(ship, req)
+                elif self._tier is not None:
+                    local = self._kv.cached_blocks(req.token_ids)
+                    if local < (plen - 1) // self._kv.block_size:
+                        pulled = self._tier.pull(
+                            req.token_ids, min_blocks=local
+                        )
+            fast = pulled is not None and pulled.exact
+            tier_src = "peer" if pulled is not None else None
             lease = None
             if self._kv is not None:
                 kv_t0 = time.time() if tr else 0.0
+                if pulled is not None:
+                    # shipped blocks land in the pool + radix BEFORE the
+                    # acquire, so the lease pins them like any local hit
+                    self._ensure_kv_ready()
+                    self._kv.adopt_blocks(
+                        req.token_ids, pulled.payload["blocks"],
+                        pulled.shipment.nblocks if fast
+                        else pulled.matched_blocks,
+                    )
                 lease = self._kv.acquire(req.token_ids)
                 if lease is None:  # backpressure: wait for a release
-                    self._pending.insert(0, (rid, req))
+                    self._pending.insert(0, (rid, req, ship))
                     if rid not in self._blocked_rids:
                         self._blocked_rids.add(rid)
                         _events.record_event(
@@ -614,45 +675,77 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                     now - tr["wall"], category="engine", request_id=rid,
                 )
             pf_wall = time.time() if tr else 0.0
-            logits, solo_cache = self._prefill_leased(
-                req, lease, trace=tr
-            )
+            if fast:
+                # zero-prefill: the payload covers every prompt token and
+                # the first token was sampled by the shipping replica
+                solo_cache = self._kv.build_row(pulled.payload, plen)
+                first = int(pulled.shipment.first_token)
+            else:
+                logits, solo_cache = self._prefill_leased(
+                    req, lease, trace=tr
+                )
+                first = int(
+                    self._sample_tokens(
+                        logits,
+                        np.array([max(req.temperature, 0.0)], np.float32),
+                        jax.random.fold_in(self._rng, rid),
+                    )[0]
+                )
             if tr:
-                cached = lease.num_cached_tokens if lease is not None else 0
+                cached = (
+                    plen if fast
+                    else lease.num_cached_tokens if lease is not None
+                    else 0
+                )
                 _tracing.emit_span(
                     "engine.prefill", tr["ctx"], pf_wall,
                     time.time() - pf_wall, category="engine",
                     request_id=rid, cached_tokens=cached,
-                    computed_tokens=len(req.token_ids) - cached,
-                    hit=cached > 0,
+                    computed_tokens=plen - cached,
+                    hit=cached > 0, tier=tier_src or "local",
                     mesh=self._mesh_tag,
                 )
-            first = int(
-                self._sample_tokens(
-                    logits,
-                    np.array([max(req.temperature, 0.0)], np.float32),
-                    jax.random.fold_in(self._rng, rid),
-                )[0]
-            )
             ts = self._enqueue_ts.pop(rid, None)
             if self._kv is not None:
-                cached = lease.num_cached_tokens
-                self._kv.record_prefill(cached, len(req.token_ids) - cached)
+                cached = plen if fast else lease.num_cached_tokens
+                self._kv.record_prefill(cached, plen - cached)
                 if ts is not None:
                     _record_ttft(
                         time.monotonic() - ts, hit=cached > 0,
                         mesh=self._mesh_tag,
+                        tier=tier_src
+                        or ("local" if cached > 0 else "miss"),
                     )
-                # commit the prompt's full blocks while the prefilled row
-                # is at hand; reserved blocks are consumed here
-                cm_t0 = time.time() if tr else 0.0
-                self._kv.commit(lease, req.token_ids, solo_cache)
-                if tr:
-                    _tracing.emit_span(
-                        "kvcache.commit", tr["ctx"], cm_t0,
-                        time.time() - cm_t0, category="kvcache",
-                        request_id=rid, tokens=len(req.token_ids),
-                    )
+                if not fast:
+                    # commit the prompt's full blocks while the prefilled
+                    # row is at hand; reserved blocks are consumed here
+                    # (the fast path adopted them instead)
+                    cm_t0 = time.time() if tr else 0.0
+                    self._kv.commit(lease, req.token_ids, solo_cache)
+                    if tr:
+                        _tracing.emit_span(
+                            "kvcache.commit", tr["ctx"], cm_t0,
+                            time.time() - cm_t0, category="kvcache",
+                            request_id=rid, tokens=len(req.token_ids),
+                        )
+                    if (
+                        self._tier is not None
+                        and lease.cacheable
+                        and self._tier.should_export(
+                            req.token_ids, plen // self._kv.block_size
+                        )
+                    ):
+                        # first computation of this prefix here: publish
+                        # it so every other replica (and fresh scale-ups)
+                        # can peer-pull instead of recomputing
+                        payload = self._kv.extract_row_payload(
+                            solo_cache, plen
+                        )
+                        self._tier.export_and_register(
+                            req.token_ids, payload,
+                            plen // self._kv.block_size,
+                            first_token=first,
+                        )
             if self._cache is None:
                 self._cache = self._empty_cache(solo_cache)
             # insert the prefilled K/V row + its write position into slot si
@@ -680,6 +773,98 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                 continue
             self._slots[si] = slot
         return finished
+
+    def _ensure_kv_ready(self) -> None:
+        """Shape the manager's block pools before the first adopt/build.
+        A scale-up replica's first request can arrive via the tier before
+        it has computed ANY prefill, so the pools are shaped from
+        eval_shape of the prefill program — no compute, just structure."""
+        if self._kv.ready:
+            return
+        cache_shape = jax.eval_shape(
+            self._prefill_impl, self._params,
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        )[1]
+        self._kv.initialize(
+            jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), cache_shape
+            )
+        )
+
+    @staticmethod
+    def _as_pulled(ship, req: GenerationRequest):
+        """Normalize a directed (KVShipment, payload) handoff into the
+        same shape a tier pull returns, trimmed to OUR prompt: matched
+        blocks is the common full-block prefix, exact means the payload
+        covers the whole prompt token-for-token with a first token."""
+        from ..kvtier import PulledPrefix
+
+        shipment, payload = ship
+        prompt = [int(t) for t in req.token_ids]
+        bs = shipment.block_size
+        nb = 0
+        for i in range(min(shipment.nblocks, len(prompt) // bs)):
+            if (
+                prompt[i * bs : (i + 1) * bs]
+                == [int(t) for t in shipment.token_ids[i * bs : (i + 1) * bs]]
+            ):
+                nb += 1
+            else:
+                break
+        exact = (
+            shipment.first_token is not None
+            and shipment.ntokens == len(prompt)
+            and [int(t) for t in shipment.token_ids] == prompt
+        )
+        if nb == 0 and not exact:
+            return None
+        return PulledPrefix(
+            shipment=shipment, payload=payload,
+            matched_blocks=nb, exact=exact,
+        )
+
+    def prefill_only(self, request: GenerationRequest):
+        """Disaggregated prefill role: run the admission prefill for ONE
+        request and ship the resulting KV (every prompt token plus the
+        first sampled token) through the tier. Returns the KVShipment the
+        decode role adopts, or None when the pool or tier cannot serve it
+        — the caller falls back to fused serving, so a prefill-side
+        problem costs latency, never a request."""
+        if self._kv is None or self._tier is None:
+            return None
+        if len(request.token_ids) + request.max_new_tokens > self._cfg.max_seq_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        with self._lock:
+            plen = len(request.token_ids)
+            lease = self._kv.acquire(request.token_ids)
+            if lease is None:
+                return None
+            rid = self._next_id
+            self._next_id += 1
+            try:
+                logits, solo_cache = self._prefill_leased(request, lease)
+                first = int(
+                    self._sample_tokens(
+                        logits,
+                        np.array(
+                            [max(request.temperature, 0.0)], np.float32
+                        ),
+                        jax.random.fold_in(self._rng, rid),
+                    )[0]
+                )
+                cached = lease.num_cached_tokens
+                self._kv.record_prefill(cached, plen - cached)
+                self._kv.commit(lease, request.token_ids, solo_cache)
+                payload = self._kv.extract_row_payload(solo_cache, plen)
+                return self._tier.ship_direct(
+                    request.token_ids, payload,
+                    plen // self._kv.block_size, first_token=first,
+                )
+            finally:
+                # committed blocks stay in the radix index (refcounted by
+                # the index itself) — the prefill replica's cache warms
+                # even though it never decodes
+                self._kv.release(lease)
 
     def _prefill_leased(self, req: GenerationRequest, lease, trace=None):
         """Prefill a request, reusing the lease's cached prefix: a full

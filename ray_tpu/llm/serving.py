@@ -20,6 +20,14 @@ it with prefix-affinity routing on the caller side —
 ``handle.options(prefix_affinity_tokens=cfg.prefix_affinity_tokens)`` —
 so repeated prefixes (chat sessions, shared system prompts) land on the
 replica whose pool already holds their blocks.
+
+With ``LLMConfig.roles={"prefill": N, "decode": M}`` the application
+disaggregates into prefill and decode replica pools behind a
+``_DisaggIngress``: prefill replicas run admission prefill and ship the
+committed KV blocks through ``_internal/transfer.py`` (registered in the
+cluster KV tier when ``kv_tier=True``), decode replicas adopt the
+shipment into their paged pool and stream tokens without re-running
+prefill. See docs/ARCHITECTURE.md §18.
 """
 
 from __future__ import annotations
@@ -32,11 +40,21 @@ from .engine import ContinuousBatchingEngine, GenerationRequest, LLMEngine
 
 
 class _LLMReplica:
-    """The replica callable (reference role: VLLMDeployment)."""
+    """The replica callable (reference role: VLLMDeployment).
+
+    ``role`` selects the disaggregated mode: "prefill" replicas serve
+    ``prefill()`` (run admission prefill, ship the committed KV through
+    the tier), "decode" replicas serve ``decode_shipped()`` (adopt the
+    shipment and decode with zero prefill-computed tokens); None is the
+    fused replica. ``tier_backend`` overrides the KV tier backend —
+    cluster replicas default to the GCS-backed one, tests inject a shared
+    ``kvtier.LocalTierBackend``."""
 
     def __init__(self, llm_config: LLMConfig, params_blob: Optional[bytes] = None,
                  tokenizer_name: Optional[str] = None,
-                 weights_name: Optional[str] = None):
+                 weights_name: Optional[str] = None,
+                 role: Optional[str] = None,
+                 tier_backend=None):
         import jax
 
         from ..parallel.plan import PartitionPlan
@@ -94,6 +112,10 @@ class _LLMReplica:
             params = unbox_params(
                 init_params(model_config, jax.random.PRNGKey(0))
             )
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(f"unknown replica role {role!r}")
+        self._role = role
+        self._kv_tier = None
         if llm_config.kv_cache_blocks:
             # paged prefix-reusing engine: requests stream through a slot
             # pool over a shared KV block pool; admission is memory-gated
@@ -105,12 +127,28 @@ class _LLMReplica:
                 block_size=llm_config.kv_block_size,
                 plan=plan,
             )
+            if llm_config.kv_tier or role is not None:
+                # cluster KV prefix tier: role replicas need it for the
+                # prefill->decode handoff; fused replicas opt in to share
+                # warm prefixes across the deployment
+                from ..kvtier import GcsTierBackend, KVTierClient
+
+                self._kv_tier = KVTierClient(
+                    model=llm_config.model_id,
+                    backend=(
+                        tier_backend if tier_backend is not None
+                        else GcsTierBackend()
+                    ),
+                    block_size=llm_config.kv_block_size,
+                    codec=llm_config.kv_ship_codec,
+                )
             self._engine = ContinuousBatchingEngine(
                 model_config, params, mesh,
                 num_slots=llm_config.max_batch_size,
                 kv_cache=self._kv_cache,
                 seed=llm_config.seed,
                 plan=plan,
+                kv_tier=self._kv_tier,
             )
         else:
             self._kv_cache = None
@@ -222,6 +260,54 @@ class _LLMReplica:
             return None
         return self._kv_cache.stats()
 
+    def kvtier_stats(self) -> Optional[Dict[str, Any]]:
+        """Replica-local KV tier stats — exports held, registry totals
+        (None when the replica is not on the tier); routed through
+        handle.options(method_name="kvtier_stats")."""
+        if self._kv_tier is None:
+            return None
+        out = self._kv_tier.stats()
+        out["role"] = self._role or "fused"
+        return out
+
+    # -- disaggregated roles -------------------------------------------------
+
+    def prefill(self, request: Dict[str, Any]) -> Optional[bytes]:
+        """Prefill role: run ONLY the admission prefill and ship the
+        committed KV (plus the first sampled token). Returns the shipment
+        blob for decode_shipped, or None when this replica can't serve it
+        right now (pool backpressure) — the ingress falls back to fused
+        decode, so the request still completes."""
+        if self._kv_tier is None:
+            return None
+        shipment = self._engine.prefill_only(self._parse_request(request))
+        return shipment.to_blob() if shipment is not None else None
+
+    def decode_shipped(self, request: Dict[str, Any],
+                       shipment_blob: Optional[bytes]) -> Dict[str, Any]:
+        """Decode role: adopt a shipped prefix and decode. A missing blob,
+        a dead prefill holder, or any fetch failure degrades to a normal
+        computed admission — a transfer-plane problem costs latency, never
+        a request."""
+        gen_req = self._parse_request(request)
+        ship = None
+        if shipment_blob is not None and self._kv_tier is not None:
+            from ..kvtier import KVShipment
+
+            shipment = KVShipment.from_blob(shipment_blob)
+            payload = self._kv_tier.fetch_shipment(shipment)
+            if payload is not None:
+                ship = (shipment, payload)
+        result = self._engine.generate_one(gen_req, shipment=ship)
+        out: Dict[str, Any] = {
+            "token_ids": result.token_ids,
+            "num_prompt_tokens": result.num_prompt_tokens,
+            "finished_reason": result.finished_reason,
+        }
+        if self._tokenizer is not None:
+            out["text"] = self._tokenizer.decode(result.token_ids)
+        return out
+
     def weights_info(self) -> Dict[str, Any]:
         return {
             "weights_name": self._weights_name,
@@ -314,6 +400,43 @@ class _LLMReplica:
                 yield summary
 
 
+class _DisaggIngress:
+    """Disaggregated serving ingress: route a new request to a prefill
+    replica (prefix-affinity biased, so shared prefixes keep hitting the
+    replica whose radix already holds them), then hand the shipment blob
+    to a decode replica. Every failure on the prefill side degrades to
+    ``decode_shipped(request, None)`` — a fused computed admission on the
+    decode replica — so disaggregation can only add latency, never
+    errors."""
+
+    def __init__(self, prefill_handle, decode_handle,
+                 prefix_affinity_tokens: int = 0):
+        self._prefill = prefill_handle.options(method_name="prefill")
+        if prefix_affinity_tokens:
+            self._prefill = self._prefill.options(
+                prefix_affinity_tokens=prefix_affinity_tokens
+            )
+        self._decode = decode_handle.options(method_name="decode_shipped")
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        blob = None
+        try:
+            blob = self._prefill.remote(request).result()
+        except Exception:
+            blob = None  # prefill-side failure: decode computes it fused
+        return self._decode.remote(request, blob).result()
+
+    def stream(self, request: Dict[str, Any]):
+        """Streaming through the disaggregated path: the prefill handoff
+        happens up front, then tokens stream from the decode replica."""
+        blob = None
+        try:
+            blob = self._prefill.remote(request).result()
+        except Exception:
+            blob = None
+        yield self._decode.remote(request, blob).result()
+
+
 def build_llm_deployment(
     llm_config: LLMConfig,
     *,
@@ -321,13 +444,50 @@ def build_llm_deployment(
     tokenizer_name: Optional[str] = None,
     name: Optional[str] = None,
     weights_name: Optional[str] = None,
+    tier_backend=None,
 ):
     """Return a bound serve Application for this LLM (reference:
-    build_llm_deployment, llm/_internal/serve/builders)."""
-    options = dict(
-        name=name or llm_config.model_id,
-        ray_actor_options=dict(llm_config.resources_per_replica),
-    )
+    build_llm_deployment, llm/_internal/serve/builders).
+
+    With ``llm_config.roles`` the application is three deployments:
+    ``<name>-prefill`` / ``<name>-decode`` replica pools plus a
+    ``_DisaggIngress`` root that routes the prefill→decode KV handoff.
+    ``tier_backend`` (tests) injects a shared in-process tier backend."""
+    base_name = name or llm_config.model_id
+
+    def _common_options() -> Dict[str, Any]:
+        return dict(
+            ray_actor_options=dict(llm_config.resources_per_replica),
+        )
+
+    if llm_config.roles is not None:
+        prefill_dep = serve.deployment(
+            _LLMReplica,
+            name=f"{base_name}-prefill",
+            num_replicas=llm_config.roles["prefill"],
+            **_common_options(),
+        ).bind(
+            llm_config, params_blob, tokenizer_name, weights_name,
+            "prefill", tier_backend,
+        )
+        decode_dep = serve.deployment(
+            _LLMReplica,
+            name=f"{base_name}-decode",
+            num_replicas=llm_config.roles["decode"],
+            **_common_options(),
+        ).bind(
+            llm_config, params_blob, tokenizer_name, weights_name,
+            "decode", tier_backend,
+        )
+        ingress = serve.deployment(
+            _DisaggIngress, name=base_name, num_replicas=1
+        )
+        return ingress.bind(
+            prefill_dep, decode_dep,
+            llm_config.prefix_affinity_tokens,
+        )
+
+    options = dict(name=base_name, **_common_options())
     autoscale_policy = getattr(llm_config, "autoscale_policy", None)
     if autoscale_policy:
         # closed-loop SLO autoscaling (serve/autoscale.py): TTFT p99 /
@@ -344,7 +504,10 @@ def build_llm_deployment(
     else:
         options["num_replicas"] = llm_config.num_replicas
     dep = serve.deployment(_LLMReplica, **options)
-    return dep.bind(llm_config, params_blob, tokenizer_name, weights_name)
+    return dep.bind(
+        llm_config, params_blob, tokenizer_name, weights_name,
+        None, tier_backend,
+    )
 
 
 def publish_llm_weights(

@@ -126,6 +126,13 @@ CHAOS_NET = KeyPrefix(
     "cluster-wide network chaos-mesh spec (JSON rules), polled by every "
     "process and applied client-side in the RPC layer",
 )
+KVTIER = KeyPrefix(
+    "kvtier",
+    "cluster-wide KV prefix tier: kvtier:fp:<model>:<fingerprint> → entry id "
+    "and kvtier:entry:<id> → shipment descriptor blob (holder + pinned "
+    "chunk refs); written by the GCS KVTierRegistry, swept on holder-node "
+    "death and on LRU eviction so stale holders never accrete",
+)
 SERVE_PROXY = KeyPrefix(
     "proxy",
     "serve ingress proxy registry proxy:<proxy_id> → identity JSON (kind, "

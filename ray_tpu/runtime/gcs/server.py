@@ -43,6 +43,7 @@ from .actor_manager import GcsActorManager
 from .placement_groups import GcsPlacementGroupManager
 from .pubsub import Publisher
 from .store import StoreClient, make_store
+from .kvtier_registry import GcsKVTierRegistry
 from .weight_registry import GcsWeightRegistry
 
 logger = logging.getLogger(__name__)
@@ -58,6 +59,7 @@ class GcsServer:
         self.actor_manager = GcsActorManager(self)
         self.pg_manager = GcsPlacementGroupManager(self)
         self.weight_registry = GcsWeightRegistry(self)
+        self.kvtier_registry = GcsKVTierRegistry(self)
 
         self._nodes: Dict[NodeID, NodeInfo] = {}
         self._node_available: Dict[NodeID, Dict[str, float]] = {}
@@ -489,6 +491,7 @@ class GcsServer:
         self._abort_member_groups(node_hex=node_id.hex(), reason=reason)
         self.publisher.publish("node", ("dead", node))
         self.weight_registry.on_node_death(node.address)
+        self.kvtier_registry.on_node_death(node.address)
         await self.actor_manager.on_node_death(node_id)
         await self.pg_manager.on_node_death(node_id)
 
@@ -824,6 +827,36 @@ class GcsServer:
 
     async def handle_weights_list(self):
         return self.weight_registry.list_models()
+
+    # -- KV prefix tier (ray_tpu.kvtier registry) --------------------------
+
+    async def handle_kvtier_register(
+        self, model: str, fps: List[str], holder_id: str, holder_address,
+        blob: bytes, meta: Optional[dict] = None
+    ):
+        return self.kvtier_registry.register(
+            model, fps, holder_id, holder_address, blob, meta
+        )
+
+    async def handle_kvtier_resolve(self, model: str, fps: List[str]):
+        return self.kvtier_registry.resolve(model, fps)
+
+    async def handle_kvtier_lease(self, entry_id: int, lease_id: str):
+        return self.kvtier_registry.lease(entry_id, lease_id)
+
+    async def handle_kvtier_release(self, entry_id: int, lease_id: str):
+        return self.kvtier_registry.release(entry_id, lease_id)
+
+    async def handle_kvtier_evict(
+        self, entry_ids: List[int], holder_id: Optional[str] = None
+    ):
+        return self.kvtier_registry.evict(entry_ids, holder_id)
+
+    async def handle_kvtier_collect(self, holder_id: str):
+        return self.kvtier_registry.collect(holder_id)
+
+    async def handle_kvtier_stats(self):
+        return self.kvtier_registry.stats()
 
     # -- placement groups --------------------------------------------------
 

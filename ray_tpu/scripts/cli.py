@@ -235,6 +235,18 @@ def cmd_kvcache(args):
     return 0
 
 
+def cmd_kvtier(args):
+    """`ray_tpu kvtier`: cluster KV-tier stats — resolution outcomes
+    (hit / peer_pull / recompute), logical vs wire transfer bytes (the
+    int8 shipment codec's compression split), and TTFT by tier
+    (local / peer / miss) read off the kvcache histogram's tier tag."""
+    _connected(args)
+    from ..util import state
+
+    print(json.dumps(state.metrics_summary()["kvtier"], indent=2, default=str))
+    return 0
+
+
 def cmd_autoscale(args):
     """`ray_tpu autoscale`: the SLO autoscaler's decision record.
 
@@ -681,6 +693,13 @@ def main(argv=None):
     )
     p.add_argument("--address", required=True, help="head host:port")
     p.set_defaults(fn=cmd_kvcache)
+
+    p = sub.add_parser(
+        "kvtier",
+        help="cluster KV-tier stats (hit/peer_pull/recompute, wire bytes)",
+    )
+    p.add_argument("--address", required=True, help="head host:port")
+    p.set_defaults(fn=cmd_kvtier)
 
     p = sub.add_parser(
         "autoscale",

@@ -85,6 +85,8 @@ CIRCUIT_CLOSE = EventName("circuit_close")
 PROXY_START = EventName("proxy_start")
 PROXY_STOP = EventName("proxy_stop")
 PROXY_DRAIN = EventName("proxy_drain")
+KV_SHIPPED = EventName("kv_shipped")
+KVTIER_EVICT = EventName("kvtier_evict")
 
 
 # -- recording ----------------------------------------------------------------

@@ -1262,11 +1262,14 @@ def _ensure_kvcache_metrics() -> dict:
                         "Total KV blocks in this engine's pool",
                         tag_keys=("mesh",),
                     ),
+                    # "tier" separates where the prefix came from:
+                    # local (this replica's radix), peer (pulled through
+                    # the cluster KV tier), miss (computed from scratch)
                     "ttft": Histogram(
                         "kvcache_ttft_ms",
                         "Time to first token (ms) by prefix-cache outcome",
                         boundaries=_KVCACHE_TTFT_BOUNDARIES_MS,
-                        tag_keys=("cache", "mesh"),
+                        tag_keys=("cache", "mesh", "tier"),
                     ),
                 }
     return _kvcache_metrics
@@ -1294,9 +1297,12 @@ def set_kvcache_blocks(in_use: int, capacity: int, mesh: str = "tp=1"):
     m["blocks_capacity"].set(float(capacity), {"mesh": mesh})
 
 
-def record_kvcache_ttft(seconds: float, hit: bool, mesh: str = "tp=1"):
+def record_kvcache_ttft(
+    seconds: float, hit: bool, mesh: str = "tp=1", tier: str = "local"
+):
     _ensure_kvcache_metrics()["ttft"].observe(
-        seconds * 1000.0, {"cache": "hit" if hit else "miss", "mesh": mesh}
+        seconds * 1000.0,
+        {"cache": "hit" if hit else "miss", "mesh": mesh, "tier": tier},
     )
 
 
@@ -1372,6 +1378,166 @@ def kvcache_summary(payloads: List[dict]) -> Dict[str, object]:
             counts = ttft_buckets.get(cache)
             if counts:
                 bounds = ttft_bounds[cache]
+                row["p50_ms"] = quantile_from_buckets(bounds, counts, 0.50)
+                row["p99_ms"] = quantile_from_buckets(bounds, counts, 0.99)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Cluster KV-tier instrumentation (kvtier's proof layer): per-request
+# resolution outcomes (hit = registry had a deeper prefix, peer_pull =
+# the blocks actually arrived and decoded, recompute = tier consulted
+# but the prefix was prefilled anyway — miss, lease conflict, dead
+# holder), plus the logical/wire byte split so the int8 shipment codec's
+# compression is visible instead of silently folded into one number.
+# kvtier_summary() is shared by the `ray_tpu kvtier` CLI and the
+# dashboard's /api/kvtier; the per-tier TTFT split rides the kvcache
+# histogram's "tier" tag rather than a second histogram.
+# ---------------------------------------------------------------------------
+
+_kvtier_metrics: Optional[dict] = None
+_kvtier_init_lock = threading.Lock()
+
+_KVTIER_OUTCOMES = ("hit", "peer_pull", "recompute")
+
+
+def _ensure_kvtier_metrics() -> dict:
+    global _kvtier_metrics
+    if _kvtier_metrics is None:
+        with _kvtier_init_lock:
+            if _kvtier_metrics is None:
+                _kvtier_metrics = {
+                    "hit": Counter(
+                        "kvtier_hit_total",
+                        "Tier resolutions that found a registered prefix "
+                        "deeper than the local radix",
+                        tag_keys=("model",),
+                    ),
+                    "peer_pull": Counter(
+                        "kvtier_peer_pull_total",
+                        "Warm prefixes successfully pulled from a peer "
+                        "replica and adopted",
+                        tag_keys=("model",),
+                    ),
+                    "recompute": Counter(
+                        "kvtier_recompute_total",
+                        "Tier consultations that fell back to prefill "
+                        "(miss, lease conflict, or dead holder)",
+                        tag_keys=("model",),
+                    ),
+                    "transfer_bytes": Counter(
+                        "kvtier_transfer_bytes_total",
+                        "KV bytes moved through the tier by kind "
+                        "(logical = raw leaf bytes, wire = encoded)",
+                        tag_keys=("model", "kind"),
+                    ),
+                }
+    return _kvtier_metrics
+
+
+def record_kvtier(outcome: str, model: str = ""):
+    """One tier resolution outcome: hit | peer_pull | recompute."""
+    if outcome not in _KVTIER_OUTCOMES:
+        raise ValueError(
+            f"kvtier outcome must be one of {_KVTIER_OUTCOMES}, "
+            f"got {outcome!r}"
+        )
+    _ensure_kvtier_metrics()[outcome].inc(1.0, {"model": model})
+
+
+def record_kvtier_transfer(
+    logical_nbytes: int, wire_nbytes: int, model: str = ""
+):
+    m = _ensure_kvtier_metrics()
+    m["transfer_bytes"].inc(float(logical_nbytes),
+                            {"model": model, "kind": "logical"})
+    m["transfer_bytes"].inc(float(wire_nbytes),
+                            {"model": model, "kind": "wire"})
+
+
+def kvtier_counters() -> Dict[str, float]:
+    """Process-local readback (tests + bench; no cluster needed)."""
+    m = _ensure_kvtier_metrics()
+
+    def _total(metric) -> float:
+        with metric._lock:
+            return float(sum(metric._values.values()))
+
+    def _kind(kind: str) -> float:
+        tm = m["transfer_bytes"]
+        with tm._lock:
+            return float(sum(
+                v for k, v in tm._values.items() if kind in k
+            ))
+
+    return {
+        "hit": _total(m["hit"]),
+        "peer_pull": _total(m["peer_pull"]),
+        "recompute": _total(m["recompute"]),
+        "transfer_logical_bytes": _kind("logical"),
+        "transfer_wire_bytes": _kind("wire"),
+    }
+
+
+def kvtier_summary(payloads: List[dict]) -> Dict[str, object]:
+    """Cluster-wide KV-tier rollup from pushed payloads: outcome counters
+    and byte totals summed across replicas, plus the per-tier TTFT split
+    (local | peer | miss) read off the kvcache histogram's tier tag."""
+    out: Dict[str, object] = {
+        "hit": 0.0,
+        "peer_pull": 0.0,
+        "recompute": 0.0,
+        "transfer_bytes": {"logical": 0.0, "wire": 0.0},
+        "ttft_ms_by_tier": {},
+    }
+    simple = {
+        "kvtier_hit_total": "hit",
+        "kvtier_peer_pull_total": "peer_pull",
+        "kvtier_recompute_total": "recompute",
+    }
+    ttft: Dict[str, Dict[str, float]] = out["ttft_ms_by_tier"]  # type: ignore[assignment]
+    ttft_buckets: Dict[str, List[float]] = {}
+    ttft_bounds: Dict[str, List[float]] = {}
+    xfer: Dict[str, float] = out["transfer_bytes"]  # type: ignore[assignment]
+    for payload in payloads:
+        for snap in payload.get("metrics", []):
+            name = snap["name"]
+            if name in simple:
+                out[simple[name]] += float(sum(snap["values"].values()))
+            elif name == "kvtier_transfer_bytes_total":
+                for tag_json, v in snap["values"].items():
+                    tags = dict(zip(snap["tag_keys"], json.loads(tag_json)))
+                    kind = tags.get("kind", "?")
+                    xfer[kind] = xfer.get(kind, 0.0) + float(v)
+            elif name == "kvcache_ttft_ms":
+                for tag_json, counts in snap.get("counts", {}).items():
+                    tags = dict(zip(snap["tag_keys"], json.loads(tag_json)))
+                    tier = tags.get("tier", "local")
+                    row = ttft.setdefault(
+                        tier, {"count": 0.0, "sum_ms": 0.0}
+                    )
+                    row["count"] += float(sum(counts))
+                    row["sum_ms"] += float(
+                        snap["values"].get(tag_json, 0.0)
+                    )
+                    merged = ttft_buckets.setdefault(
+                        tier, [0.0] * len(counts)
+                    )
+                    if len(merged) < len(counts):
+                        merged.extend([0.0] * (len(counts) - len(merged)))
+                    for i, c in enumerate(counts):
+                        merged[i] += c
+                    ttft_bounds.setdefault(
+                        tier,
+                        list(snap.get("boundaries")
+                             or _KVCACHE_TTFT_BOUNDARIES_MS),
+                    )
+    for tier, row in ttft.items():
+        if row["count"]:
+            row["mean_ms"] = row["sum_ms"] / row["count"]
+            counts = ttft_buckets.get(tier)
+            if counts:
+                bounds = ttft_bounds[tier]
                 row["p50_ms"] = quantile_from_buckets(bounds, counts, 0.50)
                 row["p99_ms"] = quantile_from_buckets(bounds, counts, 0.99)
     return out

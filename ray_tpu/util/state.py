@@ -126,6 +126,7 @@ def metrics_summary() -> Dict[str, Any]:
         fetch_metric_payloads,
         ingress_summary,
         kvcache_summary,
+        kvtier_summary,
         partition_summary,
         serve_ft_summary,
         serve_latency_summary,
@@ -195,6 +196,7 @@ def metrics_summary() -> Dict[str, Any]:
         "scaling_efficiency": efficiency,
         "devices": device_rows(payloads),
         "kvcache": kvcache_summary(payloads),
+        "kvtier": kvtier_summary(payloads),
         "train_ft": train_ft_summary(payloads),
         "serve_ft": serve_ft_summary(payloads),
         "serve_latency": serve_latency_summary(payloads),

@@ -22,10 +22,9 @@ pulling, then pulls with ``prefer_source`` pointing at the parent, so:
 from __future__ import annotations
 
 import asyncio
-import time
 from typing import List, Optional, Tuple
 
-from ..object_ref import ObjectRef
+from .._internal import transfer
 from .manifest import ChunkInfo
 
 
@@ -38,52 +37,27 @@ async def fetch_chunk_value(
 ):
     """Fetch one chunk into the local store (along the tree) and return its
     deserialized value. Runs on the worker's event loop. ``fellback`` is a
-    one-element flag list set True when the parent wait was abandoned."""
-    raylet = worker.client_pool.get(*worker.raylet_address)
-    ref = ObjectRef(chunk.object_id, tuple(chunk.owner_address))
-    prefer = None
-    local = await raylet.call("store_contains", chunk.object_id)
-    if not local:
-        if parent is not None and tuple(parent) != tuple(worker.raylet_address):
-            prefer = await _wait_for_parent(worker, chunk, parent, prefer_wait_s)
-            if prefer is None and fellback is not None:
-                fellback[0] = True
-        elif parent is None and not _is_local_owner(worker, chunk):
-            # seed position: the publisher node is the designated source
-            prefer = _owner_node_hint(chunk)
-    return await worker._read_plasma(ref, chunk.size, prefer_source=prefer)
+    one-element flag list set True when the parent wait was abandoned.
 
-
-def _is_local_owner(worker, chunk: ChunkInfo) -> bool:
-    return tuple(chunk.owner_address) == tuple(worker.address or ())
-
-
-def _owner_node_hint(chunk: ChunkInfo) -> Optional[Tuple[str, int]]:
-    # The pull path resolves actual holders through the owner's location
-    # table; no extra preference is needed for the seed — owner locations
-    # already start at the publisher node. Returning None keeps the plain
-    # path (and its spill/restore handling) intact.
-    return None
+    Thin veneer over the shared transfer layer: the tree parent is the
+    preferred source, with the bounded holds-the-object wait; a seed
+    position (``parent is None``) pulls owner-directed — owner locations
+    already start at the publisher node, so no extra preference is needed
+    and the plain path keeps its spill/restore handling."""
+    return await transfer.fetch_chunk(
+        worker, chunk, parent, wait_s=prefer_wait_s, fellback=fellback
+    )
 
 
 async def _wait_for_parent(
     worker, chunk: ChunkInfo, parent, prefer_wait_s: float
 ):
     """Poll the parent raylet until it holds the chunk (tree ordering), with
-    a deadline fallback to an unconstrained pull."""
-    deadline = time.monotonic() + prefer_wait_s
-    parent_client = worker.client_pool.get(*parent)
-    delay = 0.01
-    while True:
-        try:
-            if await parent_client.call("store_contains", chunk.object_id):
-                return tuple(parent)
-        except Exception:
-            return None  # parent unreachable: fall back to any holder
-        if time.monotonic() >= deadline:
-            return None
-        await asyncio.sleep(delay)
-        delay = min(delay * 2, 0.25)
+    a deadline fallback to an unconstrained pull. (Kept as the historical
+    name; delegates to ``transfer.wait_for_holder``.)"""
+    return await transfer.wait_for_holder(
+        worker, chunk.object_id, tuple(parent), prefer_wait_s
+    )
 
 
 async def fetch_version_chunks(
@@ -135,21 +109,8 @@ def version_logical_bytes(chunks: List[ChunkInfo]) -> int:
 async def pin_local_chunks(worker, chunks: List[ChunkInfo]) -> List:
     """Weight-pin every chunk's local copy (eviction/spill exemption for the
     subscribe's lifetime); returns the object ids actually pinned."""
-    raylet = worker.client_pool.get(*worker.raylet_address)
-    pinned = []
-    for chunk in chunks:
-        try:
-            if await raylet.call("store_pin_weight", chunk.object_id):
-                pinned.append(chunk.object_id)
-        except Exception:
-            pass
-    return pinned
+    return await transfer.pin_chunks(worker, [c.object_id for c in chunks])
 
 
 async def unpin_local_chunks(worker, object_ids: List):
-    raylet = worker.client_pool.get(*worker.raylet_address)
-    for oid in object_ids:
-        try:
-            await raylet.call_oneway("store_unpin_weight", oid)
-        except Exception:
-            pass
+    await transfer.unpin_chunks(worker, object_ids)

@@ -17,7 +17,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .. import _worker_api
-from .._internal import serialization
+from .._internal import transfer
 from ..object_ref import ObjectRef
 from ..util import metrics
 from .manifest import (
@@ -62,19 +62,11 @@ class WeightPublisher:
         )
 
         async def _store():
-            raylet = worker.client_pool.get(*worker.raylet_address)
+            # pin=True: spill/evict exemption while the version is live — a
+            # chunk mid-broadcast must stay resident at its source
+            stored = await transfer.put_chunks(worker, chunk_values, pin=True)
             infos, refs = [], []
-            for value in chunk_values:
-                meta_b, bufs = serialization.serialize(value)
-                oid, size = await worker.put_serialized(
-                    meta_b, bufs, force_plasma=True
-                )
-                # spill/evict exemption while the version is live: a chunk
-                # mid-broadcast must stay resident at its source
-                try:
-                    await raylet.call("store_pin_weight", oid)
-                except Exception:
-                    pass
+            for value, (oid, size) in zip(chunk_values, stored):
                 refs.append(ObjectRef(oid, worker.address))
                 infos.append(
                     ChunkInfo(

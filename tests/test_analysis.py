@@ -429,13 +429,63 @@ def test_rt010_wrapper_and_non_train_exempt(tmp_path):
     assert result.findings == []
 
 
+# ---------------------------------------------------------------- RT011
+
+
+def test_rt011_flags_raw_puts_in_serving_kv_paths(tmp_path):
+    result = _run(tmp_path, {
+        "kvtier/tier.py": """
+            async def export(worker, meta, bufs):
+                oid, _ = await worker.put_serialized(meta, bufs)
+                return oid
+        """,
+        "kvcache/spill.py": """
+            def spill(client, key, blob):
+                return client.call("store_put", key, blob)
+        """,
+        "llm/engine.py": """
+            async def stash(worker, meta, bufs):
+                return await worker.put_serialized(meta, bufs)
+        """,
+    }, rules=["RT011"])
+    assert _rules(result) == ["RT011"] * 3
+    msgs = " ".join(f.message for f in result.findings)
+    assert "_internal/transfer.py" in msgs
+    assert "store_put" in msgs
+
+
+def test_rt011_transfer_layer_and_other_planes_exempt(tmp_path):
+    result = _run(tmp_path, {
+        # the chokepoint itself: outside the patrolled paths
+        "_internal/transfer.py": """
+            async def put_chunks(worker, meta, bufs):
+                return await worker.put_serialized(meta, bufs)
+        """,
+        # object plane proper: put_serialized is ITS primitive
+        "runtime/worker/core_worker.py": """
+            async def put(self, meta, bufs):
+                return await self.put_serialized(meta, bufs)
+        """,
+        # other GCS RPCs in serving paths are fine, as is going through
+        # the transfer layer
+        "kvtier/registry.py": """
+            from ray_tpu._internal import transfer
+
+            async def register(client, shipment, worker, values):
+                refs = await transfer.put_chunks(worker, values)
+                return client.call("kvtier_register", shipment), refs
+        """,
+    }, rules=["RT011"])
+    assert result.findings == []
+
+
 # ------------------------------------------------------------- framework
 
 
-def test_catalog_has_all_ten_rules():
+def test_catalog_has_all_eleven_rules():
     assert sorted(checker_catalog()) == [
         "RT001", "RT002", "RT003", "RT004", "RT005", "RT006", "RT007",
-        "RT008", "RT009", "RT010",
+        "RT008", "RT009", "RT010", "RT011",
     ]
 
 

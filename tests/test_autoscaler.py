@@ -266,6 +266,7 @@ def test_tpu_slice_provider_scales_pending_slice_up_and_down(shutdown_only):
         cluster.shutdown()
 
 
+@pytest.mark.slow
 def test_tpu_slice_partial_launch_rolls_back(shutdown_only):
     """Chaos: a host launch failing mid-slice must roll back the already
     launched hosts — the cluster never holds a partial ICI domain."""

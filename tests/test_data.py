@@ -284,6 +284,7 @@ def test_read_binary_files(cluster, tmp_path):
     assert by_path["y.bin"] == b"abc"
 
 
+@pytest.mark.slow
 def test_iter_tf_batches(cluster):
     import ray_tpu.data as rd
 

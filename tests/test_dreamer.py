@@ -141,6 +141,7 @@ def test_runner_arrival_alignment():
     assert len(out["episode_returns"]) > 0
 
 
+@pytest.mark.slow
 def test_dreamer_trains_cartpole(cluster):
     algo = _tiny_config().build()
     try:

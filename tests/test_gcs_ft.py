@@ -84,6 +84,7 @@ def test_gcs_restart_preserves_cluster(shutdown_only, tmp_path):
     assert _kv("kv_get", "ft-key") == b"ft-value"
 
 
+@pytest.mark.slow
 def test_gcs_restart_restores_actor_after_worker_death(shutdown_only, tmp_path):
     """An actor whose worker dies WHILE the GCS is down is restarted after
     the GCS comes back: the re-registering raylet reports its live workers

@@ -334,7 +334,7 @@ class TestAdmission:
         ]
         engine.step()
         assert len(engine._slots) == 1  # only the first fit
-        assert [rid for rid, _ in engine._pending] == rids[1:]
+        assert [entry[0] for entry in engine._pending] == rids[1:]
         results = engine.run_until_complete()
         assert set(results) == set(rids)
         assert kv.stats()["admission_blocked"] >= 1

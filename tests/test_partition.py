@@ -179,7 +179,10 @@ def test_blackhole_typed_error_and_circuit_opens(shutdown_only):
 
     worker = _worker_api.get_core_worker()
     host, port = worker.gcs_address
-    gcs = worker.client_pool.get(host, port)
+    # a dedicated client: the pooled GCS client also carries the worker's
+    # background traffic, whose successes reset the consecutive-failure
+    # count mid-test on a loaded box (the breaker is per-client state)
+    gcs = rpc_mod.RpcClient(host, port, name="breaker-probe")
 
     def call_once(timeout):
         return _worker_api.run_on_worker_loop(
@@ -217,6 +220,7 @@ def test_blackhole_typed_error_and_circuit_opens(shutdown_only):
     finally:
         rpc_mod.set_rpc_chaos({})
         rpc_mod.configure_circuit_breaker(5, 2.0)
+        _worker_api.run_on_worker_loop(gcs.close())
 
 
 def test_dropped_call_does_not_stall_actor_sequence(shutdown_only):

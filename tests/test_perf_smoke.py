@@ -1,6 +1,8 @@
 """Microbenchmark suite smoke (reference: _private/ray_perf.py metrics run
 in release/microbenchmark) — correctness of the harness, not speed."""
 
+import pytest
+
 import ray_tpu
 from ray_tpu._internal.perf import run_microbenchmarks
 
@@ -215,6 +217,7 @@ def test_router_pick_fast_allocates_no_dicts():
     assert not (ops & banned), ops & banned
 
 
+@pytest.mark.slow
 def test_multiproxy_tracing_disabled_overhead_guard(shutdown_only,
                                                     monkeypatch):
     """The multi-proxy data plane must not tax the single-proxy request
@@ -343,6 +346,7 @@ def test_scale_smoke_queued_tasks(shutdown_only):
     assert out == list(range(400))
 
 
+@pytest.mark.slow
 def test_scale_smoke_many_actors(shutdown_only):
     """Actor-count envelope smoke: 16 concurrently alive zero-cpu actors
     (sized for the 1-core CI box; the reference envelope is BASELINE.md's)."""

@@ -71,6 +71,7 @@ def test_vector_env_autoreset():
     vec.close()
 
 
+@pytest.mark.slow
 def test_ppo_cartpole_improves(cluster):
     from ray_tpu import rllib
 

@@ -1,6 +1,7 @@
 """Scanned (stacked-layer) Llama path: param structure, loss parity with
 the python-loop form, LoRA split compatibility."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -25,6 +26,7 @@ def test_scan_layers_params_stacked_and_loss_runs():
     assert jnp.isfinite(loss)
 
 
+@pytest.mark.slow
 def test_scan_layers_grads_flow_and_lora_split():
     from ray_tpu.models.llama import init_params, next_token_loss
     from ray_tpu.parallel.sharding import unbox_params

@@ -226,6 +226,7 @@ def _world_size_probe(config):
     rt_train.report({"world": ctx.get_world_size(), "rank": ctx.get_world_rank()})
 
 
+@pytest.mark.slow
 def test_elastic_scaling_shrinks_to_cluster(train_cluster):
     """num_workers=(min,max): the gang sizes itself to what the cluster can
     schedule (cluster has 8 CPUs; max 32 can never fit)."""

@@ -147,6 +147,7 @@ def _tf_train_loop(config):
     )
 
 
+@pytest.mark.slow
 def test_tensorflow_trainer_multiworker_cluster(cluster):
     """TensorflowTrainer: the TF_CONFIG backend must form a real 2-worker
     MultiWorkerMirroredStrategy ring (reference: TensorflowConfig)."""

@@ -8,6 +8,7 @@ import pickle
 import pytest
 
 
+@pytest.mark.slow
 def test_llama_lora_jaxtrainer_end_to_end(cluster):
     from ray_tpu.train.examples.llama_lora import make_trainer
 

@@ -20,6 +20,7 @@ def test_forward_shapes():
     assert logits.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_sharded_training_learns():
     cfg = ViTConfig.tiny()
     boxed = init_params(cfg, jax.random.PRNGKey(0))

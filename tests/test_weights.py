@@ -552,6 +552,7 @@ def test_llm_serve_hot_reload(ray_start_regular):
 # -- rllib put-once regression guard ----------------------------------------
 
 
+@pytest.mark.slow
 def test_rllib_params_serialized_once_per_iteration(shutdown_only):
     """Params must travel once per train() iteration (api.put + ObjectRef),
     never inline per env-runner: with N runners, driver-side task-arg bytes
