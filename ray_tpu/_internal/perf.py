@@ -31,6 +31,10 @@ def metric_unit(metric: str) -> str:
         return "MB/s"
     if "per_task" in metric:
         return "rpcs/task"
+    if metric.endswith("_pct"):
+        return "%"
+    if metric.endswith("_ns"):
+        return "ns"
     if metric.endswith("_s"):
         return "s"
     return "ops/s"
@@ -60,6 +64,9 @@ def run_microbenchmarks(
         )
 
     try:
+        # -- telemetry record overhead (clusterless) ------------------------
+        results.update(_telemetry_overhead_bench(scale))
+
         # -- puts/gets ------------------------------------------------------
         n = max(int(1000 * scale), 50)
         payload = b"x" * 1024
@@ -179,6 +186,65 @@ def run_microbenchmarks(
         if owns_cluster:
             ray_tpu.shutdown()
     return results
+
+
+def _telemetry_overhead_bench(scale: float) -> Dict[str, float]:
+    """Cost of the telemetry plane on a training hot loop: a synthetic
+    step (~6 ms of numpy matmul — the pessimistic *small* end of real
+    step times) recording three series per step, with the record block
+    timed in-context inside the loop.  Direct timing (not an on/off
+    wall-clock A/B — that difference sits below a shared host's noise
+    floor) so the cold-cache cost the records actually pay between
+    matmuls is included; medians keep scheduler spikes out.  Reports
+    the relative step-time overhead — the <1% budget pinned by
+    tests/test_timeseries.py — plus the per-record in-context cost."""
+    import statistics
+
+    import numpy as np
+
+    from ray_tpu.util import timeseries
+
+    steps = max(int(300 * scale), 60)
+    a = np.random.default_rng(0).random((512, 512))
+    stream = timeseries.TelemetryStream(push_period_s=3600.0)
+    step_series = stream.register(
+        timeseries.STEP_TIME_S,
+        labels={"run": "perf", "group": "perf", "rank": "0"},
+    )
+    frac_series = stream.register(
+        timeseries.EXPOSED_COLLECTIVE_FRACTION,
+        labels={"group": "perf", "epoch": "0"},
+    )
+    queue_series = stream.register(
+        timeseries.SERVE_QUEUE_DEPTH,
+        labels={"deployment": "perf", "replica": "perf-0"},
+    )
+
+    def _loop(n: int):
+        record_block, compute = [], []
+        prev = time.perf_counter()
+        for i in range(n):
+            x = a @ a  # noqa: F841 -- the simulated step compute
+            t1 = time.perf_counter()
+            step_series.record(t1 - prev, ts=t1)
+            frac_series.record(0.25, ts=t1)
+            queue_series.record(float(i & 7), ts=t1)
+            t2 = time.perf_counter()
+            record_block.append(t2 - t1)
+            compute.append(t1 - prev)
+            prev = time.perf_counter()
+        return statistics.median(record_block), statistics.median(compute)
+
+    prev_enabled = timeseries.set_enabled(True)
+    try:
+        _loop(10)  # warm the rings + allocator before measuring
+        rec_s, step_s = _loop(steps)
+    finally:
+        timeseries.set_enabled(prev_enabled)
+    return {
+        "telemetry_overhead_pct": rec_s / step_s * 100.0,
+        "telemetry_record_ns": rec_s / 3 * 1e9,
+    }
 
 
 def _transfer_plane_bench(scale: float) -> Dict[str, float]:

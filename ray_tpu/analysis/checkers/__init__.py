@@ -11,3 +11,4 @@ from . import rt008_rpc_timeouts  # noqa: F401
 from . import rt009_host_roundtrips  # noqa: F401
 from . import rt010_scheduler_reduce  # noqa: F401
 from . import rt011_transfer_layer  # noqa: F401
+from . import rt012_series_registry  # noqa: F401

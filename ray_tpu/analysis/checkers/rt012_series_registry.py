@@ -1,0 +1,209 @@
+"""RT012: telemetry series registry + label-cardinality discipline.
+
+The timeseries twin of RT004 (metrics registry) and RT007 (event
+taxonomy), for ``util/timeseries.py``. Two independent invariants, both
+existing to keep the series namespace closed and its cardinality
+bounded — an unbounded label value mints one GCS-resident series per
+distinct runtime string and melts the store:
+
+- every series *name* reaching ``TelemetryStream.register(...)`` /
+  ``register_series(...)`` must be a reference to a ``SeriesName``
+  constant, and those constants are literal snake_case strings declared
+  exactly once, in ``util/timeseries.py`` — the registry's single home;
+- every *labels* argument at a register site must be a dict literal
+  with statically-known string keys, and no label value may be an
+  f-string / string-concat / ``.format()`` / ``%`` expression.  A plain
+  name or ``str(rank)`` call is fine — ranks and group names are
+  bounded by the cluster — but string-building syntax is how unbounded
+  ids (request ids, timestamps) sneak into label sets.
+
+Import-aware like RT004/RT007: only names bound from
+``util.timeseries`` (or used inside the home file itself) count.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from ..astutil import str_const
+from ..core import Checker, Finding, register
+
+_SERIES_CLASS = "SeriesName"
+_REGISTER_FN = "register_series"
+_REGISTER_METHOD = "register"
+_SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_HOME_FILE = "util/timeseries.py"
+
+
+def _series_bindings(tree: ast.AST, path: str) -> Dict[str, str]:
+    """local name -> canonical name, honoring imports. Tracks both the
+    SeriesName class and register_series, plus declared constants
+    (STEP_TIME_S etc.) imported from util.timeseries."""
+    bound: Dict[str, str] = {}
+    if path.endswith(_HOME_FILE):
+        bound[_SERIES_CLASS] = _SERIES_CLASS
+        bound[_REGISTER_FN] = _REGISTER_FN
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module.endswith("util.timeseries")
+            or node.module == "timeseries"
+        ):
+            for alias in node.names:
+                bound[alias.asname or alias.name] = alias.name
+    return bound
+
+
+def _is_string_building(node: ast.AST) -> bool:
+    """f-string / concat / %-format / .format() — the unbounded-label
+    syntaxes the rule bans as label values."""
+    if isinstance(node, ast.JoinedStr):
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.Add, ast.Mod)
+    ):
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "format"
+    )
+
+
+@register
+class SeriesRegistryChecker(Checker):
+    RULE_ID = "RT012"
+    DESCRIPTION = (
+        "telemetry series: names are SeriesName constants declared once in"
+        " util/timeseries.py; label sets statically bounded"
+    )
+
+    def __init__(self):
+        # declared series name -> list of (path, line)
+        self._declarations: Dict[str, List[Tuple[str, int]]] = {}
+
+    def check_file(self, path, tree, source):
+        bound = _series_bindings(tree, path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_series_class(node, bound):
+                yield from self._check_declaration(path, node)
+            elif self._is_register(node, bound):
+                yield from self._check_register(path, node, bound)
+
+    # -- SeriesName("...") declarations --------------------------------------
+
+    def _check_declaration(self, path, node: ast.Call):
+        name_node = node.args[0] if node.args else None
+        name = str_const(name_node) if name_node is not None else None
+        if name is None:
+            yield self.finding(
+                path, node,
+                "SeriesName must be constructed from a literal string "
+                "(computed names defeat the registry audit and "
+                "`/api/timeseries?name=`)",
+            )
+            return
+        if not _SNAKE_RE.match(name):
+            yield self.finding(
+                path, node, f"series name {name!r} is not snake_case",
+            )
+        if not path.endswith(_HOME_FILE):
+            yield self.finding(
+                path, node,
+                f"series {name!r} declared outside util/timeseries.py — "
+                f"the registry lives there so readers/docs can't drift",
+            )
+        self._declarations.setdefault(name, []).append((path, node.lineno))
+
+    # -- register_series(...) / stream.register(...) sites --------------------
+
+    def _check_register(self, path, node: ast.Call, bound):
+        if not node.args:
+            return
+        name_node = node.args[0]
+        if str_const(name_node) is not None or isinstance(
+            name_node, ast.JoinedStr
+        ):
+            # inside the home file the module-level default samplers pass
+            # local constants; everywhere a literal is a registry bypass
+            yield self.finding(
+                path, node,
+                "series name at a register site must be a SeriesName "
+                "constant from util.timeseries, not a string literal",
+            )
+        labels_node = None
+        if len(node.args) > 1:
+            labels_node = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "labels":
+                labels_node = kw.value
+        if labels_node is None or isinstance(labels_node, ast.Constant):
+            return
+        if not isinstance(labels_node, ast.Dict):
+            yield self.finding(
+                path, node,
+                "labels at a register site must be a dict literal so the "
+                "label-set cardinality is statically auditable",
+            )
+            return
+        for key in labels_node.keys:
+            if key is None or str_const(key) is None:
+                yield self.finding(
+                    path, node,
+                    "label keys must be literal strings (no ** / computed "
+                    "keys) — the set of label names is part of the schema",
+                )
+        for value in labels_node.values:
+            if _is_string_building(value):
+                yield self.finding(
+                    path, node,
+                    "label value built with f-string/concat/format — "
+                    "unbounded label values mint unbounded series; pass a "
+                    "bounded variable (or str(rank)) instead",
+                )
+
+    def finalize(self):
+        for name, decls in sorted(self._declarations.items()):
+            if len(decls) > 1:
+                sites = ", ".join(f"{p}:{ln}" for p, ln in decls)
+                yield Finding(
+                    rule=self.RULE_ID, path=decls[0][0], line=decls[0][1],
+                    message=f"series {name!r} declared {len(decls)} times "
+                            f"({sites}) — the registry keys by name, later "
+                            f"declarations raise at import",
+                )
+
+    # -- call-shape recognizers ----------------------------------------------
+
+    @staticmethod
+    def _is_series_class(node: ast.Call, bound: Dict[str, str]) -> bool:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return bound.get(func.id) == _SERIES_CLASS
+        return (
+            isinstance(func, ast.Attribute)
+            and func.attr == _SERIES_CLASS
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("timeseries", "_ts")
+        )
+
+    @staticmethod
+    def _is_register(node: ast.Call, bound: Dict[str, str]) -> bool:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return bound.get(func.id) == _REGISTER_FN
+        if not isinstance(func, ast.Attribute):
+            return False
+        if func.attr == _REGISTER_FN:
+            # timeseries.register_series(...) / _ts.register_series(...)
+            return True
+        if func.attr == _REGISTER_METHOD and isinstance(
+            func.value, ast.Name
+        ) and func.value.id in ("stream", "_stream"):
+            # TelemetryStream handles conventionally named stream/_stream;
+            # other .register() attributes (rpc servers etc.) are unrelated
+            return True
+        return False

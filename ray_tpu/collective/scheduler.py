@@ -201,10 +201,12 @@ class PendingReduce:
     reduced tree and records the exposed/overlapped split."""
 
     def __init__(self, handles: List[AsyncHandle],
-                 bucketizer: GradientBucketizer, group_name: str):
+                 bucketizer: GradientBucketizer, group_name: str,
+                 epoch: int = 0):
         self._handles = handles
         self._bucketizer = bucketizer
         self._group_name = group_name
+        self._epoch = epoch
 
     def done(self) -> bool:
         return all(h.done() for h in self._handles)
@@ -226,9 +228,30 @@ class PendingReduce:
         exposed = sum(h.exposed_s for h in self._handles)
         overlapped = sum(h.overlapped_s for h in self._handles)
         metrics.record_collective_overlap(self._group_name, exposed, overlapped)
+        self._record_series(exposed, overlapped)
         if error is not None:
             raise error
         return self._bucketizer.unpack(results)
+
+    def _record_series(self, exposed: float, overlapped: float):
+        """Per-reduce exposed-fraction history, tagged with the group and
+        its rendezvous epoch so a resize shows up as a labeled regime
+        change in `/api/timeseries` instead of a mystery step."""
+        total = exposed + overlapped
+        if total <= 0:
+            return
+        try:
+            from ..util import timeseries as _ts
+
+            _ts.register_series(
+                _ts.EXPOSED_COLLECTIVE_FRACTION,
+                labels={
+                    "group": self._group_name,
+                    "epoch": str(self._epoch),
+                },
+            ).record(exposed / total)
+        except Exception:
+            pass  # telemetry is best-effort; never fail a reduce
 
 
 class GradientReduceScheduler:
@@ -309,7 +332,10 @@ class GradientReduceScheduler:
                 handles.append(
                     CompletedHandle(out, time.perf_counter() - t0)
                 )
-        return PendingReduce(handles, bucketizer, self.group.group_name)
+        return PendingReduce(
+            handles, bucketizer, self.group.group_name,
+            epoch=getattr(self.group, "epoch", 0),
+        )
 
     def step(self, tree: Any) -> Optional[Any]:
         """Loop API: reduced gradients for this step, or — at

@@ -14,7 +14,10 @@ Routes:
   GET  /api/cluster_resources | /api/cluster_status
   GET  /api/train              (elastic-training FT rollup + live runs)
   GET  /api/autoscale          (SLO-autoscaler decision log + counters)
-  GET  /api/events             (flight-recorder event query, post-mortem)
+  GET  /api/events             (flight-recorder events; ?name=&since= filters
+                                + ring/store truncation accounting)
+  GET  /api/timeseries         (telemetry series; ?name=&worker=&since=&limit=)
+  GET  /api/alerts             (active alerts, rules, transitions, stragglers)
   GET  /api/jobs/              (list submitted jobs)
   POST /api/jobs/              (submit: {"entrypoint": ..., "runtime_env": ...})
   GET  /api/jobs/{id}
@@ -114,7 +117,12 @@ class DashboardServer:
     # -- routing ------------------------------------------------------------
 
     def _route(self, req, verb: str):
-        path = req.path.split("?", 1)[0].rstrip("/")
+        from urllib.parse import parse_qs
+
+        path, _, qs = req.path.partition("?")
+        path = path.rstrip("/")
+        # last-wins single-valued query params ("?name=x&since=123")
+        query = {k: v[-1] for k, v in parse_qs(qs).items()}
         try:
             body = None
             if verb == "POST":
@@ -124,7 +132,7 @@ class DashboardServer:
             handler = self._find_handler(verb, path)
             if handler is None:
                 return self._send(req, 404, {"error": f"no route {verb} {path}"})
-            status, payload, content_type = handler(body)
+            status, payload, content_type = handler(body, query)
             if content_type is not None:
                 header = {
                     "text/plain": "text/plain; version=0.0.4",
@@ -157,25 +165,25 @@ class DashboardServer:
         if m:
             job_id, action = m.group(1), m.group(2)
             if verb == "GET" and action is None:
-                return lambda b: (200, jm.get(job_id).to_dict(), None)
+                return lambda b, q: (200, jm.get(job_id).to_dict(), None)
             if verb == "GET" and action == "/logs":
-                return lambda b: (200, {"logs": jm.logs(job_id)}, None)
+                return lambda b, q: (200, {"logs": jm.logs(job_id)}, None)
             if verb == "POST" and action == "/stop":
-                return lambda b: (200, {"stopped": jm.stop(job_id)}, None)
+                return lambda b, q: (200, {"stopped": jm.stop(job_id)}, None)
             return None
         table = {
-            ("GET", "/api/version"): lambda b: (200, _VERSION, None),
-            ("GET", "/api/nodes"): lambda b: (
+            ("GET", "/api/version"): lambda b, q: (200, _VERSION, None),
+            ("GET", "/api/nodes"): lambda b, q: (
                 200, self._gcs("get_all_nodes"), None),
-            ("GET", "/api/actors"): lambda b: (
+            ("GET", "/api/actors"): lambda b, q: (
                 200, self._gcs("list_actors"), None),
-            ("GET", "/api/tasks"): lambda b: (
+            ("GET", "/api/tasks"): lambda b, q: (
                 200, self._gcs("list_task_events", None, 1000), None),
-            ("GET", "/api/placement_groups"): lambda b: (
+            ("GET", "/api/placement_groups"): lambda b, q: (
                 200, self._gcs("list_placement_groups"), None),
-            ("GET", "/api/cluster_resources"): lambda b: (
+            ("GET", "/api/cluster_resources"): lambda b, q: (
                 200, self._gcs("cluster_resources"), None),
-            ("GET", "/api/cluster_status"): lambda b: (
+            ("GET", "/api/cluster_status"): lambda b, q: (
                 200,
                 {
                     "resource_state": self._gcs("get_cluster_resource_state"),
@@ -183,7 +191,7 @@ class DashboardServer:
                 },
                 None,
             ),
-            ("GET", "/api/jobs"): lambda b: (200, jm.list(), None),
+            ("GET", "/api/jobs"): lambda b, q: (200, jm.list(), None),
             ("POST", "/api/jobs"): self._submit_job,
             # chrome-trace task timeline from the GCS task-event store
             # (role of `ray timeline` + the React timeline view)
@@ -210,15 +218,19 @@ class DashboardServer:
             # transitions, retries, watchdog stack captures) — post-mortem
             # queryable after a process SIGKILL
             ("GET", "/api/events"): self._events,
+            # telemetry time-series plane (GCS store) + alerting engine
+            ("GET", "/api/timeseries"): self._timeseries,
+            ("GET", "/api/alerts"): self._alerts,
             ("GET", "/metrics"): self._metrics,
             # browser UI (role of the React frontend, dashboard/client/ —
             # here a dependency-free single page over the same REST API)
-            ("GET", ""): lambda b: (200, _INDEX_HTML, "text/html"),
-            ("GET", "/index.html"): lambda b: (200, _INDEX_HTML, "text/html"),
+            ("GET", ""): lambda b, q: (200, _INDEX_HTML, "text/html"),
+            ("GET", "/index.html"): lambda b, q: (
+                200, _INDEX_HTML, "text/html"),
         }
         return table.get((verb, path))
 
-    def _submit_job(self, body):
+    def _submit_job(self, body, query):
         if not body or "entrypoint" not in body:
             return 400, {"error": "body must include 'entrypoint'"}, None
         submission_id = self.job_manager.submit(
@@ -229,7 +241,8 @@ class DashboardServer:
         )
         return 200, {"submission_id": submission_id}, None
 
-    def _timeline(self, body, limit: int = 250, span_limit: int = 250):
+    def _timeline(self, body, query=None, limit: int = 250,
+                  span_limit: int = 250):
         """UI refresh payload: recent events only — the browser renders the
         last 80 bars; /api/timeline/full is the whole-trace download. Both
         merge GCS task-state events with the cluster span store, so the
@@ -245,30 +258,30 @@ class DashboardServer:
         merge_span_events(trace, spans)
         return 200, {"traceEvents": trace}, None
 
-    def _timeline_full(self, body):
-        return self._timeline(body, limit=100000, span_limit=100000)
+    def _timeline_full(self, body, query=None):
+        return self._timeline(body, query, limit=100000, span_limit=100000)
 
     def _metric_payloads(self):
         from ..util.metrics import fetch_metric_payloads
 
         return fetch_metric_payloads(self._gcs)
 
-    def _devices(self, body):
+    def _devices(self, body, query=None):
         from ..util.metrics import device_rows
 
         return 200, device_rows(self._metric_payloads()), None
 
-    def _kvcache(self, body):
+    def _kvcache(self, body, query=None):
         from ..util.metrics import kvcache_summary
 
         return 200, kvcache_summary(self._metric_payloads()), None
 
-    def _kvtier(self, body):
+    def _kvtier(self, body, query=None):
         from ..util.metrics import kvtier_summary
 
         return 200, kvtier_summary(self._metric_payloads()), None
 
-    def _train(self, body):
+    def _train(self, body, query=None):
         import json as _json
 
         from ..util.metrics import train_ft_summary
@@ -287,12 +300,18 @@ class DashboardServer:
                 runs.append(rec)
         except Exception:
             pass
+        try:
+            stragglers = self._gcs("straggler_verdicts")
+        except Exception:
+            stragglers = None
         return 200, {
             "runs": runs,
-            "fault_tolerance": train_ft_summary(self._metric_payloads()),
+            "fault_tolerance": train_ft_summary(
+                self._metric_payloads(), stragglers=stragglers
+            ),
         }, None
 
-    def _serve(self, body):
+    def _serve(self, body, query=None):
         import json as _json
 
         from ..util.metrics import serve_ft_summary
@@ -310,7 +329,7 @@ class DashboardServer:
             "fault_tolerance": serve_ft_summary(self._metric_payloads()),
         }, None
 
-    def _proxies(self, body):
+    def _proxies(self, body, query=None):
         import json as _json
 
         from ..util.metrics import ingress_summary
@@ -337,7 +356,7 @@ class DashboardServer:
             "traffic": ingress_summary(self._metric_payloads()),
         }, None
 
-    def _autoscale(self, body):
+    def _autoscale(self, body, query=None):
         import json as _json
 
         from ..util.metrics import autoscale_summary
@@ -354,14 +373,49 @@ class DashboardServer:
             "summary": autoscale_summary(self._metric_payloads()),
         }, None
 
-    def _events(self, body):
+    def _events(self, body, query=None):
+        query = query or {}
+        name = query.get("name") or None
         try:
-            events = self._gcs("list_events", 1000, None)
+            since = float(query["since"]) if "since" in query else None
+            limit = int(query.get("limit", 1000))
+        except ValueError:
+            return 400, {"error": "since/limit must be numeric"}, None
+        try:
+            events = self._gcs("list_events", limit, name, since)
         except Exception:
             events = []
-        return 200, {"events": events}, None
+        # truncation accounting: how much history is already gone — rings
+        # (per-process events_dropped_total) and the GCS store's own cap
+        from ..util.metrics import events_dropped_from_payloads
 
-    def _metrics(self, body):
+        dropped = {"rings": 0.0, "store": 0}
+        try:
+            dropped["rings"] = events_dropped_from_payloads(
+                self._metric_payloads()
+            )
+            dropped["store"] = self._gcs("events_stats")["dropped_total"]
+        except Exception:
+            pass
+        return 200, {"events": events, "dropped": dropped}, None
+
+    def _timeseries(self, body, query=None):
+        query = query or {}
+        try:
+            since = float(query["since"]) if "since" in query else None
+            limit = int(query.get("limit", 500))
+        except ValueError:
+            return 400, {"error": "since/limit must be numeric"}, None
+        series = self._gcs(
+            "ts_query", query.get("name") or None, None, since,
+            query.get("worker") or None, limit,
+        )
+        return 200, {"series": series}, None
+
+    def _alerts(self, body, query=None):
+        return 200, self._gcs("alerts_snapshot"), None
+
+    def _metrics(self, body, query=None):
         from ..util.metrics import render_prometheus
 
         return 200, render_prometheus(self._metric_payloads()), "text/plain"
@@ -403,6 +457,8 @@ _INDEX_HTML = """<!doctype html>
 <h2>KV cache</h2><table id="kvcache"></table>
 <h2>KV tier</h2><table id="kvtier"></table>
 <h2>Autoscale</h2><table id="autoscale"></table>
+<h2>Alerts</h2><table id="alerts"></table>
+<h2>Stragglers</h2><table id="stragglers"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Placement groups</h2><table id="pgs"></table>
 <h2>Jobs</h2><table id="jobs"></table>
@@ -538,6 +594,28 @@ async function refresh() {
       breach_s: (ev.breach_age_s ?? 0).toFixed(2),
       totals: "up " + (ascSum.scale_ups ?? 0) + " / down " + (ascSum.scale_downs ?? 0),
     })), ["time", "deployment", "decision", "reason", "breach_s", "totals"]);
+    const al = await j("/api/alerts");
+    const fired = (al.active || []).map(a => ({
+      state: "FIRING", rule: a.rule, series: a.series,
+      labels: JSON.stringify(a.labels || {}),
+      value: Number(a.value ?? 0).toFixed(4),
+      threshold: a.threshold, trace: (a.exemplar || "").slice(0, 12),
+    }));
+    const recent = (al.log || []).slice(-10).reverse().map(t => ({
+      state: t.transition, rule: t.rule, series: t.series,
+      labels: JSON.stringify(t.labels || {}),
+      value: Number(t.value ?? 0).toFixed(4),
+      threshold: t.threshold, trace: (t.exemplar || "").slice(0, 12),
+    }));
+    fill("alerts", fired.concat(recent),
+      ["state", "rule", "series", "labels", "value", "threshold", "trace"]);
+    fill("stragglers", (al.stragglers || []).map(v => ({
+      group: v.group, rank: v.rank ?? "", worker: (v.worker_id || "").slice(0, 12),
+      step_s: Number(v.median_s ?? 0).toFixed(4),
+      group_s: Number(v.group_median_s ?? 0).toFixed(4),
+      deviation: (100 * (v.deviation ?? 0)).toFixed(1) + "%",
+      straggler: v.straggler ? "STRAGGLER" : "",
+    })), ["group", "rank", "worker", "step_s", "group_s", "deviation", "straggler"]);
     const actors = await j("/api/actors");
     fill("actors", actors.map(a => ({
       id: (a.actor_id || "").slice(0, 12),

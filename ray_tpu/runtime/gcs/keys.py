@@ -133,6 +133,13 @@ KVTIER = KeyPrefix(
     "chunk refs); written by the GCS KVTierRegistry, swept on holder-node "
     "death and on LRU eviction so stale holders never accrete",
 )
+TIMESERIES = KeyPrefix(
+    "ts",
+    "telemetry time-series store: ts:<name>:<digest> → series entry "
+    "(identity + labels + retention-trimmed points); written by the GCS "
+    "TimeseriesStore on every ts_push, persisted write-through so series "
+    "history survives a GCS restart like the weight registry",
+)
 SERVE_PROXY = KeyPrefix(
     "proxy",
     "serve ingress proxy registry proxy:<proxy_id> → identity JSON (kind, "

@@ -44,6 +44,7 @@ from .placement_groups import GcsPlacementGroupManager
 from .pubsub import Publisher
 from .store import StoreClient, make_store
 from .kvtier_registry import GcsKVTierRegistry
+from .timeseries_store import GcsTimeseriesStore
 from .weight_registry import GcsWeightRegistry
 
 logger = logging.getLogger(__name__)
@@ -60,6 +61,7 @@ class GcsServer:
         self.pg_manager = GcsPlacementGroupManager(self)
         self.weight_registry = GcsWeightRegistry(self)
         self.kvtier_registry = GcsKVTierRegistry(self)
+        self.timeseries = GcsTimeseriesStore(self)
 
         self._nodes: Dict[NodeID, NodeInfo] = {}
         self._node_available: Dict[NodeID, Dict[str, float]] = {}
@@ -92,6 +94,9 @@ class GcsServer:
         # `ray_tpu events` can post-mortem a dead replica
         self._events: List[dict] = []
         self._events_cap = 50000
+        # store-side truncation counter (the process-local twin is the
+        # events_dropped_total metric): how many events this store evicted
+        self._events_dropped = 0
         # autoscaler state (reference: GcsAutoscalerStateManager)
         self._node_demands: Dict[NodeID, list] = {}
         self._autoscaling_state: Optional[dict] = None
@@ -154,6 +159,7 @@ class GcsServer:
         restored_nodes |= self.actor_manager.restore_from(self.storage)
         restored_nodes |= self.pg_manager.restore_from(self.storage)
         self.weight_registry.restore_from(self.storage)
+        self.timeseries.restore_from(self.storage)
         if restored_nodes:
             deadline = time.time() + self.config.health_check_timeout_s
             self._restored_nodes_pending = {
@@ -435,6 +441,9 @@ class GcsServer:
                 )
                 await self.actor_manager.on_node_death(node_id)
                 await self.pg_manager.on_node_death(node_id)
+        # telemetry evaluation rides the health cadence so alerts resolve
+        # and retention reaps even when no worker is pushing series
+        self.timeseries.evaluate(now, force=True)
 
     async def _probe_node(self, node_id: NodeID, report_age_s: float):
         """Active liveness probe of a node whose reports stopped (reference:
@@ -501,16 +510,9 @@ class GcsServer:
         # synthetic flight-recorder marker: the dead worker can't dump its
         # own ring (SIGKILL), but its continuously pushed events are already
         # here — this stitches the death cause into the same event stream
-        self._events.append({
-            "ts": time.time(),
-            "pid": None,
-            "name": "worker_death",
-            "worker_id": worker_id.hex(),
-            "reason": reason,
-            "synthetic": True,
-        })
-        if len(self._events) > self._events_cap:
-            del self._events[: len(self._events) - self._events_cap]
+        self.append_synthetic_event(
+            "worker_death", worker_id=worker_id.hex(), reason=reason
+        )
         await self.actor_manager.on_worker_death(worker_id, reason)
         # reap the dead worker's pushed metrics snapshot, or its series
         # would live in every /metrics scrape forever
@@ -744,18 +746,77 @@ class GcsServer:
 
     # -- flight-recorder event store (see util/events.py) ------------------
 
+    def _trim_events(self):
+        if len(self._events) > self._events_cap:
+            drop = len(self._events) - self._events_cap
+            del self._events[:drop]
+            self._events_dropped += drop
+
+    def append_synthetic_event(self, name: str, **fields):
+        """Server-originated flight-recorder entry (worker deaths, straggler
+        verdicts, alert transitions): the source process can't or won't push
+        one, so the store stitches it into the same stream itself."""
+        ev = {"ts": time.time(), "pid": None, "name": str(name),
+              "synthetic": True}
+        ev.update(fields)
+        self._events.append(ev)
+        self._trim_events()
+
     async def handle_report_events(self, events: List[dict]):
         self._events.extend(events)
-        if len(self._events) > self._events_cap:
-            del self._events[: len(self._events) - self._events_cap]
+        self._trim_events()
         return True
 
     async def handle_list_events(
-        self, limit: int = 1000, name: Optional[str] = None
+        self, limit: int = 1000, name: Optional[str] = None,
+        since: Optional[float] = None,
     ):
-        if name is None:
-            return self._events[-limit:]
-        return [e for e in self._events if e.get("name") == name][-limit:]
+        events = self._events
+        if name is not None:
+            events = [e for e in events if e.get("name") == name]
+        if since is not None:
+            events = [e for e in events if e.get("ts", 0) >= since]
+        return events[-limit:]
+
+    async def handle_events_stats(self):
+        """Truncation accounting for /api/events: how much history the
+        store itself has already forgotten."""
+        return {
+            "stored": len(self._events),
+            "cap": self._events_cap,
+            "dropped_total": self._events_dropped,
+        }
+
+    # -- telemetry time-series plane (see util/timeseries.py) --------------
+
+    async def handle_ts_push(self, payload: dict) -> int:
+        return self.timeseries.push(payload)
+
+    async def handle_ts_query(
+        self, name: Optional[str] = None, labels: Optional[dict] = None,
+        since: Optional[float] = None, worker_id: Optional[str] = None,
+        limit_points: int = 500,
+    ):
+        return self.timeseries.query(
+            name=name, labels=labels, since=since, worker_id=worker_id,
+            limit_points=limit_points,
+        )
+
+    async def handle_ts_list(self):
+        return self.timeseries.list_series()
+
+    async def handle_alerts_snapshot(self):
+        return self.timeseries.alerts_snapshot()
+
+    async def handle_alerts_set_rule(self, rule: dict):
+        return self.timeseries.set_rule(rule)
+
+    async def handle_alerts_delete_rule(self, name: str) -> bool:
+        return self.timeseries.delete_rule(name)
+
+    async def handle_straggler_verdicts(self):
+        self.timeseries.evaluate()
+        return self.timeseries.straggler_detector.verdicts()
 
     async def handle_register_job(self, metadata: dict) -> JobID:
         job_id = JobID.from_int(self._next_job)

@@ -122,7 +122,9 @@ def cmd_stop(args):
 def _connected(args):
     import ray_tpu
 
-    ray_tpu.init(address=args.address)
+    # reuse a live driver when one exists in-process (tests drive commands
+    # through main() against their own cluster)
+    ray_tpu.init(address=args.address, ignore_reinit_error=True)
     return ray_tpu
 
 
@@ -280,9 +282,97 @@ def cmd_events(args):
     from ..util import state
 
     print(json.dumps(
-        state.list_events(limit=args.limit, name=args.name),
+        state.list_events(
+            limit=args.limit, name=args.name,
+            since=getattr(args, "since", None),
+        ),
         indent=2, default=str,
     ))
+    return 0
+
+
+def cmd_top(args):
+    """`ray_tpu top`: live per-worker training table, sorted by step-time
+    deviation from the group median — the straggler hunt's first screen.
+    Rows come from the GCS timeseries store's MAD verdicts; ``--watch``
+    refreshes until interrupted."""
+    _connected(args)
+    import time as _time
+
+    from ..util import state
+
+    def _render():
+        rows = state.straggler_verdicts()
+        if getattr(args, "json", False):
+            print(json.dumps(rows, indent=2, default=str))
+            return
+        if not rows:
+            print("no step-time series yet (is a training run reporting?)")
+            return
+        header = (
+            f"{'GROUP':<14} {'RANK':>4} {'WORKER':<14} {'STEP s':>9} "
+            f"{'GROUP s':>9} {'DEV %':>8}  STATUS"
+        )
+        print(header)
+        for v in rows:
+            print(
+                f"{str(v.get('group') or '?')[:14]:<14} "
+                f"{str(v.get('rank') if v.get('rank') is not None else '?'):>4} "
+                f"{str(v.get('worker_id') or '')[:14]:<14} "
+                f"{v.get('median_s', 0.0):>9.4f} "
+                f"{v.get('group_median_s', 0.0):>9.4f} "
+                f"{100.0 * v.get('deviation', 0.0):>8.1f}  "
+                f"{'STRAGGLER' if v.get('straggler') else 'ok'}"
+            )
+
+    if getattr(args, "watch", False):
+        try:
+            while True:
+                print(f"\n-- {_time.strftime('%H:%M:%S')} --")
+                _render()
+                _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+    else:
+        _render()
+    return 0
+
+
+def cmd_alerts(args):
+    """`ray_tpu alerts`: the alerting engine's surface — active alerts,
+    declared rules, recent firing/resolved transitions, and straggler
+    verdicts, straight off the GCS ``alerts_snapshot`` RPC. ``--events``
+    tails the alert/straggler flight-recorder stream instead;
+    ``--set-rule`` / ``--delete-rule`` manage the rule registry."""
+    _connected(args)
+    from ..util import state
+
+    if getattr(args, "set_rule", None):
+        rule = json.loads(args.set_rule)
+        print(json.dumps(state.set_alert_rule(rule), indent=2, default=str))
+        return 0
+    if getattr(args, "delete_rule", None):
+        ok = state.delete_alert_rule(args.delete_rule)
+        print(json.dumps({"deleted": ok}))
+        return 0 if ok else 1
+    if getattr(args, "events", False):
+        out = []
+        for name in (
+            "alert_firing", "alert_resolved",
+            "straggler_detected", "straggler_resolved",
+        ):
+            out.extend(state.list_events(
+                limit=args.limit, name=name,
+                since=getattr(args, "since", None),
+            ))
+        out.sort(key=lambda e: e.get("ts", 0))
+        print(json.dumps(out[-args.limit:], indent=2, default=str))
+        return 0
+    snapshot = state.alerts_snapshot()
+    if getattr(args, "rules", False):
+        print(json.dumps(snapshot["rules"], indent=2, default=str))
+        return 0
+    print(json.dumps(snapshot, indent=2, default=str))
     return 0
 
 
@@ -503,7 +593,7 @@ def cmd_chaos(args):
 def cmd_lint(args):
     """`ray_tpu lint`: the project-invariant static-analysis pass.
 
-    Runs the RT001..RT008 checkers (ray_tpu/analysis/) over the package —
+    Runs the RT001..RT012 checkers (ray_tpu/analysis/) over the package —
     or the given paths — subtracts the committed baseline, and reports
     what's left. Exit codes: 0 clean, 1 findings (new or stale baseline),
     2 internal error. ``--baseline-update`` rewrites the baseline from the
@@ -726,7 +816,58 @@ def main(argv=None):
         "--name", default=None,
         help="filter to one event name (e.g. replica_state, request_retry)",
     )
+    p.add_argument(
+        "--since", type=float, default=None,
+        help="only events with ts >= this unix timestamp",
+    )
     p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser(
+        "top",
+        help="live per-worker training table sorted by step-time "
+             "deviation (straggler hunt)",
+    )
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument("--json", action="store_true", help="raw verdict rows")
+    p.add_argument(
+        "--watch", action="store_true", help="refresh until interrupted"
+    )
+    p.add_argument(
+        "--interval", type=float, default=2.0,
+        help="seconds between --watch refreshes",
+    )
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser(
+        "alerts",
+        help="alerting engine: active alerts, rules, transitions, "
+             "straggler verdicts",
+    )
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument(
+        "--rules", action="store_true", help="list declared rules only"
+    )
+    p.add_argument(
+        "--events", action="store_true",
+        help="tail alert/straggler flight-recorder events instead",
+    )
+    p.add_argument(
+        "--limit", type=int, default=100, help="max events (--events)"
+    )
+    p.add_argument(
+        "--since", type=float, default=None,
+        help="only events with ts >= this unix timestamp (--events)",
+    )
+    p.add_argument(
+        "--set-rule", default=None, metavar="JSON",
+        help='declare/replace a rule, e.g. \'{"name": "slow_ttft", '
+             '"series": "serve_ttft_s", "threshold": 0.5}\'',
+    )
+    p.add_argument(
+        "--delete-rule", default=None, metavar="NAME",
+        help="remove a rule from the registry",
+    )
+    p.set_defaults(fn=cmd_alerts)
 
     p = sub.add_parser(
         "proxies",
@@ -833,7 +974,7 @@ def main(argv=None):
 
     p = sub.add_parser(
         "lint",
-        help="run the RT001..RT008 static-analysis pass "
+        help="run the RT001..RT012 static-analysis pass "
              "(exit 0 clean / 1 findings / 2 internal error)",
     )
     p.add_argument(
@@ -899,16 +1040,19 @@ def main(argv=None):
     p.add_argument("--address", required=True, help="head host:port")
     p.set_defaults(fn=cmd_list)
 
-    p = sub.add_parser(
-        "microbenchmark", help="core-ops throughput suite "
-        "(reference: release/microbenchmark)",
-    )
-    p.add_argument("--small", action="store_true")
-    p.add_argument(
-        "--json", action="store_true",
-        help="emit one machine-readable JSON line (BENCH_LOG.md appends)",
-    )
-    p.set_defaults(fn=cmd_microbenchmark)
+    # `perf` is the canonical name; `microbenchmark` stays as the
+    # backward-compatible alias from earlier rounds
+    for bench_name in ("perf", "microbenchmark"):
+        p = sub.add_parser(
+            bench_name, help="core-ops throughput suite "
+            "(reference: release/microbenchmark)",
+        )
+        p.add_argument("--small", action="store_true")
+        p.add_argument(
+            "--json", action="store_true",
+            help="emit one machine-readable JSON line (BENCH_LOG.md appends)",
+        )
+        p.set_defaults(fn=cmd_microbenchmark)
 
     args = parser.parse_args(argv)
     return args.fn(args) or 0

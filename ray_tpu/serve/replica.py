@@ -87,6 +87,42 @@ class Replica:
         from ..util.metrics import record_serve_replica_warmup
 
         record_serve_replica_warmup(deployment_name, self._warmup_s)
+        # per-replica telemetry series (util/timeseries.py): TTFT recorded
+        # inline per request, queue depth pulled by a sampler on the push
+        # cadence so the request hot path never pays for it
+        self._ttft_series = None
+        try:
+            from ..util import timeseries as _ts
+
+            _ts.register_series(
+                _ts.SERVE_QUEUE_DEPTH,
+                labels={
+                    "deployment": deployment_name,
+                    "replica": replica_id,
+                },
+                sampler=lambda: float(self._queued),
+            )
+        except Exception:
+            pass  # telemetry is best-effort; replicas start regardless
+
+    def _ttft_telemetry(self, ttft_s: float, trace_id: Optional[str]):
+        """Per-replica TTFT history; the point carries the request's
+        trace_id as an exemplar so a firing TTFT alert names a concrete
+        slow request. Never raises."""
+        try:
+            if self._ttft_series is None:
+                from ..util import timeseries as _ts
+
+                self._ttft_series = _ts.register_series(
+                    _ts.SERVE_TTFT_S,
+                    labels={
+                        "deployment": self._deployment_name,
+                        "replica": self._replica_id,
+                    },
+                )
+            self._ttft_series.record(ttft_s, exemplar=trace_id)
+        except Exception:
+            pass
 
     _AFFINITY_KEY_WINDOW_S = 60.0
     _AFFINITY_KEY_CAP = 4096
@@ -349,9 +385,13 @@ class Replica:
             # unary TTFT = first (and only) output; queue wait is
             # included on purpose — that is the latency the caller
             # experiences and the signal the autoscaler scales on
+            ttft = time.perf_counter() - t0
             record_serve_ttft(
-                self._deployment_name, time.perf_counter() - t0,
+                self._deployment_name, ttft,
                 trace_id=span_ctx["trace_id"] if span_ctx else None,
+            )
+            self._ttft_telemetry(
+                ttft, span_ctx["trace_id"] if span_ctx else None
             )
             return result
         finally:
@@ -388,6 +428,9 @@ class Replica:
                 record_serve_ttft(
                     self._deployment_name, ttft,
                     trace_id=span_ctx["trace_id"] if span_ctx else None,
+                )
+                self._ttft_telemetry(
+                    ttft, span_ctx["trace_id"] if span_ctx else None
                 )
                 if span_ctx is not None:
                     # streaming first-token stage: admission to first item

@@ -65,6 +65,10 @@ class TrainContext:
     # report-to-report step telemetry (compute/collective split +
     # scaling-efficiency gauge; util/metrics.StepBreakdown)
     _step_breakdown: Any = None
+    # per-worker step-time series (util/timeseries.py) — the straggler
+    # detector's cross-worker input; wall-clock of the previous report
+    _step_series: Any = None
+    _last_report_t: Optional[float] = None
     # lazily-built GradientReduceScheduler for this run's group (one per
     # context: the re-formed gang's context rebuilds it at the new epoch)
     _grad_scheduler: Any = None
@@ -115,6 +119,7 @@ class TrainContext:
 
             self._step_breakdown = StepBreakdown(role="train")
         self._step_breakdown.mark()
+        self._record_step_series()
         persisted: Optional[Checkpoint] = None
         if checkpoint is not None:
             dest = os.path.join(self.run_dir, f"checkpoint_{index:06d}")
@@ -127,6 +132,40 @@ class TrainContext:
             self._reports.append(
                 TrainingReport(dict(metrics), persisted, index, self.world_rank)
             )
+
+    def _record_step_series(self):
+        """Publish this worker's report-to-report wall clock into the
+        telemetry plane. Labels name the run/group/rank so the GCS-side
+        MAD detector can compare ranks inside one gang; the point carries
+        the worker's root trace id as an exemplar so a STRAGGLER_DETECTED
+        event links straight to its trace timeline. Never raises."""
+        import time as _time
+
+        now = _time.time()
+        last, self._last_report_t = self._last_report_t, now
+        if last is None:
+            return
+        try:
+            if self._step_series is None:
+                from ..util import timeseries as _ts
+
+                self._step_series = _ts.register_series(
+                    _ts.STEP_TIME_S,
+                    labels={
+                        "run": self.experiment_name,
+                        "group": self.collective_group,
+                        "rank": str(self.world_rank),
+                    },
+                )
+            from ..util import tracing as _tracing
+
+            ctx = _tracing.current_context()
+            self._step_series.record(
+                now - last, ts=now,
+                exemplar=ctx["trace_id"] if ctx else None,
+            )
+        except Exception:
+            pass  # telemetry is best-effort; never fail a report
 
     def drain_reports(self):
         with self._lock:

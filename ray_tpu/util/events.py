@@ -87,6 +87,10 @@ PROXY_STOP = EventName("proxy_stop")
 PROXY_DRAIN = EventName("proxy_drain")
 KV_SHIPPED = EventName("kv_shipped")
 KVTIER_EVICT = EventName("kvtier_evict")
+STRAGGLER_DETECTED = EventName("straggler_detected")
+STRAGGLER_RESOLVED = EventName("straggler_resolved")
+ALERT_FIRING = EventName("alert_firing")
+ALERT_RESOLVED = EventName("alert_resolved")
 
 
 # -- recording ----------------------------------------------------------------
@@ -99,6 +103,7 @@ def record_event(name: str, **fields) -> None:
     ev = {"ts": time.time(), "pid": os.getpid(), "name": str(name)}
     ev.update(fields)
     global _flush_cursor
+    dropped = 0
     with _lock:
         _events.append(ev)
         if len(_events) > _events_cap:
@@ -107,6 +112,14 @@ def record_event(name: str, **fields) -> None:
             drop = len(_events) - _events_cap
             del _events[:drop]
             _flush_cursor = max(0, _flush_cursor - drop)
+            dropped = drop
+    if dropped:
+        try:
+            from . import metrics as _metrics
+
+            _metrics.record_events_dropped(dropped)
+        except Exception:
+            pass  # forensics are best-effort; never take down the caller
     _ensure_event_pusher()
 
 

@@ -283,6 +283,48 @@ def rpc_latency_summary() -> Dict[str, dict]:
     return out
 
 
+# -- flight-recorder health ---------------------------------------------------
+
+_events_dropped: Optional["Counter"] = None
+_events_dropped_lock = threading.Lock()
+
+
+def _ensure_events_dropped():
+    global _events_dropped
+    if _events_dropped is None:
+        with _events_dropped_lock:
+            if _events_dropped is None:
+                _events_dropped = Counter(
+                    "events_dropped_total",
+                    "Flight-recorder ring overflows: oldest events dropped "
+                    "when the cap was hit, truncating the post-mortem window",
+                )
+    return _events_dropped
+
+
+def record_events_dropped(n: float = 1.0):
+    """Called from util/events.py when the ring drops its oldest events."""
+    _ensure_events_dropped().inc(float(n))
+
+
+def events_dropped_total() -> float:
+    """Process-local readback."""
+    c = _ensure_events_dropped()
+    with c._lock:
+        return sum(c._values.values())
+
+
+def events_dropped_from_payloads(payloads) -> float:
+    """Cluster rollup over pushed metric payloads: total events every
+    process's ring has dropped (the /api/events truncation banner)."""
+    total = 0.0
+    for payload in payloads:
+        for snap in payload.get("metrics", ()):
+            if snap.get("name") == "events_dropped_total":
+                total += sum(snap.get("values", {}).values())
+    return total
+
+
 # ---------------------------------------------------------------------------
 # Object-serialization accounting: how many times (and how many bytes) this
 # process serialized values into the object plane, by context — "put"
@@ -866,9 +908,15 @@ def train_ft_counters() -> Dict[str, float]:
     return out
 
 
-def train_ft_summary(payloads: List[dict]) -> Dict[str, object]:
+def train_ft_summary(
+    payloads: List[dict],
+    stragglers: Optional[List[dict]] = None,
+) -> Dict[str, object]:
     """Cluster rollup of the train fault-tolerance plane from every
-    worker's pushed snapshot (state.metrics_summary / dashboard)."""
+    worker's pushed snapshot (state.metrics_summary / dashboard).
+    ``stragglers`` joins the timeseries plane's MAD verdicts (GCS
+    ``straggler_verdicts`` RPC) into the same rollup, so the dashboard's
+    train table answers "is anyone slow" next to "did anyone die"."""
     out = {
         "resizes": 0.0,
         "restarts": 0.0,
@@ -908,6 +956,9 @@ def train_ft_summary(payloads: List[dict]) -> Dict[str, object]:
         out["overlap_fraction"] = (
             out["collective_overlapped_s"] / overlap_total
         )
+    if stragglers is not None:
+        out["stragglers"] = [v for v in stragglers if v.get("straggler")]
+        out["straggler_verdicts"] = stragglers
     return out
 
 
@@ -1295,6 +1346,15 @@ def set_kvcache_blocks(in_use: int, capacity: int, mesh: str = "tp=1"):
     m = _ensure_kvcache_metrics()
     m["blocks_in_use"].set(float(in_use), {"mesh": mesh})
     m["blocks_capacity"].set(float(capacity), {"mesh": mesh})
+    if capacity > 0:
+        try:
+            from . import timeseries as _ts
+
+            _ts.register_series(
+                _ts.KV_POOL_OCCUPANCY, labels={"mesh": mesh}
+            ).record(float(in_use) / float(capacity))
+        except Exception:
+            pass  # telemetry is best-effort; the gauges above are canonical
 
 
 def record_kvcache_ttft(

@@ -197,7 +197,7 @@ def metrics_summary() -> Dict[str, Any]:
         "devices": device_rows(payloads),
         "kvcache": kvcache_summary(payloads),
         "kvtier": kvtier_summary(payloads),
-        "train_ft": train_ft_summary(payloads),
+        "train_ft": train_ft_summary(payloads, stragglers=_stragglers()),
         "serve_ft": serve_ft_summary(payloads),
         "serve_latency": serve_latency_summary(payloads),
         "autoscale": autoscale_summary(payloads),
@@ -289,13 +289,67 @@ def autoscale_log(limit: int = 100) -> List[Dict[str, Any]]:
 
 
 def list_events(
-    limit: int = 1000, name: Optional[str] = None
+    limit: int = 1000, name: Optional[str] = None,
+    since: Optional[float] = None,
 ) -> List[Dict[str, Any]]:
     """Most recent flight-recorder events from the GCS event store, oldest
-    first, optionally filtered by event name (`ray_tpu events`,
-    ``/api/events``). Because every process streams its ring continuously,
-    this works for SIGKILLed processes too — the post-mortem path."""
-    return _gcs_call("list_events", limit, name)
+    first, optionally filtered by event name and/or a ``ts >= since``
+    floor (`ray_tpu events`, ``/api/events``). Because every process
+    streams its ring continuously, this works for SIGKILLed processes
+    too — the post-mortem path."""
+    return _gcs_call("list_events", limit, name, since)
+
+
+def events_stats() -> Dict[str, Any]:
+    """GCS event-store truncation accounting (stored / cap / dropped)."""
+    return _gcs_call("events_stats")
+
+
+def _stragglers() -> Optional[List[Dict[str, Any]]]:
+    """Best-effort straggler verdicts for the train_ft join — None when
+    the GCS predates the timeseries plane or the call fails."""
+    try:
+        return _gcs_call("straggler_verdicts")
+    except Exception:
+        return None
+
+
+def query_timeseries(
+    name: Optional[str] = None,
+    labels: Optional[Dict[str, str]] = None,
+    since: Optional[float] = None,
+    worker_id: Optional[str] = None,
+    limit_points: int = 500,
+) -> List[Dict[str, Any]]:
+    """Series entries (with points) from the GCS timeseries store
+    (``ray_tpu top``, ``/api/timeseries``)."""
+    return _gcs_call(
+        "ts_query", name, labels, since, worker_id, limit_points
+    )
+
+
+def list_timeseries() -> List[Dict[str, Any]]:
+    """Series index (no points) from the GCS timeseries store."""
+    return _gcs_call("ts_list")
+
+
+def alerts_snapshot() -> Dict[str, Any]:
+    """Active alerts + rules + recent transitions + straggler verdicts
+    in one round-trip (``ray_tpu alerts``, ``/api/alerts``)."""
+    return _gcs_call("alerts_snapshot")
+
+
+def set_alert_rule(rule: Dict[str, Any]) -> Dict[str, Any]:
+    return _gcs_call("alerts_set_rule", rule)
+
+
+def delete_alert_rule(name: str) -> bool:
+    return _gcs_call("alerts_delete_rule", name)
+
+
+def straggler_verdicts() -> List[Dict[str, Any]]:
+    """Per-worker step-time deviation rows, sorted worst-first."""
+    return _gcs_call("straggler_verdicts")
 
 
 def list_weights() -> List[Dict[str, Any]]:

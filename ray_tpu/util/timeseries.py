@@ -1,0 +1,512 @@
+"""Per-process telemetry time-series plane.
+
+Everything else in the observability stack is either a point-in-time
+snapshot (util/metrics.py counters and gauges, overwritten on every
+push) or a post-mortem ring (util/events.py, util/tracing.py).  This
+module keeps *history*: a :class:`TelemetryStream` samples registered
+series (step time, exposed-collective fraction, KV-pool occupancy,
+transfer bytes, RPC latency, ...) into fixed-size downsampling ring
+buffers and pushes raw deltas to the GCS-backed store
+(`runtime/gcs/timeseries_store.py`, ``ts:`` keys) where the straggler
+detector and alert engine evaluate them cluster-side.
+
+Series names form a closed registry, exactly like event names
+(util/events.py) and metric declarations (util/metrics.py): every
+series recorded anywhere in the tree must be a :class:`SeriesName`
+constant declared in THIS file, and label sets must be statically
+bounded — both enforced by lint rule RT012
+(analysis/checkers/rt012_series_registry.py).
+
+Hot-path budget: ``Series.record`` is one lock plus two list appends
+(bench: ``ray_tpu perf`` asserts <1% step-time overhead with sampling
+enabled).  Heavier signals (RPC latency, transfer bytes) are pulled by
+*samplers* on the push cadence instead of being recorded inline.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+# -- series-name registry (lint rule RT012 enforces closure) -----------------
+
+_registry: Dict[str, str] = {}
+_registry_lock = threading.Lock()
+
+
+class SeriesName(str):
+    """A declared time-series name. Instantiating registers the name;
+    duplicates raise so the registry in this file stays the single
+    source of truth (mirrors util/events.py EventName)."""
+
+    def __new__(cls, name: str, doc: str = ""):
+        with _registry_lock:
+            if name in _registry:
+                raise ValueError(f"duplicate series name: {name!r}")
+            _registry[name] = doc
+        return super().__new__(cls, name)
+
+
+def registered_series_names() -> Dict[str, str]:
+    with _registry_lock:
+        return dict(_registry)
+
+
+# -- the series taxonomy -----------------------------------------------------
+
+STEP_TIME_S = SeriesName(
+    "step_time_s",
+    "Per-worker wall-clock seconds between training step reports; the "
+    "straggler detector's input signal.",
+)
+EXPOSED_COLLECTIVE_FRACTION = SeriesName(
+    "exposed_collective_fraction",
+    "Fraction of a gradient collective NOT hidden under backward "
+    "compute, tagged with the collective group and epoch.",
+)
+KV_POOL_OCCUPANCY = SeriesName(
+    "kv_pool_occupancy",
+    "KV block pool occupancy fraction (blocks in use / capacity).",
+)
+TRANSFER_BYTES = SeriesName(
+    "transfer_bytes",
+    "Bytes moved by the transfer planes (collective wire + weight wire "
+    "+ kvtier wire) per sample interval; sampler-driven delta.",
+)
+RPC_LATENCY_MS = SeriesName(
+    "rpc_latency_ms",
+    "Mean client RPC round-trip latency over the sample interval (ms); "
+    "sampler-driven delta over the rpc_client_latency_ms histogram.",
+)
+INPUT_WAIT_S = SeriesName(
+    "input_wait_s",
+    "Per-step seconds the trainer blocked waiting on input. Declared "
+    "ahead of the streaming data plane (ROADMAP item 4); no producer "
+    "records it yet.",
+)
+SERVE_TTFT_S = SeriesName(
+    "serve_ttft_s",
+    "Per-replica time-to-first-token seconds; points carry the request "
+    "trace_id as an exemplar so alerts link to a representative trace.",
+)
+SERVE_QUEUE_DEPTH = SeriesName(
+    "serve_queue_depth",
+    "Per-replica queued request count, sampled on the push cadence.",
+)
+
+
+# -- downsampling ring -------------------------------------------------------
+
+# point layout (lists, not dicts: they travel through JSON a lot)
+TS_FIRST, TS_LAST, SUM, MIN, MAX, COUNT, EXEMPLAR = range(7)
+
+
+def merge_points(a: list, b: list) -> list:
+    """Merge two adjacent aggregate points (b follows a in time)."""
+    return [
+        a[TS_FIRST],
+        b[TS_LAST],
+        a[SUM] + b[SUM],
+        min(a[MIN], b[MIN]),
+        max(a[MAX], b[MAX]),
+        a[COUNT] + b[COUNT],
+        b[EXEMPLAR] or a[EXEMPLAR],
+    ]
+
+
+def point_dict(p: list) -> dict:
+    """Render an aggregate point for API surfaces."""
+    return {
+        "ts": p[TS_LAST],
+        "ts_first": p[TS_FIRST],
+        "value": p[SUM] / p[COUNT] if p[COUNT] else 0.0,
+        "min": p[MIN],
+        "max": p[MAX],
+        "count": p[COUNT],
+        "exemplar": p[EXEMPLAR],
+    }
+
+
+class DownsamplingRing:
+    """Fixed-capacity time series that degrades resolution, not span.
+
+    Raw samples accumulate into the newest point until that point holds
+    ``stride`` of them; when the buffer would exceed ``capacity`` whole
+    points, adjacent pairs merge and the stride doubles.  Invariants
+    (pinned by tests/test_timeseries.py): total sample count and sum are
+    preserved exactly, min/max never tighten, and the buffer never
+    exceeds ``capacity`` points — so a long-running series keeps its
+    full history at geometrically coarser resolution instead of
+    silently forgetting the oldest half.
+    """
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 2:
+            raise ValueError("capacity must be >= 2")
+        self._capacity = capacity
+        self._stride = 1
+        self._points: List[list] = []
+        self._lock = threading.Lock()
+
+    def append(self, ts: float, value: float, exemplar=None) -> None:
+        with self._lock:
+            pts = self._points
+            if pts and pts[-1][COUNT] < self._stride:
+                p = pts[-1]
+                p[TS_LAST] = ts
+                p[SUM] += value
+                if value < p[MIN]:
+                    p[MIN] = value
+                if value > p[MAX]:
+                    p[MAX] = value
+                p[COUNT] += 1
+                if exemplar is not None:
+                    p[EXEMPLAR] = exemplar
+                return
+            pts.append([ts, ts, value, value, value, 1, exemplar])
+            if len(pts) > self._capacity:
+                merged = [
+                    merge_points(pts[i], pts[i + 1])
+                    for i in range(0, len(pts) - 1, 2)
+                ]
+                if len(pts) % 2:
+                    merged.append(pts[-1])
+                self._points = merged
+                self._stride *= 2
+
+    @property
+    def stride(self) -> int:
+        return self._stride
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._points)
+
+    def total_count(self) -> int:
+        with self._lock:
+            return sum(p[COUNT] for p in self._points)
+
+    def points(self) -> List[dict]:
+        with self._lock:
+            return [point_dict(p) for p in self._points]
+
+    def last(self) -> Optional[dict]:
+        with self._lock:
+            return point_dict(self._points[-1]) if self._points else None
+
+
+# -- series + stream ---------------------------------------------------------
+
+_PENDING_CAP = 4096
+
+
+def labels_key(labels: Optional[dict]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
+
+
+class Series:
+    """One (name, labels) stream: a local downsampling ring for in-process
+    reads plus a raw pending buffer drained by the GCS pusher."""
+
+    def __init__(self, name: str, labels: Optional[dict] = None, *,
+                 capacity: int = 256,
+                 sampler: Optional[Callable[[], Optional[float]]] = None):
+        self.name = str(name)
+        self.labels = {str(k): str(v) for k, v in (labels or {}).items()}
+        self.sampler = sampler
+        self.ring = DownsamplingRing(capacity)
+        self._pending: List[list] = []
+        self._pending_dropped = 0
+        self._lock = threading.Lock()
+
+    def record(self, value: float, ts: Optional[float] = None,
+               exemplar: Optional[str] = None) -> None:
+        """Hot path: one lock, two appends. Never raises."""
+        if not _enabled:
+            return
+        if ts is None:
+            ts = time.time()
+        value = float(value)
+        self.ring.append(ts, value, exemplar)
+        with self._lock:
+            self._pending.append([ts, value, exemplar])
+            if len(self._pending) > _PENDING_CAP:
+                drop = len(self._pending) - _PENDING_CAP
+                del self._pending[:drop]
+                self._pending_dropped += drop
+
+    def drain(self) -> List[list]:
+        with self._lock:
+            out, self._pending = self._pending, []
+        return out
+
+    def requeue(self, points: List[list]) -> None:
+        """Put an unsent batch back at the front (push failed)."""
+        with self._lock:
+            self._pending[:0] = points
+            if len(self._pending) > _PENDING_CAP:
+                drop = len(self._pending) - _PENDING_CAP
+                del self._pending[:drop]
+                self._pending_dropped += drop
+
+
+class TelemetryStream:
+    """Process-wide registry of :class:`Series` plus the push loop.
+
+    ``register`` is idempotent per (name, labels) and is the RT012
+    chokepoint: names must be SeriesName constants from this module.
+    Sampler-backed series are polled once per push tick so their cost
+    never lands on a request or step hot path.
+    """
+
+    def __init__(self, push_period_s: Optional[float] = None):
+        self.push_period_s = push_period_s if push_period_s is not None else \
+            float(os.environ.get("RAY_TPU_TS_PUSH_PERIOD_S", "2.0"))
+        self._series: Dict[Tuple[str, tuple], Series] = {}
+        self._lock = threading.Lock()
+        self._pusher_started = False
+
+    def register(self, name: str, labels: Optional[dict] = None, *,
+                 sampler: Optional[Callable[[], Optional[float]]] = None,
+                 capacity: int = 256) -> Series:
+        key = (str(name), labels_key(labels))
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = Series(name, labels, capacity=capacity, sampler=sampler)
+                self._series[key] = s
+            elif sampler is not None and s.sampler is None:
+                s.sampler = sampler
+        self._ensure_pusher()
+        return s
+
+    def get(self, name: str, labels: Optional[dict] = None) -> Optional[Series]:
+        with self._lock:
+            return self._series.get((str(name), labels_key(labels)))
+
+    def series(self) -> List[Series]:
+        with self._lock:
+            return list(self._series.values())
+
+    def sample_once(self, now: Optional[float] = None) -> None:
+        """Poll every sampler-backed series once. Called on the push
+        cadence (and directly by tests / flush)."""
+        if now is None:
+            now = time.time()
+        for s in self.series():
+            if s.sampler is None:
+                continue
+            try:
+                v = s.sampler()
+            except Exception:
+                continue
+            if v is not None:
+                s.record(float(v), ts=now)
+
+    # -- push plane ----------------------------------------------------------
+
+    def build_payload(self) -> Optional[dict]:
+        """Drain pending points into one identity-tagged delta payload
+        (None when there is nothing to send). Callers that fail to
+        deliver it should ``requeue_payload`` so points survive a
+        transient GCS outage."""
+        from .. import _worker_api
+        from . import metrics as _metrics
+
+        series_out = []
+        for s in self.series():
+            batch = s.drain()
+            if batch:
+                series_out.append({
+                    "name": s.name,
+                    "labels": s.labels,
+                    "points": batch,
+                })
+        if not series_out:
+            return None
+        worker = _worker_api.maybe_get_core_worker()
+        return {
+            "worker_id": worker.worker_id.hex() if worker else "",
+            "node_id": _metrics._node_hex(),
+            "pid": os.getpid(),
+            "ts": time.time(),
+            "series": series_out,
+        }
+
+    def requeue_payload(self, payload: dict) -> None:
+        for row in payload.get("series", ()):
+            s = self.register(row["name"], row["labels"])
+            s.requeue(row["points"])
+
+    def flush(self) -> bool:
+        """Sample, then push pending deltas to the GCS store. Returns
+        True when a payload was delivered. Safe (no-op) with no cluster."""
+        from .. import _worker_api
+
+        self.sample_once()
+        payload = self.build_payload()
+        if payload is None:
+            return False
+        worker = _worker_api.maybe_get_core_worker()
+        if worker is None:
+            self.requeue_payload(payload)
+            return False
+        try:
+            _worker_api.run_on_worker_loop(
+                worker.client_pool.get(*worker.gcs_address).call(
+                    "ts_push", payload
+                ),
+                timeout=5,
+            )
+            return True
+        except Exception:
+            self.requeue_payload(payload)
+            return False
+
+    def _ensure_pusher(self) -> None:
+        with self._lock:
+            if self._pusher_started:
+                return
+            self._pusher_started = True
+
+        def _loop():
+            while True:
+                time.sleep(self.push_period_s)
+                try:
+                    self.flush()
+                except Exception:
+                    pass  # telemetry is best-effort; never take down the host
+
+        threading.Thread(
+            target=_loop, daemon=True, name="telemetry-push"
+        ).start()
+
+
+# -- module-level singleton + convenience ------------------------------------
+
+_stream: Optional[TelemetryStream] = None
+_stream_lock = threading.Lock()
+_enabled = os.environ.get("RAY_TPU_TELEMETRY", "1") != "0"
+
+
+def set_enabled(flag: bool) -> bool:
+    """Toggle the record() hot path (the perf bench's on/off switch).
+    Returns the previous value."""
+    global _enabled
+    prev, _enabled = _enabled, bool(flag)
+    return prev
+
+
+def telemetry_enabled() -> bool:
+    return _enabled
+
+
+def get_stream() -> TelemetryStream:
+    global _stream
+    if _stream is None:
+        with _stream_lock:
+            if _stream is None:
+                stream = TelemetryStream()
+                _install_default_samplers(stream)
+                # assigned last: its non-None-ness gates the fast path, so
+                # the default samplers must already exist when readers see it
+                _stream = stream
+    return _stream
+
+
+def register_series(name: str, labels: Optional[dict] = None, *,
+                    sampler: Optional[Callable[[], Optional[float]]] = None,
+                    capacity: int = 256) -> Series:
+    """The canonical emitter entry point (what RT012 audits): ``name``
+    must be a SeriesName constant declared in this module and ``labels``
+    a statically bounded dict literal."""
+    return get_stream().register(
+        name, labels, sampler=sampler, capacity=capacity
+    )
+
+
+def flush_stream() -> bool:
+    """Synchronous flush for tests and the graceful-shutdown path."""
+    if _stream is None:
+        return False
+    return _stream.flush()
+
+
+def _reset_for_tests() -> None:
+    global _stream
+    with _stream_lock:
+        _stream = None
+
+
+def _install_default_samplers(stream: TelemetryStream) -> None:
+    """Sampler-backed cluster-health series every process exports: delta
+    mean RPC latency and delta transfer-plane bytes per push interval.
+    Samplers read process-local metric state (no RPCs) and return None
+    when nothing changed, so idle processes stay silent."""
+    from . import metrics as _metrics
+
+    state = {"rpc_sum": 0.0, "rpc_count": 0, "xfer": 0.0}
+
+    def _rpc_latency_delta() -> Optional[float]:
+        latency, _, _ = _metrics._ensure_rpc_metrics()
+        with latency._lock:
+            total_sum = sum(latency._sums.values())
+            total_count = sum(
+                sum(counts) for counts in latency._counts.values()
+            )
+        d_sum = total_sum - state["rpc_sum"]
+        d_count = total_count - state["rpc_count"]
+        state["rpc_sum"], state["rpc_count"] = total_sum, total_count
+        return d_sum / d_count if d_count > 0 else None
+
+    def _counter_total(name: str) -> float:
+        with _metrics._registry_lock:
+            m = _metrics._registry.get(name)
+        if m is None:
+            return 0.0
+        with m._lock:
+            return sum(m._values.values())
+
+    def _transfer_bytes_delta() -> Optional[float]:
+        total = (
+            _counter_total("collective_wire_bytes_total")
+            + _counter_total("weights_wire_bytes_total")
+            + _counter_total("kvtier_transfer_bytes_total")
+        )
+        delta, state["xfer"] = total - state["xfer"], total
+        return delta if delta > 0 else None
+
+    stream.register(RPC_LATENCY_MS, sampler=_rpc_latency_delta)
+    stream.register(TRANSFER_BYTES, sampler=_transfer_bytes_delta)
+
+
+def series_table() -> List[dict]:
+    """In-process view of every registered series (the clusterless
+    debugging surface; the dashboard reads the GCS store instead)."""
+    if _stream is None:
+        return []
+    out = []
+    for s in _stream.series():
+        last = s.ring.last()
+        out.append({
+            "name": s.name,
+            "labels": s.labels,
+            "points": s.ring.total_count(),
+            "stride": s.ring.stride,
+            "last": last,
+        })
+    return out
+
+
+def series_id(name: str, labels: Optional[dict], worker_id: str = "") -> str:
+    """Stable id for one (name, labels, worker) stream — the tail of its
+    ``ts:`` GCS key. Deterministic so re-pushes append, not fork."""
+    lk = labels_key(labels)
+    blob = json.dumps(lk, separators=(",", ":"))
+    import hashlib
+
+    digest = hashlib.sha1(
+        (worker_id + "|" + blob).encode()
+    ).hexdigest()[:10]
+    return f"{name}:{digest}"

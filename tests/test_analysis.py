@@ -482,10 +482,10 @@ def test_rt011_transfer_layer_and_other_planes_exempt(tmp_path):
 # ------------------------------------------------------------- framework
 
 
-def test_catalog_has_all_eleven_rules():
+def test_catalog_has_all_twelve_rules():
     assert sorted(checker_catalog()) == [
         "RT001", "RT002", "RT003", "RT004", "RT005", "RT006", "RT007",
-        "RT008", "RT009", "RT010", "RT011",
+        "RT008", "RT009", "RT010", "RT011", "RT012",
     ]
 
 

@@ -163,8 +163,10 @@ def test_train_endpoint(dashboard_cluster):
     assert set(ft) == {
         "resizes", "restarts", "aborts", "recoveries", "recovery_mean_s",
         "collective_exposed_s", "collective_overlapped_s", "overlap_fraction",
+        "stragglers", "straggler_verdicts",
     }
     assert ft["overlap_fraction"] == 0.0  # no overlapped collectives yet
+    assert ft["stragglers"] == []  # timeseries join present, nobody slow
 
 
 def test_autoscale_endpoint(dashboard_cluster):

@@ -1,0 +1,545 @@
+"""Telemetry time-series plane: downsampling rings, the GCS-backed store
+(retention, compaction, restart survival), the MAD straggler detector,
+the alert engine lifecycle, and the dashboard/CLI read paths.
+
+Unit tests exercise util/timeseries.py, util/alerts.py and
+runtime/gcs/timeseries_store.py directly (the store only needs an object
+with ``.storage`` and ``.append_synthetic_event``); one live cluster at
+the end drives the full path — ts_push ingest, straggler verdict within
+three steps, alert firing/resolution, /api/timeseries + /api/alerts +
+/api/events filters, and ``ray_tpu top`` / ``ray_tpu alerts``.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime.gcs.store import SqliteStoreClient
+from ray_tpu.runtime.gcs.timeseries_store import (
+    GcsTimeseriesStore,
+    _compact_points,
+)
+from ray_tpu.util import timeseries
+from ray_tpu.util.alerts import AlertEngine, AlertRule, StragglerDetector
+
+
+# -- downsampling ring --------------------------------------------------------
+
+
+def test_ring_invariants_preserved_under_downsampling():
+    ring = timeseries.DownsamplingRing(capacity=16)
+    n = 5000
+    values = [float(i % 97) for i in range(n)]
+    for i, v in enumerate(values):
+        ring.append(float(i), v)
+    assert len(ring) <= 16
+    assert ring.total_count() == n
+    pts = ring.points()
+    # count and sum exact; min/max never tighten
+    assert sum(p["count"] for p in pts) == n
+    total = sum(p["value"] * p["count"] for p in pts)
+    assert total == pytest.approx(sum(values))
+    assert min(p["min"] for p in pts) == min(values)
+    assert max(p["max"] for p in pts) == max(values)
+    # stride doubled (power of two), timestamps stay ordered
+    assert ring.stride > 1 and (ring.stride & (ring.stride - 1)) == 0
+    assert [p["ts"] for p in pts] == sorted(p["ts"] for p in pts)
+
+
+def test_ring_keeps_full_span_and_exemplars():
+    ring = timeseries.DownsamplingRing(capacity=4)
+    ring.append(0.0, 1.0, exemplar="trace-first")
+    for i in range(1, 200):
+        ring.append(float(i), 1.0)
+    pts = ring.points()
+    # oldest data degrades in resolution but is never forgotten
+    assert pts[0]["ts_first"] == 0.0
+    assert pts[-1]["ts"] == 199.0
+    assert any(p["exemplar"] == "trace-first" for p in pts)
+    assert ring.last()["ts"] == 199.0
+
+
+def test_ring_rejects_tiny_capacity():
+    with pytest.raises(ValueError):
+        timeseries.DownsamplingRing(capacity=1)
+
+
+# -- series + stream ----------------------------------------------------------
+
+
+def test_series_name_registry_rejects_duplicates():
+    assert "step_time_s" in timeseries.registered_series_names()
+    with pytest.raises(ValueError):
+        timeseries.SeriesName("step_time_s")
+
+
+def test_series_record_respects_enable_switch():
+    s = timeseries.Series(timeseries.STEP_TIME_S, {"run": "t"})
+    prev = timeseries.set_enabled(False)
+    try:
+        s.record(1.0)
+        assert s.ring.total_count() == 0 and s.drain() == []
+        timeseries.set_enabled(True)
+        s.record(2.0, exemplar="tr-1")
+        assert s.ring.total_count() == 1
+    finally:
+        timeseries.set_enabled(prev)
+    batch = s.drain()
+    assert len(batch) == 1 and batch[0][1] == 2.0 and batch[0][2] == "tr-1"
+
+
+def test_stream_register_idempotent_and_payload_roundtrip():
+    stream = timeseries.TelemetryStream(push_period_s=3600.0)
+    a = stream.register(
+        timeseries.STEP_TIME_S, labels={"run": "r", "rank": "0"}
+    )
+    b = stream.register(
+        timeseries.STEP_TIME_S, labels={"rank": "0", "run": "r"}
+    )
+    assert a is b  # label order does not fork the series
+    prev = timeseries.set_enabled(True)
+    try:
+        a.record(0.5, ts=10.0)
+    finally:
+        timeseries.set_enabled(prev)
+    payload = stream.build_payload()
+    assert payload is not None
+    row = next(r for r in payload["series"] if r["name"] == "step_time_s")
+    assert row["labels"] == {"run": "r", "rank": "0"}
+    assert row["points"] == [[10.0, 0.5, None]]
+    assert stream.build_payload() is None  # drained
+    stream.requeue_payload(payload)  # push failed: points survive
+    assert stream.build_payload()["series"][0]["points"] == [[10.0, 0.5, None]]
+
+
+def test_sampler_backed_series_polled_on_flush_cadence():
+    stream = timeseries.TelemetryStream(push_period_s=3600.0)
+    box = {"v": 1.5}
+    stream.register(
+        timeseries.SERVE_QUEUE_DEPTH,
+        labels={"deployment": "d", "replica": "r0"},
+        sampler=lambda: box["v"],
+    )
+    prev = timeseries.set_enabled(True)
+    try:
+        stream.sample_once(now=1.0)
+        box["v"] = None  # idle: sampler returning None records nothing
+        stream.sample_once(now=2.0)
+    finally:
+        timeseries.set_enabled(prev)
+    s = stream.get(
+        timeseries.SERVE_QUEUE_DEPTH,
+        {"deployment": "d", "replica": "r0"},
+    )
+    assert s.ring.total_count() == 1 and s.ring.last()["value"] == 1.5
+
+
+def test_series_id_stable_across_label_order():
+    a = timeseries.series_id("step_time_s", {"a": 1, "b": 2}, "w1")
+    b = timeseries.series_id("step_time_s", {"b": 2, "a": 1}, "w1")
+    assert a == b and a.startswith("step_time_s:")
+    assert a != timeseries.series_id("step_time_s", {"a": 1, "b": 2}, "w2")
+
+
+# -- GCS store: retention, compaction, restart --------------------------------
+
+
+class _StubGcs:
+    """The two attributes GcsTimeseriesStore needs from GcsServer."""
+
+    def __init__(self, storage):
+        self.storage = storage
+        self.events = []
+
+    def append_synthetic_event(self, name, **fields):
+        self.events.append({"name": name, **fields})
+
+
+def _push(store, worker, points, name="step_time_s", labels=None, node="n0"):
+    return store.push({
+        "worker_id": worker, "node_id": node, "pid": 1, "ts": time.time(),
+        "series": [{
+            "name": name,
+            "labels": labels or {"group": "g", "rank": worker[-1]},
+            "points": points,
+        }],
+    })
+
+
+def test_store_compaction_and_retention(tmp_path):
+    gcs = _StubGcs(SqliteStoreClient(str(tmp_path / "gcs.db")))
+    store = GcsTimeseriesStore(gcs)
+    store.max_points = 8
+    now = time.time()
+    pts = [[now - 100 + i * 0.1, float(i), None] for i in range(100)]
+    assert _push(store, "w0", pts) == 100
+    (entry,) = store.query(name="step_time_s")
+    assert len(entry["points"]) <= 8  # pair-merged under the cap
+    # compaction degrades resolution, not span: the newest timestamp and
+    # chronological order survive, merged values stay within data range
+    assert entry["points"][-1][0] == pytest.approx(pts[-1][0])
+    assert entry["points"][0][0] >= pts[0][0]
+    ts_seq = [p[0] for p in entry["points"]]
+    assert ts_seq == sorted(ts_seq)
+    assert all(0.0 <= p[1] <= 99.0 for p in entry["points"])
+    # points beyond retention are reaped...
+    store.retention_s = 50.0
+    old = [[now - 300, 9.0, None]]
+    _push(store, "w1", old)
+    fresh = store.query(worker_id="w1")
+    assert fresh == [] or all(
+        p[0] >= now - 51 for e in fresh for p in e["points"]
+    )
+    # ...and a series whose whole history aged out disappears entirely
+    store.evaluate(now + 120, force=True)
+    assert store.query(name="step_time_s") == []
+    gcs.storage.close()
+
+
+def test_store_survives_gcs_restart(tmp_path):
+    path = str(tmp_path / "gcs.db")
+    gcs = _StubGcs(SqliteStoreClient(path))
+    store = GcsTimeseriesStore(gcs)
+    now = time.time()
+    _push(store, "w0", [[now, 1.0, "tr-9"]])
+    store.set_rule({
+        "name": "slow", "series": "step_time_s", "threshold": 2.0,
+    })
+    gcs.storage.close()  # "crash"
+
+    gcs2 = _StubGcs(SqliteStoreClient(path))
+    store2 = GcsTimeseriesStore(gcs2)
+    store2.restore_from(gcs2.storage)
+    (entry,) = store2.query(name="step_time_s")
+    assert entry["worker_id"] == "w0"
+    assert entry["points"] == [[pytest.approx(now), 1.0, "tr-9"]]
+    assert [r["name"] for r in store2.alert_engine.rules()] == ["slow"]
+    # deleting a rule deletes its persisted record too
+    assert store2.delete_rule("slow") is True
+    gcs2.storage.close()
+    gcs3 = _StubGcs(SqliteStoreClient(path))
+    store3 = GcsTimeseriesStore(gcs3)
+    store3.restore_from(gcs3.storage)
+    assert store3.alert_engine.rules() == []
+    gcs3.storage.close()
+
+
+def test_compact_points_unit():
+    pts = [[float(i), float(i), None] for i in range(10)]
+    out = _compact_points(list(pts), now=10.0, retention_s=100.0,
+                          max_points=4)
+    assert len(out) <= 4
+    assert out[-1][0] == 9.0  # newest timestamp survives
+
+
+# -- straggler detector -------------------------------------------------------
+
+
+def _group_entries(now, slow_rank=3, slow=3.0, fast=1.0, steps=3):
+    entries = []
+    for rank in range(4):
+        v = slow if rank == slow_rank else fast
+        entries.append({
+            "id": f"step_time_s:{rank:010d}",
+            "name": "step_time_s",
+            "labels": {"group": "g1", "rank": str(rank), "run": "r"},
+            "worker_id": f"w{rank}",
+            "node_id": f"n{rank}",
+            "points": [[now - (steps - i) * v, v, f"tr-{rank}-{i}"]
+                       for i in range(steps)],
+        })
+    return entries
+
+
+def test_mad_straggler_detection_and_resolution():
+    det = StragglerDetector()
+    events = []
+    now = time.time()
+    # three steps from each of four workers; rank 3 runs 3x slow
+    verdicts = det.evaluate(
+        _group_entries(now), now,
+        lambda name, **f: events.append({"name": name, **f}),
+    )
+    assert verdicts[0]["straggler"] is True  # sorted by deviation
+    assert verdicts[0]["worker_id"] == "w3"
+    assert verdicts[0]["rank"] == "3"
+    assert verdicts[0]["node_id"] == "n3"
+    assert sum(v["straggler"] for v in verdicts) == 1
+    (fired,) = [e for e in events if e["name"] == "straggler_detected"]
+    assert fired["worker_id"] == "w3" and fired["group"] == "g1"
+    assert fired["exemplar"] == "tr-3-2"  # newest exemplar in window
+    assert len(fired["series_tail"]) == 3  # the offending series attached
+    # firing is edge-triggered: a second evaluation does not re-emit
+    det.evaluate(_group_entries(now), now, lambda n, **f: events.append(f))
+    assert len([e for e in events if e.get("name")]) == 1
+    # worker recovers -> resolved event on the falling edge
+    events.clear()
+    det.evaluate(
+        _group_entries(now, slow=1.0), now,
+        lambda name, **f: events.append({"name": name, **f}),
+    )
+    assert [e["name"] for e in events] == ["straggler_resolved"]
+    assert all(v["straggler"] is False for v in det.verdicts())
+
+
+def test_straggler_needs_quorum_and_tolerates_uniform_jitter():
+    det = StragglerDetector()
+    now = time.time()
+    # two workers: below min_workers, no verdicts at all
+    assert det.evaluate(_group_entries(now)[:2], now) == []
+    # uniform group with tiny jitter: rel_floor keeps MAD~0 from flagging
+    entries = _group_entries(now, slow=1.02)
+    assert all(not v["straggler"] for v in det.evaluate(entries, now))
+
+
+# -- alert engine -------------------------------------------------------------
+
+
+def _ttft_entry(now, values, exemplar="tr-slow"):
+    return {
+        "id": "serve_ttft_s:abc", "name": "serve_ttft_s",
+        "labels": {"deployment": "d", "replica": "r0"},
+        "worker_id": "w0", "node_id": "n0",
+        "points": [
+            [now - (len(values) - 1 - i), v,
+             exemplar if i == len(values) - 1 else None]
+            for i, v in enumerate(values)
+        ],
+    }
+
+
+def test_alert_threshold_lifecycle_with_for_s_and_exemplar():
+    eng = AlertEngine()
+    eng.set_rule(AlertRule(
+        "slow_ttft", "serve_ttft_s", threshold=0.5, for_s=5.0,
+        labels={"deployment": "d"},
+    ))
+    events = []
+    emit = lambda name, **f: events.append({"name": name, **f})  # noqa: E731
+    now = time.time()
+    # breach starts the pending clock but does not fire before for_s
+    eng.evaluate([_ttft_entry(now, [0.1, 0.9])], now, emit)
+    assert eng.active() == [] and events == []
+    # still breached after for_s -> firing, with the window's exemplar
+    eng.evaluate([_ttft_entry(now + 6, [0.9, 0.8])], now + 6, emit)
+    (active,) = eng.active()
+    assert active["rule"] == "slow_ttft" and active["value"] == 0.8
+    assert active["exemplar"] == "tr-slow"
+    assert [e["name"] for e in events] == ["alert_firing"]
+    # recovery resolves and logs the transition
+    eng.evaluate([_ttft_entry(now + 8, [0.2])], now + 8, emit)
+    assert eng.active() == []
+    assert [e["name"] for e in events] == ["alert_firing", "alert_resolved"]
+    assert [r["transition"] for r in eng.log] == ["firing", "resolved"]
+
+
+def test_alert_label_filter_scopes_rule():
+    eng = AlertEngine()
+    eng.set_rule(AlertRule(
+        "slow_ttft", "serve_ttft_s", threshold=0.5,
+        labels={"deployment": "other"},
+    ))
+    now = time.time()
+    eng.evaluate([_ttft_entry(now, [0.9])], now)
+    assert eng.active() == []  # labels don't match -> never considered
+
+
+def test_alert_vanished_series_resolves():
+    eng = AlertEngine()
+    eng.set_rule(AlertRule("slow_ttft", "serve_ttft_s", threshold=0.5))
+    events = []
+    emit = lambda name, **f: events.append({"name": name, **f})  # noqa: E731
+    now = time.time()
+    eng.evaluate([_ttft_entry(now, [0.9])], now, emit)
+    assert len(eng.active()) == 1
+    eng.evaluate([], now + 1, emit)  # retention reaped the series
+    assert eng.active() == []
+    assert events[-1]["name"] == "alert_resolved"
+    assert events[-1]["reason"] == "series_gone"
+
+
+def test_alert_rate_of_change_and_burn_rate_kinds():
+    now = time.time()
+    roc = AlertRule("leak", "kv_pool_occupancy", kind="rate_of_change",
+                    threshold=0.05)
+    # 0.2 -> 0.8 over 4s = 0.15/s, over the 0.05/s budget
+    window = [[now - 4, 0.2, None], [now, 0.8, None]]
+    assert roc.breached(roc.signal(window))
+    assert not roc.breached(roc.signal([[now - 4, 0.2, None],
+                                        [now, 0.21, None]]))
+    assert roc.signal([[now, 0.2, None]]) is None  # needs a span
+    burn = AlertRule("burn", "serve_ttft_s", kind="burn_rate",
+                     threshold=0.5, burn_fraction=0.5)
+    bad = [[now - i, 0.9, None] for i in range(3)]
+    good = [[now - i, 0.1, None] for i in range(3)]
+    assert burn.breached(burn.signal(bad))
+    assert not burn.breached(burn.signal(good + bad[:1]))  # 1/4 < 50%
+    with pytest.raises(ValueError):
+        AlertRule("x", "s", kind="nonsense")
+    with pytest.raises(ValueError):
+        AlertRule("x", "s", cmp="ge")
+
+
+def test_alert_rule_json_roundtrip():
+    rule = AlertRule("r", "step_time_s", kind="burn_rate", threshold=2.0,
+                     cmp="lt", window_s=30, for_s=5, burn_fraction=0.8,
+                     labels={"group": "g"})
+    assert AlertRule.from_dict(rule.to_dict()).to_dict() == rule.to_dict()
+
+
+# -- events_dropped accounting ------------------------------------------------
+
+
+def test_events_dropped_counter_and_rollup():
+    from ray_tpu.util import metrics
+
+    before = metrics.events_dropped_total()
+    metrics.record_events_dropped(7)
+    assert metrics.events_dropped_total() == before + 7
+    # same {"values": {json-labels: value}} shape metrics._snapshot emits
+    payloads = [
+        {"metrics": [{"name": "events_dropped_total", "type": "counter",
+                      "values": {"[]": 3.0}}]},
+        {"metrics": [{"name": "events_dropped_total", "type": "counter",
+                      "values": {"[]": 2.0}},
+                     {"name": "other", "type": "counter",
+                      "values": {"[]": 9.0}}]},
+    ]
+    assert metrics.events_dropped_from_payloads(payloads) == 5.0
+
+
+# -- perf: telemetry overhead budget ------------------------------------------
+
+
+def test_telemetry_overhead_under_one_percent():
+    from ray_tpu._internal.perf import _telemetry_overhead_bench
+
+    out = _telemetry_overhead_bench(0.1)
+    assert out["telemetry_overhead_pct"] < 1.0
+    assert 0 < out["telemetry_record_ns"] < 50_000
+
+
+# -- live cluster: ingest, straggler verdict, alerts, HTTP + CLI --------------
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_cluster_straggler_alerts_and_read_paths(shutdown_only, capsys):
+    node = ray_tpu.init(
+        num_cpus=4, resources={"TPU": 4}, include_dashboard=True
+    )
+    from ray_tpu.scripts import cli
+    from ray_tpu.util import state
+
+    # four synthetic workers report three steps each; rank 3 runs 3x slow
+    now = time.time()
+    for rank in range(4):
+        v = 3.0 if rank == 3 else 1.0
+        assert state._gcs_call("ts_push", {
+            "worker_id": f"w{rank}", "node_id": f"n{rank}", "pid": 100 + rank,
+            "ts": now,
+            "series": [{
+                "name": "step_time_s",
+                "labels": {"group": "g1", "rank": str(rank), "run": "demo"},
+                "points": [[now - (3 - i) * v, v, f"tr-{rank}-{i}"]
+                           for i in range(3)],
+            }],
+        }) == 3
+    time.sleep(0.6)  # let the store's evaluation rate limiter expire
+
+    # straggler named within the three pushed steps, top-ranked by deviation
+    verdicts = state.straggler_verdicts()
+    assert verdicts and verdicts[0]["worker_id"] == "w3"
+    assert verdicts[0]["straggler"] is True
+    fired = state.list_events(name="straggler_detected")
+    assert fired and fired[-1]["worker_id"] == "w3"
+    assert fired[-1]["synthetic"] is True
+
+    # alert rule fires on the slow series, then resolves on recovery
+    state.set_alert_rule({
+        "name": "slow_step", "series": "step_time_s", "threshold": 2.0,
+        "labels": {"group": "g1"},
+    })
+    time.sleep(0.6)
+    snap = state.alerts_snapshot()
+    assert [r["name"] for r in snap["rules"]] == ["slow_step"]
+    assert any(a["worker_id"] == "w3" for a in snap["active"])
+    state._gcs_call("ts_push", {
+        "worker_id": "w3", "node_id": "n3", "pid": 103, "ts": time.time(),
+        "series": [{
+            "name": "step_time_s",
+            "labels": {"group": "g1", "rank": "3", "run": "demo"},
+            "points": [[time.time(), 1.0, None]],
+        }],
+    })
+    time.sleep(0.6)
+    snap = state.alerts_snapshot()
+    assert snap["active"] == []
+    assert any(r["transition"] == "resolved" for r in snap["log"])
+    assert state.list_events(name="alert_firing")
+    assert state.list_events(name="alert_resolved")
+
+    # driver-side stream: register + record + flush lands in the store
+    s = timeseries.register_series(
+        timeseries.SERVE_TTFT_S,
+        labels={"deployment": "d", "replica": "r0"},
+    )
+    prev = timeseries.set_enabled(True)
+    try:
+        s.record(0.123, exemplar="tr-live")
+        assert timeseries.flush_stream() is True
+    finally:
+        timeseries.set_enabled(prev)
+    (ttft,) = state.query_timeseries(name="serve_ttft_s")
+    assert ttft["points"][-1][1] == 0.123
+    assert ttft["points"][-1][2] == "tr-live"
+    assert any(
+        r["name"] == "serve_ttft_s" for r in state.list_timeseries()
+    )
+
+    # dashboard read paths
+    dash = node.dashboard
+    ts = _get_json(dash.url + "/api/timeseries?name=step_time_s")
+    assert len(ts["series"]) == 4
+    assert all(e["points"] for e in ts["series"])
+    al = _get_json(dash.url + "/api/alerts")
+    assert set(al) >= {"active", "rules", "log", "stragglers"}
+    assert al["stragglers"][0]["worker_id"] == "w3"
+    ev = _get_json(
+        dash.url + "/api/events?name=straggler_detected&since=0"
+    )
+    assert ev["events"] and all(
+        e["name"] == "straggler_detected" for e in ev["events"]
+    )
+    assert set(ev["dropped"]) == {"rings", "store"}
+    far_future = now + 10**6
+    assert _get_json(
+        dash.url + f"/api/events?since={far_future}"
+    )["events"] == []
+
+    # CLI: `ray_tpu top` ranks the straggler first; `ray_tpu alerts` dumps
+    # the snapshot; `--events` tails the alert stream
+    assert cli.main(["top", "--address", "local", "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert rows[0]["worker_id"] == "w3"
+    assert cli.main(["top", "--address", "local"]) == 0
+    text = capsys.readouterr().out
+    assert "STRAGGLER" in text and "GROUP" in text
+    assert cli.main(["alerts", "--address", "local"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert set(snap) >= {"active", "rules", "log", "stragglers"}
+    assert cli.main(["alerts", "--address", "local", "--events"]) == 0
+    tail = json.loads(capsys.readouterr().out)
+    assert {"straggler_detected", "alert_firing", "alert_resolved"} <= {
+        e["name"] for e in tail
+    }
+    assert cli.main([
+        "alerts", "--address", "local", "--delete-rule", "slow_step",
+    ]) == 0
+    assert capsys.readouterr().out.strip() == '{"deleted": true}'
