@@ -470,6 +470,192 @@ def llm_prefix_cache():
     }))
 
 
+def spec_decode():
+    """`python bench.py spec_decode` — speculative decoding + chunked
+    prefill A/B on the paged engine.
+
+    Arm 1 (speculation): the target is a 6-layer tiny model whose layers
+    1..5 have their residual-write kernels (attn wo, mlp w_down) zeroed —
+    each zeroed block is an exact identity, so the target is numerically
+    a 1-layer model that still PAYS 6 layers of compute. A 1-layer draft
+    sharing layer 0 therefore proposes exactly the target's greedy tokens
+    (acceptance ~1.0, the best case), and a random 1-layer draft shows
+    the worst case (acceptance ~0: every step pays the draft + verify
+    and emits one token — when speculation loses). Reported speedup is
+    acceptance-weighted decode tokens/s vs the dense engine on the SAME
+    zeroed target.
+
+    Arm 2 (chunked prefill): two slots, a short request decoding while a
+    2048-token prompt arrives. Unchunked, the admission prefill runs to
+    completion inside one engine step — the short request's inter-token
+    gap spikes by exactly that stall. With prefill_chunk_tokens=256 the
+    prompt advances <=256 tokens per step and the gap stays bounded.
+    Prints ONE JSON line for BENCH_LOG.md. CPU-safe."""
+    if os.environ.get("RAY_TPU_BENCH_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.kvcache import KVCacheManager
+    from ray_tpu.llm.engine import ContinuousBatchingEngine, GenerationRequest
+    from ray_tpu.models.llama import LlamaConfig, init_params
+    from ray_tpu.parallel.sharding import unbox_params
+    from ray_tpu.util.metrics import llm_counters
+
+    _log(f"devices={jax.devices()}")
+    n_layers, k = 6, 4
+    cfg = LlamaConfig.tiny(max_seq_len=512, n_layers=n_layers)
+    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
+    for i in range(1, n_layers):
+        layer = params[f"layer_{i}"]
+        layer["attn"]["wo"]["base"]["kernel"] = jnp.zeros_like(
+            layer["attn"]["wo"]["base"]["kernel"]
+        )
+        layer["mlp"]["w_down"]["kernel"] = jnp.zeros_like(
+            layer["mlp"]["w_down"]["kernel"]
+        )
+    dcfg = LlamaConfig.tiny(max_seq_len=512, n_layers=1)
+    draft_same = {
+        "embed": params["embed"], "final_norm": params["final_norm"],
+        "layer_0": params["layer_0"], "lm_head": params["lm_head"],
+    }
+    draft_rand = unbox_params(init_params(dcfg, jax.random.PRNGKey(7)))
+
+    rng = __import__("random").Random(99)
+    prompts = [
+        [rng.randrange(3, cfg.vocab_size - 1) for _ in range(32)]
+        for _ in range(4)
+    ]
+    new_tokens = 64
+
+    def decode_tps(draft, tag):
+        kv = KVCacheManager(num_blocks=64, block_size=32)
+        eng = ContinuousBatchingEngine(
+            cfg, params, num_slots=4, kv_cache=kv, seed=0,
+            draft=draft, spec_tokens=k if draft else 0,
+        )
+        # compile every program off the clock (prefill, decode/verify,
+        # draft loop) with one throwaway request
+        eng.add_request(GenerationRequest(
+            token_ids=list(prompts[0]), max_new_tokens=new_tokens,
+            temperature=0.0,
+        ))
+        eng.run_until_complete()
+        c0 = llm_counters()
+        rids = [
+            eng.add_request(GenerationRequest(
+                token_ids=list(p), max_new_tokens=new_tokens,
+                temperature=0.0,
+            ))
+            for p in prompts
+        ]
+        t0 = time.perf_counter()
+        out = eng.run_until_complete()
+        dt = time.perf_counter() - t0
+        c1 = llm_counters()
+        total = sum(len(out[r].token_ids) for r in rids)
+        proposed = c1["spec_proposed_tokens"] - c0["spec_proposed_tokens"]
+        accepted = c1["spec_accepted_tokens"] - c0["spec_accepted_tokens"]
+        acc = (accepted / proposed) if proposed else None
+        tps = total / dt
+        _log(
+            f"{tag}: {tps:.1f} tok/s over {total} tokens"
+            + (f", acceptance={acc:.3f}" if acc is not None else "")
+        )
+        return tps, acc, [out[r].token_ids for r in rids]
+
+    tps_dense, _, toks_dense = decode_tps(None, "dense")
+    tps_spec, acc_spec, toks_spec = decode_tps((dcfg, draft_same), "spec")
+    tps_rand, acc_rand, _ = decode_tps((dcfg, draft_rand), "spec_rand")
+    assert toks_dense == toks_spec, "temp-0 spec parity broke in bench"
+
+    # -- arm 2: chunked prefill vs stall ----------------------------------
+    ccfg = LlamaConfig.tiny(max_seq_len=2304)
+    cparams = unbox_params(init_params(ccfg, jax.random.PRNGKey(0)))
+
+    def itl_under_long_prefill(chunk_tokens, tag):
+        kv = KVCacheManager(num_blocks=80, block_size=64)
+        eng = ContinuousBatchingEngine(
+            ccfg, cparams, num_slots=2, kv_cache=kv, seed=0,
+            prefill_chunk_tokens=chunk_tokens,
+        )
+        long_a = [rng.randrange(3, ccfg.vocab_size - 1) for _ in range(2048)]
+        long_b = [rng.randrange(3, ccfg.vocab_size - 1) for _ in range(2048)]
+        # warm EVERY program (short prefill, decode, long prefill path)
+        # with long_a; measure with long_b so no prefix blocks are warm
+        eng.add_request(GenerationRequest(
+            token_ids=long_a, max_new_tokens=2, temperature=0.0,
+        ))
+        eng.run_until_complete()
+        short = eng.add_request(GenerationRequest(
+            token_ids=[5, 6, 7, 8], max_new_tokens=120, temperature=0.0,
+        ))
+        for _ in range(5):
+            eng.step()
+        slot = next(
+            s for s in eng._slots.values() if s.request_id == short
+        )
+        base_gaps, long_gaps = [], []
+        long_rid = None
+        done_long = False
+        for _ in range(200):
+            n0 = len(slot.generated)
+            t0 = time.perf_counter()
+            eng.step()
+            gap = time.perf_counter() - t0
+            if len(slot.generated) > n0:
+                if long_rid is None:
+                    base_gaps.append(gap)
+                elif not done_long:
+                    long_gaps.append(gap)
+            if long_rid is None and len(base_gaps) >= 5:
+                long_rid = eng.add_request(GenerationRequest(
+                    token_ids=long_b, max_new_tokens=2, temperature=0.0,
+                ))
+            if long_rid is not None and eng.num_active <= 1:
+                done_long = True
+            if len(slot.generated) >= 120 or eng.num_active == 0:
+                break
+        base = sorted(base_gaps)[len(base_gaps) // 2]
+        worst = max(long_gaps) if long_gaps else 0.0
+        _log(
+            f"{tag}: base step {base * 1e3:.1f}ms, worst step while "
+            f"2k-prompt admits {worst * 1e3:.1f}ms"
+        )
+        return base, worst
+
+    base_u, worst_u = itl_under_long_prefill(0, "unchunked")
+    base_c, worst_c = itl_under_long_prefill(256, "chunked")
+
+    print(json.dumps({
+        "metric": "spec_decode_tokens_per_sec_speedup",
+        "value": round(tps_spec / tps_dense, 2),
+        "unit": "x (spec decode tok/s / dense decode tok/s, acceptance ~1)",
+        "tokens_per_sec_dense": round(tps_dense, 1),
+        "tokens_per_sec_spec": round(tps_spec, 1),
+        "tokens_per_sec_spec_rand_draft": round(tps_rand, 1),
+        "acceptance_equal_draft": round(acc_spec, 3),
+        "acceptance_rand_draft": round(acc_rand, 3),
+        "chunked_prefill": {
+            "base_step_ms_unchunked": round(base_u * 1e3, 1),
+            "worst_step_ms_unchunked": round(worst_u * 1e3, 1),
+            "base_step_ms_chunked": round(base_c * 1e3, 1),
+            "worst_step_ms_chunked": round(worst_c * 1e3, 1),
+            "stall_reduction_x": round(
+                worst_u / worst_c, 1
+            ) if worst_c else None,
+        },
+        "config": {
+            "target_layers": n_layers, "draft_layers": 1,
+            "spec_tokens": k, "new_tokens": new_tokens,
+            "long_prompt_tokens": 2048, "prefill_chunk_tokens": 256,
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
 def tp_serving():
     """`python bench.py tp_serving` — tensor-parallel paged serving A/B.
 
@@ -1812,6 +1998,8 @@ def disagg_serve():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "llm_prefix_cache":
         llm_prefix_cache()
+    elif len(sys.argv) > 1 and sys.argv[1] == "spec_decode":
+        spec_decode()
     elif len(sys.argv) > 1 and sys.argv[1] == "tp_serving":
         tp_serving()
     elif len(sys.argv) > 1 and sys.argv[1] == "elastic_recover":

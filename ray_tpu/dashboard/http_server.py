@@ -314,7 +314,7 @@ class DashboardServer:
     def _serve(self, body, query=None):
         import json as _json
 
-        from ..util.metrics import serve_ft_summary
+        from ..util.metrics import llm_summary, serve_ft_summary
 
         replicas = []
         try:
@@ -324,9 +324,11 @@ class DashboardServer:
         except Exception:
             pass
         replicas.sort(key=lambda r: (str(r.get("app")), str(r.get("replica_id"))))
+        payloads = self._metric_payloads()
         return 200, {
             "replicas": replicas,
-            "fault_tolerance": serve_ft_summary(self._metric_payloads()),
+            "fault_tolerance": serve_ft_summary(payloads),
+            "llm": llm_summary(payloads),
         }, None
 
     def _proxies(self, body, query=None):

@@ -227,6 +227,24 @@ class KVCacheManager:
         lease.reserved = []
         self._update_gauges()
 
+    def extend(self, lease: KVCacheLease, n_blocks: int) -> int:
+        """Best-effort speculative lease extension: reserve up to
+        ``n_blocks`` more pool blocks for decode-tail commits (an accepted
+        speculative run can cross several block boundaries in one engine
+        step). Returns how many were actually obtained — on pool pressure
+        the tail simply goes uncached; reserved blocks that never get
+        committed are returned by release() like any other."""
+        if lease.closed or lease.cacheable is False:
+            return 0
+        got = 0
+        for _ in range(max(int(n_blocks), 0)):
+            bid = self._allocate_or_evict()
+            if bid is None:
+                break
+            lease.reserved.append(bid)
+            got += 1
+        return got
+
     # -- device state --------------------------------------------------------
 
     def initialize(self, cache_row) -> None:

@@ -87,6 +87,21 @@ class LLMConfig:
     # with a bounded per-block quantization error (same codec as the
     # quantized weight plane)
     kv_ship_codec: str = "raw"
+    # speculative decoding: a small draft model (same config grammar as
+    # model_id/model_kwargs) proposes spec_tokens tokens per engine step;
+    # the target verifies all of them in ONE forward pass and keeps the
+    # longest accepted prefix — lossless at temperature 0, rejection-
+    # sampled (distribution-preserving) otherwise. Requires the paged
+    # engine (kv_cache_blocks). spec_tokens defaults to 4 when a
+    # draft_model is named without an explicit k.
+    draft_model: Optional[str] = None
+    draft_model_kwargs: Dict[str, Any] = field(default_factory=dict)
+    spec_tokens: int = 0
+    # chunked prefill: per-engine-step prefill token budget so a long
+    # prompt admission interleaves with in-flight decodes instead of
+    # stalling them; 0 = prefill runs to completion at admission.
+    # Requires the paged engine.
+    prefill_chunk_tokens: int = 0
 
     def __post_init__(self):
         if self.mesh is not None:
@@ -129,6 +144,21 @@ class LLMConfig:
                 "disaggregated roles / kv_tier need the paged engine: "
                 "set kv_cache_blocks"
             )
+        if self.draft_model is not None and self.spec_tokens <= 0:
+            self.spec_tokens = 4
+        if self.spec_tokens > 0 and self.draft_model is None:
+            raise ValueError(
+                "spec_tokens needs a draft_model to propose tokens"
+            )
+        if self.prefill_chunk_tokens < 0:
+            raise ValueError("prefill_chunk_tokens must be >= 0")
+        if (
+            self.draft_model is not None or self.prefill_chunk_tokens
+        ) and not self.kv_cache_blocks:
+            raise ValueError(
+                "speculative decoding / chunked prefill run on the "
+                "continuous-batching engine: set kv_cache_blocks"
+            )
 
     def effective_parallelism(self) -> tuple:
         """(tp, sp) with ``mesh`` winning over the scalar fields."""
@@ -154,3 +184,18 @@ class LLMConfig:
                 "tiny"
             ) else MoEConfig(**kwargs)
         raise ValueError(f"unknown model family {self.model_family!r}")
+
+    def build_draft_model_config(self):
+        """Model config for the speculative draft — same name grammar as
+        build_model_config (llama only: the draft shares the target's
+        vocab/tokenizer, and its max_seq_len must cover the target's so
+        both caches hold the same positions)."""
+        if self.draft_model is None:
+            return None
+        from ..models.llama import LlamaConfig
+
+        kwargs = dict(self.draft_model_kwargs)
+        kwargs.setdefault("max_seq_len", self.max_seq_len)
+        return LlamaConfig.tiny(**kwargs) if self.draft_model.endswith(
+            "tiny"
+        ) else LlamaConfig(**kwargs)

@@ -57,6 +57,27 @@ def _record_ttft(seconds: float, hit: bool, mesh: str = "tp=1",
         pass
 
 
+def _record_itl(seconds: float, n: int = 1, mesh: str = "tp=1") -> None:
+    """Inter-token latency: one observation per emitted token. A
+    speculative step that lands n tokens at once records n observations
+    of gap/n — the per-token cadence a streaming client actually sees."""
+    try:
+        from ..util.metrics import record_serve_itl
+
+        record_serve_itl(seconds, n=n, mesh=mesh)
+    except Exception:
+        pass
+
+
+def _record_spec(proposed: int, accepted: int, mesh: str = "tp=1") -> None:
+    try:
+        from ..util.metrics import record_spec_tokens
+
+        record_spec_tokens(proposed, accepted, mesh=mesh)
+    except Exception:
+        pass
+
+
 def host_sync(x) -> np.ndarray:
     """The ONE audited device->host materialization point on the serving
     hot path. Everything the engines move to the host — sampled token ids,
@@ -114,6 +135,8 @@ class _DecodeModelBase:
         self._plan = plan
         self._mesh_tag = plan.describe() if plan is not None else "tp=1"
         self._model = Llama(model_config, mesh, decode=True)
+        self._cache_shardings = None
+        self._replicated = None
         if plan is not None:
             # compile-with-plan: params live sharded; both programs pin
             # their outputs (replicated logits for host sampling, the
@@ -128,6 +151,8 @@ class _DecodeModelBase:
             )[1]
             cache_sh = plan.cache_shardings(cache_shape)
             rep = plan.replicated()
+            self._cache_shardings = cache_sh
+            self._replicated = rep
             self._prefill = jax.jit(
                 self._prefill_impl, out_shardings=(rep, cache_sh)
             )
@@ -324,6 +349,11 @@ class _Slot:
     last_token: int
     lease: Any = None  # KVCacheLease when the engine runs paged
     trace: Any = None  # {"ctx", "wall"} when the request is traced
+    # leading full blocks of (prompt + generated[:-1]) already committed
+    # into the radix index — the speculative path commits decode-tail
+    # blocks eagerly (accepted runs cross block boundaries mid-flight)
+    committed_blocks: int = 0
+    last_emit_ts: float = 0.0  # monotonic stamp of the last emitted token
 
 
 class ContinuousBatchingEngine(_DecodeModelBase):
@@ -350,6 +380,9 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         seed: Optional[int] = None,
         plan=None,
         kv_tier=None,
+        draft=None,
+        spec_tokens: int = 0,
+        prefill_chunk_tokens: int = 0,
     ):
         super().__init__(model_config, params, mesh, plan=plan)
         self._num_slots = num_slots
@@ -407,6 +440,69 @@ class ContinuousBatchingEngine(_DecodeModelBase):
             ),
             donate_argnums=(0,),
         )
+        # -- speculative decoding (draft proposes, target verifies) --------
+        # ``draft`` is (draft_model_config, draft_params): a small model
+        # whose proposals the target verifies k-at-a-time in ONE forward
+        # pass. The draft keeps its own dense per-slot cache pool (no
+        # paging — it is tiny) with the SAME position invariant as the
+        # target: K/V for prompt + generated[:-1], last_token not yet fed.
+        self._spec_k = int(spec_tokens) if draft is not None else 0
+        self._draft = None
+        self._draft_cache = None
+        if draft is not None and self._spec_k > 0:
+            draft_cfg, draft_params = draft
+            if draft_cfg.max_seq_len < model_config.max_seq_len:
+                raise ValueError(
+                    "draft max_seq_len must cover the target's "
+                    f"({draft_cfg.max_seq_len} < {model_config.max_seq_len})"
+                )
+            self._draft = _DecodeModelBase(
+                draft_cfg, draft_params, mesh, plan=plan
+            )
+            if self._draft._cache_shardings is not None:
+                self._propose = jax.jit(
+                    self._propose_impl,
+                    out_shardings=(
+                        self._draft._replicated, self._draft._replicated,
+                        self._draft._replicated,
+                        self._draft._cache_shardings,
+                    ),
+                )
+            else:
+                self._propose = jax.jit(self._propose_impl)
+            if self._cache_shardings is not None:
+                self._verify = jax.jit(
+                    self._verify_impl,
+                    out_shardings=(
+                        self._replicated, self._replicated,
+                        self._cache_shardings, self._replicated,
+                    ),
+                )
+            else:
+                self._verify = jax.jit(self._verify_impl)
+            # rollback-as-index-reset for the draft pool: K/V past the
+            # accepted prefix is garbage the causal mask never reads and
+            # the next write overwrites — only the position moves back
+            self._set_index = jax.jit(
+                lambda cache, idx: jax.tree.map(
+                    lambda leaf: idx.astype(leaf.dtype)
+                    if leaf.ndim == 1 else leaf,
+                    cache,
+                ),
+                donate_argnums=(0,),
+            )
+        # -- chunked prefill ----------------------------------------------
+        # per-STEP token budget across all in-progress prefills; 0 = run
+        # each admission prefill to completion (the historical behavior).
+        # In-progress prefills park in _prefilling keyed by their reserved
+        # slot index, advancing <= budget tokens per step so in-flight
+        # decodes keep stepping instead of stalling behind a long prompt.
+        self._prefill_chunk = int(prefill_chunk_tokens or 0)
+        self._prefilling: Dict[int, dict] = {}
+        self._empty_row_template = None
+        # observability for the perf-smoke guard: prefill tokens actually
+        # computed by the most recent step()
+        self.last_step_prefill_tokens = 0
 
     # -- public API ----------------------------------------------------------
 
@@ -418,6 +514,18 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         blocks instead of re-running prefill."""
         if len(request.token_ids) + request.max_new_tokens > self._cfg.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        if self._spec_k and (
+            len(request.token_ids) + request.max_new_tokens + self._spec_k
+            > self._cfg.max_seq_len
+        ):
+            # the verify pass writes k+1 provisional positions past the
+            # current index; dynamic_update_slice CLAMPS out-of-range
+            # starts, which would silently corrupt earlier cache entries
+            # near max_seq_len — refuse up front instead
+            raise ValueError(
+                "prompt + max_new_tokens + spec_tokens exceeds max_seq_len "
+                "(speculative verification needs headroom)"
+            )
         tr = None
         if _tracing.is_tracing_enabled():
             tr = {"ctx": _tracing.current_context(), "wall": time.time()}
@@ -432,7 +540,7 @@ class ContinuousBatchingEngine(_DecodeModelBase):
 
     @property
     def num_active(self) -> int:
-        return len(self._slots) + len(self._pending)
+        return len(self._slots) + len(self._pending) + len(self._prefilling)
 
     def step(self) -> List[tuple]:
         """One engine iteration: admit pending requests into free slots
@@ -442,9 +550,19 @@ class ContinuousBatchingEngine(_DecodeModelBase):
             return self._step_locked()
 
     def _step_locked(self) -> List[tuple]:
+        self.last_step_prefill_tokens = 0
         finished: List[tuple] = self._admit()
+        if self._prefilling:
+            self._advance_prefills(finished)
         if not self._slots:
             return finished
+        if self._spec_k and self._draft is not None:
+            self._spec_step(finished)
+        else:
+            self._dense_step(finished)
+        return finished
+
+    def _dense_step(self, finished: List[tuple]) -> None:
         # one decode step for the whole pool; free rows compute garbage at
         # their stale positions (static-shape trade) and are ignored
         last = np.zeros((self._num_slots, 1), np.int32)
@@ -455,33 +573,129 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         )
         self._step_count += 1
         tokens = self._sample_rows(logits)
+        now = time.monotonic()
         for si in list(self._slots):
             slot = self._slots[si]
             tok = int(tokens[si])
             slot.generated.append(tok)
             slot.last_token = tok
+            if slot.last_emit_ts:
+                _record_itl(now - slot.last_emit_ts, mesh=self._mesh_tag)
+            slot.last_emit_ts = now
             req = slot.request
             done_eos = req.eos_token_id is not None and tok == req.eos_token_id
             done_len = len(slot.generated) >= req.max_new_tokens
             if done_eos or done_len:
-                result = GenerationResult(
-                    token_ids=slot.generated[: req.max_new_tokens],
-                    num_prompt_tokens=len(req.token_ids),
-                    finished_reason="eos" if done_eos else "length",
+                self._finish_slot(
+                    si, slot, "eos" if done_eos else "length", finished
                 )
-                finished.append((slot.request_id, result))
-                if slot.trace is not None:
-                    _tracing.emit_span(
-                        "engine.decode", slot.trace["ctx"],
-                        slot.trace["wall"],
-                        time.time() - slot.trace["wall"],
-                        category="engine", request_id=slot.request_id,
-                        tokens=len(slot.generated),
-                        finished=result.finished_reason,
-                        mesh=self._mesh_tag,
-                    )
-                self._retire_slot(si)
-        return finished
+
+    def _spec_step(self, finished: List[tuple]) -> None:
+        """One speculative iteration for the whole pool: the draft model
+        proposes k tokens per row (ONE fused scan program), the target
+        verifies all k in ONE (num_slots, k+1) forward pass that also
+        computes the accepted-prefix length, the bonus / correction token,
+        and the rolled-back cache index — two compiled programs and one
+        host transfer of (tokens, counts) per step."""
+        S, k = self._num_slots, self._spec_k
+        last = np.zeros((S, 1), np.int32)
+        temps = np.zeros(S, np.float32)
+        start = np.zeros(S, np.int32)
+        for si, slot in self._slots.items():
+            last[si, 0] = slot.last_token
+            temps[si] = max(slot.request.temperature, 0.0)
+            # cache invariant: K/V covers prompt + generated[:-1]
+            start[si] = (
+                len(slot.request.token_ids) + len(slot.generated) - 1
+            )
+        key = jax.random.fold_in(self._rng, 10_000 + self._step_count)
+        self._step_count += 1
+        temps_d = jnp.asarray(temps)
+        # proposal: the whole k-step draft loop is one fused program
+        chunk, draft_tok, draft_logits, self._draft_cache = self._propose(
+            self._draft._params, self._draft_cache, jnp.asarray(last),
+            temps_d, key,
+        )
+        emitted, counts, self._cache, new_idx = self._verify(
+            self._params, self._cache, chunk, draft_tok, draft_logits,
+            temps_d, jax.random.fold_in(key, 0), jnp.asarray(start),
+        )
+        # the draft pool rolls back to the same corrected position
+        self._draft_cache = self._set_index(self._draft_cache, new_idx)
+        em = host_sync(emitted)
+        cnt = host_sync(counts)
+        now = time.monotonic()
+        proposed = accepted = 0
+        for si in list(self._slots):
+            slot = self._slots[si]
+            req = slot.request
+            n = int(cnt[si])
+            proposed += k
+            accepted += n - 1  # the last emitted token is bonus/correction
+            done_reason = None
+            for j in range(n):
+                tok = int(em[si, j])
+                slot.generated.append(tok)
+                slot.last_token = tok
+                if req.eos_token_id is not None and tok == req.eos_token_id:
+                    done_reason = "eos"
+                    break
+                if len(slot.generated) >= req.max_new_tokens:
+                    done_reason = "length"
+                    break
+            if slot.last_emit_ts:
+                # n tokens landed in one step: each saw gap/n of latency
+                _record_itl(
+                    (now - slot.last_emit_ts) / max(n, 1), n=n,
+                    mesh=self._mesh_tag,
+                )
+            slot.last_emit_ts = now
+            if done_reason is not None:
+                self._finish_slot(si, slot, done_reason, finished)
+            else:
+                self._commit_decode_tail(si, slot)
+        if proposed:
+            _record_spec(proposed, accepted, mesh=self._mesh_tag)
+
+    def _finish_slot(self, si: int, slot: _Slot, reason: str,
+                     finished: List[tuple]) -> None:
+        req = slot.request
+        result = GenerationResult(
+            token_ids=slot.generated[: req.max_new_tokens],
+            num_prompt_tokens=len(req.token_ids),
+            finished_reason=reason,
+        )
+        finished.append((slot.request_id, result))
+        if slot.trace is not None:
+            _tracing.emit_span(
+                "engine.decode", slot.trace["ctx"],
+                slot.trace["wall"],
+                time.time() - slot.trace["wall"],
+                category="engine", request_id=slot.request_id,
+                tokens=len(slot.generated),
+                finished=result.finished_reason,
+                mesh=self._mesh_tag,
+            )
+        self._retire_slot(si)
+
+    def _commit_decode_tail(self, si: int, slot: _Slot) -> None:
+        """Speculative mode commits decode-tail blocks eagerly: an
+        accepted run can cross several block boundaries in one step, and
+        waiting for retire would keep long-lived sequences' tails
+        invisible to concurrent shared-prefix requests. Best-effort — the
+        lease is extended for the new blocks first; on pool pressure the
+        tail simply is not cached (never an error)."""
+        if self._kv is None or slot.lease is None or slot.lease.cacheable is False:
+            return
+        bs = self._kv.block_size
+        tokens = list(slot.request.token_ids) + slot.generated[:-1]
+        avail = len(tokens) // bs
+        if avail <= slot.committed_blocks:
+            return
+        self._kv.extend(slot.lease, avail - slot.committed_blocks)
+        row = self._extract_row(self._cache, jnp.asarray(si, jnp.int32))
+        self._kv.commit(slot.lease, tokens[: avail * bs], row, pin=False)
+        slot.committed_blocks = avail
 
     def _retire_slot(self, si: int) -> None:
         """Free the slot; with a KV manager, first commit the sequence's
@@ -494,7 +708,11 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         # K/V exists for prompt + generated[:-1]: the final sampled token
         # was never fed back through the model
         tokens = list(req.token_ids) + slot.generated[:-1]
-        if len(tokens) // self._kv.block_size > len(req.token_ids) // self._kv.block_size:
+        already = max(
+            slot.committed_blocks,
+            len(req.token_ids) // self._kv.block_size,
+        )
+        if len(tokens) // self._kv.block_size > already:
             cm_t0 = time.time() if slot.trace else 0.0
             row = self._extract_row(self._cache, jnp.asarray(si, jnp.int32))
             self._kv.commit(slot.lease, tokens, row, pin=False)
@@ -617,7 +835,10 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         prompt plus the first sampled token takes the zero-prefill fast
         path — the shipped payload becomes the slot row outright."""
         finished: List[tuple] = []
-        free = [i for i in range(self._num_slots) if i not in self._slots]
+        free = [
+            i for i in range(self._num_slots)
+            if i not in self._slots and i not in self._prefilling
+        ]
         while free and self._pending:
             si = free.pop(0)
             rid, req, ship = self._pending.pop(0)
@@ -674,6 +895,17 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                     "engine.queue_wait", tr["ctx"], tr["wall"],
                     now - tr["wall"], category="engine", request_id=rid,
                 )
+            if self._prefill_chunk and not fast:
+                # budgeted prefill: the request keeps its slot reservation
+                # but computes nothing yet — _advance_prefills spreads the
+                # prompt over engine steps alongside in-flight decodes
+                self._prefilling[si] = {
+                    "rid": rid, "req": req, "lease": lease,
+                    "tier_src": tier_src, "tr": tr,
+                    "row": None, "pos": 0, "logits": None, "committed": 0,
+                    "pf_wall": time.time() if tr else 0.0,
+                }
+                continue
             pf_wall = time.time() if tr else 0.0
             if fast:
                 # zero-prefill: the payload covers every prompt token and
@@ -684,6 +916,9 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                 logits, solo_cache = self._prefill_leased(
                     req, lease, trace=tr
                 )
+                self.last_step_prefill_tokens += plen - (
+                    lease.num_cached_tokens if lease is not None else 0
+                )
                 first = int(
                     self._sample_tokens(
                         logits,
@@ -691,88 +926,308 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                         jax.random.fold_in(self._rng, rid),
                     )[0]
                 )
-            if tr:
-                cached = (
-                    plen if fast
-                    else lease.num_cached_tokens if lease is not None
-                    else 0
-                )
-                _tracing.emit_span(
-                    "engine.prefill", tr["ctx"], pf_wall,
-                    time.time() - pf_wall, category="engine",
-                    request_id=rid, cached_tokens=cached,
-                    computed_tokens=plen - cached,
-                    hit=cached > 0, tier=tier_src or "local",
+            if not self._finish_admission(
+                si, rid, req, lease, solo_cache, first, fast, tier_src,
+                tr, pf_wall, finished,
+            ):
+                free.insert(0, si)
+        return finished
+
+    def _finish_admission(self, si, rid, req, lease, solo_cache, first,
+                          fast, tier_src, tr, pf_wall, finished) -> bool:
+        """The admission tail every prefill path funnels through (inline,
+        chunked, zero-prefill): TTFT + prefill metrics, prompt-block
+        commit + tier export, pool row insert, slot creation. Returns
+        False when the request finished AT admission (eos on the first
+        token / max_new_tokens <= 1) — the caller returns the slot."""
+        plen = len(req.token_ids)
+        if tr:
+            cached = (
+                plen if fast
+                else lease.num_cached_tokens if lease is not None
+                else 0
+            )
+            _tracing.emit_span(
+                "engine.prefill", tr["ctx"], pf_wall,
+                time.time() - pf_wall, category="engine",
+                request_id=rid, cached_tokens=cached,
+                computed_tokens=plen - cached,
+                hit=cached > 0, tier=tier_src or "local",
+                mesh=self._mesh_tag,
+            )
+        ts = self._enqueue_ts.pop(rid, None)
+        if self._kv is not None:
+            cached = plen if fast else lease.num_cached_tokens
+            self._kv.record_prefill(cached, plen - cached)
+            if ts is not None:
+                _record_ttft(
+                    time.monotonic() - ts, hit=cached > 0,
                     mesh=self._mesh_tag,
+                    tier=tier_src
+                    or ("local" if cached > 0 else "miss"),
                 )
-            ts = self._enqueue_ts.pop(rid, None)
-            if self._kv is not None:
-                cached = plen if fast else lease.num_cached_tokens
-                self._kv.record_prefill(cached, plen - cached)
-                if ts is not None:
-                    _record_ttft(
-                        time.monotonic() - ts, hit=cached > 0,
-                        mesh=self._mesh_tag,
-                        tier=tier_src
-                        or ("local" if cached > 0 else "miss"),
+            if not fast:
+                # commit the prompt's full blocks while the prefilled
+                # row is at hand; reserved blocks are consumed here
+                # (the fast path adopted them instead)
+                cm_t0 = time.time() if tr else 0.0
+                self._kv.commit(lease, req.token_ids, solo_cache)
+                if tr:
+                    _tracing.emit_span(
+                        "kvcache.commit", tr["ctx"], cm_t0,
+                        time.time() - cm_t0, category="kvcache",
+                        request_id=rid, tokens=len(req.token_ids),
                     )
-                if not fast:
-                    # commit the prompt's full blocks while the prefilled
-                    # row is at hand; reserved blocks are consumed here
-                    # (the fast path adopted them instead)
-                    cm_t0 = time.time() if tr else 0.0
-                    self._kv.commit(lease, req.token_ids, solo_cache)
+                if (
+                    self._tier is not None
+                    and lease.cacheable
+                    and self._tier.should_export(
+                        req.token_ids, plen // self._kv.block_size
+                    )
+                ):
+                    # first computation of this prefix here: publish
+                    # it so every other replica (and fresh scale-ups)
+                    # can peer-pull instead of recomputing
+                    payload = self._kv.extract_row_payload(
+                        solo_cache, plen
+                    )
+                    self._tier.export_and_register(
+                        req.token_ids, payload,
+                        plen // self._kv.block_size,
+                        first_token=first,
+                    )
+        if self._cache is None:
+            self._cache = self._empty_cache(solo_cache)
+        # insert the prefilled K/V row + its write position into slot si
+        self._cache = self._insert_row(
+            self._cache, solo_cache, jnp.asarray(si, jnp.int32)
+        )
+        req_eos = req.eos_token_id is not None and first == req.eos_token_id
+        if req_eos or req.max_new_tokens <= 1:
+            result = GenerationResult(
+                token_ids=[first][: req.max_new_tokens],
+                num_prompt_tokens=len(req.token_ids),
+                finished_reason="eos" if req_eos else "length",
+            )
+            finished.append((rid, result))
+            if self._kv is not None:
+                self._kv.release(lease)
+            return False
+        if self._draft is not None:
+            self._admit_draft_row(req, si)
+        self._slots[si] = _Slot(
+            request_id=rid, request=req, generated=[first],
+            last_token=first, lease=lease,
+            committed_blocks=(
+                plen // self._kv.block_size if self._kv is not None else 0
+            ),
+            last_emit_ts=time.monotonic(),
+            trace=(
+                {"ctx": tr["ctx"], "wall": time.time()} if tr else None
+            ),
+        )
+        return True
+
+    def _advance_prefills(self, finished: List[tuple]) -> None:
+        """Advance in-progress chunked prefills, spending at most
+        ``prefill_chunk_tokens`` across ALL of them this step. Chunks stay
+        <= block_size (paged) so XLA keeps the same bounded program set as
+        suffix prefill; a completed prompt takes the normal admission tail
+        (first-token sample, TTFT, commit, slot insert) and decodes in
+        the very same step."""
+        budget = self._prefill_chunk - self.last_step_prefill_tokens
+        chunk_max = self._kv.block_size if self._kv is not None else 32
+        for si in list(self._prefilling):
+            if budget <= 0:
+                break
+            st = self._prefilling[si]
+            req, lease, tr = st["req"], st["lease"], st["tr"]
+            tokens = req.token_ids
+            if st["row"] is None:
+                if lease is not None and lease.num_cached_tokens:
+                    as_t0 = time.time() if tr else 0.0
+                    st["row"] = self._kv.assemble(lease)
                     if tr:
                         _tracing.emit_span(
-                            "kvcache.commit", tr["ctx"], cm_t0,
-                            time.time() - cm_t0, category="kvcache",
-                            request_id=rid, tokens=len(req.token_ids),
+                            "kvcache.assemble", tr["ctx"], as_t0,
+                            time.time() - as_t0, category="kvcache",
+                            cached_tokens=lease.num_cached_tokens,
                         )
-                    if (
-                        self._tier is not None
-                        and lease.cacheable
-                        and self._tier.should_export(
-                            req.token_ids, plen // self._kv.block_size
-                        )
-                    ):
-                        # first computation of this prefix here: publish
-                        # it so every other replica (and fresh scale-ups)
-                        # can peer-pull instead of recomputing
-                        payload = self._kv.extract_row_payload(
-                            solo_cache, plen
-                        )
-                        self._tier.export_and_register(
-                            req.token_ids, payload,
-                            plen // self._kv.block_size,
-                            first_token=first,
-                        )
-            if self._cache is None:
-                self._cache = self._empty_cache(solo_cache)
-            # insert the prefilled K/V row + its write position into slot si
-            self._cache = self._insert_row(
-                self._cache, solo_cache, jnp.asarray(si, jnp.int32)
-            )
-            slot = _Slot(
-                request_id=rid, request=req, generated=[first],
-                last_token=first, lease=lease,
-                trace=(
-                    {"ctx": tr["ctx"], "wall": time.time()} if tr else None
-                ),
-            )
-            req_eos = req.eos_token_id is not None and first == req.eos_token_id
-            if req_eos or req.max_new_tokens <= 1:
-                result = GenerationResult(
-                    token_ids=[first][: req.max_new_tokens],
-                    num_prompt_tokens=len(req.token_ids),
-                    finished_reason="eos" if req_eos else "length",
+                    st["pos"] = lease.num_cached_tokens
+                    st["committed"] = (
+                        lease.num_cached_tokens // self._kv.block_size
+                    )
+                else:
+                    st["row"] = self._empty_row()
+            pos = st["pos"]
+            while pos < len(tokens) and budget > 0:
+                take = min(chunk_max, len(tokens) - pos, budget)
+                chunk = jnp.asarray([tokens[pos:pos + take]], jnp.int32)
+                st["logits"], st["row"] = self._decode(
+                    self._params, st["row"], chunk
                 )
-                finished.append((rid, result))
-                if self._kv is not None:
-                    self._kv.release(lease)
-                free.insert(0, si)
+                pos += take
+                budget -= take
+                self.last_step_prefill_tokens += take
+            st["pos"] = pos
+            if pos < len(tokens):
+                bs = self._kv.block_size if self._kv is not None else 0
+                if (
+                    self._kv is not None and lease is not None
+                    and pos // bs > st["committed"]
+                ):
+                    # partial commit: completed full blocks become
+                    # hittable for concurrent shared-prefix admissions
+                    # NOW, not when the whole prompt lands
+                    self._kv.commit(lease, tokens[:pos], st["row"])
+                    st["committed"] = pos // bs
                 continue
-            self._slots[si] = slot
-        return finished
+            del self._prefilling[si]
+            first = int(
+                self._sample_tokens(
+                    st["logits"],
+                    np.array([max(req.temperature, 0.0)], np.float32),
+                    jax.random.fold_in(self._rng, st["rid"]),
+                )[0]
+            )
+            self._finish_admission(
+                si, st["rid"], req, lease, st["row"], first, False,
+                st["tier_src"], tr, st["pf_wall"], finished,
+            )
+
+    def _empty_row(self):
+        """An all-zero solo cache row with write position 0 — the chunked
+        prefill seed when no cached prefix exists (shaped via eval_shape:
+        structure only, no compute). Memoized: the eval_shape trace walks
+        the whole model (~hundreds of ms) and the template never changes;
+        handing out the same immutable arrays is safe because ``_decode``
+        does not donate its cache argument."""
+        if self._empty_row_template is not None:
+            return self._empty_row_template
+        cache_shape = jax.eval_shape(
+            self._prefill_impl, self._params,
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        )[1]
+        row = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shape
+        )
+        if self._plan is not None:
+            row = jax.tree.map(
+                jax.device_put, row, self._plan.cache_shardings(row)
+            )
+        self._empty_row_template = row
+        return row
+
+    def _admit_draft_row(self, req: GenerationRequest, si: int) -> None:
+        """Full-prompt draft prefill into the draft pool. The draft never
+        pages or prefix-caches — it is small enough that recomputing its
+        prompt K/V is the cheap part of the speculative trade — but it
+        keeps the target's exact position invariant so both caches roll
+        back with the same corrected index."""
+        _, dsolo = self._draft._prefill(
+            self._draft._params, jnp.asarray([req.token_ids], jnp.int32)
+        )
+        if self._draft_cache is None:
+            self._draft_cache = self._empty_cache(dsolo)
+        self._draft_cache = self._insert_row(
+            self._draft_cache, dsolo, jnp.asarray(si, jnp.int32)
+        )
+
+    def _propose_impl(self, dparams, dcache, last, temps, key):
+        """The whole k-step draft proposal as ONE compiled program: a
+        ``lax.scan`` decodes and samples d_1..d_k with the draft cache as
+        carry, then one extra feed writes d_k's K/V so the rollback index
+        ``start + counts`` is valid for EVERY acceptance count. Fusing the
+        loop matters on both ends of the scale: on TPU it removes 2k-1
+        dispatch round-trips per step; on the 1-core CPU bench it is the
+        difference between speculation winning and losing to its own
+        Python overhead. Returns (chunk (S,k+1), draft_tok (S,k),
+        draft_logits (S,k,V), new_cache)."""
+        def one(carry, j):
+            cache, tok = carry
+            lg, cache = self._draft._decode_impl(dparams, cache, tok)
+            nxt = _sample_impl(lg, temps, jax.random.fold_in(key, j + 1))
+            return (cache, nxt[:, None].astype(jnp.int32)), (tok[:, 0], lg)
+
+        (cache, tok), (fed, dlogits) = jax.lax.scan(
+            one, (dcache, last), jnp.arange(self._spec_k)
+        )
+        _, cache = self._draft._decode_impl(dparams, cache, tok)
+        chunk = jnp.concatenate([fed.T, tok], axis=1)  # [last, d_1..d_k]
+        return chunk, chunk[:, 1:], jnp.swapaxes(dlogits, 0, 1), cache
+
+    def _verify_impl(self, params, cache, chunk, draft_tok, draft_logits,
+                     temps, key, start_idx):
+        """The fused speculative verify: ONE forward pass over the
+        (num_slots, k+1) chunk [last_token, d_1..d_k] scores every
+        proposal (position j's logits predict the token after input j),
+        acceptance + bonus/correction sampling + cache-index rollback all
+        happen in the same program — the host sees only (emitted tokens,
+        counts).
+
+        Lossless by construction: at temperature 0 a proposal is accepted
+        iff it equals the target argmax, so the emitted prefix is exactly
+        the greedy trajectory; at temperature > 0 standard rejection
+        sampling (accept d_j w.p. min(1, p_t/p_d), resample the first
+        rejection from the normalized residual max(p_t - p_d, 0)) keeps
+        the output distribution identical to ancestral sampling from the
+        target."""
+        k = draft_tok.shape[1]
+        logits, vars_out = self._model.apply(
+            {"params": params, "cache": cache}, chunk, mutable=["cache"]
+        )  # (S, k+1, V)
+        new_cache = vars_out["cache"]
+        ka, kb = jax.random.split(key)
+        tscale = jnp.maximum(temps, 1e-6)
+        greedy_ok = jnp.argmax(logits[:, :k, :], axis=-1) == draft_tok
+        pt = jax.nn.softmax(
+            logits[:, :k, :] / tscale[:, None, None], axis=-1
+        )
+        pd = jax.nn.softmax(draft_logits / tscale[:, None, None], axis=-1)
+        pt_d = jnp.take_along_axis(pt, draft_tok[..., None], axis=-1)[..., 0]
+        pd_d = jnp.take_along_axis(pd, draft_tok[..., None], axis=-1)[..., 0]
+        u = jax.random.uniform(ka, draft_tok.shape)
+        stoch_ok = u * jnp.maximum(pd_d, 1e-20) < pt_d
+        ok = jnp.where((temps == 0.0)[:, None], greedy_ok, stoch_ok)
+        # longest accepted prefix: cumprod flips to 0 at the 1st rejection
+        a = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=-1), axis=-1)
+        pos_logits = jnp.take_along_axis(
+            logits, a[:, None, None], axis=1
+        )[:, 0, :]  # (S, V): the target's logits right after the prefix
+        greedy_bonus = jnp.argmax(pos_logits, axis=-1)
+        pt_a = jax.nn.softmax(pos_logits / tscale[:, None], axis=-1)
+        pd_a = jnp.take_along_axis(
+            pd, jnp.minimum(a, k - 1)[:, None, None], axis=1
+        )[:, 0, :]
+        resid = jnp.where(
+            (a < k)[:, None], jnp.maximum(pt_a - pd_a, 0.0), pt_a
+        )
+        resid_sum = jnp.sum(resid, axis=-1, keepdims=True)
+        resid = jnp.where(resid_sum > 1e-20, resid, pt_a)
+        stoch_bonus = jax.random.categorical(
+            kb, jnp.log(jnp.maximum(resid, 1e-20)), axis=-1
+        )
+        bonus = jnp.where(temps == 0.0, greedy_bonus, stoch_bonus)
+        counts = a + 1  # accepted prefix + the bonus/correction token
+        jpos = jnp.arange(k + 1)[None, :]
+        padded = jnp.pad(draft_tok, ((0, 0), (0, 1)))
+        emitted = jnp.where(
+            jpos < a[:, None], padded,
+            jnp.where(
+                jpos == a[:, None], bonus[:, None].astype(jnp.int32), 0
+            ),
+        )
+        new_idx = start_idx + counts
+        # rollback-as-index-reset: the only non-KV cache leaves are the
+        # (num_slots,) per-row write positions; K/V past new_idx is
+        # garbage the causal mask never reads and the next verify
+        # overwrites before attending
+        new_cache = jax.tree.map(
+            lambda leaf: new_idx.astype(leaf.dtype)
+            if leaf.ndim == 1 else leaf,
+            new_cache,
+        )
+        return emitted, counts, new_cache, new_idx
 
     def _ensure_kv_ready(self) -> None:
         """Shape the manager's block pools before the first adopt/build.

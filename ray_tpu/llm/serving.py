@@ -142,6 +142,17 @@ class _LLMReplica:
                     block_size=llm_config.kv_block_size,
                     codec=llm_config.kv_ship_codec,
                 )
+            draft = None
+            if llm_config.draft_model is not None:
+                # speculative draft: initialized per replica (the draft is
+                # tiny — no weight plane, no sharded publish)
+                from ..models.llama import init_params as _init_draft
+
+                draft_cfg = llm_config.build_draft_model_config()
+                draft_params = unbox_params(
+                    _init_draft(draft_cfg, jax.random.PRNGKey(1))
+                )
+                draft = (draft_cfg, draft_params)
             self._engine = ContinuousBatchingEngine(
                 model_config, params, mesh,
                 num_slots=llm_config.max_batch_size,
@@ -149,6 +160,9 @@ class _LLMReplica:
                 seed=llm_config.seed,
                 plan=plan,
                 kv_tier=self._kv_tier,
+                draft=draft,
+                spec_tokens=llm_config.spec_tokens,
+                prefill_chunk_tokens=llm_config.prefill_chunk_tokens,
             )
         else:
             self._kv_cache = None

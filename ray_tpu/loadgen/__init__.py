@@ -18,7 +18,13 @@ from .runner import (
     RequestResult,
 )
 from .trace import Trace, TraceRecord, bundled_trace
-from .workload import RequestClass, ZipfPrefixes, echo_trace, synthesize
+from .workload import (
+    RequestClass,
+    ZipfPrefixes,
+    echo_trace,
+    long_prefill_mix,
+    synthesize,
+)
 
 __all__ = [
     "BurstyRampArrivals",
@@ -35,5 +41,6 @@ __all__ = [
     "ZipfPrefixes",
     "bundled_trace",
     "echo_trace",
+    "long_prefill_mix",
     "synthesize",
 ]

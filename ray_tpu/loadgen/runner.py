@@ -30,21 +30,37 @@ import json
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .trace import Trace, TraceRecord
 
 
 class CallableTarget:
-    """Wrap ``fn(payload) -> Any`` as a target (TTFT == latency)."""
+    """Wrap ``fn(payload) -> Any`` as a target (TTFT == latency). When the
+    callable returns an iterator/generator (a streaming engine adapter),
+    it is drained here: TTFT is the first item and the gaps between
+    consecutive items are recorded as per-token ITL."""
 
     def __init__(self, fn: Callable[[Dict[str, Any]], Any]):
         self._fn = fn
 
-    def __call__(self, record: TraceRecord) -> Tuple[float, float]:
+    def __call__(self, record: TraceRecord):
         t0 = time.perf_counter()
-        self._fn(record.payload())
+        out = self._fn(record.payload())
+        if hasattr(out, "__next__"):  # streaming: drain + stamp gaps
+            first: Optional[float] = None
+            itl: List[float] = []
+            prev = t0
+            for _ in out:
+                now = time.perf_counter()
+                if first is None:
+                    first = now - t0
+                else:
+                    itl.append(now - prev)
+                prev = now
+            latency = time.perf_counter() - t0
+            return first if first is not None else latency, latency, "", itl
         dt = time.perf_counter() - t0
         return dt, dt
 
@@ -77,12 +93,18 @@ class HandleTarget:
         with tracing.request_span("loadgen.request", ctx, cls=record.cls):
             if self._stream:
                 first: Optional[float] = None
+                itl: List[float] = []
+                prev = t0
                 for item in h.options(stream=True).remote(record.payload()):
+                    now = time.perf_counter()
                     if first is None:
-                        first = time.perf_counter() - t0
+                        first = now - t0
+                    else:
+                        itl.append(now - prev)
+                    prev = now
                 latency = time.perf_counter() - t0
                 ttft = first if first is not None else latency
-                return ttft, latency, trace_id
+                return ttft, latency, trace_id, itl
             h.remote(record.payload()).result()
             dt = time.perf_counter() - t0
             return dt, dt, trace_id
@@ -138,6 +160,9 @@ class RequestResult:
     cls: str = "default"
     prefix_id: int = 0
     trace_id: str = ""  # joins this request to its distributed trace
+    # gaps (s) between consecutive streamed items after the first — the
+    # inter-token latency a streaming client saw; empty for unary calls
+    itl_s: List[float] = field(default_factory=list)
 
     @property
     def lag_s(self) -> float:
@@ -194,6 +219,30 @@ class LoadResult:
                 latency_p50_ms=round(_pct(lats, 0.50) * 1000, 2),
                 latency_p99_ms=round(_pct(lats, 0.99) * 1000, 2),
             )
+        # per-class rollup with ITL percentiles: the chunked-prefill
+        # claim is exactly "short_decode ITL p99 stays flat while the
+        # long_prefill class admits", so the split per class is the
+        # measurement, not a nicety
+        classes: Dict[str, Any] = {}
+        for cls_name in sorted({r.cls for r in ok}):
+            rows = [r for r in ok if r.cls == cls_name]
+            entry: Dict[str, Any] = {
+                "requests": len(rows),
+                "ttft_p50_ms": round(
+                    _pct(sorted(r.ttft_s for r in rows), 0.50) * 1000, 2
+                ),
+                "ttft_p99_ms": round(
+                    _pct(sorted(r.ttft_s for r in rows), 0.99) * 1000, 2
+                ),
+            }
+            itls = sorted(g for r in rows for g in r.itl_s)
+            if itls:
+                entry["itl_p50_ms"] = round(_pct(itls, 0.50) * 1000, 3)
+                entry["itl_p99_ms"] = round(_pct(itls, 0.99) * 1000, 3)
+                entry["itl_max_ms"] = round(itls[-1] * 1000, 3)
+            classes[cls_name] = entry
+        if classes:
+            out["classes"] = classes
         return out
 
     def to_trace(self) -> Trace:
@@ -344,12 +393,14 @@ class LoadGenerator:
         try:
             try:
                 out = self.target(rec)
-                # targets return (ttft, latency) or (ttft, latency, trace_id)
+                # targets return (ttft, latency[, trace_id[, itl_s]])
                 ttft, latency = out[0], out[1]
                 trace_id = out[2] if len(out) > 2 else ""
+                itl = list(out[3]) if len(out) > 3 else []
                 outcome = "ok"
             except BaseException as exc:  # noqa: BLE001 — recorded, not raised
                 ttft = latency = time.perf_counter() - base - start
+                itl = []
                 outcome = _classify(exc)
             records[index] = RequestResult(
                 index=index,
@@ -361,6 +412,7 @@ class LoadGenerator:
                 cls=rec.cls,
                 prefix_id=rec.prefix_id,
                 trace_id=trace_id,
+                itl_s=itl,
             )
         finally:
             inflight.release()

@@ -138,3 +138,44 @@ def echo_trace(num_requests: int, rps: float, *, num_prefixes: int = 8,
         prefix_tokens=prefix_tokens, seed=seed,
     )
     return synthesize(arrivals, classes, prefixes, seed=seed)
+
+
+def long_prefill_mix(
+    num_requests: int,
+    rps: float,
+    *,
+    long_prompt_tokens: int = 2048,
+    short_prompt_tokens: int = 64,
+    short_new_tokens: int = 64,
+    long_weight: float = 0.1,
+    vocab_size: int = 32000,
+    seed: int = 0,
+) -> Trace:
+    """The chunked-prefill stress workload: a minority ``long_prefill``
+    class (2k-token prompts, short generations) mixed into a majority
+    ``short_decode`` class (short prompts, streaming decodes). Without a
+    prefill budget each long arrival stalls every in-flight decode for a
+    full 2k-token prefill — the stall shows up directly in the
+    short_decode class's ITL p99/max in ``LoadResult.summary()``; with
+    ``prefill_chunk_tokens`` set it should stay flat. Prefixes are kept
+    trivial (no sharing) so prefix-cache hits don't mask the stall."""
+    if num_requests < 1 or rps <= 0:
+        raise ValueError("need num_requests >= 1 and rps > 0")
+    arrivals = [i / float(rps) for i in range(int(num_requests))]
+    classes = [
+        RequestClass(
+            "short_decode", weight=1.0 - long_weight,
+            prompt_tokens=short_prompt_tokens,
+            max_new_tokens=short_new_tokens, deadline_s=None,
+        ),
+        RequestClass(
+            "long_prefill", weight=long_weight,
+            prompt_tokens=long_prompt_tokens,
+            max_new_tokens=8, deadline_s=None,
+        ),
+    ]
+    prefixes = ZipfPrefixes(
+        num_prefixes=1, alpha=1.1, prefix_tokens=0, seed=seed,
+        vocab_size=vocab_size,
+    )
+    return synthesize(arrivals, classes, prefixes, seed=seed)

@@ -127,6 +127,7 @@ def metrics_summary() -> Dict[str, Any]:
         ingress_summary,
         kvcache_summary,
         kvtier_summary,
+        llm_summary,
         partition_summary,
         serve_ft_summary,
         serve_latency_summary,
@@ -200,6 +201,7 @@ def metrics_summary() -> Dict[str, Any]:
         "train_ft": train_ft_summary(payloads, stragglers=_stragglers()),
         "serve_ft": serve_ft_summary(payloads),
         "serve_latency": serve_latency_summary(payloads),
+        "llm": llm_summary(payloads),
         "autoscale": autoscale_summary(payloads),
         "partition": partition_summary(payloads),
         "ingress": ingress_summary(payloads),

@@ -195,6 +195,7 @@ def test_dreamer_continuous_actions(cluster):
         algo.stop()
 
 
+@pytest.mark.slow  # 17s: heaviest dreamer path; math/runner tests stay tier-1
 def test_dreamer_checkpoint_roundtrip(cluster, tmp_path):
     import jax
 

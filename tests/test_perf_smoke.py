@@ -330,6 +330,46 @@ def test_prefix_cache_prefill_computes_only_suffix():
     )
 
 
+def test_chunked_prefill_respects_step_budget():
+    """Perf guard for the chunked-prefill scheduler (CPU-safe,
+    counter-based): with prefill_chunk_tokens set, NO engine step may
+    compute more prefill tokens than the budget — the whole point is
+    bounding the per-step stall a long prompt can impose on in-flight
+    decodes. Also pins the floor: the prompt must take at least
+    ceil(plen / budget) steps to admit (no silent budget bypass)."""
+    import math
+
+    import jax
+
+    from ray_tpu.kvcache import KVCacheManager
+    from ray_tpu.llm.engine import ContinuousBatchingEngine, GenerationRequest
+    from ray_tpu.models.llama import LlamaConfig, init_params
+    from ray_tpu.parallel.sharding import unbox_params
+
+    budget = 16
+    cfg = LlamaConfig.tiny(max_seq_len=256)
+    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
+    kv = KVCacheManager(num_blocks=32, block_size=16)
+    eng = ContinuousBatchingEngine(
+        cfg, params, num_slots=2, kv_cache=kv,
+        prefill_chunk_tokens=budget,
+    )
+    plen = 100
+    eng.add_request(GenerationRequest(
+        token_ids=list(range(plen)), max_new_tokens=2, temperature=0.0,
+    ))
+    steps = 0
+    while eng.num_active:
+        eng.step()
+        steps += 1
+        assert eng.last_step_prefill_tokens <= budget, (
+            f"step computed {eng.last_step_prefill_tokens} prefill "
+            f"tokens, budget is {budget}"
+        )
+        assert steps < 100
+    assert steps >= math.ceil(plen / budget)
+
+
 def test_scale_smoke_queued_tasks(shutdown_only):
     """Queue-depth envelope smoke (BASELINE.md 'tasks queued on a single
     node'): hundreds of queued no-op tasks on 2 workers all complete

@@ -41,6 +41,7 @@ def test_resnet50_param_count():
     assert 25_400_000 < n < 25_800_000, n
 
 
+@pytest.mark.slow  # 10s: DP trainer loop; forward/bn + param-count stay tier-1
 def test_resnet_data_parallel_trainer(cluster):
     from ray_tpu.train.examples.resnet import make_trainer
 

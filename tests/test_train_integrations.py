@@ -60,6 +60,7 @@ def _hf_train_loop(config):
     trainer.train()
 
 
+@pytest.mark.slow  # 14s: full HF-shim session; the gating test stays tier-1
 def test_transformers_trainer_reports_through_session(cluster):
     from ray_tpu import train as rt_train
 

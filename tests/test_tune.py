@@ -158,6 +158,7 @@ def test_result_dataframe(cluster):
     assert set(df["config/x"]) == {1, 2, 3}
 
 
+@pytest.mark.slow  # 10s: PBT loop; ASHA/hyperband/TPE/BOHB stay tier-1
 def test_pbt_perturbs_and_checkpoints(cluster):
     """Bottom-quantile trials clone a top trial's checkpoint + mutated
     config; cloned trials see the donor's progress via tune.get_checkpoint."""
