@@ -1995,6 +1995,233 @@ def disagg_serve():
     }))
 
 
+def lora_multitenant():
+    """`python bench.py lora_multitenant` — multi-tenant LoRA serving on
+    the paged adapter plane: N=64 published adapters, a 2-replica set,
+    Zipf(1.0) tenant mix.
+
+    64 rank-8 adapters are published to the weight plane (int8 chunks);
+    two replica engines each run an AdapterStore (max_live=8 slots) and
+    serve a multi_tenant_mix trace routed by adapter-id affinity (the
+    same crc32 ring bias serve handles use). Mixed arm: up to 4 tenants
+    decode CONCURRENTLY per wave through the batched-gather path — one
+    jitted program, no re-jit, no swap_params. Sequential arm: the same
+    requests one at a time (what per-request adapter swapping degrades
+    to). A temp-0 parity check pins mixed == solo per tenant. The
+    one-deployment-per-adapter baseline is reported as provisioning
+    cost: a dedicated engine's build+compile time and param bytes,
+    versus one cold attach and one bank row. Prints ONE JSON line for
+    BENCH_LOG.md. CPU-safe (RAY_TPU_BENCH_CPU=1)."""
+    if os.environ.get("RAY_TPU_BENCH_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import zlib
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu.kvcache import KVCacheManager
+    from ray_tpu.llm.engine import ContinuousBatchingEngine, GenerationRequest
+    from ray_tpu.loadgen import multi_tenant_mix
+    from ray_tpu.lora import AdapterStore, adapter_target_paths, publish_adapter
+    from ray_tpu.models.llama import LlamaConfig, init_params
+    from ray_tpu.parallel.sharding import unbox_params
+
+    num_adapters, max_live, rank, alpha = 64, 8, 8, 16.0
+    num_requests, new_tokens = 96, 16
+    cfg = LlamaConfig.tiny(max_seq_len=128)
+    params = unbox_params(init_params(cfg, jax.random.PRNGKey(0)))
+    _log(f"devices={jax.devices()}")
+
+    def make_tree(i):
+        rngi = np.random.RandomState(1000 + i)
+        tree = {}
+        for path, in_dim, out_dim in adapter_target_paths(cfg):
+            node = tree
+            for p in path[:-1]:
+                node = node.setdefault(p, {})
+            node[path[-1]] = {
+                "lora_a": jnp.asarray(
+                    rngi.normal(0.0, 0.3, (in_dim, rank)), jnp.float32
+                ),
+                "lora_b": jnp.asarray(
+                    rngi.normal(0.0, 0.3, (rank, out_dim)), jnp.float32
+                ),
+            }
+        return tree
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        t0 = time.perf_counter()
+        for i in range(num_adapters):
+            publish_adapter("bench/lora", f"tenant_{i:02d}", make_tree(i))
+        publish_s = time.perf_counter() - t0
+        _log(f"published {num_adapters} int8 adapters in {publish_s:.1f}s")
+
+        def make_replica():
+            store = AdapterStore(
+                cfg, max_live=max_live, rank=rank, alpha=alpha,
+                source="weights:bench/lora",
+            )
+            kv = KVCacheManager(num_blocks=128, block_size=8)
+            eng = ContinuousBatchingEngine(
+                cfg, params, num_slots=4, kv_cache=kv, seed=0,
+                adapter_store=store,
+            )
+            # compile prefill/decode off the clock
+            eng.add_request(GenerationRequest(
+                token_ids=[5] * 24, max_new_tokens=new_tokens,
+                temperature=0.0,
+            ))
+            eng.run_until_complete()
+            return eng, store
+
+        replicas = [make_replica(), make_replica()]
+        trace = multi_tenant_mix(
+            num_requests, rps=1000.0, num_adapters=num_adapters,
+            adapter_alpha=1.0, base_weight=0.1, prompt_tokens=24,
+            max_new_tokens=new_tokens, vocab_size=cfg.vocab_size - 1,
+            seed=7,
+        )
+        # adapter-id affinity ring bias (serve/handle.py): a tenant's
+        # requests concentrate on one replica so its slot stays hot
+        def route(rec, i):
+            if rec.adapter_id is None:
+                return i % 2
+            return zlib.crc32(
+                ("adapter:" + rec.adapter_id).encode()
+            ) % 2
+
+        per_replica = [[], []]
+        for i, rec in enumerate(trace.requests):
+            per_replica[route(rec, i)].append(rec)
+        _log(f"routing: {len(per_replica[0])}/{len(per_replica[1])} "
+             "requests per replica")
+
+        def serve_requests(replica, recs, wave_size):
+            """Serve recs in waves of wave_size concurrent requests;
+            returns (tokens/s, {rec-id: tokens}, cold-attach ms list)."""
+            eng, store = replica
+            outs, attach_ms = {}, []
+            total = 0
+            t0 = time.perf_counter()
+            for w0 in range(0, len(recs), wave_size):
+                wave = recs[w0:w0 + wave_size]
+                leases = []
+                rids = {}
+                for rec in wave:
+                    lease = None
+                    if rec.adapter_id is not None:
+                        c0 = store.cold_attaches
+                        ta = time.perf_counter()
+                        lease = store.acquire(rec.adapter_id)
+                        if store.cold_attaches > c0:
+                            attach_ms.append(
+                                (time.perf_counter() - ta) * 1e3
+                            )
+                        leases.append(lease)
+                    rids[eng.add_request(GenerationRequest(
+                        token_ids=list(rec.token_ids),
+                        max_new_tokens=rec.max_new_tokens,
+                        temperature=0.0,
+                        adapter_id=rec.adapter_id,
+                        adapter_slot=lease.slot if lease else -1,
+                    ))] = rec
+                done = eng.run_until_complete()
+                for lease in leases:
+                    store.release(lease)
+                for rid, rec in rids.items():
+                    outs[id(rec)] = done[rid].token_ids
+                    total += len(done[rid].token_ids)
+            return total / (time.perf_counter() - t0), outs, attach_ms
+
+        mixed_tps, mixed_outs, attach_ms = [], {}, []
+        for ri, replica in enumerate(replicas):
+            tps, outs, att = serve_requests(replica, per_replica[ri], 4)
+            mixed_tps.append(tps)
+            mixed_outs.update(outs)
+            attach_ms.extend(att)
+        mixed = sum(mixed_tps)
+        stats0 = replicas[0][1].stats()
+        _log(f"mixed: {mixed:.1f} tok/s aggregate; replica0 stats {stats0}")
+
+        seq_tps = []
+        for ri, replica in enumerate(replicas):
+            tps, seq_outs, _ = serve_requests(replica, per_replica[ri], 1)
+            seq_tps.append(tps)
+            # temp-0 parity: every request's mixed-batch tokens == its
+            # sequential tokens (same replica, same adapter slot plane)
+            for rec in per_replica[ri]:
+                assert mixed_outs[id(rec)] == seq_outs[id(rec)], (
+                    f"parity broke for {rec.adapter_id}"
+                )
+        sequential = sum(seq_tps)
+        _log(f"sequential: {sequential:.1f} tok/s aggregate; parity OK")
+
+        # one-deployment-per-adapter baseline: what a tenant costs when it
+        # gets a dedicated engine instead of a bank row
+        t0 = time.perf_counter()
+        ded_kv = KVCacheManager(num_blocks=128, block_size=8)
+        ded = ContinuousBatchingEngine(
+            cfg, params, num_slots=4, kv_cache=ded_kv, seed=0,
+        )
+        ded.add_request(GenerationRequest(
+            token_ids=[5] * 24, max_new_tokens=new_tokens, temperature=0.0,
+        ))
+        ded.run_until_complete()
+        dedicated_s = time.perf_counter() - t0
+        params_mb = sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(params)
+        ) / 1e6
+        bank_mb = sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(replicas[0][1].bank())
+        ) / 1e6
+
+        att = sorted(attach_ms)
+        p = lambda q: att[min(len(att) - 1, int(q * len(att)))] if att else None  # noqa: E731
+        print(json.dumps({
+            "metric": "lora_multitenant_mixed_vs_sequential_speedup",
+            "value": round(mixed / sequential, 2) if sequential else None,
+            "unit": "x (mixed-adapter batched-gather tok/s / one-request-"
+                    "at-a-time tok/s, 2 replicas)",
+            "tokens_per_sec_mixed": round(mixed, 1),
+            "tokens_per_sec_sequential": round(sequential, 1),
+            "cold_attach_ms": {
+                "count": len(att),
+                "p50": round(p(0.50), 1) if att else None,
+                "p99": round(p(0.99), 1) if att else None,
+                "max": round(att[-1], 1) if att else None,
+            },
+            "adapter_stats_replica0": {
+                k: stats0[k]
+                for k in ("hits", "cold_attaches", "evictions",
+                          "slots_live")
+            },
+            "per_tenant_dedicated_engine_baseline": {
+                "provision_s": round(dedicated_s, 2),
+                "params_mb_per_tenant": round(params_mb, 2),
+                "bank_mb_total_all_slots": round(bank_mb, 2),
+                "publish_s_64_adapters": round(publish_s, 2),
+            },
+            "config": {
+                "num_adapters": num_adapters, "max_live": max_live,
+                "rank": rank, "alpha": alpha, "zipf_alpha": 1.0,
+                "base_weight": 0.1, "num_requests": num_requests,
+                "prompt_tokens": 24, "new_tokens": new_tokens,
+                "wave_size": 4, "replicas": 2, "ship_codec": "int8",
+                "backend": jax.default_backend(),
+            },
+        }))
+    finally:
+        ray_tpu.shutdown()
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "llm_prefix_cache":
         llm_prefix_cache()
@@ -2018,6 +2245,8 @@ if __name__ == "__main__":
         overlap_train()
     elif len(sys.argv) > 1 and sys.argv[1] == "disagg_serve":
         disagg_serve()
+    elif len(sys.argv) > 1 and sys.argv[1] == "lora_multitenant":
+        lora_multitenant()
     elif len(sys.argv) > 1:
         raise SystemExit(f"unknown bench mode {sys.argv[1]!r}")
     else:

@@ -12,3 +12,4 @@ from . import rt009_host_roundtrips  # noqa: F401
 from . import rt010_scheduler_reduce  # noqa: F401
 from . import rt011_transfer_layer  # noqa: F401
 from . import rt012_series_registry  # noqa: F401
+from . import rt013_adapter_slots  # noqa: F401

@@ -1,0 +1,81 @@
+"""RT013: the LoRA slot bank is mutated only through AdapterStore.
+
+Incident class this encodes: the multi-tenant LoRA plane (PR 20). The
+adapter bank is a stacked ``(num_slots, ...)`` device buffer shared by
+every in-flight request — decode programs gather rows out of it by slot
+index every step. ``AdapterStore._write_slot`` is the one audited way to
+change a row: a jitted copy-on-write ``dynamic_update_index_in_dim``
+over the whole tree that keeps the bank's shardings, scales ``lora_b``
+by alpha/rank at attach, and only runs while the slot holds zero leases
+(the superseded bank stays valid for decode steps already in flight).
+Writing a row any other way — rebuilding the bank pytree in the engine,
+poking ``store._bank`` from serving code, or calling the private
+``_write_slot`` from outside the store — silently corrupts whatever
+request is decoding from that row, skips the refcount gate, and drops
+the sharded-layout guarantee the engine's compiled programs rely on.
+
+Flags, in ``ray_tpu/llm/``, ``ray_tpu/serve/`` and ``ray_tpu/kvcache/``:
+
+- any assignment to an attribute named ``_bank`` or ``_adapter_bank`` —
+  rebinding the slot pool outside the store;
+- any ``X._write_slot(...)`` attribute call — reaching the private write
+  primitive around its lease accounting.
+
+``ray_tpu/lora/`` itself is outside the scanned paths: that IS the
+chokepoint. Mutate slots via ``AdapterStore.acquire`` / ``release`` /
+``prewarm`` so lease refcounts, LRU state and metrics stay coherent.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, register
+
+_BANK_NAMES = ("_bank", "_adapter_bank")
+
+
+@register
+class AdapterSlotsChecker(Checker):
+    RULE_ID = "RT013"
+    DESCRIPTION = (
+        "LoRA slot-bank mutation outside AdapterStore (llm/serve/kvcache); "
+        "go through acquire/release/prewarm in ray_tpu/lora"
+    )
+
+    def applies_to(self, path: str) -> bool:
+        parts = path.split("/")
+        return any(p in ("llm", "serve", "kvcache") for p in parts[:-1])
+
+    def check_file(self, path, tree, source):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and tgt.attr in _BANK_NAMES
+                    ):
+                        yield self.finding(
+                            path, node,
+                            f"assignment to .{tgt.attr} rebinds the LoRA "
+                            "slot bank outside AdapterStore, corrupting "
+                            "rows in-flight requests are gathering from; "
+                            "mutate slots via AdapterStore.acquire/"
+                            "release/prewarm",
+                        )
+                continue
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_write_slot"
+            ):
+                yield self.finding(
+                    path, node,
+                    "direct _write_slot() call bypasses AdapterStore's "
+                    "lease refcounts and LRU accounting; attach adapters "
+                    "via AdapterStore.acquire/prewarm",
+                )

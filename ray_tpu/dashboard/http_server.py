@@ -314,7 +314,11 @@ class DashboardServer:
     def _serve(self, body, query=None):
         import json as _json
 
-        from ..util.metrics import llm_summary, serve_ft_summary
+        from ..util.metrics import (
+            adapter_summary,
+            llm_summary,
+            serve_ft_summary,
+        )
 
         replicas = []
         try:
@@ -329,6 +333,7 @@ class DashboardServer:
             "replicas": replicas,
             "fault_tolerance": serve_ft_summary(payloads),
             "llm": llm_summary(payloads),
+            "adapters": adapter_summary(payloads),
         }, None
 
     def _proxies(self, body, query=None):

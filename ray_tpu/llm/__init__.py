@@ -9,7 +9,7 @@ KV cache in a flax "cache" collection, bfloat16 on the MXU, TP/SP via the
 mesh (GSPMD), replicas scheduled on TPU resources through serve.
 """
 
-from .config import LLMConfig
+from .config import AdapterConfig, LLMConfig
 from .engine import (
     ContinuousBatchingEngine,
     GenerationRequest,
@@ -20,6 +20,7 @@ from .serving import build_llm_deployment, publish_llm_weights
 from .batch import LLMPredictor
 
 __all__ = [
+    "AdapterConfig",
     "LLMConfig",
     "LLMEngine",
     "ContinuousBatchingEngine",

@@ -16,10 +16,22 @@ from .engine import GenerationRequest, LLMEngine
 
 
 class LLMPredictor:
-    """map_batches UDF: {"token_ids": list-of-lists} -> adds "generated"."""
+    """map_batches UDF: {"token_ids": list-of-lists} -> adds "generated".
+
+    Params resolve in priority order: ``params_blob`` (serialized pytree
+    shipped in the UDF constructor args), then ``weights_name`` (pulled
+    from the weight plane on first construction inside each map actor —
+    the blob never rides the task spec), then random init.
+
+    An optional per-row ``"adapter_id"`` column multiplexes LoRA tenants
+    through one engine: rows sharing a batch may name different adapters
+    (or None for the base model) and still execute as one mixed batch via
+    the batched-gather decode path. Requires ``llm_config.adapters``.
+    """
 
     def __init__(self, llm_config: Optional[LLMConfig] = None,
-                 params_blob: Optional[bytes] = None):
+                 params_blob: Optional[bytes] = None,
+                 weights_name: Optional[str] = None):
         import jax
 
         from ..parallel.sharding import unbox_params
@@ -30,28 +42,74 @@ class LLMPredictor:
             from .._internal import serialization
 
             params = serialization.loads(params_blob)
+        elif weights_name is not None:
+            from .. import weights
+
+            _, params = weights.fetch(weights_name, timeout=60.0)
         else:
             from ..models.llama import init_params
 
             params = unbox_params(
                 init_params(model_config, jax.random.PRNGKey(0))
             )
+        self._adapter_store = None
+        if self._config.adapters is not None:
+            from ..lora import AdapterStore
+
+            ac = self._config.adapters
+            self._adapter_store = AdapterStore(
+                model_config,
+                max_live=ac.max_live,
+                rank=ac.slot_rank,
+                alpha=ac.alpha,
+                source=ac.source,
+                param_dtype=model_config.param_dtype,
+            )
         self._engine = LLMEngine(
             model_config, params,
             max_batch_size=self._config.max_batch_size,
+            adapter_store=self._adapter_store,
         )
 
     def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
         prompts = batch["token_ids"]
-        requests = [
-            GenerationRequest(
-                token_ids=list(p),
-                max_new_tokens=self._config.max_new_tokens,
-                temperature=self._config.temperature,
+        adapter_ids = batch.get("adapter_id")
+        if adapter_ids is not None and self._adapter_store is None:
+            raise ValueError(
+                "batch has an 'adapter_id' column but LLMConfig.adapters "
+                "is not configured"
             )
-            for p in prompts
-        ]
-        results = self._engine.generate(requests)
+        leases: Dict[str, Any] = {}
+        try:
+            requests = []
+            for i, p in enumerate(prompts):
+                aid = adapter_ids[i] if adapter_ids is not None else None
+                if aid is not None:
+                    aid = str(aid)
+                slot = -1
+                if aid:
+                    lease = leases.get(aid)
+                    if lease is None:
+                        lease = self._adapter_store.acquire(aid)
+                        if lease is None:
+                            raise RuntimeError(
+                                f"no free adapter slot for {aid!r}: batch "
+                                "names more live adapters than "
+                                "adapters.max_live"
+                            )
+                        leases[aid] = lease
+                    slot = lease.slot
+                requests.append(GenerationRequest(
+                    token_ids=list(p),
+                    max_new_tokens=self._config.max_new_tokens,
+                    temperature=self._config.temperature,
+                    adapter_id=aid or None,
+                    adapter_slot=slot,
+                ))
+            results = self._engine.generate(requests)
+        finally:
+            for lease in leases.values():
+                self._adapter_store.release(lease)
         out = dict(batch)
         out["generated"] = [r.token_ids for r in results]
         return out

@@ -13,6 +13,38 @@ from typing import Any, Dict, Optional
 
 
 @dataclass
+class AdapterConfig:
+    """Multi-tenant LoRA serving (ray_tpu.lora): each replica keeps a
+    paged AdapterStore of ``max_live`` HBM slots at rank ``slot_rank``;
+    requests name an adapter via ``@serve.multiplexed`` model-id or an
+    explicit ``adapter_id`` field, and a cold adapter refills from
+    ``source`` (``"weights:<prefix>"`` pulls ``<prefix>/<adapter_id>``
+    over the weight plane — the int8 chunk codec makes per-tenant
+    publishes near-free)."""
+
+    max_live: int = 8  # resident adapter slots per replica
+    slot_rank: int = 8  # the bank-wide LoRA rank (fixed: slots are paged)
+    alpha: float = 16.0  # lora_b is pre-scaled by alpha/rank at attach
+    source: Optional[str] = None  # "weights:<prefix>" | None (prewarm-only)
+    # acquire() retry budget when every slot is pinned before the replica
+    # raises BackPressureError (routers retry elsewhere)
+    acquire_timeout_s: float = 5.0
+
+    def __post_init__(self):
+        if self.max_live < 1:
+            raise ValueError("AdapterConfig.max_live must be >= 1")
+        if self.slot_rank < 1:
+            raise ValueError("AdapterConfig.slot_rank must be >= 1")
+        if self.source is not None and not (
+            callable(self.source) or str(self.source).startswith("weights:")
+        ):
+            raise ValueError(
+                'AdapterConfig.source must be "weights:<prefix>" or a '
+                f"callable, got {self.source!r}"
+            )
+
+
+@dataclass
 class LLMConfig:
     model_id: str = "llama-tiny"
     # model construction: either a models.llama config name or kwargs
@@ -102,6 +134,11 @@ class LLMConfig:
     # stalling them; 0 = prefill runs to completion at admission.
     # Requires the paged engine.
     prefill_chunk_tokens: int = 0
+    # multi-tenant LoRA plane (ray_tpu.lora): an AdapterConfig (or its
+    # dict form) turns each replica into a multiplexed adapter server —
+    # paged slots, batched-gather decode, weight-plane refill. Requires
+    # the paged engine.
+    adapters: Optional[AdapterConfig] = None
 
     def __post_init__(self):
         if self.mesh is not None:
@@ -158,6 +195,13 @@ class LLMConfig:
             raise ValueError(
                 "speculative decoding / chunked prefill run on the "
                 "continuous-batching engine: set kv_cache_blocks"
+            )
+        if isinstance(self.adapters, dict):
+            self.adapters = AdapterConfig(**self.adapters)
+        if self.adapters is not None and not self.kv_cache_blocks:
+            raise ValueError(
+                "multi-tenant adapters run on the continuous-batching "
+                "engine: set kv_cache_blocks"
             )
 
     def effective_parallelism(self) -> tuple:

@@ -26,6 +26,7 @@ import dataclasses
 import os
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -108,6 +109,12 @@ class GenerationRequest:
     max_new_tokens: int = 64
     temperature: float = 0.0
     eos_token_id: Optional[int] = None
+    # multi-tenant LoRA (ray_tpu.lora): the replica resolves adapter_id to
+    # an AdapterStore slot lease at admission and stamps the slot index
+    # here; -1 = base model. The engine only ever reads the index — lease
+    # lifecycle (pin/release) belongs to the caller holding the lease.
+    adapter_id: Optional[str] = None
+    adapter_slot: int = -1
 
 
 @dataclasses.dataclass
@@ -121,11 +128,17 @@ class _DecodeModelBase:
     """Shared jitted prefill/decode programs over the cached Llama
     (both engines compile the identical two programs)."""
 
-    def __init__(self, model_config, params, mesh=None, plan=None):
+    def __init__(self, model_config, params, mesh=None, plan=None,
+                 adapter_store=None):
         from ..models.llama import Llama
 
         self._cfg = model_config
         self._mesh = mesh
+        # multi-tenant LoRA slot bank (ray_tpu.lora.AdapterStore) or None.
+        # With a store, every prefill/decode call threads (bank, slots)
+        # through the SAME jitted programs — the bank is a traced argument
+        # like params, so attaching/evicting adapters never re-compiles.
+        self._adapter_store = adapter_store
         # tensor-parallel plan: explicit, or derived from a non-trivial
         # mesh so `mesh=` alone wires TP through either engine
         if plan is None and mesh is not None and mesh.shape.get("tp", 1) > 1:
@@ -164,17 +177,32 @@ class _DecodeModelBase:
             self._prefill = jax.jit(self._prefill_impl)
             self._decode = jax.jit(self._decode_impl)
 
-    def _prefill_impl(self, params, tokens):
+    def _prefill_impl(self, params, tokens, adapters=None, adapter_slots=None):
         logits, vars_out = self._model.apply(
-            {"params": params}, tokens, mutable=["cache"]
+            {"params": params}, tokens, adapters, adapter_slots,
+            mutable=["cache"],
         )
         return logits[:, -1, :], vars_out["cache"]
 
-    def _decode_impl(self, params, cache, last_tokens):
+    def _decode_impl(self, params, cache, last_tokens, adapters=None,
+                     adapter_slots=None):
         logits, vars_out = self._model.apply(
-            {"params": params, "cache": cache}, last_tokens, mutable=["cache"]
+            {"params": params, "cache": cache}, last_tokens, adapters,
+            adapter_slots, mutable=["cache"],
         )
         return logits[:, -1, :], vars_out["cache"]
+
+    def _adapter_args(self, slots) -> tuple:
+        """Extra jit arguments for an adapter-aware call: the slot bank
+        plus the per-row slot index vector (-1 = base model). Empty when
+        the engine has no store, so every legacy 2/3-arg call keeps its
+        compiled program."""
+        if self._adapter_store is None:
+            return ()
+        return (
+            self._adapter_store.bank(),
+            jnp.asarray(np.asarray(slots, np.int32)),
+        )
 
     def swap_params(self, params):
         """Hot weight reload: the jitted prefill/decode programs close over
@@ -205,8 +233,11 @@ class LLMEngine(_DecodeModelBase):
         max_batch_size: int = 8,
         seed: Optional[int] = None,
         plan=None,
+        adapter_store=None,
     ):
-        super().__init__(model_config, params, mesh, plan=plan)
+        super().__init__(
+            model_config, params, mesh, plan=plan, adapter_store=adapter_store
+        )
         self._max_batch = max_batch_size
         self._rng = jax.random.PRNGKey(_resolve_seed(seed))
 
@@ -243,7 +274,10 @@ class LLMEngine(_DecodeModelBase):
             [r.token_ids for r in requests], np.int32
         )  # (b, plen), no padding by construction
 
-        logits, cache = self._prefill(self._params, jnp.asarray(tokens))
+        slots = [r.adapter_slot for r in requests]
+        logits, cache = self._prefill(
+            self._params, jnp.asarray(tokens), *self._adapter_args(slots)
+        )
         rng = self._rng
         generated: List[List[int]] = [[] for _ in range(b)]
         finished = [False] * b
@@ -268,7 +302,8 @@ class LLMEngine(_DecodeModelBase):
             ):
                 break
             logits, cache = self._decode(
-                self._params, cache, jnp.asarray(last).reshape(b, 1)
+                self._params, cache, jnp.asarray(last).reshape(b, 1),
+                *self._adapter_args(slots),
             )
             last = self._sample(logits, requests, rng, step)
             record(last)
@@ -308,7 +343,10 @@ class LLMEngine(_DecodeModelBase):
             )
             return
         tokens = np.asarray([request.token_ids], np.int32)
-        logits, cache = self._prefill(self._params, jnp.asarray(tokens))
+        logits, cache = self._prefill(
+            self._params, jnp.asarray(tokens),
+            *self._adapter_args([request.adapter_slot]),
+        )
         rng = self._rng
         generated: List[int] = []
         reason = "length"
@@ -320,7 +358,8 @@ class LLMEngine(_DecodeModelBase):
         else:
             for step in range(1, request.max_new_tokens):
                 logits, cache = self._decode(
-                    self._params, cache, jnp.asarray([[last]], jnp.int32)
+                    self._params, cache, jnp.asarray([[last]], jnp.int32),
+                    *self._adapter_args([request.adapter_slot]),
                 )
                 last = self._sample_step(logits, request, rng, step)
                 generated.append(last)
@@ -383,8 +422,11 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         draft=None,
         spec_tokens: int = 0,
         prefill_chunk_tokens: int = 0,
+        adapter_store=None,
     ):
-        super().__init__(model_config, params, mesh, plan=plan)
+        super().__init__(
+            model_config, params, mesh, plan=plan, adapter_store=adapter_store
+        )
         self._num_slots = num_slots
         self._slots: Dict[int, _Slot] = {}  # slot index -> active request
         # (request_id, GenerationRequest, shipment-or-None): the third
@@ -569,7 +611,8 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         for si, slot in self._slots.items():
             last[si, 0] = slot.last_token
         logits, self._cache = self._decode(
-            self._params, self._cache, jnp.asarray(last)
+            self._params, self._cache, jnp.asarray(last),
+            *self._adapter_args(self._row_adapter_slots()),
         )
         self._step_count += 1
         tokens = self._sample_rows(logits)
@@ -616,9 +659,14 @@ class ContinuousBatchingEngine(_DecodeModelBase):
             self._draft._params, self._draft_cache, jnp.asarray(last),
             temps_d, key,
         )
+        # adapters apply to the TARGET verify pass only: the draft proposes
+        # base-model tokens (it has no per-tenant fine-tune), which costs
+        # acceptance rate on adapter-heavy rows but never correctness —
+        # verification is against the adapter-applied target distribution
         emitted, counts, self._cache, new_idx = self._verify(
             self._params, self._cache, chunk, draft_tok, draft_logits,
             temps_d, jax.random.fold_in(key, 0), jnp.asarray(start),
+            *self._adapter_args(self._row_adapter_slots()),
         )
         # the draft pool rolls back to the same corrected position
         self._draft_cache = self._set_index(self._draft_cache, new_idx)
@@ -657,6 +705,29 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         if proposed:
             _record_spec(proposed, accepted, mesh=self._mesh_tag)
 
+    def _row_adapter_slots(self) -> np.ndarray:
+        """Per-row adapter slot indices for the pooled decode batch; free
+        rows read -1 (base path — their garbage compute stays adapter-free
+        and cheap)."""
+        slots = np.full(self._num_slots, -1, np.int32)
+        for si, slot in self._slots.items():
+            slots[si] = slot.request.adapter_slot
+        return slots
+
+    @staticmethod
+    def _kv_key_tokens(req: GenerationRequest, tokens=None) -> List[int]:
+        """The radix/tier identity of a request's KV: adapter-tinted K/V
+        (wq/wk/wv run through the adapter) must never collide with the
+        base model's — or another adapter's — cached prefixes, so adapter
+        requests salt every token id with the adapter id, namespacing the
+        shared radix per tenant. Salted ids never reach the device; they
+        exist only as trie keys."""
+        toks = list(tokens if tokens is not None else req.token_ids)
+        if req.adapter_id is None:
+            return toks
+        salt = (zlib.crc32(req.adapter_id.encode("utf-8")) + 1) << 32
+        return [int(t) + salt for t in toks]
+
     def _finish_slot(self, si: int, slot: _Slot, reason: str,
                      finished: List[tuple]) -> None:
         req = slot.request
@@ -694,7 +765,11 @@ class ContinuousBatchingEngine(_DecodeModelBase):
             return
         self._kv.extend(slot.lease, avail - slot.committed_blocks)
         row = self._extract_row(self._cache, jnp.asarray(si, jnp.int32))
-        self._kv.commit(slot.lease, tokens[: avail * bs], row, pin=False)
+        self._kv.commit(
+            slot.lease,
+            self._kv_key_tokens(slot.request, tokens[: avail * bs]),
+            row, pin=False,
+        )
         slot.committed_blocks = avail
 
     def _retire_slot(self, si: int) -> None:
@@ -715,7 +790,9 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         if len(tokens) // self._kv.block_size > already:
             cm_t0 = time.time() if slot.trace else 0.0
             row = self._extract_row(self._cache, jnp.asarray(si, jnp.int32))
-            self._kv.commit(slot.lease, tokens, row, pin=False)
+            self._kv.commit(
+                slot.lease, self._kv_key_tokens(req, tokens), row, pin=False
+            )
             if slot.trace:
                 _tracing.emit_span(
                     "kvcache.commit", slot.trace["ctx"], cm_t0,
@@ -845,7 +922,10 @@ class ContinuousBatchingEngine(_DecodeModelBase):
             tr = self._req_trace.get(rid)
             plen = len(req.token_ids)
             pulled = None
-            if self._kv is not None:
+            # the cluster tier and directed shipments carry BASE-model KV;
+            # adapter requests stay out of both (their prefixes live in the
+            # adapter-salted local radix namespace instead)
+            if self._kv is not None and req.adapter_id is None:
                 if ship is not None:
                     pulled = self._as_pulled(ship, req)
                 elif self._tier is not None:
@@ -868,7 +948,7 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                         pulled.shipment.nblocks if fast
                         else pulled.matched_blocks,
                     )
-                lease = self._kv.acquire(req.token_ids)
+                lease = self._kv.acquire(self._kv_key_tokens(req))
                 if lease is None:  # backpressure: wait for a release
                     self._pending.insert(0, (rid, req, ship))
                     if rid not in self._blocked_rids:
@@ -971,7 +1051,7 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                 # row is at hand; reserved blocks are consumed here
                 # (the fast path adopted them instead)
                 cm_t0 = time.time() if tr else 0.0
-                self._kv.commit(lease, req.token_ids, solo_cache)
+                self._kv.commit(lease, self._kv_key_tokens(req), solo_cache)
                 if tr:
                     _tracing.emit_span(
                         "kvcache.commit", tr["ctx"], cm_t0,
@@ -981,6 +1061,7 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                 if (
                     self._tier is not None
                     and lease.cacheable
+                    and req.adapter_id is None
                     and self._tier.should_export(
                         req.token_ids, plen // self._kv.block_size
                     )
@@ -1064,7 +1145,8 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                 take = min(chunk_max, len(tokens) - pos, budget)
                 chunk = jnp.asarray([tokens[pos:pos + take]], jnp.int32)
                 st["logits"], st["row"] = self._decode(
-                    self._params, st["row"], chunk
+                    self._params, st["row"], chunk,
+                    *self._adapter_args([req.adapter_slot]),
                 )
                 pos += take
                 budget -= take
@@ -1079,7 +1161,10 @@ class ContinuousBatchingEngine(_DecodeModelBase):
                     # partial commit: completed full blocks become
                     # hittable for concurrent shared-prefix admissions
                     # NOW, not when the whole prompt lands
-                    self._kv.commit(lease, tokens[:pos], st["row"])
+                    self._kv.commit(
+                        lease, self._kv_key_tokens(req, tokens[:pos]),
+                        st["row"],
+                    )
                     st["committed"] = pos // bs
                 continue
             del self._prefilling[si]
@@ -1157,7 +1242,8 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         return chunk, chunk[:, 1:], jnp.swapaxes(dlogits, 0, 1), cache
 
     def _verify_impl(self, params, cache, chunk, draft_tok, draft_logits,
-                     temps, key, start_idx):
+                     temps, key, start_idx, adapters=None,
+                     adapter_slots=None):
         """The fused speculative verify: ONE forward pass over the
         (num_slots, k+1) chunk [last_token, d_1..d_k] scores every
         proposal (position j's logits predict the token after input j),
@@ -1174,7 +1260,8 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         target."""
         k = draft_tok.shape[1]
         logits, vars_out = self._model.apply(
-            {"params": params, "cache": cache}, chunk, mutable=["cache"]
+            {"params": params, "cache": cache}, chunk, adapters,
+            adapter_slots, mutable=["cache"],
         )  # (S, k+1, V)
         new_cache = vars_out["cache"]
         ka, kb = jax.random.split(key)
@@ -1287,6 +1374,10 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         problem costs latency, never a request."""
         if self._kv is None or self._tier is None:
             return None
+        if request.adapter_id is not None:
+            # adapter-tinted KV must not ship through the base-model tier;
+            # the caller falls back to fused serving for this request
+            return None
         if len(request.token_ids) + request.max_new_tokens > self._cfg.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
         with self._lock:
@@ -1330,7 +1421,8 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         tokens = req.token_ids
         if lease is None or lease.num_cached_tokens == 0:
             return self._prefill(
-                self._params, jnp.asarray([tokens], jnp.int32)
+                self._params, jnp.asarray([tokens], jnp.int32),
+                *self._adapter_args([req.adapter_slot]),
             )
         as_t0 = time.time() if trace else 0.0
         row = self._kv.assemble(lease)
@@ -1345,7 +1437,10 @@ class ContinuousBatchingEngine(_DecodeModelBase):
         while pos < len(tokens):
             take = min(self._kv.block_size, len(tokens) - pos)
             chunk = jnp.asarray([tokens[pos : pos + take]], jnp.int32)
-            logits, row = self._decode(self._params, row, chunk)
+            logits, row = self._decode(
+                self._params, row, chunk,
+                *self._adapter_args([req.adapter_slot]),
+            )
             pos += take
         return logits, row
 

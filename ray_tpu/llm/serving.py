@@ -153,6 +153,24 @@ class _LLMReplica:
                     _init_draft(draft_cfg, jax.random.PRNGKey(1))
                 )
                 draft = (draft_cfg, draft_params)
+            self._adapter_store = None
+            if llm_config.adapters is not None:
+                # multi-tenant LoRA plane: one paged AdapterStore per
+                # replica; request threads resolve slot leases before
+                # admission so cold weight-plane pulls never block the
+                # engine loop
+                from ..lora import AdapterStore
+
+                ac = llm_config.adapters
+                self._adapter_store = AdapterStore(
+                    model_config,
+                    max_live=ac.max_live,
+                    rank=ac.slot_rank,
+                    alpha=ac.alpha,
+                    source=ac.source,
+                    plan=plan,
+                    param_dtype=model_config.param_dtype,
+                )
             self._engine = ContinuousBatchingEngine(
                 model_config, params, mesh,
                 num_slots=llm_config.max_batch_size,
@@ -163,9 +181,11 @@ class _LLMReplica:
                 draft=draft,
                 spec_tokens=llm_config.spec_tokens,
                 prefill_chunk_tokens=llm_config.prefill_chunk_tokens,
+                adapter_store=self._adapter_store,
             )
         else:
             self._kv_cache = None
+            self._adapter_store = None
             self._engine = LLMEngine(
                 model_config, params, mesh,
                 max_batch_size=llm_config.max_batch_size,
@@ -294,6 +314,10 @@ class _LLMReplica:
         decode, so the request still completes."""
         if self._kv_tier is None:
             return None
+        if self._requested_adapter_id(request) is not None:
+            # adapter-tinted KV never ships through the base-model tier;
+            # the ingress falls back to fused decode for this request
+            return None
         shipment = self._engine.prefill_only(self._parse_request(request))
         return shipment.to_blob() if shipment is not None else None
 
@@ -303,7 +327,8 @@ class _LLMReplica:
         a dead prefill holder, or any fetch failure degrades to a normal
         computed admission — a transfer-plane problem costs latency, never
         a request."""
-        gen_req = self._parse_request(request)
+        lease = self._resolve_adapter(request)
+        gen_req = self._parse_request(request, lease)
         ship = None
         if shipment_blob is not None and self._kv_tier is not None:
             from ..kvtier import KVShipment
@@ -312,7 +337,11 @@ class _LLMReplica:
             payload = self._kv_tier.fetch_shipment(shipment)
             if payload is not None:
                 ship = (shipment, payload)
-        result = self._engine.generate_one(gen_req, shipment=ship)
+        try:
+            result = self._engine.generate_one(gen_req, shipment=ship)
+        finally:
+            if self._adapter_store is not None:
+                self._adapter_store.release(lease)
         out: Dict[str, Any] = {
             "token_ids": result.token_ids,
             "num_prompt_tokens": result.num_prompt_tokens,
@@ -342,7 +371,8 @@ class _LLMReplica:
             ),
         }
 
-    def _parse_request(self, request: Dict[str, Any]) -> GenerationRequest:
+    def _parse_request(self, request: Dict[str, Any],
+                       lease=None) -> GenerationRequest:
         token_ids = request.get("token_ids")
         if token_ids is None:
             prompt = request.get("prompt")
@@ -362,14 +392,76 @@ class _LLMReplica:
                 request.get("temperature", self._config.temperature)
             ),
             eos_token_id=request.get("eos_token_id"),
+            adapter_id=lease.adapter_id if lease is not None else None,
+            adapter_slot=lease.slot if lease is not None else -1,
         )
+
+    # -- multi-tenant adapters -----------------------------------------------
+
+    def _requested_adapter_id(self, request: Dict[str, Any]) -> Optional[str]:
+        """The tenant identity of a request: an explicit ``adapter_id``
+        field wins, else the ``@serve.multiplexed`` model-id the router
+        stamped on this call (serve/replica.py binds it to the request
+        thread before user code runs)."""
+        aid = request.get("adapter_id")
+        if aid is None:
+            aid = serve.get_multiplexed_model_id() or None
+        return aid
+
+    def _resolve_adapter(self, request: Dict[str, Any]):
+        """Resolve adapter id -> slot lease BEFORE engine admission, on
+        the replica's request thread — a cold adapter's weight-plane pull
+        runs here, never under the engine lock, so in-flight decodes keep
+        stepping (the no-stall property). When every slot is pinned the
+        request backpressures like KV-pool exhaustion: BackPressureError
+        is retryable, routers send the request elsewhere."""
+        aid = self._requested_adapter_id(request)
+        if aid is None:
+            return None
+        if self._adapter_store is None:
+            raise ValueError(
+                f"request names adapter {aid!r} but the deployment has no "
+                "adapter plane; set LLMConfig(adapters=AdapterConfig(...))"
+            )
+        import time as _time
+
+        from ..exceptions import BackPressureError
+
+        deadline = (
+            _time.monotonic() + self._config.adapters.acquire_timeout_s
+        )
+        while True:
+            lease = self._adapter_store.acquire(aid)
+            if lease is not None:
+                return lease
+            if _time.monotonic() >= deadline:
+                raise BackPressureError(
+                    f"adapter store exhausted: all "
+                    f"{self._adapter_store.num_slots} slots pinned by "
+                    "in-flight requests"
+                )
+            _time.sleep(0.02)
+
+    def adapters_stats(self) -> Optional[Dict[str, Any]]:
+        """Replica-local adapter-plane stats (None without an adapter
+        store); routed through handle.options(method_name=...)."""
+        if self._adapter_store is None:
+            return None
+        return self._adapter_store.stats()
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         if request.get("stream"):
             # through a plain (non-stream) handle this collapses to the
             # buffered result; the HTTP/handle streaming path calls .stream
             return list(self.stream(request))[-1]
-        result = self._engine.generate([self._parse_request(request)])[0]
+        lease = self._resolve_adapter(request)
+        try:
+            result = self._engine.generate(
+                [self._parse_request(request, lease)]
+            )[0]
+        finally:
+            if self._adapter_store is not None:
+                self._adapter_store.release(lease)
         out: Dict[str, Any] = {
             "token_ids": result.token_ids,
             "num_prompt_tokens": result.num_prompt_tokens,
@@ -384,7 +476,15 @@ class _LLMReplica:
         serve — DeploymentResponseGenerator): yields one dict per generated
         token as it is sampled, then a final summary dict. Time-to-first-
         token is prefill latency instead of full-generation latency."""
-        gen_req = self._parse_request(request)
+        lease = self._resolve_adapter(request)
+        try:
+            yield from self._stream_leased(request, lease)
+        finally:
+            if self._adapter_store is not None:
+                self._adapter_store.release(lease)
+
+    def _stream_leased(self, request: Dict[str, Any], lease):
+        gen_req = self._parse_request(request, lease)
         index = 0
         all_ids: list = []
         prev_text = ""

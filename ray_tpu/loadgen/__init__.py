@@ -23,6 +23,7 @@ from .workload import (
     ZipfPrefixes,
     echo_trace,
     long_prefill_mix,
+    multi_tenant_mix,
     synthesize,
 )
 
@@ -42,5 +43,6 @@ __all__ = [
     "bundled_trace",
     "echo_trace",
     "long_prefill_mix",
+    "multi_tenant_mix",
     "synthesize",
 ]

@@ -82,6 +82,10 @@ class HandleTarget:
         h = self._handle
         if record.deadline_s is not None:
             h = h.options(timeout_s=record.deadline_s)
+        if record.adapter_id is not None:
+            # adapter-id affinity: same tenant -> same replica, so its
+            # slot stays leased-hot instead of cold-attaching everywhere
+            h = h.options(multiplexed_model_id=record.adapter_id)
         # one fresh trace per request (not the process root): the recorded
         # trace_id then names exactly this request's proxy->chip span tree
         ctx = (

@@ -32,15 +32,21 @@ class TraceRecord:
     token_ids: List[int] = field(default_factory=list)
     max_new_tokens: int = 16
     deadline_s: Optional[float] = None
+    adapter_id: Optional[str] = None
 
     def payload(self) -> Dict[str, Any]:
         """The request body shipped to the target. Carrying ``token_ids``
         means prefix-affinity handles (prefix_affinity_tokens > 0) and the
-        paged KV cache both see real shared prefixes."""
-        return {
+        paged KV cache both see real shared prefixes; ``adapter_id`` rides
+        along for multi-tenant LoRA traces so replicas resolve a slot
+        lease per request."""
+        body = {
             "token_ids": list(self.token_ids),
             "max_new_tokens": self.max_new_tokens,
         }
+        if self.adapter_id is not None:
+            body["adapter_id"] = self.adapter_id
+        return body
 
     def as_dict(self) -> dict:
         return asdict(self)
@@ -54,6 +60,7 @@ class TraceRecord:
             token_ids=list(d.get("token_ids", [])),
             max_new_tokens=int(d.get("max_new_tokens", 16)),
             deadline_s=d.get("deadline_s"),
+            adapter_id=d.get("adapter_id"),
         )
 
 
@@ -82,6 +89,7 @@ class Trace:
                     token_ids=list(r.token_ids),
                     max_new_tokens=r.max_new_tokens,
                     deadline_s=r.deadline_s,
+                    adapter_id=r.adapter_id,
                 )
                 for r in reqs
             ],

@@ -179,3 +179,71 @@ def long_prefill_mix(
         vocab_size=vocab_size,
     )
     return synthesize(arrivals, classes, prefixes, seed=seed)
+
+
+def multi_tenant_mix(
+    num_requests: int,
+    rps: float,
+    *,
+    num_adapters: int = 8,
+    adapter_alpha: float = 1.0,
+    base_weight: float = 0.1,
+    prompt_tokens: int = 32,
+    max_new_tokens: int = 16,
+    vocab_size: int = 32000,
+    seed: int = 0,
+) -> Trace:
+    """The multi-tenant LoRA workload: each arrival belongs to one of
+    ``num_adapters`` tenants, sampled Zipf(``adapter_alpha``) so a head
+    few adapters dominate (realistic multiplexing: hot tenants stay slot
+    resident, tail tenants cold-attach and get LRU-evicted). A
+    ``base_weight`` fraction of arrivals carry no adapter at all — the
+    slot −1 rows that share the mixed batch with tenant rows.
+
+    Each tenant gets its own request class name (``tenant_03``) so
+    ``LoadResult.summary()["classes"]`` reports per-tenant TTFT/latency
+    percentiles — the head tenant's p50 vs a tail tenant's p99 is the
+    cold-attach tax made visible. Replays route with adapter-id affinity
+    (see ``HandleTarget``), so tenants concentrate on replicas."""
+    if num_requests < 1 or rps <= 0:
+        raise ValueError("need num_requests >= 1 and rps > 0")
+    if num_adapters < 1:
+        raise ValueError("need num_adapters >= 1")
+    rng = random.Random(seed)
+    weights = [1.0 / (k + 1) ** float(adapter_alpha)
+               for k in range(num_adapters)]
+    total = sum(weights)
+    cdf: List[float] = []
+    acc = 0.0
+    for w in weights:
+        acc += w / total
+        cdf.append(acc)
+    cdf[-1] = 1.0
+
+    records: List[TraceRecord] = []
+    for i in range(int(num_requests)):
+        if base_weight > 0 and rng.random() < base_weight:
+            cls_name, adapter_id = "base", None
+        else:
+            rank = bisect.bisect_left(cdf, rng.random())
+            cls_name = f"tenant_{rank:02d}"
+            adapter_id = cls_name
+        records.append(TraceRecord(
+            t=round(i / float(rps), 4),
+            cls=cls_name,
+            prefix_id=0,
+            token_ids=[rng.randrange(vocab_size)
+                       for _ in range(prompt_tokens)],
+            max_new_tokens=max_new_tokens,
+            deadline_s=None,
+            adapter_id=adapter_id,
+        ))
+    return Trace(
+        meta={
+            "seed": seed,
+            "num_adapters": num_adapters,
+            "adapter_alpha": float(adapter_alpha),
+            "base_weight": float(base_weight),
+        },
+        requests=records,
+    )

@@ -1,0 +1,39 @@
+"""ray_tpu.lora — the multi-tenant adapter plane.
+
+Serving "millions of users" means many *tenants*, not one model: this
+package serves hundreds of per-tenant LoRA fine-tunes over ONE shared
+base-model replica fleet instead of a replica set per fine-tune (the
+Gemma-on-Cloud-TPU consolidation argument from PAPERS.md). Three pieces:
+
+- :class:`AdapterStore` — paged adapter *slots* in HBM mirroring the KV
+  block-pool design (kvcache/manager.py): a fixed-capacity stacked
+  ``(num_slots, ...)`` buffer per ``lora_a``/``lora_b`` target path,
+  refcount leases pinning in-use slots, LRU eviction of idle adapters,
+  and cold-miss refill from the weight plane (int8 chunks dequantize at
+  assembly straight into the slot).
+- batched-gather LoRA matmul — the decode/prefill programs take a
+  per-request ``adapter_slot`` index vector and compute
+  ``x @ gather(A, slot) @ gather(B, slot)`` (slot -1 = zero-adapter base
+  path), so ONE jitted step serves a mixed-adapter batch: no per-tenant
+  re-jit, no swap_params (models/llama.py LoRADense + llm/engine.py).
+- :func:`publish_adapter` — adapters ride the weight plane under
+  ``<prefix>/<adapter_id>`` names; they are tiny, and the int8 chunk
+  codec makes publishing a new tenant's adapter near-free.
+
+Serving wires this up through ``LLMConfig(adapters=AdapterConfig(...))``;
+see docs/ARCHITECTURE.md §21.
+"""
+
+from .store import (
+    AdapterLease,
+    AdapterStore,
+    adapter_target_paths,
+    publish_adapter,
+)
+
+__all__ = [
+    "AdapterLease",
+    "AdapterStore",
+    "adapter_target_paths",
+    "publish_adapter",
+]

@@ -108,7 +108,15 @@ class LoRADense(nn.Module):
 
     The base kernel is annotated like a normal weight; A/B carry the
     ``lora_rank`` logical axis (replicated by default rules). Training
-    freezes the base via an optimizer mask (train/lora.py)."""
+    freezes the base via an optimizer mask (train/lora.py).
+
+    Multi-tenant serving path: ``adapter`` is a stacked slot bank
+    ``{"lora_a": (num_slots, in_dim, r), "lora_b": (num_slots, r, out)}``
+    (ray_tpu.lora.AdapterStore; lora_b pre-scaled by alpha/r at attach)
+    and ``adapter_slots`` a per-row ``(batch,)`` int32 index vector —
+    the delta is the batched gather ``x @ A[slot] @ B[slot]``, with slot
+    -1 masked to zero (the base-only path), so ONE program serves a
+    mixed-adapter batch."""
 
     features: int
     logical_axes: Tuple[str, ...]
@@ -118,32 +126,43 @@ class LoRADense(nn.Module):
     dtype: Any
 
     @nn.compact
-    def __call__(self, x):
-        base = _dense(
+    def __call__(self, x, adapter=None, adapter_slots=None):
+        y = _dense(
             self.features, self.logical_axes, "base", self.param_dtype, self.dtype
         )(x)
-        if self.rank <= 0:
-            return base
-        in_dim = x.shape[-1]
-        a = self.param(
-            "lora_a",
-            nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), (self.logical_axes[0], "lora_rank")
-            ),
-            (in_dim, self.rank),
-            self.param_dtype,
-        )
-        b = self.param(
-            "lora_b",
-            nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), ("lora_rank", self.logical_axes[-1])
-            ),
-            (self.rank, self.features),
-            self.param_dtype,
-        )
-        scale = self.alpha / self.rank
-        delta = (x @ a.astype(x.dtype)) @ b.astype(x.dtype) * scale
-        return base + delta
+        if self.rank > 0:
+            in_dim = x.shape[-1]
+            a = self.param(
+                "lora_a",
+                nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), (self.logical_axes[0], "lora_rank")
+                ),
+                (in_dim, self.rank),
+                self.param_dtype,
+            )
+            b = self.param(
+                "lora_b",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("lora_rank", self.logical_axes[-1])
+                ),
+                (self.rank, self.features),
+                self.param_dtype,
+            )
+            scale = self.alpha / self.rank
+            y = y + (x @ a.astype(x.dtype)) @ b.astype(x.dtype) * scale
+        if adapter is not None and adapter_slots is not None:
+            bank_a = adapter["lora_a"]
+            bank_b = adapter["lora_b"]
+            # clamp the gather index so slot -1 reads row 0 safely, then
+            # mask its contribution to exactly zero
+            idx = jnp.clip(adapter_slots, 0, bank_a.shape[0] - 1)
+            ag = jnp.take(bank_a, idx, axis=0).astype(x.dtype)  # (b, in, r)
+            bg = jnp.take(bank_b, idx, axis=0).astype(x.dtype)  # (b, r, out)
+            delta = jnp.einsum("bsi,bir->bsr", x, ag)
+            delta = jnp.einsum("bsr,bro->bso", delta, bg)
+            live = (adapter_slots >= 0).astype(x.dtype)[:, None, None]
+            y = y + delta * live
+        return y
 
 
 class Attention(nn.Module):
@@ -152,10 +171,11 @@ class Attention(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, cos, sin):
+    def __call__(self, x, cos, sin, adapters=None, adapter_slots=None):
         cfg = self.config
         b, s, _ = x.shape
         h, hk, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        adapters = adapters or {}
 
         def proj(n_out, name):
             return LoRADense(
@@ -168,9 +188,12 @@ class Attention(nn.Module):
                 name=name,
             )
 
-        q = proj(h * d, "wq")(x).reshape(b, s, h, d).transpose(0, 2, 1, 3)
-        k = proj(hk * d, "wk")(x).reshape(b, s, hk, d).transpose(0, 2, 1, 3)
-        v = proj(hk * d, "wv")(x).reshape(b, s, hk, d).transpose(0, 2, 1, 3)
+        def run(mod, name):
+            return mod(x, adapters.get(name), adapter_slots)
+
+        q = run(proj(h * d, "wq"), "wq").reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        k = run(proj(hk * d, "wk"), "wk").reshape(b, s, hk, d).transpose(0, 2, 1, 3)
+        v = run(proj(hk * d, "wv"), "wv").reshape(b, s, hk, d).transpose(0, 2, 1, 3)
 
         if self.decode:
             # KV-cache incremental path (serving; reference role: vLLM's
@@ -249,7 +272,7 @@ class Attention(nn.Module):
             param_dtype=cfg.param_dtype,
             dtype=cfg.dtype,
             name="wo",
-        )(out)
+        )(out, adapters.get("wo"), adapter_slots)
 
 
 class MLP(nn.Module):
@@ -276,7 +299,7 @@ class Block(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, cos, sin):
+    def __call__(self, x, cos, sin, adapters=None, adapter_slots=None):
         cfg = self.config
         attn_norm_w = self.param(
             "attn_norm",
@@ -285,7 +308,8 @@ class Block(nn.Module):
             cfg.param_dtype,
         )
         h = x + Attention(cfg, self.mesh, self.decode, name="attn")(
-            rmsnorm(x, attn_norm_w.astype(x.dtype), cfg.norm_eps), cos, sin
+            rmsnorm(x, attn_norm_w.astype(x.dtype), cfg.norm_eps), cos, sin,
+            (adapters or {}).get("attn"), adapter_slots,
         )
         mlp_norm_w = self.param(
             "mlp_norm",
@@ -318,7 +342,10 @@ class Llama(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens):  # (batch, seq) int32
+    def __call__(self, tokens, adapters=None, adapter_slots=None):
+        # tokens: (batch, seq) int32; adapters: nested AdapterStore bank
+        # {"layer_i": {"attn": {"wq": {"lora_a": ..., "lora_b": ...}, ...}}};
+        # adapter_slots: (batch,) int32 per-row slot index, -1 = base-only
         cfg = self.config
         embed = self.param(
             "embed",
@@ -358,7 +385,8 @@ class Llama(nn.Module):
                 )
             for i in range(cfg.n_layers):
                 x = block(cfg, self.mesh, self.decode, name=f"layer_{i}")(
-                    x, cos, sin
+                    x, cos, sin,
+                    (adapters or {}).get(f"layer_{i}"), adapter_slots,
                 )
         final_norm_w = self.param(
             "final_norm",

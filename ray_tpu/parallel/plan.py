@@ -53,11 +53,19 @@ from .mesh import make_mesh
 DEFAULT_LLM_RULES: List[Tuple[str, P]] = [
     (r"attn/(wq|wk|wv)/base/kernel$", P(None, "tp")),
     (r"attn/wo/base/kernel$", P("tp", None)),
+    # LoRA adapter factors follow their base kernel: where the base shards
+    # its output axis (wq/wk/wv), lora_b (rank, out) shards out and lora_a
+    # replicates; where the base shards its input axis (wo), lora_a
+    # (in, rank) shards in and lora_b replicates. Rank never shards.
+    (r"attn/(wq|wk|wv)/lora_a$", P()),
+    (r"attn/(wq|wk|wv)/lora_b$", P(None, "tp")),
+    (r"attn/wo/lora_a$", P("tp", None)),
+    (r"attn/wo/lora_b$", P()),
     (r"mlp/(w_gate|w_up)/kernel$", P(None, "tp")),
     (r"mlp/w_down/kernel$", P("tp", None)),
     (r"(^|/)embed$", P("tp", None)),
     (r"(^|/)lm_head$", P(None, "tp")),
-    (r".*", P()),  # norms, LoRA adapters, router weights, scalars
+    (r".*", P()),  # norms, router weights, scalars
 ]
 
 # decode-cache / block-pool KV layout: heads at axis 1 in both
@@ -207,6 +215,18 @@ class PartitionPlan:
         sharded layout — each device materializes only its shard."""
         return jax.tree.map(
             jax.device_put, params, self.param_shardings(params)
+        )
+
+    def lora_bank_shardings(self, bank: Any) -> Any:
+        """Shardings for an AdapterStore slot bank: each ``lora_a``/
+        ``lora_b`` leaf is the per-adapter matrix with a leading
+        ``num_slots`` axis prepended, so match the 2-D rule table against
+        the tree paths and prepend a replicated slot axis to each spec."""
+        specs = match_partition_rules(self.rules, bank)
+        return jax.tree.map(
+            lambda spec: NamedSharding(self.mesh, P(None, *spec)),
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
         )
 
     def kv_sharding(self) -> NamedSharding:

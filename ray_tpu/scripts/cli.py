@@ -249,6 +249,20 @@ def cmd_kvtier(args):
     return 0
 
 
+def cmd_adapters(args):
+    """`ray_tpu adapters`: the multi-tenant LoRA adapter plane — lease
+    hit rate vs cold attaches (is max_live sized right?), LRU evictions
+    (thrash indicator), live slots, and cold-attach latency percentiles
+    (the TTFT tax of a tenant's first request on a replica)."""
+    _connected(args)
+    from ..util import state
+
+    print(json.dumps(
+        state.metrics_summary()["adapters"], indent=2, default=str
+    ))
+    return 0
+
+
 def cmd_autoscale(args):
     """`ray_tpu autoscale`: the SLO autoscaler's decision record.
 
@@ -790,6 +804,13 @@ def main(argv=None):
     )
     p.add_argument("--address", required=True, help="head host:port")
     p.set_defaults(fn=cmd_kvtier)
+
+    p = sub.add_parser(
+        "adapters",
+        help="LoRA adapter-plane stats (hit rate, cold attaches, evictions)",
+    )
+    p.add_argument("--address", required=True, help="head host:port")
+    p.set_defaults(fn=cmd_adapters)
 
     p = sub.add_parser(
         "autoscale",

@@ -643,7 +643,17 @@ class DeploymentHandle:
             router_cfg.get("prefix_affinity_tokens", 0) or 0
         )
         affinity = None
-        if tokens > 0:
+        if self._multiplexed_model_id:
+            # adapter-id affinity WINS over prefix affinity: a multiplexed
+            # deployment (multi-tenant LoRA serving) keeps each tenant hot
+            # on few replicas — the adapter stays resident in their slot
+            # banks and that tenant's prefixes concentrate in their radix,
+            # so both the adapter hit rate AND the prefix hit rate ride
+            # the same rendezvous bias
+            affinity = zlib.crc32(
+                ("adapter:" + self._multiplexed_model_id).encode()
+            )
+        elif tokens > 0:
             affinity = _prefix_affinity_key(args, kwargs, tokens)
         timeout_s = self._timeout_s
         if timeout_s is None:

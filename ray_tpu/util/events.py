@@ -87,6 +87,8 @@ PROXY_STOP = EventName("proxy_stop")
 PROXY_DRAIN = EventName("proxy_drain")
 KV_SHIPPED = EventName("kv_shipped")
 KVTIER_EVICT = EventName("kvtier_evict")
+ADAPTER_COLD_ATTACH = EventName("adapter_cold_attach")
+ADAPTER_EVICT = EventName("adapter_evict")
 STRAGGLER_DETECTED = EventName("straggler_detected")
 STRAGGLER_RESOLVED = EventName("straggler_resolved")
 ALERT_FIRING = EventName("alert_firing")

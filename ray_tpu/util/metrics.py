@@ -1907,6 +1907,134 @@ def llm_summary(payloads: List[dict]) -> Dict[str, object]:
 
 
 # ---------------------------------------------------------------------------
+# Adapter plane (ray_tpu.lora): per-replica AdapterStore hit/cold-attach/
+# evict counters, a live-slots gauge, and the cold-attach latency histogram
+# — the number that tells an operator whether max_live is sized right
+# (thrashing shows up as evictions + cold-attach p99, a healthy fleet shows
+# hits). Stores record through lora/store.py's lazy hooks; adapter_summary()
+# is the one rollup shared by state.metrics_summary()["adapters"], the
+# `ray_tpu adapters` CLI, and the dashboard's /api/serve.
+# ---------------------------------------------------------------------------
+
+_ADAPTER_ATTACH_BOUNDARIES_S = [
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10,
+]
+
+_adapter_metrics: Optional[dict] = None
+_adapter_init_lock = threading.Lock()
+
+
+def _ensure_adapter_metrics() -> dict:
+    global _adapter_metrics
+    if _adapter_metrics is None:
+        with _adapter_init_lock:
+            if _adapter_metrics is None:
+                _adapter_metrics = {
+                    "hits": Counter(
+                        "adapter_hit_total",
+                        "Adapter lease acquisitions served by a resident "
+                        "slot (no weight-plane pull)",
+                        tag_keys=("mesh",),
+                    ),
+                    "cold": Counter(
+                        "adapter_cold_attach_total",
+                        "Adapter lease acquisitions that pulled and wrote "
+                        "the adapter into a slot",
+                        tag_keys=("mesh",),
+                    ),
+                    "evict": Counter(
+                        "adapter_evict_total",
+                        "Idle adapters evicted from their slot (LRU) to "
+                        "make room for a cold attach",
+                        tag_keys=("mesh",),
+                    ),
+                    "live": Gauge(
+                        "adapter_slots_live",
+                        "Adapters currently resident in this process's "
+                        "slot banks (pinned + idle)",
+                        tag_keys=("mesh",),
+                    ),
+                    "attach": Histogram(
+                        "adapter_cold_attach_seconds",
+                        "Cold-attach latency: source fetch + normalize + "
+                        "slot write, the TTFT tax of an adapter's first "
+                        "request on a replica",
+                        boundaries=_ADAPTER_ATTACH_BOUNDARIES_S,
+                        tag_keys=("mesh",),
+                    ),
+                }
+    return _adapter_metrics
+
+
+def record_adapter_hit(mesh: str = "tp=1"):
+    _ensure_adapter_metrics()["hits"].inc(1.0, {"mesh": mesh})
+
+
+def record_adapter_cold_attach(seconds: float, mesh: str = "tp=1"):
+    m = _ensure_adapter_metrics()
+    m["cold"].inc(1.0, {"mesh": mesh})
+    m["attach"].observe(seconds, {"mesh": mesh})
+
+
+def record_adapter_evict(mesh: str = "tp=1"):
+    _ensure_adapter_metrics()["evict"].inc(1.0, {"mesh": mesh})
+
+
+def set_adapter_slots_live(n: int, mesh: str = "tp=1"):
+    _ensure_adapter_metrics()["live"].set(float(n), {"mesh": mesh})
+
+
+def adapter_counters() -> Dict[str, float]:
+    """Process-local readback (tests + bench; no cluster needed)."""
+    m = _ensure_adapter_metrics()
+
+    def _total(metric) -> float:
+        with metric._lock:
+            return float(sum(metric._values.values()))
+
+    return {
+        "adapter_hits": _total(m["hits"]),
+        "adapter_cold_attaches": _total(m["cold"]),
+        "adapter_evictions": _total(m["evict"]),
+    }
+
+
+def adapter_summary(payloads: List[dict]) -> Dict[str, object]:
+    """Cluster rollup: hit rate + cold-attach latency percentiles (ms)."""
+    out: Dict[str, object] = {
+        "hits": 0.0,
+        "cold_attaches": 0.0,
+        "evictions": 0.0,
+        "slots_live": 0.0,
+        "hit_rate": None,
+        "cold_attach_ms": None,
+    }
+    simple = {
+        "adapter_hit_total": "hits",
+        "adapter_cold_attach_total": "cold_attaches",
+        "adapter_evict_total": "evictions",
+        "adapter_slots_live": "slots_live",
+    }
+    for payload in payloads:
+        for snap in payload.get("metrics", []):
+            name = snap.get("name")
+            if name in simple:
+                out[simple[name]] += float(sum(snap["values"].values()))
+    acquired = out["hits"] + out["cold_attaches"]
+    if acquired:
+        out["hit_rate"] = out["hits"] / acquired
+    m = merged_histogram(payloads, "adapter_cold_attach_seconds")
+    if m and m["count"]:
+        out["cold_attach_ms"] = {
+            "count": m["count"],
+            "mean": m["sum"] / m["count"] * 1000.0,
+            "p50": _scaled_quantile(m, 0.50, 1000.0),
+            "p99": _scaled_quantile(m, 0.99, 1000.0),
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Ingress plane: per-proxy request counters / inflight gauge / end-to-end
 # proxy latency, tagged proxy_id so the multi-proxy data plane shows per-
 # listener load spread. The proxies record through pre-bound handles

@@ -121,6 +121,7 @@ def metrics_summary() -> Dict[str, Any]:
     import json as _json
 
     from .metrics import (
+        adapter_summary,
         autoscale_summary,
         device_rows,
         fetch_metric_payloads,
@@ -202,6 +203,7 @@ def metrics_summary() -> Dict[str, Any]:
         "serve_ft": serve_ft_summary(payloads),
         "serve_latency": serve_latency_summary(payloads),
         "llm": llm_summary(payloads),
+        "adapters": adapter_summary(payloads),
         "autoscale": autoscale_summary(payloads),
         "partition": partition_summary(payloads),
         "ingress": ingress_summary(payloads),

@@ -479,13 +479,59 @@ def test_rt011_transfer_layer_and_other_planes_exempt(tmp_path):
     assert result.findings == []
 
 
+# ---------------------------------------------------------------- RT013
+
+
+def test_rt013_flags_bank_mutation_outside_store(tmp_path):
+    result = _run(tmp_path, {
+        "llm/engine.py": """
+            def attach(self, store, tree, slot):
+                store._bank = rebuild(store._bank, tree, slot)
+        """,
+        "serve/replica.py": """
+            def hot_swap(self, store, tree, slot):
+                store._write_slot(store._bank, tree, slot)
+        """,
+        "kvcache/manager.py": """
+            def steal(self, pool):
+                self._adapter_bank = pool
+        """,
+    }, rules=["RT013"])
+    assert _rules(result) == ["RT013"] * 3  # 2 bank assigns + 1 raw call
+    msgs = " ".join(f.message for f in result.findings)
+    assert "AdapterStore" in msgs
+
+
+def test_rt013_store_itself_and_other_planes_exempt(tmp_path):
+    result = _run(tmp_path, {
+        # the chokepoint itself: outside the patrolled paths
+        "lora/store.py": """
+            def acquire(self, adapter_id):
+                self._bank = self._write_slot(self._bank, tree, slot)
+        """,
+        # leasing through the store API in serving paths is fine
+        "llm/serving.py": """
+            def resolve(self, store, adapter_id):
+                lease = store.acquire(adapter_id)
+                return lease
+        """,
+        # unrelated trains-plane code with its own _bank attr name is
+        # out of scope by path
+        "train/optim.py": """
+            def init(self):
+                self._bank = {}
+        """,
+    }, rules=["RT013"])
+    assert result.findings == []
+
+
 # ------------------------------------------------------------- framework
 
 
-def test_catalog_has_all_twelve_rules():
+def test_catalog_has_all_thirteen_rules():
     assert sorted(checker_catalog()) == [
         "RT001", "RT002", "RT003", "RT004", "RT005", "RT006", "RT007",
-        "RT008", "RT009", "RT010", "RT011", "RT012",
+        "RT008", "RT009", "RT010", "RT011", "RT012", "RT013",
     ]
 
 

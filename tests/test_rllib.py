@@ -100,6 +100,7 @@ def test_ppo_cartpole_improves(cluster):
     assert best > 60, best
 
 
+@pytest.mark.slow  # 8s: checkpoint roundtrip stays tier-1 via test_dqn_checkpoint_roundtrip
 def test_ppo_checkpoint_roundtrip(cluster, tmp_path):
     from ray_tpu import rllib
 

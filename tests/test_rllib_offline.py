@@ -105,6 +105,7 @@ def test_iql_discrete(cluster):
     assert algo.compute_single_action(np.array([-1.5, 0, 0, 0], np.float32)) == 0
 
 
+@pytest.mark.slow  # 9s: IQL stays tier-1 via test_iql_discrete
 def test_iql_continuous(cluster, tmp_path):
     """Pendulum-shaped continuous control: expert action = -obs[0] (clipped);
     IQL's AWR extraction should recover its sign."""

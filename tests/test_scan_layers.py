@@ -12,6 +12,7 @@ def _cfg(**kw):
     return LlamaConfig.tiny(lora_rank=4, **kw)
 
 
+@pytest.mark.slow  # 7s: scan-vs-loop agreement stays tier-1 via test_scan_and_loop_agree_with_same_params
 def test_scan_layers_params_stacked_and_loss_runs():
     from ray_tpu.models.llama import init_params, next_token_loss
     from ray_tpu.parallel.sharding import unbox_params

@@ -312,6 +312,7 @@ def test_bohb_searcher_models_largest_qualified_budget():
     assert sum(abs(x - 2.0) < 4.0 for x in xs) >= 6, xs
 
 
+@pytest.mark.slow  # 6s: BOHB stays tier-1 via test_bohb_searcher_models_largest_qualified_budget
 def test_bohb_with_hyperband_tuner(cluster):
     def objective(config):
         for i in range(6):

@@ -59,6 +59,7 @@ def test_remat_matches_no_remat():
     np.testing.assert_allclose(outs[0], outs[1], atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # 9s: remat parity stays tier-1 via test_remat_matches_no_remat
 def test_remat_with_dropout_trains():
     """remat + dropout: deterministic must be static under nn.remat."""
     cfg = ViTConfig.tiny(remat=True, dropout=0.1)
